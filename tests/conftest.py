@@ -1,0 +1,48 @@
+"""Test harness: an 8-device virtual CPU mesh in one process.
+
+SURVEY.md §4: the reference had zero automated tests (the demos were the
+tests).  JAX lets us do better — ``--xla_force_host_platform_device_count=8``
+simulates an 8-device mesh in-process, so DP/model-split/trainer semantics,
+sampler sharding, seeding, and checkpointing are ordinary pytest units.
+Env vars must be set before jax initializes its backends, hence here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Some environments register an accelerator plugin at interpreter start and
+# force jax_platforms via jax.config; re-force CPU so tests always run on the
+# 8-device virtual host mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def dp_mesh():
+    from tpudist.runtime.mesh import data_parallel_mesh
+
+    return data_parallel_mesh()
+
+
+@pytest.fixture()
+def dm_mesh():
+    from tpudist.runtime.mesh import data_model_mesh
+
+    return data_model_mesh(model_size=2)

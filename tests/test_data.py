@@ -1,0 +1,92 @@
+"""DistributedSampler-semantics tests (SURVEY.md §7 hard part (a))."""
+
+import numpy as np
+import pytest
+
+from tpudist.data.sharding import ShardPlan, epoch_indices
+from tpudist.data.toy import make_toy_data
+from tpudist.data.loader import ShardedLoader
+
+
+def gather_all(plan_for):
+    """Union of all shards' indices for one epoch."""
+    plans = [plan_for(r) for r in range(plan_for(0).num_shards)]
+    return np.concatenate([epoch_indices(p, epoch=0) for p in plans])
+
+
+def test_toy_data_shape_and_determinism():
+    d1 = make_toy_data(seed=7)
+    d2 = make_toy_data(seed=7)
+    assert d1.x.shape == (512, 2) and d1.y.shape == (512, 1)
+    np.testing.assert_array_equal(d1.x, d2.x)
+    np.testing.assert_array_equal(d1.y, d2.y)
+    # x is a scalar duplicated to 2 dims (toy_model_and_data.py:29)
+    np.testing.assert_array_equal(d1.x[:, 0], d1.x[:, 1])
+    # y ≈ x² + 0.5ε — check correlation, not exact values
+    resid = d1.y[:, 0] - d1.x[:, 0] ** 2
+    assert abs(resid.mean()) < 0.1 and 0.3 < resid.std() < 0.7
+
+
+def test_shards_partition_dataset():
+    def plan_for(r):
+        return ShardPlan(num_samples=512, num_shards=8, shard_id=r, seed=0)
+
+    all_idx = gather_all(plan_for)
+    assert len(all_idx) == 512
+    assert set(all_idx.tolist()) == set(range(512))
+
+
+def test_wraparound_padding_equalizes():
+    # 10 samples over 4 shards → ceil(10/4)=3 each, 2 duplicated (wrap-around)
+    plans = [ShardPlan(num_samples=10, num_shards=4, shard_id=r) for r in range(4)]
+    sizes = [len(epoch_indices(p, 0)) for p in plans]
+    assert sizes == [3, 3, 3, 3]
+    union = np.concatenate([epoch_indices(p, 0) for p in plans])
+    assert set(union.tolist()) == set(range(10))
+
+
+def test_set_epoch_reshuffles_deterministically():
+    p = ShardPlan(num_samples=512, num_shards=2, shard_id=0, seed=5)
+    e0a, e0b = epoch_indices(p, 0), epoch_indices(p, 0)
+    e1 = epoch_indices(p, 1)
+    np.testing.assert_array_equal(e0a, e0b)
+    assert not np.array_equal(e0a, e1)
+
+
+def test_no_shuffle_is_identity_order():
+    p = ShardPlan(num_samples=8, num_shards=2, shard_id=1, shuffle=False)
+    np.testing.assert_array_equal(epoch_indices(p, 0), [1, 3, 5, 7])
+
+
+def test_standard_mode_full_dataset():
+    # demo.py:149-154 — every rank sees the whole dataset
+    p = ShardPlan(num_samples=512, num_shards=8, shard_id=3, mode="standard")
+    assert len(epoch_indices(p, 0)) == 512
+
+
+def test_loader_batches():
+    data = make_toy_data(seed=0)
+    plan = ShardPlan(num_samples=512, num_shards=4, shard_id=0, seed=0)
+    loader = ShardedLoader(data, batch_size=32, plan=plan)
+    batches = list(loader)
+    assert len(loader) == len(batches) == 4  # 128 local samples / 32
+    for x, y in batches:
+        assert x.shape == (32, 2) and y.shape == (32, 1)
+
+
+def test_loader_epoch_determinism_across_shards():
+    """Two shards' epoch-2 permutations come from the same global order."""
+    data = make_toy_data(seed=0)
+    idx = {}
+    for r in range(2):
+        plan = ShardPlan(num_samples=512, num_shards=2, shard_id=r, seed=9)
+        idx[r] = epoch_indices(plan, epoch=2)
+    assert set(idx[0]).isdisjoint(set(idx[1]))
+    assert len(idx[0]) + len(idx[1]) == 512
+
+
+def test_invalid_plan():
+    with pytest.raises(ValueError):
+        ShardPlan(num_samples=4, num_shards=2, shard_id=2)
+    with pytest.raises(ValueError):
+        ShardPlan(num_samples=4, num_shards=2, shard_id=0, mode="bogus")

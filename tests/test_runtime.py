@@ -1,0 +1,128 @@
+"""Bootstrap contract tests — the rank-derivation matrix of SURVEY.md §3.1-3.3."""
+
+import pytest
+
+from tpudist.runtime.bootstrap import (
+    BootstrapError,
+    ProcessContext,
+    find_free_port,
+    resolve_process_context,
+)
+from tpudist.runtime.mesh import MeshConfig, data_model_mesh, data_parallel_mesh, make_mesh
+from tpudist.runtime.seeding import per_process_seed
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    for var in (
+        "TPUDIST_NUM_PROCESSES", "TPUDIST_PROCESS_ID", "TPUDIST_COORDINATOR",
+        "RANK", "WORLD_SIZE", "LOCAL_RANK", "LOCAL_WORLD_SIZE",
+        "MASTER_ADDR", "MASTER_PORT", "SLURM_PROCID", "SLURM_LOCALID",
+        "SLURM_NTASKS", "NODE_RANK", "TASKS_PER_NODE",
+        "OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+def test_single_process_default():
+    ctx = resolve_process_context()
+    assert ctx.launch_source == "single"
+    assert ctx.num_processes == 1 and ctx.process_id == 0
+    assert not ctx.is_distributed
+
+
+def test_torchrun_contract(monkeypatch):
+    monkeypatch.setenv("RANK", "3")
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    monkeypatch.setenv("LOCAL_RANK", "3")
+    monkeypatch.setenv("LOCAL_WORLD_SIZE", "4")
+    monkeypatch.setenv("MASTER_ADDR", "node0")
+    monkeypatch.setenv("MASTER_PORT", "2345")
+    ctx = resolve_process_context()
+    assert ctx.launch_source == "torchrun"
+    assert ctx.process_id == 3 and ctx.num_processes == 8
+    assert ctx.coordinator_address == "node0:2345"
+    assert ctx.local_rank == 3 and ctx.local_world_size == 4
+
+
+def test_slurm_procid_contract(monkeypatch):
+    # demo.py:41 — global rank from SLURM_PROCID
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    monkeypatch.setenv("SLURM_PROCID", "2")
+    monkeypatch.setenv("SLURM_LOCALID", "0")
+    monkeypatch.setenv("TASKS_PER_NODE", "2")
+    monkeypatch.setenv("MASTER_ADDR", "head")
+    ctx = resolve_process_context()
+    assert ctx.launch_source == "slurm"
+    assert ctx.process_id == 2 and ctx.num_processes == 4
+    assert ctx.coordinator_address == "head:2345"  # default port parity
+
+
+def test_slurm_node_rank_contract(monkeypatch):
+    # demo.py:38-39 — global = NODE_RANK * TASKS_PER_NODE + SLURM_LOCALID
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    monkeypatch.setenv("SLURM_PROCID", "0")  # deliberately wrong; must be ignored
+    monkeypatch.setenv("SLURM_LOCALID", "1")
+    monkeypatch.setenv("TASKS_PER_NODE", "2")
+    monkeypatch.setenv("NODE_RANK", "1")
+    monkeypatch.setenv("MASTER_ADDR", "head")
+    monkeypatch.setenv("MASTER_PORT", "9999")
+    ctx = resolve_process_context(use_node_rank=True)
+    assert ctx.process_id == 3
+    assert ctx.coordinator_address == "head:9999"
+
+
+def test_mpi_contract_requires_coordinator(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    with pytest.raises(BootstrapError):
+        resolve_process_context()
+    monkeypatch.setenv("MASTER_ADDR", "head")
+    ctx = resolve_process_context()
+    assert ctx.launch_source == "mpi" and ctx.process_id == 1
+
+
+def test_tpudist_contract_wins_over_torchrun(monkeypatch):
+    monkeypatch.setenv("TPUDIST_NUM_PROCESSES", "2")
+    monkeypatch.setenv("TPUDIST_PROCESS_ID", "1")
+    monkeypatch.setenv("TPUDIST_COORDINATOR", "c:1234")
+    monkeypatch.setenv("RANK", "0")
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    ctx = resolve_process_context()
+    assert ctx.launch_source == "tpudist"
+    assert ctx.process_id == 1 and ctx.num_processes == 2
+
+
+def test_missing_env_fails_fast(monkeypatch):
+    # fail-fast guard parity (demo.py:31-33,47-48)
+    monkeypatch.setenv("RANK", "0")
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    with pytest.raises(BootstrapError):
+        resolve_process_context()
+
+
+def test_find_free_port():
+    p = find_free_port()
+    assert 0 < p < 65536
+
+
+def test_per_process_seed():
+    assert per_process_seed(100, process_id=3) == 103
+    assert per_process_seed(None, process_id=0) >= 0
+
+
+def test_mesh_shapes(devices):
+    m = data_parallel_mesh()
+    assert m.axis_names == ("data",) and m.devices.shape == (8,)
+    m2 = data_model_mesh(model_size=2)
+    assert m2.axis_names == ("data", "model") and m2.devices.shape == (4, 2)
+    m4 = make_mesh(MeshConfig(data=-1, stage=2, seq=2, model=1))
+    assert m4.devices.shape == (2, 2, 2, 1)
+
+
+def test_mesh_config_validation():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, stage=1, seq=1, model=1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, stage=-1).resolve(8)

@@ -1,0 +1,26 @@
+"""tpudist — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities demonstrated by
+``ammunk/distributed-training-pytorch`` (see SURVEY.md):
+
+- ``tpudist.runtime``   — process bootstrap / rank contract / device mesh
+  (replaces torch.distributed.init_process_group + the torchrun/srun/MPI
+  env contracts of reference ``demo.py:19-73``).
+- ``tpudist.comm``      — dual-fabric collectives: in-step device (ICI)
+  gradient reduction + off-step host (DCN) metric reduction (replaces the
+  NCCL default group + the Gloo logging group of ``demo.py:84,114-121``).
+- ``tpudist.data``      — deterministic sharded data loading
+  (DistributedSampler/set_epoch semantics of ``demo.py:139-154``).
+- ``tpudist.models``    — Flax model zoo: the toy MLP (parity with
+  ``toy_model_and_data.py``), the two-stage split model, and a flagship
+  transformer exercising dp/tp/pp/sp/ep.
+- ``tpudist.parallel``  — parallelism building blocks (DP, tensor,
+  pipeline, ring-attention sequence parallel, MoE expert parallel).
+- ``tpudist.train``     — jitted train steps and the training loop.
+- ``tpudist.trainer``   — a Lightning-equivalent high-level Trainer facade
+  (parity with ``demo_pytorch_lightning.py``).
+- ``tpudist.ops``       — Pallas TPU kernels for hot ops.
+- ``tpudist.utils``     — metrics/W&B-compatible logging, profiling, misc.
+"""
+
+from tpudist.version import __version__  # noqa: F401

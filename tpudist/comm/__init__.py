@@ -1,0 +1,9 @@
+from tpudist.comm.collectives import (  # noqa: F401
+    psum_tree,
+    pmean_tree,
+    cross_process_mean_scalar,
+    batch_weighted_loss_mean,
+    host_allreduce_sum,
+    barrier,
+    MetricBackend,
+)

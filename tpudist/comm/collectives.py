@@ -1,0 +1,109 @@
+"""Dual-fabric collectives.
+
+The reference runs two fabrics in one job (SURVEY.md §5.8): the fast device
+backend (NCCL) for gradient all-reduce inside DDP, plus a second explicit
+**Gloo** CPU group used only to all-reduce scalar losses for logging
+(``demo.py:84,114-121``).  The TPU-native split is:
+
+- **ICI fabric** — XLA collectives inside the compiled step.  Gradient
+  reduction needs no explicit call at all in the pjit formulation (sharded
+  batch + replicated params ⇒ XLA inserts the ``psum``); the explicit
+  ``psum_tree``/``pmean_tree`` helpers exist for the ``shard_map`` formulation
+  and for tests.
+- **Host fabric (DCN)** — coordination-service-backed host transfers
+  (``multihost_utils``) for scalar metric reduction *off* the compiled path,
+  preserving the reference's "log the global batch-weighted mean, not the
+  per-rank loss" semantics (``demo.py:113-121``) without ever stalling the
+  device step.
+
+The reference's ``--backend {nccl,mpi,gloo}`` flag survives as
+:class:`MetricBackend` ``{ici, host}`` selecting where metric reductions run.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MetricBackend(str, enum.Enum):
+    ICI = "ici"    # reduce on-device inside the compiled step (NCCL analog)
+    HOST = "host"  # reduce host-side over DCN (Gloo analog)
+
+
+def psum_tree(tree: Any, axis_name: str) -> Any:
+    """``lax.psum`` over every leaf — gradient all-reduce for the shard_map
+    formulation of DP (DDP's bucketed all-reduce, ``demo.py:70-72``, collapses
+    to this single fused collective)."""
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def pmean_tree(tree: Any, axis_name: str) -> Any:
+    """``lax.pmean`` over every leaf — DDP averages, so this is the drop-in."""
+    return jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def host_allreduce_sum(x: Any) -> Any:
+    """Sum pytree leaves across *processes* on the host (Gloo-group analog).
+
+    Uses ``multihost_utils.process_allgather`` (DCN / coordination service)
+    when the job is multi-process; identity in a single process.
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, x)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(x)  # leading axis = process
+    return jax.tree.map(lambda g: np.sum(np.asarray(g), axis=0), gathered)
+
+
+def cross_process_mean_scalar(value, weight: float) -> float:
+    """Weighted mean of a scalar across processes: Σ(value·weight)/Σ(weight)."""
+    num, den = host_allreduce_sum((np.float64(value) * weight, np.float64(weight)))
+    return float(num / den)
+
+
+def batch_weighted_loss_mean(
+    losses: Mapping[str, Any],
+    batch_size: int,
+    backend: MetricBackend = MetricBackend.HOST,
+) -> dict:
+    """The reference's logging-loss semantics (``demo.py:113-121``): each
+    rank contributes ``loss × batch_size``; the sum over ranks is divided by
+    ``batch_size × world_size``.  Assumes equal per-rank batch size every
+    iteration, as the reference does (comment at ``demo.py:113``).
+
+    With ``backend=ICI`` the caller's losses are expected to already be global
+    means (computed inside the compiled step over the globally-sharded batch),
+    so this is a device→host fetch only.
+    """
+    if backend == MetricBackend.ICI:
+        return {k: float(jax.device_get(v)) for k, v in losses.items()}
+    local = {k: float(jax.device_get(v)) for k, v in losses.items()}
+    return {k: cross_process_mean_scalar(v, batch_size) for k, v in local.items()}
+
+
+def barrier(name: str = "tpudist_barrier") -> None:
+    """Cross-process barrier (``dist.barrier()``, ``demo.py:177``)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def device_put_global(x: np.ndarray, sharding) -> jax.Array:
+    """Assemble a global sharded array from per-process host data.
+
+    Each process passes its *local* shard; the result is a global
+    ``jax.Array`` laid out by ``sharding``.  Single-process: a plain
+    ``device_put``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    global_shape = (x.shape[0] * jax.process_count(), *x.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, x, global_shape)

@@ -1,0 +1,3 @@
+from tpudist.data.toy import ToyData, make_toy_data  # noqa: F401
+from tpudist.data.sharding import ShardPlan, epoch_indices  # noqa: F401
+from tpudist.data.loader import ShardedLoader, shard_batch  # noqa: F401

@@ -1,0 +1,1 @@
+from tpudist.models.toy_mlp import ToyMLP, create_toy_model  # noqa: F401

@@ -1,0 +1,40 @@
+"""The toy MLP, Flax edition.
+
+Architecture parity with the reference ``ToyModel``
+(``toy_model_and_data.py:8-25``): Linear 2→10→10→10→10→1 with LeakyReLU
+(negative slope 0.01, torch's default) between all but the last layer —
+a quadratic-regression head the toy dataset converges on in seconds.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ToyMLP(nn.Module):
+    features: Sequence[int] = (10, 10, 10, 10, 1)
+    negative_slope: float = 0.01
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i != len(self.features) - 1:
+                x = nn.leaky_relu(x, negative_slope=self.negative_slope)
+        return x
+
+
+def create_toy_model(rng: jax.Array, input_dim: int = 2):
+    """Init a ToyMLP; returns ``(module, params)``.
+
+    Every process must pass the same ``rng`` so replicated parameters are
+    bit-identical across hosts — the JAX-native replacement for DDP's
+    broadcast-from-rank-0 at wrap time (``demo.py:70-72``).
+    """
+    module = ToyMLP()
+    params = module.init(rng, jnp.zeros((1, input_dim), jnp.float32))
+    return module, params

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for hot ops (flash attention, fused MLP) with jnp
+reference implementations used as CPU fallbacks and in correctness tests."""

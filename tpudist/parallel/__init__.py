@@ -1,0 +1,7 @@
+"""Parallelism building blocks: DP (shard_map formulation), tensor parallel,
+pipeline, ring-attention sequence parallel, MoE expert parallel.
+
+Populated incrementally; the pjit DP formulation lives in
+``tpudist.train.step`` (parameters replicated, batch sharded — XLA inserts
+the gradient all-reduce).
+"""

@@ -1,0 +1,9 @@
+from tpudist.runtime.bootstrap import (  # noqa: F401
+    ProcessContext,
+    resolve_process_context,
+    initialize,
+    shutdown,
+)
+from tpudist.runtime.mesh import MeshConfig, make_mesh  # noqa: F401
+from tpudist.runtime.seeding import per_process_seed, fold_in_process  # noqa: F401
+from tpudist.runtime.rank_logging import rank_print, rank_zero_only, describe_runtime  # noqa: F401

@@ -1,0 +1,106 @@
+"""Device-mesh construction.
+
+The reference binds one CUDA device per rank (``torch.cuda.set_device``,
+``demo.py:66``) and leaves topology to NCCL.  The TPU-native design is the
+inverse: one global :class:`jax.sharding.Mesh` over *all* devices in the job,
+with named axes carrying the parallelism meaning:
+
+- ``data``  — data parallelism (replaces DDP's gradient all-reduce group)
+- ``stage`` — pipeline parallelism (generalizes the 2-stage vertical split of
+  ``demo_one_model_multi_gpu.py:17-42``)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``model`` — tensor parallelism (the TPU-idiomatic way to put one model on
+  several chips)
+
+Expert parallelism reuses ``('data', 'seq')`` as the expert group (DeepSpeed-
+MoE style); see ``tpudist.parallel.moe``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_STAGE = "stage"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+ALL_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_SEQ, AXIS_MODEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Axis sizes; ``-1`` means "absorb all remaining devices"."""
+
+    data: int = -1
+    stage: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = {"data": self.data, "stage": self.stage, "seq": self.seq, "model": self.model}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if len(unknown) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if unknown:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[unknown[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} does not cover {n_devices} devices"
+            )
+        return MeshConfig(**sizes)
+
+    def axis_sizes(self) -> dict:
+        return {"data": self.data, "stage": self.stage, "seq": self.seq, "model": self.model}
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Sequence[str] = ALL_AXES,
+) -> Mesh:
+    """Build the global mesh.
+
+    Axis order is ``(data, stage, seq, model)`` — outermost axis maps to the
+    slowest-varying device dimension so that ``model`` (the most bandwidth-
+    hungry axis) lands on adjacent chips and rides ICI, while ``data`` may
+    span hosts over DCN.
+    """
+    if devices is None:
+        devices = jax.devices()
+    config = (config or MeshConfig()).resolve(len(devices))
+    sizes = [config.axis_sizes()[a] for a in axis_names]
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D all-data mesh — the DDP-equivalent default (SURVEY.md §2.4)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+
+
+def data_model_mesh(
+    model_size: int = 2, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """2-D ``('data','model')`` mesh for the one-model-multi-chip demo
+    (parity with ``demo_one_model_multi_gpu.py``'s 2-GPU-per-process shape)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % model_size != 0:
+        raise ValueError(f"{n} devices not divisible by model axis {model_size}")
+    dev_array = np.asarray(devices).reshape(n // model_size, model_size)
+    return Mesh(dev_array, axis_names=(AXIS_DATA, AXIS_MODEL))

@@ -1,0 +1,78 @@
+"""Bootstrap the JAX coordination service from an MPI launch.
+
+The reference pattern (``demo_assume_started_with_mpiexec.py:29-50``): use one
+communication fabric (MPI) to bootstrap another — rank 0 picks a free port
+(``:20-27``), broadcasts its hostname and the port over ``MPI.COMM_WORLD``
+(``:43-45``), every rank exports ``MASTER_ADDR``/``MASTER_PORT``/``RANK``/
+``WORLD_SIZE`` and then initializes the real backend (``:46-50``).
+
+Here the "real backend" is the JAX coordination service.  mpi4py is optional:
+when absent (it is not baked into the TPU image) we fall back to the pure
+``OMPI_*`` env contract, which additionally requires ``MASTER_ADDR`` (and
+optionally ``MASTER_PORT``) to be exported by the launcher — there is no way
+to agree on rank 0's hostname without either a collective or the env.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional, Tuple
+
+from tpudist.runtime.bootstrap import (
+    ProcessContext,
+    find_free_port,
+    initialize,
+    resolve_process_context,
+)
+
+
+def have_mpi4py() -> bool:
+    try:
+        import mpi4py  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def exchange_coordinator(port: Optional[int] = None) -> Tuple[str, int, int]:
+    """Agree on ``(coordinator_address, num_processes, process_id)`` via MPI.
+
+    Mirrors ``demo_assume_started_with_mpiexec.py:35-47``: rank, size from
+    ``COMM_WORLD``; rank 0 picks the port; hostname+port broadcast to all.
+    Exports MASTER_ADDR/MASTER_PORT so later env-contract resolution agrees.
+    """
+    from mpi4py import MPI  # deferred: optional dependency
+
+    comm = MPI.COMM_WORLD
+    rank = comm.Get_rank()
+    size = comm.Get_size()
+    if rank == 0:
+        hostname = socket.gethostname()
+        port = port or find_free_port()
+    else:
+        hostname, port = None, None
+    hostname = comm.bcast(hostname, root=0)
+    port = comm.bcast(port, root=0)
+    os.environ["MASTER_ADDR"] = hostname
+    os.environ["MASTER_PORT"] = str(port)
+    os.environ.setdefault("WORLD_SIZE", str(size))
+    return f"{hostname}:{port}", size, rank
+
+
+def initialize_from_mpi(port: Optional[int] = None) -> ProcessContext:
+    """One-call MPI-launched bootstrap → initialized JAX distributed runtime."""
+    if have_mpi4py():
+        coord, size, rank = exchange_coordinator(port)
+        ctx = ProcessContext(
+            process_id=rank,
+            num_processes=size,
+            coordinator_address=coord if size > 1 else None,
+            local_rank=int(os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK", 0)),
+            local_world_size=int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE", 1)),
+            launch_source="mpi",
+        )
+        return initialize(ctx)
+    # mpi4py-less fallback: pure env contract (OMPI_* + MASTER_ADDR).
+    return initialize(resolve_process_context())

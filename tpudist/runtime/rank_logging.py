@@ -1,0 +1,45 @@
+"""Rank-aware console observability.
+
+Parity with the reference's rank-prefixed prints of world size / hostname /
+device count / seed / backend (``demo.py:51-63``) and rank-0-only tqdm
+(``demo.py:91-92``).
+"""
+
+from __future__ import annotations
+
+import functools
+import socket
+from typing import Callable
+
+import jax
+
+
+def rank_print(*args, **kwargs) -> None:
+    """Print prefixed with ``[rank r/w]``."""
+    prefix = f"[rank {jax.process_index()}/{jax.process_count()}]"
+    print(prefix, *args, **kwargs, flush=True)
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 (wandb.init / tqdm discipline,
+    ``demo.py:76-78,91-92``)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if jax.process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper
+
+
+def describe_runtime(ctx=None, seed=None) -> None:
+    """The ``demo.py:51-63`` startup banner, TPU edition."""
+    rank_print(
+        f"host={socket.gethostname()} "
+        f"local_devices={jax.local_device_count()} "
+        f"global_devices={jax.device_count()} "
+        f"platform={jax.devices()[0].platform} "
+        + (f"launch={ctx.launch_source} " if ctx is not None else "")
+        + (f"seed={seed}" if seed is not None else "")
+    )

@@ -1,0 +1,40 @@
+"""Seeding discipline.
+
+The reference adds the rank to the user seed so each process gets distinct
+randomness (``config.seed += dist.get_rank()``, ``demo.py:59-60``) and draws a
+random seed when none is given (``argument_parser.py:18``).  In JAX the
+idiomatic form is a single base PRNG key folded with the process index; model
+init uses the *base* key on every process (so replicated params are bit-
+identical without a broadcast — DDP gets this by broadcasting from rank 0
+instead), while data/dropout keys use the folded key.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+import jax
+
+
+def draw_seed() -> int:
+    """Random 32-bit seed, mirroring ``random.randint(0, 2**32-1)`` in
+    ``argument_parser.py:18``."""
+    return secrets.randbits(32)
+
+
+def per_process_seed(base_seed: Optional[int], process_id: Optional[int] = None) -> int:
+    """``base_seed + rank`` (``demo.py:59-60``)."""
+    if base_seed is None:
+        base_seed = draw_seed()
+    if process_id is None:
+        process_id = jax.process_index()
+    return base_seed + process_id
+
+
+def fold_in_process(key: jax.Array, process_id: Optional[int] = None) -> jax.Array:
+    """Fold the process index into a PRNG key — the JAX-native analog of
+    per-rank seeding."""
+    if process_id is None:
+        process_id = jax.process_index()
+    return jax.random.fold_in(key, process_id)

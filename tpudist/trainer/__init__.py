@@ -1,0 +1,2 @@
+"""High-level Trainer facade (Lightning-equivalent, parity with
+``demo_pytorch_lightning.py``)."""

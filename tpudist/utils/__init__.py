@@ -1,0 +1,1 @@
+from tpudist.utils.metrics import MetricsLogger, init_metrics  # noqa: F401

@@ -1,0 +1,102 @@
+"""W&B-compatible metrics logging with a first-class offline mode.
+
+Parity targets (SURVEY.md §5.5):
+- rank-0-only ``wandb.init(project=…, group=…)`` (``demo.py:76-78``),
+- per-iteration ``wandb.log({...}, commit=False)`` + committing log
+  (``demo.py:119-121``),
+- ``--dry_run`` → ``WANDB_MODE=dryrun`` offline fixture (``demo.py:160-161``),
+- ``wandb.finish()`` **before** distributed teardown to avoid shutdown
+  races (``demo.py:133-136``),
+- API key via ``WANDB_API_KEY`` env (plumbed by the launcher, §2.2 B1).
+
+wandb is an optional dependency: when importable (and not in dry-run mode)
+the real client is used; otherwise an in-tree JSONL logger with the same
+surface (``log``/``finish``) records to ``<dir>/metrics.jsonl`` so offline
+clusters and tests need no network or credentials.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Mapping, Optional
+
+import jax
+
+
+class MetricsLogger:
+    """Minimal wandb-Run-alike: ``log(metrics, commit=)`` + ``finish()``."""
+
+    def __init__(self, run=None, jsonl_path: Optional[Path] = None):
+        self._run = run  # a real wandb run, or None
+        self._jsonl_path = jsonl_path
+        self._jsonl_file = None
+        self._pending: dict = {}
+        self._step = 0
+        if jsonl_path is not None:
+            jsonl_path.parent.mkdir(parents=True, exist_ok=True)
+            self._jsonl_file = open(jsonl_path, "a")
+
+    def log(self, metrics: Mapping[str, float], commit: bool = True) -> None:
+        self._pending.update(metrics)
+        if not commit:
+            return
+        record, self._pending = self._pending, {}
+        if self._run is not None:
+            self._run.log(record)
+        if self._jsonl_file is not None:
+            record = {"_step": self._step, "_time": time.time(), **record}
+            self._jsonl_file.write(json.dumps(record) + "\n")
+            self._jsonl_file.flush()
+        self._step += 1
+
+    def finish(self) -> None:
+        """Must run before ``runtime.shutdown()`` — same ordering discipline
+        as ``wandb.finish()`` before ``destroy_process_group``
+        (``demo.py:133-136``)."""
+        if self._pending:
+            self.log({}, commit=True)
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+        if self._jsonl_file is not None:
+            self._jsonl_file.close()
+            self._jsonl_file = None
+
+
+class _NullLogger(MetricsLogger):
+    def __init__(self):
+        super().__init__(run=None, jsonl_path=None)
+
+
+def init_metrics(
+    project: str = "tpudist",
+    group: Optional[str] = None,
+    *,
+    dry_run: bool = False,
+    log_dir: str = "runs",
+    rank_zero_only: bool = True,
+) -> MetricsLogger:
+    """Create the job's metrics logger (rank 0 gets the real one; other ranks
+    a no-op, mirroring ``if rank == 0: wandb.init`` at ``demo.py:76-78``)."""
+    if rank_zero_only and jax.process_index() != 0:
+        return _NullLogger()
+    if dry_run:
+        os.environ["WANDB_MODE"] = "dryrun"  # demo.py:160-161
+    use_wandb = not dry_run and os.environ.get("WANDB_MODE") not in ("dryrun", "offline", "disabled")
+    run = None
+    if use_wandb:
+        try:
+            import wandb
+
+            run = wandb.init(
+                project=project,
+                group=group,
+                settings=wandb.Settings(start_method="thread"),  # demo.py:78
+            )
+        except Exception:
+            run = None  # no wandb / no credentials → JSONL fallback only
+    jsonl = Path(log_dir) / f"{group or project}" / "metrics.jsonl"
+    return MetricsLogger(run=run, jsonl_path=jsonl)
