@@ -1,0 +1,70 @@
+"""Shared setup for the example entry points (the reference's demos share
+``toy_model_and_data.py`` + ``argument_parser.py`` the same way)."""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from tpudist.comm.collectives import MetricBackend
+from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
+from tpudist.models import create_toy_model
+from tpudist.train import TrainLoopConfig, init_model_states, make_multi_model_train_step
+from tpudist.utils import init_metrics
+
+
+def build_two_models(seed: int):
+    """Two independent ToyModels trained side by side (``demo.py:22-23``).
+    Init keys derive from the *base* seed so params are identical across
+    processes without a broadcast."""
+    kx, ky = jax.random.split(jax.random.PRNGKey(seed))
+    mx, px = create_toy_model(kx)
+    my, py = create_toy_model(ky)
+    return {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+
+
+def build_loader(args, *, seed: int) -> ShardedLoader:
+    data = make_toy_data(seed=seed)  # same dataset on every process
+    plan = ShardPlan(
+        num_samples=len(data),
+        num_shards=jax.process_count(),
+        shard_id=jax.process_index(),
+        shuffle=True,
+        seed=seed,
+        mode=args.dataloader,
+    )
+    return ShardedLoader(data, batch_size=args.batch_size, plan=plan)
+
+
+def build_training(args, mesh, *, state_sharding_fn=None):
+    """Models + optimizer + compiled step + loader + loop config.
+
+    ``state_sharding_fn(mesh, states) -> sharding pytree`` overrides the
+    default replicated parameter layout (used by the model-split demo).
+    """
+    models = build_two_models(args.seed)
+    tx = optax.adam(args.lr)  # demo.py:80-81
+    states = init_model_states(models, tx)
+    state_sharding = None
+    if state_sharding_fn is not None:
+        state_sharding = state_sharding_fn(mesh, states)
+        states = jax.device_put(states, state_sharding)
+    step = make_multi_model_train_step(
+        {k: f for k, (f, _) in models.items()}, tx, mesh,
+        state_sharding=state_sharding,
+    )
+    loader = build_loader(args, seed=args.seed)
+    loop_cfg = TrainLoopConfig(
+        total_iterations=args.total_iterations,
+        log_every=args.log_every,
+        metric_backend=MetricBackend(args.backend),
+    )
+    return states, step, loader, loop_cfg
+
+
+def build_logger(args, default_group: str):
+    return init_metrics(
+        project=args.project,
+        group=args.group or default_group,
+        dry_run=args.dry_run,
+    )

@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Entry point A/B — the main data-parallel demo.
+
+TPU-native equivalent of reference ``demo.py`` (SURVEY.md §3.1/§3.2): two
+independent toy models trained side by side under data parallelism, launched
+either by the managed launcher (``launch/tpurun`` — torchrun equivalent) or
+by raw scheduler env vars (srun path, ``--use_node_rank``).  Rank/world-size
+derivation is contract-autodetected (see ``tpudist.runtime.bootstrap``); the
+compiled step shards the batch over the global ``data`` mesh axis and XLA
+inserts the gradient all-reduce that DDP's C++ reducer performed
+(``demo.py:70-72``).
+
+Run single-process:      python examples/demo.py --dry_run
+Run under the launcher:  launch/tpurun --nproc 4 python examples/demo.py ...
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from common import build_logger, build_training  # noqa: E402
+
+from tpudist.config import get_args  # noqa: E402
+from tpudist.runtime import (  # noqa: E402
+    describe_runtime,
+    initialize,
+    per_process_seed,
+    resolve_shared_seed,
+    shutdown,
+)
+from tpudist.runtime.mesh import data_parallel_mesh  # noqa: E402
+from tpudist.train import run_training  # noqa: E402
+from tpudist.utils.record import record  # noqa: E402
+
+
+@record
+def main() -> None:
+    args = get_args()
+    ctx = initialize(use_node_rank=args.use_node_rank)
+    args.seed = resolve_shared_seed(args.seed)  # job-wide agreement
+    # per-rank seed offset (demo.py:59-60) — used for anything rank-local;
+    # model init and the global shuffle use the shared base seed.
+    local_seed = per_process_seed(args.seed)
+    describe_runtime(ctx, local_seed)
+
+    mesh = data_parallel_mesh()
+    states, step, loader, loop_cfg = build_training(args, mesh)
+    logger = build_logger(args, default_group="demo_dp")
+
+    states, losses = run_training(states, step, loader, mesh, logger, loop_cfg)
+    print(f"[rank {ctx.process_id}] final losses: {losses}")
+
+    # teardown ordering parity (demo.py:130-136,177-178): metrics logger is
+    # finished inside run_training, then the runtime goes down.
+    shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Entry point C — training bootstrapped by an external MPI launcher.
+
+TPU-native equivalent of ``demo_assume_started_with_mpiexec.py`` (SURVEY.md
+§3.3): the job is started by ``mpiexec -np W`` (PBS/Sockeye recipe,
+``using_sockeye_arc_ubc.md:34``), rank/world-size come from ``MPI.COMM_WORLD``
+and rank 0's hostname + a free port are broadcast over MPI to seed the real
+backend — here the JAX coordination service instead of c10d
+(``tpudist.runtime.mpi_bootstrap``).  Per the reference, this variant logs to
+stdout only (no wandb).
+
+Run: mpiexec -np 4 python examples/demo_mpi_bootstrap.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from common import build_training  # noqa: E402
+
+from tpudist.config import get_args  # noqa: E402
+from tpudist.runtime import (  # noqa: E402
+    describe_runtime,
+    per_process_seed,
+    resolve_shared_seed,
+    shutdown,
+)
+from tpudist.runtime.mesh import data_parallel_mesh  # noqa: E402
+from tpudist.runtime.mpi_bootstrap import initialize_from_mpi  # noqa: E402
+from tpudist.runtime.rank_logging import rank_print  # noqa: E402
+from tpudist.train import run_training  # noqa: E402
+from tpudist.utils.record import record  # noqa: E402
+
+
+@record
+def main() -> None:
+    args = get_args()
+    ctx = initialize_from_mpi()
+    args.seed = resolve_shared_seed(args.seed)
+    local_seed = per_process_seed(args.seed)
+    describe_runtime(ctx, local_seed)
+
+    mesh = data_parallel_mesh()
+    states, step, loader, loop_cfg = build_training(args, mesh)
+    states, losses = run_training(states, step, loader, mesh, logger=None, config=loop_cfg)
+    rank_print(f"final losses: {losses}")
+    shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Shared CLI — flag parity with the reference ``argument_parser.py:6-28``.
+
+Mapping of reference flags onto the TPU runtime:
+
+- ``--dataloader {distributed,standard}`` — identical semantics
+  (``demo.py:139-154``).
+- ``--backend {ici,host}`` — replaces ``{nccl,mpi,gloo}``: selects where the
+  per-iteration metric reduction runs (SURVEY.md §5.8).  Gradient reduction
+  always rides ICI inside the compiled step; ``host`` reduces logged scalars
+  over DCN like the reference's Gloo logging group.  ``nccl``/``gloo``/``mpi``
+  are accepted as aliases for migration (nccl→ici, gloo/mpi→host).
+- ``--torchrun`` — accepted for launcher-script compatibility; rank
+  derivation is contract-autodetected here, so it is a no-op.
+- ``--use_node_rank`` — identical semantics (``demo.py:38-39``).
+- ``--seed`` — random 32-bit default (``argument_parser.py:18``).
+- ``--num_workers`` — accepted; the host loader is synchronous numpy (no
+  worker processes to configure), so >0 is a no-op.
+- ``--dry_run`` — offline metrics mode (``demo.py:160-161``).
+
+Plus training-shape flags (fixed constants in the reference):
+``--total_iterations`` (``demo.py:88``), ``--batch_size`` (``demo.py:145``),
+``--lr`` (``demo.py:80-81``), and TPU extras ``--profile_dir`` /
+``--checkpoint_dir`` / ``--checkpoint_every``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+BACKEND_ALIASES = {"nccl": "ici", "gloo": "host", "mpi": "host", "ici": "ici", "host": "host"}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="tpudist training entry point")
+    p.add_argument("--dataloader", choices=["distributed", "standard"],
+                   type=str, default="distributed")
+    p.add_argument("--backend", choices=sorted(BACKEND_ALIASES),
+                   type=str, default="ici",
+                   help="metric-reduction fabric: ici (on-device) or host (DCN); "
+                        "nccl/gloo/mpi accepted as migration aliases")
+    p.add_argument("--torchrun", action="store_true",
+                   help="compat no-op: launch contract is autodetected")
+    p.add_argument("--use_node_rank", action="store_true",
+                   help="derive global rank as NODE_RANK*TASKS_PER_NODE+LOCAL_RANK")
+    p.add_argument("--seed", default=None, type=int,
+                   help="job-wide seed; when omitted, rank 0 draws one after "
+                        "runtime init and broadcasts it (see "
+                        "runtime.seeding.resolve_shared_seed)")
+    p.add_argument("--num_workers", default=0, type=int,
+                   help="compat no-op: host loader is synchronous")
+    p.add_argument("--dry_run", action="store_true",
+                   help="offline metrics (no wandb network/credentials)")
+    p.add_argument("--total_iterations", default=1000, type=int)
+    p.add_argument("--batch_size", default=256, type=int,
+                   help="per-process batch size")
+    p.add_argument("--lr", default=1e-3, type=float)
+    p.add_argument("--log_every", default=1, type=int)
+    p.add_argument("--project", default="tpudist", type=str)
+    p.add_argument("--group", default=None, type=str)
+    p.add_argument("--profile_dir", default=None, type=str,
+                   help="capture a jax.profiler trace into this directory")
+    p.add_argument("--checkpoint_dir", default=None, type=str)
+    p.add_argument("--checkpoint_every", default=0, type=int)
+    return p
+
+
+def get_args(argv=None, parser: argparse.ArgumentParser | None = None) -> argparse.Namespace:
+    """Parse + normalize.  ``parser`` lets entry points extend the shared
+    parser (extra flags) while keeping normalization in one place.
+
+    ``args.seed`` stays ``None`` when not given: it must be resolved
+    job-wide *after* ``runtime.initialize`` via
+    ``resolve_shared_seed(args.seed)`` — a per-process random draw here
+    would silently desynchronize replicated init and shard plans.
+    """
+    args = (parser or build_parser()).parse_args(argv)
+    args.backend = BACKEND_ALIASES[args.backend]
+    return args

@@ -1,0 +1,77 @@
+"""One model split across multiple chips — the TPU-native re-design of the
+reference's 2-GPU vertical model split (``demo_one_model_multi_gpu.py:17-42``).
+
+The reference places ``layers0`` on device 0 and ``layers1`` on device 1 and
+moves activations by hand in ``forward`` (``:40-42``) because CUDA has no
+automatic sharding.  On TPU the idiomatic way to put one model on several
+chips is to *shard the weight matrices* over a ``model`` mesh axis
+(Megatron-style column/row splits) and let XLA's SPMD partitioner insert the
+activation collectives — same capability (one model, N chips per replica,
+composed with data parallelism, cf. ``DDP(device_ids=None)`` at ``:96-98``),
+but expressed as partition specs instead of device placement (SURVEY.md §2.4).
+The layer-*group* (pipeline) expression of the same split lives in
+``tpudist.parallel.pipeline``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.runtime.mesh import AXIS_MODEL
+
+# Alternating column/row splits over the hidden 10-wide layers; the 2-wide
+# input and 1-wide output stay replicated (cannot and need not be split).
+_KERNEL_SPECS = {
+    "dense_0": P(None, AXIS_MODEL),  # column-split: output features sharded
+    "dense_1": P(AXIS_MODEL, None),  # row-split: input features sharded
+    "dense_2": P(None, AXIS_MODEL),
+    "dense_3": P(AXIS_MODEL, None),
+    "dense_4": P(),                  # (10, 1) head: replicated
+}
+_BIAS_SPECS = {
+    "dense_0": P(AXIS_MODEL),
+    "dense_1": P(),
+    "dense_2": P(AXIS_MODEL),
+    "dense_3": P(),
+    "dense_4": P(),
+}
+
+
+def _spec_for_path(path) -> P:
+    layer, leafname = None, None
+    for entry in path:
+        key = getattr(entry, "key", getattr(entry, "name", None))
+        if isinstance(key, str):
+            if key.startswith("dense_"):
+                layer = key
+            if key in ("kernel", "bias"):
+                leafname = key
+    if layer is None:
+        return P()  # optimizer counts and anything unrecognized: replicate
+    if leafname == "kernel":
+        return _KERNEL_SPECS[layer]
+    if leafname == "bias":
+        return _BIAS_SPECS[layer]
+    return P()
+
+
+def split_state_sharding(mesh: Mesh, tree: Any):
+    """Sharding pytree for a states/params tree of :class:`ToyMLP` models,
+    splitting each model over the ``model`` mesh axis.
+
+    Works on the full train-state tree: Adam's ``mu``/``nu`` mirror the param
+    structure, so their leaves pick up the same specs by key path; scalar
+    leaves (step counts) replicate.
+    """
+
+    def to_sharding(path, leaf):
+        spec = _spec_for_path(path)
+        # scalar leaves can't carry a non-empty spec
+        if getattr(leaf, "ndim", 0) == 0:
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(to_sharding, tree)

@@ -5,5 +5,9 @@ from tpudist.runtime.bootstrap import (  # noqa: F401
     shutdown,
 )
 from tpudist.runtime.mesh import MeshConfig, make_mesh  # noqa: F401
-from tpudist.runtime.seeding import per_process_seed, fold_in_process  # noqa: F401
+from tpudist.runtime.seeding import (  # noqa: F401
+    per_process_seed,
+    fold_in_process,
+    resolve_shared_seed,
+)
 from tpudist.runtime.rank_logging import rank_print, rank_zero_only, describe_runtime  # noqa: F401

@@ -15,12 +15,33 @@ import secrets
 from typing import Optional
 
 import jax
+import numpy as np
 
 
 def draw_seed() -> int:
     """Random 32-bit seed, mirroring ``random.randint(0, 2**32-1)`` in
     ``argument_parser.py:18``."""
     return secrets.randbits(32)
+
+
+def resolve_shared_seed(seed: Optional[int]) -> int:
+    """One seed the whole job agrees on.
+
+    When the user passes no seed, the reference draws one per process and
+    relies on DDP's rank-0 parameter broadcast to re-converge the models
+    (``argument_parser.py:18`` + DDP wrap).  There is no such compensating
+    broadcast in the replicated-init design, so the random draw itself must
+    be agreed on: rank 0 draws, everyone else receives it over the
+    coordination service.  Must be called *after* ``runtime.initialize``.
+    """
+    if seed is not None:
+        return seed
+    if jax.process_count() == 1:
+        return draw_seed()
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(draw_seed(), dtype=np.int64)
+    return int(multihost_utils.broadcast_one_to_all(local))
 
 
 def per_process_seed(base_seed: Optional[int], process_id: Optional[int] = None) -> int:

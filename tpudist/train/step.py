@@ -50,25 +50,35 @@ jax.tree_util.register_dataclass(
 )
 
 
+def _tx_for(tx, name: str) -> optax.GradientTransformation:
+    """``tx`` may be one transformation for all models or a per-model dict
+    (the reference's Lightning variant returns one optimizer per model,
+    ``demo_pytorch_lightning.py:35-40``)."""
+    if isinstance(tx, Mapping):
+        return tx[name]
+    return tx
+
+
 def init_model_states(
     models: Mapping[str, Tuple[Callable, Any]],
-    tx: optax.GradientTransformation,
+    tx,
 ) -> Dict[str, ModelState]:
     """``models`` maps name → ``(apply_fn, params)``; returns the train state."""
     return {
-        name: ModelState(params=params, opt_state=tx.init(params))
+        name: ModelState(params=params, opt_state=_tx_for(tx, name).init(params))
         for name, (_, params) in models.items()
     }
 
 
 def make_multi_model_train_step(
     apply_fns: Mapping[str, Callable],
-    tx: optax.GradientTransformation,
+    tx,
     mesh: Mesh,
     loss_fn: Callable = mse_loss,
     *,
     batch_axis: str = AXIS_DATA,
     donate_state: bool = True,
+    state_sharding=None,
 ):
     """Build the compiled DP train step.
 
@@ -76,9 +86,17 @@ def make_multi_model_train_step(
     dict of *global* scalar means (computed over the full sharded batch, so
     the reference's batch-weighted cross-rank loss average, ``demo.py:114-121``,
     falls out for free — every epoch's logged loss is already the global mean).
+
+    ``state_sharding`` (a sharding pytree matching the states dict, or a
+    single ``NamedSharding``) overrides the default replicated-parameters
+    layout — this is how the model-split entry point shards one model's
+    weights over the ``model`` mesh axis while staying data-parallel on
+    ``data``.  ``tx`` may be a single optax transformation or a per-model
+    dict; ``loss_fn`` takes ``(pred, target)``.
     """
     repl = NamedSharding(mesh, P())
-    batch_sharding = NamedSharding(mesh, P(batch_axis))
+    bs = NamedSharding(mesh, P(batch_axis))
+    state_sharding = repl if state_sharding is None else state_sharding
 
     def _step(states: Dict[str, ModelState], x: jax.Array, y: jax.Array):
         new_states, losses = {}, {}
@@ -89,7 +107,8 @@ def make_multi_model_train_step(
                 return loss_fn(apply_fn(params, x), y)
 
             loss, grads = jax.value_and_grad(loss_of)(state.params)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            model_tx = _tx_for(tx, name)
+            updates, new_opt = model_tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_states[name] = ModelState(params=new_params, opt_state=new_opt)
             losses[name] = loss
@@ -97,8 +116,8 @@ def make_multi_model_train_step(
 
     return jax.jit(
         _step,
-        in_shardings=(repl, batch_sharding, batch_sharding),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sharding, bs, bs),
+        out_shardings=(state_sharding, repl),
         donate_argnums=(0,) if donate_state else (),
     )
 

@@ -1,2 +1,4 @@
 """High-level Trainer facade (Lightning-equivalent, parity with
 ``demo_pytorch_lightning.py``)."""
+
+from tpudist.trainer.trainer import Trainer, TrainerModule  # noqa: F401

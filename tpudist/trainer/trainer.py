@@ -1,0 +1,132 @@
+"""High-level Trainer facade — parity with PyTorch Lightning as used by the
+reference (``demo_pytorch_lightning.py``, SURVEY.md §3.4).
+
+The reference's ``LitToyModel`` holds two models (``:16-25``), sums their MSE
+losses in ``training_step`` (``:27-33``) and returns one Adam per model from
+``configure_optimizers`` (``:35-40``); ``pl.Trainer(gpus, num_nodes,
+strategy='ddp', precision=32)`` owns the loop, device placement, and
+distributed wiring (``:57-60``).
+
+The TPU-native facade keeps that division of labor: the user supplies a
+:class:`TrainerModule` (models + optimizers + loss); the :class:`Trainer`
+owns the mesh, the compiled step, logging, and teardown.  ``strategy`` maps
+onto mesh layout: ``'dp'`` (1-D data mesh, the ``strategy='ddp'`` analog) or
+``'dp_model'`` (2-D ``('data','model')`` mesh with user-supplied sharding).
+``devices``/``num_nodes`` are *not* parameters — the mesh covers whatever the
+launch contract provided, which is the multi-controller JAX model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import optax
+
+from tpudist.comm.collectives import MetricBackend
+from tpudist.runtime.bootstrap import initialize, shutdown
+from tpudist.runtime.mesh import data_model_mesh, data_parallel_mesh
+from tpudist.runtime.seeding import resolve_shared_seed
+from tpudist.train.loop import TrainLoopConfig, run_training
+from tpudist.train.step import (
+    init_model_states,
+    make_multi_model_train_step,
+    mse_loss,
+)
+from tpudist.utils.metrics import MetricsLogger, init_metrics
+
+
+class TrainerModule:
+    """Subclass and override; the Lightning-``LightningModule`` analog."""
+
+    def configure_models(self, rng: jax.Array) -> Dict[str, Tuple[Callable, object]]:
+        """Return name → ``(apply_fn, params)``.  Called once on every
+        process with the same ``rng`` (replicated init without broadcast)."""
+        raise NotImplementedError
+
+    def configure_optimizers(self):
+        """Return one optax transformation, or a per-model dict — the
+        ``configure_optimizers`` returning a list of Adams analog
+        (``demo_pytorch_lightning.py:35-40``)."""
+        return optax.adam(1e-3)
+
+    def loss(self, pred: jax.Array, target: jax.Array) -> jax.Array:
+        """Per-model loss; the total logged loss is the sum over models
+        (``training_step`` summing loss_X + loss_Y, ``:27-33``)."""
+        return mse_loss(pred, target)
+
+    def state_sharding(self, mesh, states):
+        """Optional non-replicated state layout for ``strategy='dp_model'``."""
+        return None
+
+
+@dataclasses.dataclass
+class Trainer:
+    max_steps: int = 1000  # demo_pytorch_lightning.py:48 (1000 steps)
+    strategy: str = "dp"   # 'dp' (≅ ddp) | 'dp_model'
+    model_parallel: int = 2
+    precision: str = "fp32"  # 'fp32' (reference precision=32) | 'bf16'
+    log_every: int = 1
+    metric_backend: MetricBackend = MetricBackend.ICI
+    project: str = "tpudist"
+    group: Optional[str] = None
+    dry_run: bool = False
+    seed: Optional[int] = 0  # None → rank-0 draw broadcast job-wide
+    use_node_rank: bool = False
+    progress_bar: bool = True
+
+    def fit(self, module: TrainerModule, loader) -> Dict[str, float]:
+        """Own the whole run: init runtime, build mesh + compiled step,
+        train, tear down.  Returns the final per-model losses."""
+        initialize(use_node_rank=self.use_node_rank)
+        seed = resolve_shared_seed(self.seed)
+        if self.strategy == "dp":
+            mesh = data_parallel_mesh()
+        elif self.strategy == "dp_model":
+            mesh = data_model_mesh(model_size=self.model_parallel)
+        else:
+            raise ValueError(f"unknown strategy {self.strategy!r}")
+
+        models = module.configure_models(jax.random.PRNGKey(seed))
+        tx = module.configure_optimizers()
+        states = init_model_states(models, tx)
+        state_sharding = module.state_sharding(mesh, states)
+        if state_sharding is not None:
+            states = jax.device_put(states, state_sharding)
+
+        apply_fns = {k: f for k, (f, _) in models.items()}
+        if self.precision == "bf16":
+            # mixed precision: fp32 master weights, bf16 compute — params are
+            # cast at apply time so grads come back fp32 for the optimizer
+            import jax.numpy as jnp
+
+            def _bf16(f):
+                def wrapped(p, x):
+                    p16 = jax.tree.map(
+                        lambda a: a.astype(jnp.bfloat16)
+                        if a.dtype == jnp.float32 else a, p)
+                    return f(p16, x.astype(jnp.bfloat16)).astype(jnp.float32)
+                return wrapped
+
+            apply_fns = {k: _bf16(f) for k, f in apply_fns.items()}
+        step = make_multi_model_train_step(
+            apply_fns, tx, mesh, loss_fn=module.loss, state_sharding=state_sharding
+        )
+
+        logger: MetricsLogger = init_metrics(
+            project=self.project, group=self.group or "trainer", dry_run=self.dry_run
+        )
+        cfg = TrainLoopConfig(
+            total_iterations=self.max_steps,
+            log_every=self.log_every,
+            metric_backend=self.metric_backend,
+            progress_bar=self.progress_bar,
+        )
+        states, losses = run_training(states, step, loader, mesh, logger, cfg)
+        self.final_states = states
+        return losses
+
+    @staticmethod
+    def teardown():
+        shutdown()

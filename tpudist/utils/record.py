@@ -1,0 +1,58 @@
+"""Crash-record decorator — parity with ``torch.distributed.elastic``'s
+``@record`` (``demo.py:14,156``): worker tracebacks are captured to a
+per-rank error file so the launcher (``launch/tpurun``) can surface the
+first failure instead of a wall of interleaved stderr.
+
+The file path comes from ``TPUDIST_ERROR_FILE`` (set by the launcher;
+``%r`` is replaced by the process id) and defaults to
+``/tmp/tpudist_error_<pid>.json``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+import traceback
+from typing import Callable
+
+
+def error_file_path(process_id: int) -> str:
+    template = os.environ.get("TPUDIST_ERROR_FILE", "/tmp/tpudist_error_%r.json")
+    return template.replace("%r", str(process_id))
+
+
+def record(fn: Callable) -> Callable:
+    """Decorate an entry point ``main``; on exception, write a structured
+    error record and re-raise."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — we re-raise
+            try:
+                pid = int(os.environ.get("TPUDIST_PROCESS_ID")
+                          or os.environ.get("RANK")
+                          or os.environ.get("SLURM_PROCID") or 0)
+            except ValueError:
+                pid = 0
+            payload = {
+                "process_id": pid,
+                "pid": os.getpid(),
+                "timestamp": time.time(),
+                "exc_type": type(e).__name__,
+                "message": str(e),
+                "traceback": traceback.format_exc(),
+                "argv": sys.argv,
+            }
+            try:
+                with open(error_file_path(pid), "w") as f:
+                    json.dump(payload, f, indent=2)
+            except OSError:
+                pass
+            raise
+
+    return wrapper
