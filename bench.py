@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload: the reference's implicit benchmark definition (BASELINE.md —
+the reference publishes no numbers, so this harness establishes them):
+the `demo.py` hot loop — two ToyMLPs, Adam(1e-3), batch 256 per chip,
+data-parallel over all local devices — measured as samples/sec/chip.
+
+Since the reference's published baseline is empty, ``vs_baseline`` is
+reported against this repo's own recorded north-star figure when present
+(``BENCH_BASELINE.json``), else 1.0 (we ARE the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import optax
+
+
+def main() -> None:
+    from tpudist.runtime.mesh import data_parallel_mesh
+    from tpudist.train import init_model_states, make_multi_model_train_step
+    from tpudist.train.step import batch_sharding
+    from tpudist.models import create_toy_model
+
+    n_chips = jax.local_device_count()
+    mesh = data_parallel_mesh()
+
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    mx, px = create_toy_model(kx)
+    my, py = create_toy_model(ky)
+    models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+    tx = optax.adam(1e-3)
+    states = init_model_states(models, tx)
+    step = make_multi_model_train_step({k: f for k, (f, _) in models.items()}, tx, mesh)
+
+    batch = 256 * n_chips  # reference: batch 256 per rank (demo.py:145)
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal(batch).astype(np.float32)
+    x = np.stack([v, v], axis=1)
+    y = (0.5 * rng.standard_normal(batch).astype(np.float32) + v**2)[:, None]
+    bs = batch_sharding(mesh)
+    gx, gy = jax.device_put(x, bs), jax.device_put(y, bs)
+
+    # warmup / compile
+    for _ in range(10):
+        states, losses = step(states, gx, gy)
+    jax.block_until_ready(losses)
+
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        states, losses = step(states, gx, gy)
+    jax.block_until_ready(losses)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * iters / dt
+    per_chip = samples_per_sec / n_chips
+
+    baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
+    vs = 1.0
+    if baseline_path.exists():
+        try:
+            recorded = json.loads(baseline_path.read_text()).get("value")
+            if recorded:
+                vs = per_chip / recorded
+        except Exception:
+            pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "toy_mlp_samples_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

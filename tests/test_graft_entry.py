@@ -1,0 +1,24 @@
+"""The driver contract: ``entry()`` compiles single-chip; ``dryrun_multichip``
+compiles + executes the full training step over an N-device mesh."""
+
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.shape == (256, 1)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_dryrun_multichip(n):
+    graft.dryrun_multichip(n)
