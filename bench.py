@@ -23,9 +23,10 @@ import optax
 
 
 def main() -> None:
+    from jax.sharding import NamedSharding, PartitionSpec
+
     from tpudist.runtime.mesh import data_parallel_mesh
-    from tpudist.train import init_model_states, make_multi_model_train_step
-    from tpudist.train.step import batch_sharding
+    from tpudist.train import init_model_states, make_scanned_train_step
     from tpudist.models import create_toy_model
 
     n_chips = jax.local_device_count()
@@ -37,29 +38,38 @@ def main() -> None:
     models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
     tx = optax.adam(1e-3)
     states = init_model_states(models, tx)
-    step = make_multi_model_train_step({k: f for k, (f, _) in models.items()}, tx, mesh)
+    # The framework hot path: device-cached dataset + scanned window
+    # (what run_training uses for the reference workload).
+    chunk_step = make_scanned_train_step(
+        {k: f for k, (f, _) in models.items()}, tx, mesh
+    )
 
     batch = 256 * n_chips  # reference: batch 256 per rank (demo.py:145)
+    window = 32            # TrainLoopConfig.sync_every default
+    from tpudist.data import make_toy_data
+
+    data = make_toy_data(seed=0)  # the 512-sample reference dataset
+    n_samples = len(data)
     rng = np.random.default_rng(0)
-    v = rng.standard_normal(batch).astype(np.float32)
-    x = np.stack([v, v], axis=1)
-    y = (0.5 * rng.standard_normal(batch).astype(np.float32) + v**2)[:, None]
-    bs = batch_sharding(mesh)
-    gx, gy = jax.device_put(x, bs), jax.device_put(y, bs)
+    repl = NamedSharding(mesh, PartitionSpec())
+    x_all, y_all = jax.device_put(data.x, repl), jax.device_put(data.y, repl)
+    idx = jax.device_put(
+        rng.integers(0, n_samples, size=(window, batch)).astype(np.int32), repl
+    )
 
     # warmup / compile
-    for _ in range(10):
-        states, losses = step(states, gx, gy)
+    for _ in range(3):
+        states, losses = chunk_step(states, x_all, y_all, idx)
     jax.block_until_ready(losses)
 
-    iters = 200
+    chunks = 32
     t0 = time.perf_counter()
-    for _ in range(iters):
-        states, losses = step(states, gx, gy)
+    for _ in range(chunks):
+        states, losses = chunk_step(states, x_all, y_all, idx)
     jax.block_until_ready(losses)
     dt = time.perf_counter() - t0
 
-    samples_per_sec = batch * iters / dt
+    samples_per_sec = batch * window * chunks / dt
     per_chip = samples_per_sec / n_chips
 
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"

@@ -9,7 +9,12 @@ import optax
 from tpudist.comm.collectives import MetricBackend
 from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
 from tpudist.models import create_toy_model
-from tpudist.train import TrainLoopConfig, init_model_states, make_multi_model_train_step
+from tpudist.train import (
+    TrainLoopConfig,
+    init_model_states,
+    make_multi_model_train_step,
+    make_scanned_train_step,
+)
 from tpudist.utils import init_metrics
 
 
@@ -49,9 +54,14 @@ def build_training(args, mesh, *, state_sharding_fn=None):
     if state_sharding_fn is not None:
         state_sharding = state_sharding_fn(mesh, states)
         states = jax.device_put(states, state_sharding)
+    apply_fns = {k: f for k, (f, _) in models.items()}
     step = make_multi_model_train_step(
-        {k: f for k, (f, _) in models.items()}, tx, mesh,
-        state_sharding=state_sharding,
+        apply_fns, tx, mesh, state_sharding=state_sharding
+    )
+    # Chunked variant for the device-cached fast path (the toy dataset always
+    # fits in HBM); run_training picks it when the shard shape allows.
+    chunk_step = make_scanned_train_step(
+        apply_fns, tx, mesh, state_sharding=state_sharding
     )
     loader = build_loader(args, seed=args.seed)
     loop_cfg = TrainLoopConfig(
@@ -59,7 +69,7 @@ def build_training(args, mesh, *, state_sharding_fn=None):
         log_every=args.log_every,
         metric_backend=MetricBackend(args.backend),
     )
-    return states, step, loader, loop_cfg
+    return states, step, loader, loop_cfg, chunk_step
 
 
 def build_logger(args, default_group: str):
@@ -68,3 +78,36 @@ def build_logger(args, default_group: str):
         group=args.group or default_group,
         dry_run=args.dry_run,
     )
+
+
+def build_checkpointing(args, states):
+    """Checkpoint manager + resume position from the shared CLI contract
+    (``--checkpoint_dir/--checkpoint_every/--resume``; dir defaults to the
+    reference's ``${scratch_dir}/${exp_name}/checkpoints`` when env-set).
+
+    Returns ``(ckpt_manager_or_None, states, start_iteration)``.
+    """
+    import os
+
+    from tpudist.checkpoint import CheckpointConfig, CheckpointManager, checkpoint_dir_for
+    from tpudist.checkpoint.manager import abstract_like
+
+    directory = args.checkpoint_dir
+    if directory is None and (args.checkpoint_every > 0 or args.resume):
+        if "scratch_dir" in os.environ or "exp_name" in os.environ:
+            directory = str(checkpoint_dir_for())
+    if directory is None:
+        if args.resume:
+            raise SystemExit(
+                "--resume needs a checkpoint location: pass --checkpoint_dir "
+                "or export scratch_dir/exp_name (launcher contract)"
+            )
+        return None, states, 0
+    mgr = CheckpointManager(
+        CheckpointConfig(directory=directory, save_every=args.checkpoint_every)
+    )
+    start = 0
+    if args.resume and mgr.latest_step is not None:
+        states, meta = mgr.restore(abstract_like(states))
+        start = int(meta.get("iteration", 0))
+    return mgr, states, start

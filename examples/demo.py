@@ -20,7 +20,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from common import build_logger, build_training  # noqa: E402
+from common import build_checkpointing, build_logger, build_training  # noqa: E402
 
 from tpudist.config import get_args  # noqa: E402
 from tpudist.runtime import (  # noqa: E402
@@ -46,10 +46,19 @@ def main() -> None:
     describe_runtime(ctx, local_seed)
 
     mesh = data_parallel_mesh()
-    states, step, loader, loop_cfg = build_training(args, mesh)
+    states, step, loader, loop_cfg, chunk_step = build_training(args, mesh)
     logger = build_logger(args, default_group="demo_dp")
+    ckpt, states, start = build_checkpointing(args, states)
 
-    states, losses = run_training(states, step, loader, mesh, logger, loop_cfg)
+    from tpudist.utils import trace
+
+    with trace(args.profile_dir):
+        states, losses = run_training(
+            states, step, loader, mesh, logger, loop_cfg,
+            ckpt=ckpt, start_iteration=start, chunk_step_fn=chunk_step,
+        )
+    if ckpt is not None:
+        ckpt.close()
     print(f"[rank {ctx.process_id}] final losses: {losses}")
 
     # teardown ordering parity (demo.py:130-136,177-178): metrics logger is

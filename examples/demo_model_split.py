@@ -56,11 +56,11 @@ def main() -> None:
     describe_runtime(ctx, local_seed)
 
     mesh = data_model_mesh(model_size=args.model_parallel)
-    states, step, loader, loop_cfg = build_training(
+    states, step, loader, loop_cfg, chunk_step = build_training(
         args, mesh, state_sharding_fn=split_state_sharding
     )
     logger = build_logger(args, default_group="demo_model_split")
-    states, losses = run_training(states, step, loader, mesh, logger, loop_cfg)
+    states, losses = run_training(states, step, loader, mesh, logger, loop_cfg, chunk_step_fn=chunk_step)
     print(f"[rank {ctx.process_id}] final losses: {losses}")
     shutdown()
 

@@ -43,8 +43,8 @@ def main() -> None:
     describe_runtime(ctx, local_seed)
 
     mesh = data_parallel_mesh()
-    states, step, loader, loop_cfg = build_training(args, mesh)
-    states, losses = run_training(states, step, loader, mesh, logger=None, config=loop_cfg)
+    states, step, loader, loop_cfg, chunk_step = build_training(args, mesh)
+    states, losses = run_training(states, step, loader, mesh, logger=None, config=loop_cfg, chunk_step_fn=chunk_step)
     rank_print(f"final losses: {losses}")
     shutdown()
 

@@ -1,0 +1,30 @@
+"""Profiling: trace capture writes a per-process trace dir; StageTimer sums."""
+
+import time
+
+from tpudist.utils import StageTimer, trace
+
+
+def test_trace_noop_when_none():
+    with trace(None):
+        pass
+
+
+def test_trace_writes_profile(tmp_path, dp_mesh):
+    import jax
+    import jax.numpy as jnp
+
+    with trace(str(tmp_path / "prof")):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    proc_dir = tmp_path / "prof" / "process_0"
+    assert proc_dir.exists()
+    assert any(proc_dir.rglob("*"))  # trace events written
+
+
+def test_stage_timer():
+    t = StageTimer()
+    with t.phase("stage"):
+        time.sleep(0.01)
+    with t.phase("stage"):
+        pass
+    assert t.durations["stage"] >= 0.01

@@ -1,0 +1,22 @@
+"""Checkpoint / resume subsystem.
+
+The reference *provisions* for checkpoints but ships no checkpoint code
+(SURVEY.md §5.4): ``job_submitter.sh:157-159`` creates
+``${scratch_dir}/${exp_name}/checkpoints`` and the sweep template passes
+``--checkpoint_every 1000 --checkpoint_dir …`` (``sweeper.yml:26-31``) to a
+hypothetical user program.  This module supplies the real capability the
+scaffolding implies, TPU-natively via Orbax:
+
+- the same directory contract (``<scratch_dir>/<exp_name>/checkpoints``),
+- multi-host-safe save/restore of the full train state (params + optimizer
+  state + data-loader position), sharded arrays written per-host,
+- retention policy + atomic finalization (Orbax),
+- restore-to-sharding: the state comes back laid out for the current mesh,
+  so a job may resume on a different topology.
+"""
+
+from tpudist.checkpoint.manager import (  # noqa: F401
+    CheckpointConfig,
+    CheckpointManager,
+    checkpoint_dir_for,
+)

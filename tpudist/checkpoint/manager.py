@@ -1,0 +1,129 @@
+"""Orbax-backed checkpoint manager for tpudist train states.
+
+Design: the unit of checkpoint is ``(states, meta)`` where ``states`` is the
+``Dict[str, ModelState]`` pytree the compiled step consumes (params + opt
+state per model) and ``meta`` carries loop position (iteration, epoch) plus
+the base seed — everything needed for a bit-faithful resume of the
+reference's training loop (fixed iteration budget + ``set_epoch`` reshuffle,
+``demo.py:88,96-98,126-128``).
+
+Multi-host: Orbax's CheckpointManager coordinates across processes through
+the JAX distributed client; each host writes its shards of sharded arrays.
+Restore takes an ``abstract_state`` (shapes/dtypes/shardings) so the state
+lands already laid out for the *current* mesh — topology-change resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str
+    save_every: int = 1000          # sweeper.yml:26-31 --checkpoint_every
+    max_to_keep: Optional[int] = 3
+    async_save: bool = True
+
+
+def checkpoint_dir_for(
+    scratch_dir: Optional[str] = None, exp_name: Optional[str] = None
+) -> Path:
+    """The reference's directory contract (``job_submitter.sh:157-159``):
+    ``${scratch_dir}/${exp_name}/checkpoints``, with env-var fallbacks on
+    the same names the launcher exports (SURVEY.md §5.6)."""
+    scratch = scratch_dir or os.environ.get("scratch_dir", "scratch")
+    exp = exp_name or os.environ.get("exp_name", "default_exp")
+    return Path(scratch) / exp / "checkpoints"
+
+
+class CheckpointManager:
+    """Save/restore ``(states, meta)`` with retention + atomicity via Orbax."""
+
+    def __init__(self, config: CheckpointConfig):
+        import orbax.checkpoint as ocp
+
+        self.config = config
+        path = Path(config.directory).resolve()
+        if jax.process_index() == 0:
+            path.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=config.max_to_keep,
+            enable_async_checkpointing=config.async_save,
+        )
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(path, options=options)
+
+    # -- save ---------------------------------------------------------------
+
+    def maybe_save(self, step: int, states: Any, meta: dict) -> bool:
+        """Save if ``step`` is on the cadence; returns whether a save started."""
+        if self.config.save_every <= 0 or step % self.config.save_every != 0:
+            return False
+        return self.save(step, states, meta)
+
+    def save(self, step: int, states: Any, meta: dict) -> bool:
+        ocp = self._ocp
+        if step in self._mgr.all_steps():
+            return False  # idempotent: cadence save + final save may collide
+        return self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(states),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+
+    # -- restore ------------------------------------------------------------
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, abstract_state: Any, step: Optional[int] = None
+    ) -> Tuple[Any, dict]:
+        """Restore ``(states, meta)``.
+
+        ``abstract_state`` is a pytree of ``jax.ShapeDtypeStruct`` (with
+        shardings) matching the saved state — build it from a freshly
+        initialized state via :func:`abstract_like`.
+        """
+        ocp = self._ocp
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.config.directory}"
+            )
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        return restored["state"], dict(restored["meta"])
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def abstract_like(states: Any) -> Any:
+    """``jax.ShapeDtypeStruct`` pytree (with shardings) mirroring ``states`` —
+    the restore target that tells Orbax the current mesh layout."""
+
+    def to_abstract(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(to_abstract, states)

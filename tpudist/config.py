@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace into this directory")
     p.add_argument("--checkpoint_dir", default=None, type=str)
     p.add_argument("--checkpoint_every", default=0, type=int)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --checkpoint_dir")
     return p
 
 

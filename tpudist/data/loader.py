@@ -56,8 +56,13 @@ class ShardedLoader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        return self.iter_from(0)
+
+    def iter_from(self, skip_batches: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Iterate the epoch starting ``skip_batches`` in — index-level skip,
+        nothing is materialized for the skipped prefix (resume fast-forward)."""
         idx = epoch_indices(self.plan, self._epoch)
-        for start in range(0, len(idx), self.batch_size):
+        for start in range(skip_batches * self.batch_size, len(idx), self.batch_size):
             sel = idx[start : start + self.batch_size]
             if len(sel) < self.batch_size and self.plan.drop_last:
                 return

@@ -2,6 +2,7 @@ from tpudist.train.step import (  # noqa: F401
     ModelState,
     init_model_states,
     make_multi_model_train_step,
+    make_scanned_train_step,
     mse_loss,
 )
 from tpudist.train.loop import TrainLoopConfig, run_training  # noqa: F401

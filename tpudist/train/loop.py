@@ -21,7 +21,7 @@ from typing import Callable, Dict, Optional
 
 import jax
 
-from tpudist.comm.collectives import MetricBackend, batch_weighted_loss_mean, barrier
+from tpudist.comm.collectives import MetricBackend, barrier
 from tpudist.data.loader import ShardedLoader, shard_batch
 from tpudist.train.step import ModelState, batch_sharding
 from tpudist.utils.metrics import MetricsLogger
@@ -34,6 +34,69 @@ class TrainLoopConfig:
     metric_backend: MetricBackend = MetricBackend.ICI
     metric_prefix: str = "loss/"
     progress_bar: bool = True
+    # Device→host syncs are batched: losses are fetched (and, for the HOST
+    # backend, cross-process reduced) once per ``sync_every`` iterations
+    # instead of per step.  Log *rows* stay per-iteration (reference
+    # semantics, demo.py:119-121); only the blocking fetch is deferred, so
+    # the device stays ahead of the host (SURVEY.md §3.1 "hot spots").
+    sync_every: int = 32
+    # Device-cached scan path: opt-out plus an HBM budget — the dataset is
+    # replicated per device, so only datasets under this cap take the path.
+    device_cache: bool = True
+    device_cache_max_bytes: int = 256 * 1024 * 1024
+
+
+def _make_pbar(config: TrainLoopConfig, initial: int = 0):
+    if not config.progress_bar or jax.process_index() != 0:
+        return None
+    try:
+        from tqdm import tqdm
+    except ImportError:
+        return None
+    return tqdm(total=config.total_iterations, desc="train", initial=initial)
+
+
+class _DeferredMetrics:
+    """Collects per-iteration device losses; flushes them to the logger in
+    batches — one blocking transfer per ``sync_every`` steps, identical
+    logged values."""
+
+    def __init__(self, logger, config: TrainLoopConfig):
+        self.logger = logger
+        self.config = config
+        self._pending = []  # (iteration, batch_size, losses_device_dict)
+
+    def add(self, iteration: int, batch_size: int, losses) -> None:
+        self._pending.append((iteration, batch_size, losses))
+        if len(self._pending) >= max(1, self.config.sync_every):
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        # One transfer for the whole window.
+        fetched = jax.device_get([losses for _, _, losses in pending])
+        if self.config.metric_backend == MetricBackend.HOST:
+            from tpudist.comm.collectives import host_allreduce_sum
+            import numpy as np
+
+            keys = sorted(fetched[0])
+            local = np.array(
+                [[float(f[k]) * bs for k in keys] for f, (_, bs, _) in zip(fetched, pending)],
+                dtype=np.float64,
+            )
+            weights = np.array([[bs] * len(keys) for _, bs, _ in pending], np.float64)
+            num, den = host_allreduce_sum((local, weights))
+            fetched = [
+                {k: num[i, j] / den[i, j] for j, k in enumerate(keys)}
+                for i in range(len(pending))
+            ]
+        for (iteration, _, _), vals in zip(pending, fetched):
+            self.logger.log(
+                {f"{self.config.metric_prefix}{k}": float(v) for k, v in vals.items()},
+                commit=True,
+            )
 
 
 def run_training(
@@ -44,47 +107,78 @@ def run_training(
     logger: Optional[MetricsLogger] = None,
     config: Optional[TrainLoopConfig] = None,
     per_process_batch_size: Optional[int] = None,
+    ckpt=None,
+    start_iteration: int = 0,
+    chunk_step_fn: Optional[Callable] = None,
 ):
-    """Run to the iteration budget; returns ``(final_states, final_losses)``."""
+    """Run to the iteration budget; returns ``(final_states, final_losses)``.
+
+    ``ckpt`` (a :class:`tpudist.checkpoint.CheckpointManager`) enables
+    periodic saves on its ``save_every`` cadence; pass ``start_iteration``
+    (from restored meta) to resume — the loop fast-forwards through the
+    deterministic epoch shuffle so the data stream continues exactly where
+    the saved run left off (set_epoch semantics, ``demo.py:96-98``).
+
+    ``chunk_step_fn`` (from :func:`make_scanned_train_step`) switches to the
+    device-cached scan path when the dataset fits in HBM: the whole dataset
+    is uploaded once, ``sync_every`` iterations run as one XLA program, and
+    only tiny index arrays cross the host↔device boundary per window.
+    Numerics and log rows are identical to the per-step path.
+    """
     config = config or TrainLoopConfig()
+    if (
+        chunk_step_fn is not None
+        and config.device_cache
+        and loader.plan.mode == "distributed"
+        and loader.plan.samples_per_shard % loader.batch_size == 0
+        and loader.dataset.x.nbytes + loader.dataset.y.nbytes
+        <= config.device_cache_max_bytes
+    ):
+        return _run_scanned(
+            states, chunk_step_fn, loader, mesh, logger, config, ckpt, start_iteration
+        )
     sharding = batch_sharding(mesh)
-    iteration = 0
-    epoch = 0
-    pbar = None
-    if config.progress_bar and jax.process_index() == 0:
-        try:
-            from tqdm import tqdm
+    # resume fast-forward: whole epochs are skipped arithmetically; only the
+    # partial first epoch's batches are skipped via the loader (index-level,
+    # nothing materialized).
+    batches_per_epoch = len(loader)
+    epoch = start_iteration // batches_per_epoch
+    iteration = epoch * batches_per_epoch
+    skip_in_epoch = start_iteration - iteration
+    pbar = _make_pbar(config, initial=start_iteration)
 
-            pbar = tqdm(total=config.total_iterations, desc="train")
-        except ImportError:
-            pbar = None
-
+    deferred = _DeferredMetrics(logger, config) if logger is not None else None
     last_losses = None
     while iteration < config.total_iterations:
         loader.set_epoch(epoch)
-        for x, y in loader:
+        iteration += skip_in_epoch
+        skip, skip_in_epoch = skip_in_epoch, 0
+        for x, y in loader.iter_from(skip):
             if iteration >= config.total_iterations:
                 break
             bs = x.shape[0]
             gx, gy = shard_batch((x, y), sharding)
             states, losses = step_fn(states, gx, gy)
             last_losses = losses
-            if logger is not None and iteration % config.log_every == 0:
-                reduced = batch_weighted_loss_mean(
-                    losses, bs, backend=config.metric_backend
-                )
-                logger.log(
-                    {f"{config.metric_prefix}{k}": v for k, v in reduced.items()},
-                    commit=True,
-                )
+            if deferred is not None and iteration % config.log_every == 0:
+                deferred.add(iteration, bs, losses)
             iteration += 1
+            if ckpt is not None:
+                ckpt.maybe_save(
+                    iteration, states, {"iteration": iteration, "epoch": epoch}
+                )
             if pbar is not None:
                 pbar.update(1)
         epoch += 1
 
     if pbar is not None:
         pbar.close()
+    if ckpt is not None:
+        ckpt.save(iteration, states, {"iteration": iteration, "epoch": epoch})
+        ckpt.wait_until_finished()
     # Teardown ordering parity (demo.py:130-136): metrics first, then barrier.
+    if deferred is not None:
+        deferred.flush()
     if logger is not None:
         logger.finish()
     barrier("end_of_training")
@@ -94,3 +188,118 @@ def run_training(
         else {}
     )
     return states, final_losses
+
+
+def _run_scanned(
+    states, chunk_step_fn, loader, mesh, logger, config, ckpt, start_iteration
+):
+    """Device-cached scan loop (see ``run_training``).
+
+    The per-epoch global permutation (DistributedSampler/set_epoch
+    semantics) is precomputed host-side exactly as the host path derives
+    it — global batch ``t`` of epoch ``e`` is the concatenation of every
+    shard's ``t``-th batch, matching the layout
+    ``make_array_from_process_local_data`` gives the host path — and only
+    the int32 index windows are shipped to the device.
+    """
+    import dataclasses as _dc
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpudist.data.sharding import epoch_indices
+
+    plan = loader.plan
+    B = loader.batch_size
+    repl = NamedSharding(mesh, PartitionSpec())
+    x_np, y_np = loader.dataset.x, loader.dataset.y
+    x_all = jax.make_array_from_callback(x_np.shape, repl, lambda i: x_np[i])
+    y_all = jax.make_array_from_callback(y_np.shape, repl, lambda i: y_np[i])
+
+    shard_plans = [_dc.replace(plan, shard_id=i) for i in range(plan.num_shards)]
+    batches_per_epoch = plan.samples_per_shard // B
+
+    def global_batches(epoch):
+        per_shard = [epoch_indices(p, epoch) for p in shard_plans]
+        for t in range(batches_per_epoch):
+            yield np.concatenate([s[t * B : (t + 1) * B] for s in per_shard])
+
+    pbar = _make_pbar(config, initial=start_iteration)
+
+    total = config.total_iterations
+    save_every = ckpt.config.save_every if ckpt is not None else 0
+    iteration = start_iteration
+    epoch = start_iteration // batches_per_epoch
+    batch_in_epoch = start_iteration % batches_per_epoch
+    gen = None
+    pending_losses = []  # (first_iteration, device dict of (K,) losses)
+    last_losses = None
+
+    while iteration < total:
+        # window length: sync cadence, save cadence, and budget boundaries
+        k = min(max(1, config.sync_every), total - iteration)
+        if save_every > 0:
+            to_save = save_every - (iteration % save_every)
+            k = min(k, to_save)
+        idx_rows = []
+        while len(idx_rows) < k:
+            if gen is None:
+                gen = global_batches(epoch)
+                for _ in range(batch_in_epoch):
+                    next(gen)
+                batch_in_epoch = 0
+            for row in gen:
+                idx_rows.append(row)
+                if len(idx_rows) == k:
+                    break
+            else:
+                gen = None
+                epoch += 1
+        idx = jax.device_put(np.stack(idx_rows).astype(np.int32), repl)
+        states, losses = chunk_step_fn(states, x_all, y_all, idx)
+        last_losses = losses
+        if logger is not None:
+            pending_losses.append((iteration, losses))
+            if len(pending_losses) * k >= config.sync_every:
+                _flush_scanned(pending_losses, logger, config)
+                pending_losses = []
+        iteration += len(idx_rows)
+        if ckpt is not None:
+            ckpt.maybe_save(iteration, states, {"iteration": iteration, "epoch": epoch})
+        if pbar is not None:
+            pbar.update(len(idx_rows))
+
+    if pbar is not None:
+        pbar.close()
+    if ckpt is not None:
+        ckpt.save(iteration, states, {"iteration": iteration, "epoch": epoch})
+        ckpt.wait_until_finished()
+    if logger is not None:
+        _flush_scanned(pending_losses, logger, config)
+        logger.finish()
+    barrier("end_of_training")
+    final_losses = {}
+    if last_losses is not None:
+        fetched = jax.device_get(last_losses)
+        final_losses = {k_: float(v[-1]) for k_, v in fetched.items()}
+    return states, final_losses
+
+
+def _flush_scanned(pending, logger, config):
+    """Fetch queued (K,) loss windows in one transfer and emit per-iteration
+    log rows (values are already global means — computed over the globally
+    sharded batch inside the compiled window)."""
+    if not pending:
+        return
+    fetched = jax.device_get([losses for _, losses in pending])
+    for (first_it, _), window in zip(pending, fetched):
+        length = len(next(iter(window.values())))
+        for j in range(length):
+            if (first_it + j) % config.log_every == 0:
+                logger.log(
+                    {
+                        f"{config.metric_prefix}{name}": float(vals[j])
+                        for name, vals in window.items()
+                    },
+                    commit=True,
+                )

@@ -32,6 +32,7 @@ from tpudist.train.loop import TrainLoopConfig, run_training
 from tpudist.train.step import (
     init_model_states,
     make_multi_model_train_step,
+    make_scanned_train_step,
     mse_loss,
 )
 from tpudist.utils.metrics import MetricsLogger, init_metrics
@@ -113,6 +114,9 @@ class Trainer:
         step = make_multi_model_train_step(
             apply_fns, tx, mesh, loss_fn=module.loss, state_sharding=state_sharding
         )
+        chunk_step = make_scanned_train_step(
+            apply_fns, tx, mesh, loss_fn=module.loss, state_sharding=state_sharding
+        )
 
         logger: MetricsLogger = init_metrics(
             project=self.project, group=self.group or "trainer", dry_run=self.dry_run
@@ -123,7 +127,7 @@ class Trainer:
             metric_backend=self.metric_backend,
             progress_bar=self.progress_bar,
         )
-        states, losses = run_training(states, step, loader, mesh, logger, cfg)
+        states, losses = run_training(states, step, loader, mesh, logger, cfg, chunk_step_fn=chunk_step)
         self.final_states = states
         return losses
 

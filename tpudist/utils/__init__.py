@@ -1,1 +1,2 @@
 from tpudist.utils.metrics import MetricsLogger, init_metrics  # noqa: F401
+from tpudist.utils.profiling import StageTimer, trace  # noqa: F401

@@ -1,0 +1,52 @@
+"""Profiling / trace capture.
+
+The reference has no tracing at all (SURVEY.md §5.1 — its only timing is
+``time tar`` in staging scripts and tqdm throughput).  The TPU-native
+framework exposes XLA's first-class profiler as a flag: a trace window
+written per-process (TensorBoard/Perfetto-readable), plus a lightweight
+wall-clock timer for the staging-style host phases.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(profile_dir: Optional[str]) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace into ``profile_dir`` (no-op when
+    ``None``).  Multi-process: each process writes its own subdirectory, so
+    traces from all hosts land side by side on shared storage."""
+    if profile_dir is None:
+        yield
+        return
+    path = Path(profile_dir) / f"process_{jax.process_index()}"
+    path.mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(path))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StageTimer:
+    """Wall-clock phase timer (the host-side analog of the reference's
+    ``time tar`` staging timing) — records named phase durations."""
+
+    def __init__(self):
+        self.durations: dict = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.durations[name] = self.durations.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
