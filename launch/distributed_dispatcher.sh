@@ -1,0 +1,36 @@
+#!/bin/bash
+# In-allocation dispatcher — tpudist equivalent of
+# virtual_env_hpc_files/distributed_dispatcher.sh (reference B4, SURVEY.md
+# §2.2): resolves the node list and coordinator, then launches ONE tpurun
+# agent per node via srun (distributed_dispatcher.sh:19-34), each agent
+# forking chips_per_node workers with the TPUDIST_* env contract.
+set -euo pipefail
+
+cd "${source_dir:?}"
+
+nodes=($(scontrol show hostname "${SLURM_JOB_NODELIST}"))
+num_nodes="${#nodes[@]}"
+MASTER_ADDR="$(hostname)"
+MASTER_PORT="${MASTER_PORT:-2345}"
+coordinator="${MASTER_ADDR}:${MASTER_PORT}"
+chips="${chips_per_node:-1}"
+[[ "${chips}" -ge 1 ]] || chips=1
+
+# Per-job node-local scratch (standard_job.sh:13-16 PLAI pattern); cleaned
+# on exit even when a worker group fails (only when we created it ourselves).
+export TPUDIST_TMPDIR="${SLURM_TMPDIR:-/tmp/tpudist_${SLURM_JOB_ID}}"
+[[ -z "${SLURM_TMPDIR:-}" ]] && trap 'rm -rf "${TPUDIST_TMPDIR}"' EXIT
+
+echo "dispatcher: ${num_nodes} nodes, ${chips} chips/node, coordinator ${coordinator}"
+
+node_rank=0
+for node in "${nodes[@]}"; do
+  srun -w "${node}" -N1 -n1 \
+    python -m tpudist.launch \
+      --nprocs "${chips}" --nnodes "${num_nodes}" --node-rank "${node_rank}" \
+      --coordinator "${coordinator}" --run-id "${SLURM_JOB_ID}" \
+      ${staged_tarballs:+--stage-data "${staged_tarballs}"} \
+      -- ${cmd:?} &
+  node_rank=$((node_rank + 1))
+done
+wait   # distributed_dispatcher.sh:34 — backgrounded per-node sruns

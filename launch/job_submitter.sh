@@ -1,0 +1,132 @@
+#!/bin/bash
+# SLURM front door — tpudist equivalent of hpc_files/job_submitter.sh
+# (reference B1, SURVEY.md §2.2: flag parsing job_submitter.sh:21-141,
+# scratch/checkpoint dir provisioning :157-163, data tarballing :166-174,
+# job-type branching :254-293, env payload :305-308, confirm+sbatch :330-344).
+#
+# Usage:
+#   bash launch/job_submitter.sh -j {standard|distributed|sweep} [options]
+# Options:
+#   -j TYPE      job type: standard | distributed | sweep        (default standard)
+#   -c N         cpus per task                                   (default 4)
+#   -g N         accelerator chips per node                      (default 0)
+#   -N N         nodes                                           (default 1)
+#   -t TIME      walltime                                        (default 02:00:00)
+#   -m MEM       memory per node                                 (default 16G)
+#   -p PART      partition
+#   -a ACCT      account
+#   -d PATHS     comma-separated data dirs -> staged as tarballs
+#   -s DIR       scratch dir root             (default ${SCRATCH:-$HOME/scratch})
+#   -e NAME      experiment name              (default timestamped)
+#   -x FILE      experiment config file (one-line command; default
+#                launch/experiment_configurations.txt)
+#   -S FILE      sweep spec YAML (sweep jobs; default launch/sweeper.yml)
+#   -n           no-confirm (skip the interactive prompt)
+#   -h           help
+set -euo pipefail
+
+source_dir="$(pwd)"
+project_name="$(basename "${source_dir}")"
+
+job_type="standard"; cpus=4; gpus=0; nodes=1; walltime="02:00:00"; mem="16G"
+partition=""; account=""; data_paths=""
+scratch_dir="${SCRATCH:-$HOME/scratch}"
+exp_name="exp_$(date +%Y%m%d_%H%M%S)"
+exp_configs_path="launch/experiment_configurations.txt"
+sweep_spec="launch/sweeper.yml"
+confirm=1
+
+while getopts "j:c:g:N:t:m:p:a:d:s:e:x:S:nh" opt; do
+  case "${opt}" in
+    j) job_type="${OPTARG}" ;;
+    c) cpus="${OPTARG}" ;;
+    g) gpus="${OPTARG}" ;;
+    N) nodes="${OPTARG}" ;;
+    t) walltime="${OPTARG}" ;;
+    m) mem="${OPTARG}" ;;
+    p) partition="${OPTARG}" ;;
+    a) account="${OPTARG}" ;;
+    d) data_paths="${OPTARG}" ;;
+    s) scratch_dir="${OPTARG}" ;;
+    e) exp_name="${OPTARG}" ;;
+    x) exp_configs_path="${OPTARG}" ;;
+    S) sweep_spec="${OPTARG}" ;;
+    n) confirm=0 ;;
+    h) sed -n '2,30p' "$0"; exit 0 ;;
+    *) echo "unknown flag; -h for help" >&2; exit 2 ;;
+  esac
+done
+
+case "${job_type}" in standard|distributed|sweep) ;; *)
+  echo "job_submitter: -j must be standard|distributed|sweep" >&2; exit 2 ;; esac
+
+# Experiment workspace: checkpoints + output dirs (job_submitter.sh:157-163).
+exp_dir="${scratch_dir}/${project_name}/${exp_name}"
+mkdir -p "${exp_dir}/checkpoints" "${exp_dir}/hpc_outputs" "${exp_dir}/data"
+
+# Stage data as tarballs once (job_submitter.sh:166-174).
+staged=""
+if [[ -n "${data_paths}" ]]; then
+  IFS=',' read -ra paths <<< "${data_paths}"
+  for p in "${paths[@]}"; do
+    tb="${exp_dir}/data/$(basename "${p}").tar"
+    if [[ ! -f "${tb}" ]]; then
+      echo "staging ${p} -> ${tb}"
+      time tar -cf "${tb}" -C "$(dirname "${p}")" "$(basename "${p}")"
+    fi
+    staged="${staged:+${staged},}${tb}"
+  done
+fi
+
+# The one-line experiment command (job_submitter.sh:300).
+cmd="$(tr -d '\n\r\\' < "${exp_configs_path}")"
+
+# W&B credentials plumbing (job_submitter.sh:154-155,306): optional file.
+wandb_key=""
+[[ -f "${HOME}/wandb_credentials.txt" ]] && wandb_key="$(head -n1 "${HOME}/wandb_credentials.txt")"
+
+sbatch_cmd=(
+  --job-name="${project_name}-${exp_name}"
+  --time="${walltime}" --mem="${mem}" --nodes="${nodes}"
+  --output="${exp_dir}/hpc_outputs/%x-%j-%N.out"
+)
+[[ -n "${partition}" ]] && sbatch_cmd+=(--partition="${partition}")
+[[ -n "${account}"   ]] && sbatch_cmd+=(--account="${account}")
+[[ "${gpus}" -gt 0   ]] && sbatch_cmd+=(--gres="gpu:${gpus}")
+
+payload="ALL,cmd=${cmd},source_dir=${source_dir},scratch_dir=${scratch_dir}"
+payload+=",exp_name=${exp_name},project_name=${project_name}"
+payload+=",staged_tarballs=${staged},WANDB_API_KEY=${wandb_key}"
+
+case "${job_type}" in
+  sweep)
+    # Array job sized by the sweep grid (job_submitter.sh:259-271 pattern,
+    # but the grid size comes from the spec — no interactive prompt needed).
+    n_sweeps="$(python -m tpudist.launch.sweep count "${sweep_spec}")"
+    echo "sweep grid size: ${n_sweeps}"
+    sbatch_cmd+=(--array="0-$((n_sweeps - 1))%10" --cpus-per-task="${cpus}" --ntasks-per-node=1)
+    [[ "${sweep_spec}" = /* ]] || sweep_spec="${source_dir}/${sweep_spec}"
+    payload+=",sweep_spec=${sweep_spec}"
+    hpc_file="launch/standard_job.sh"
+    ;;
+  distributed)
+    # torchrun-style: ONE agent task per node that forks the workers itself
+    # (job_submitter.sh:290-291: ntasks-per-node=1, cpus *= chips).
+    chips=$(( gpus > 0 ? gpus : 1 ))
+    sbatch_cmd+=(--ntasks-per-node=1 --cpus-per-task="$((cpus * chips))")
+    payload+=",chips_per_node=${chips}"
+    hpc_file="launch/distributed_dispatcher.sh"
+    ;;
+  standard)
+    sbatch_cmd+=(--ntasks-per-node=1 --cpus-per-task="${cpus}")
+    hpc_file="launch/standard_job.sh"
+    ;;
+esac
+sbatch_cmd+=(--export="${payload}")
+
+echo "sbatch ${sbatch_cmd[*]} ${hpc_file}"
+if [[ "${confirm}" -eq 1 ]]; then
+  read -r -p "submit? [y/N] " yn   # confirm prompt (job_submitter.sh:330-343)
+  [[ "${yn}" == "y" || "${yn}" == "Y" ]] || { echo "aborted"; exit 0; }
+fi
+sbatch "${sbatch_cmd[@]}" "${hpc_file}"

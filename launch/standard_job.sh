@@ -1,0 +1,27 @@
+#!/bin/bash
+# Single-node batch job — tpudist equivalent of
+# virtual_env_hpc_files/standard_job.sh (reference B5): node-local scratch,
+# data staging, run the experiment command (or one sweep index for array
+# jobs), cleanup.
+set -euo pipefail
+
+cd "${source_dir:?}"
+export TPUDIST_TMPDIR="${SLURM_TMPDIR:-/tmp/tpudist_${SLURM_JOB_ID:-$$}}"
+mkdir -p "${TPUDIST_TMPDIR}"
+# Cleanup must survive a failing cmd (standard_job.sh:29-31 discipline, but
+# via EXIT trap so set -e cannot skip it). Never remove a scheduler-owned
+# SLURM_TMPDIR — only the /tmp dir we created ourselves.
+[[ -z "${SLURM_TMPDIR:-}" ]] && trap 'rm -rf "${TPUDIST_TMPDIR}"' EXIT
+
+if [[ -n "${staged_tarballs:-}" ]]; then
+  IFS=',' read -ra tbs <<< "${staged_tarballs}"
+  for tb in "${tbs[@]}"; do time tar -xf "${tb}" -C "${TPUDIST_TMPDIR}"; done
+fi
+
+if [[ -n "${sweep_spec:-}" ]]; then
+  # One array task = one sweep configuration (§3.5 sweep path).
+  python -m tpudist.launch.sweep agent "${sweep_spec}" \
+    --index "${SLURM_ARRAY_TASK_ID:-0}"
+else
+  ${cmd:?}
+fi

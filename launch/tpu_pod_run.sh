@@ -1,0 +1,20 @@
+#!/bin/bash
+# TPU-pod launcher — the non-SLURM path. Where the reference drives multi-node
+# jobs with per-node srun (distributed_dispatcher.sh:25-34), Cloud TPU pods use
+# one gcloud command fanned out to every worker VM (--worker=all); each worker
+# runs a tpurun agent that starts one process per host (the standard JAX
+# multi-controller shape: 1 process/host, all local chips visible to it).
+#
+# Usage:
+#   bash launch/tpu_pod_run.sh TPU_NAME ZONE "python examples/demo.py --dry_run"
+set -euo pipefail
+
+tpu_name="${1:?tpu name}"; zone="${2:?zone}"; shift 2
+cmd="$*"
+[[ "${cmd}" == python* ]] || { echo "command must start with python" >&2; exit 2; }
+
+# On TPU VMs jax.distributed.initialize() discovers coordinator/world from the
+# TPU metadata server, so no TPUDIST_*/MASTER_* plumbing is needed — the
+# bootstrap's priority chain falls through to the single-arg initialize path.
+gcloud compute tpus tpu-vm ssh "${tpu_name}" --zone "${zone}" --worker=all \
+  --command "cd ~/$(basename "$(pwd)") && ${cmd}"

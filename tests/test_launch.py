@@ -1,0 +1,219 @@
+"""Launch-layer tests: tpurun agent (spawn/env-contract/restart/crash
+records), data staging, and sweep expansion.
+
+The reference verified its launcher only by manual cluster runs (SURVEY.md
+§4); here the agent is exercised for real with subprocess worker groups on
+CPU.  True multi-process rendezvous (jax.distributed over localhost) is in
+``test_multiprocess.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpudist.launch.run import main as tpurun_main
+from tpudist.launch.staging import create_tarball, extract_tarballs
+from tpudist.launch.sweep import SweepSpec
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _write_worker(tmp_path: Path, body: str) -> Path:
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+def _clean_env(monkeypatch):
+    for var in list(os.environ):
+        if var.startswith("TPUDIST_") or var in ("RANK", "WORLD_SIZE", "MASTER_ADDR"):
+            monkeypatch.delenv(var, raising=False)
+
+
+class TestTpurun:
+    def test_env_contract(self, tmp_path, monkeypatch):
+        """Workers see the full TPUDIST_* contract with correct ranks."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import json, os, sys
+            keys = ["TPUDIST_NUM_PROCESSES", "TPUDIST_PROCESS_ID",
+                    "TPUDIST_LOCAL_RANK", "TPUDIST_LOCAL_WORLD_SIZE",
+                    "TPUDIST_COORDINATOR", "TPUDIST_RUN_ID", "TPUDIST_TMPDIR"]
+            rec = {k: os.environ.get(k) for k in keys}
+            out = os.path.join(os.environ["OUT_DIR"],
+                               f"rank{rec['TPUDIST_PROCESS_ID']}.json")
+            json.dump(rec, open(out, "w"))
+        """)
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        monkeypatch.setenv("OUT_DIR", str(out_dir))
+        rc = tpurun_main(["--nprocs", "3", "--tmpdir", str(tmp_path / "scratch"),
+                          "--", sys.executable, str(worker)])
+        assert rc == 0
+        recs = {json.load(open(f))["TPUDIST_PROCESS_ID"]: json.load(open(f))
+                for f in out_dir.glob("rank*.json")}
+        assert sorted(recs) == ["0", "1", "2"]
+        for rank, rec in recs.items():
+            assert rec["TPUDIST_NUM_PROCESSES"] == "3"
+            assert rec["TPUDIST_LOCAL_RANK"] == rank
+            assert rec["TPUDIST_LOCAL_WORLD_SIZE"] == "3"
+            assert rec["TPUDIST_COORDINATOR"].startswith("127.0.0.1:")
+
+    def test_node_rank_offsets_global_rank(self, tmp_path, monkeypatch):
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import os, pathlib
+            pathlib.Path(os.environ["OUT_DIR"],
+                         "g" + os.environ["TPUDIST_PROCESS_ID"]).touch()
+        """)
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        monkeypatch.setenv("OUT_DIR", str(out_dir))
+        rc = tpurun_main(["--nprocs", "2", "--nnodes", "2", "--node-rank", "1",
+                          "--coordinator", "127.0.0.1:12399",
+                          "--tmpdir", str(tmp_path / "s"),
+                          "--", sys.executable, str(worker)])
+        assert rc == 0
+        assert sorted(p.name for p in out_dir.iterdir()) == ["g2", "g3"]
+
+    def test_restart_then_success(self, tmp_path, monkeypatch):
+        """A worker that fails on attempt 0 and succeeds on attempt 1:
+        tpurun must restart the group (torchrun --max_restarts parity) and
+        exit 0, leaving a crash record from the first attempt."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import os
+            from tpudist.utils.record import record
+
+            @record
+            def main():
+                if os.environ["TPUDIST_RESTART_COUNT"] == "0":
+                    raise RuntimeError("injected first-attempt failure")
+
+            main()
+        """)
+        err_dir = tmp_path / "errors"
+        monkeypatch.setenv("PYTHONPATH", str(REPO))
+        rc = tpurun_main(["--nprocs", "2", "--max-restarts", "2",
+                          "--restart-backoff", "0.05",
+                          "--tmpdir", str(tmp_path / "s"),
+                          "--error-dir", str(err_dir),
+                          "--", sys.executable, str(worker)])
+        assert rc == 0
+        records = list(err_dir.glob("error_attempt0_rank*.json"))
+        assert records, "first attempt must leave crash records"
+        rec = json.load(open(records[0]))
+        assert rec["exc_type"] == "RuntimeError"
+        assert "injected" in rec["message"]
+
+    def test_exhausted_restarts_fail(self, tmp_path, monkeypatch):
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, "raise SystemExit(7)\n")
+        rc = tpurun_main(["--nprocs", "1", "--max-restarts", "1",
+                          "--restart-backoff", "0.01",
+                          "--tmpdir", str(tmp_path / "s"),
+                          "--", sys.executable, str(worker)])
+        assert rc == 1
+
+    def test_cmd_must_start_with_python(self, tmp_path):
+        # torchrun_launcher.sh:23-25 parity.
+        with pytest.raises(SystemExit):
+            tpurun_main(["--nprocs", "1", "--", "bash", "-c", "true"])
+
+    def test_peer_workers_killed_on_failure(self, tmp_path, monkeypatch):
+        """When one rank dies the agent terminates the rest of the group
+        promptly instead of waiting out a hung job."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import os, sys, time
+            if os.environ["TPUDIST_PROCESS_ID"] == "0":
+                sys.exit(3)
+            time.sleep(120)   # would hang without group termination
+        """)
+        import time
+        t0 = time.time()
+        rc = tpurun_main(["--nprocs", "2", "--max-restarts", "0",
+                          "--tmpdir", str(tmp_path / "s"),
+                          "--", sys.executable, str(worker)])
+        assert rc == 1
+        assert time.time() - t0 < 60
+
+
+class TestStaging:
+    def test_tarball_roundtrip(self, tmp_path):
+        src = tmp_path / "dataset"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("hello")
+        (src / "sub" / "b.txt").write_text("world")
+        tb = create_tarball(src, tmp_path / "staged")
+        assert tb.exists()
+        # Second call: skip (job_submitter.sh:166-174 "tar once" semantics).
+        mtime = tb.stat().st_mtime_ns
+        assert create_tarball(src, tmp_path / "staged").stat().st_mtime_ns == mtime
+        dest = tmp_path / "scratch"
+        roots = extract_tarballs([tb], dest)
+        assert (dest / "dataset" / "a.txt").read_text() == "hello"
+        assert (dest / "dataset" / "sub" / "b.txt").read_text() == "world"
+        assert roots == [dest / "dataset"]
+
+    def test_missing_tarball_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            extract_tarballs([tmp_path / "nope.tar"], tmp_path)
+
+
+SPEC = {
+    "program": "examples/demo.py",
+    "method": "grid",
+    "metric": {"name": "loss/loss_X", "goal": "minimize"},
+    "parameters": {
+        "lr": {"values": [0.01, 0.001]},
+        "batch_size": {"values": [128, 256, 512]},
+        "seed": {"value": 0},
+    },
+    "command": ["python", "${program}", "--dry_run", "${args}"],
+}
+
+
+class TestSweep:
+    def test_count_is_grid_product(self):
+        # count_sweeps.bash parity: 2 * 3 * 1.
+        assert SweepSpec.from_dict(SPEC).count() == 6
+
+    def test_grid_enumeration_deterministic_and_complete(self):
+        spec = SweepSpec.from_dict(SPEC)
+        configs = [spec.config_at(i) for i in range(spec.count())]
+        assert len({tuple(sorted(c.items())) for c in configs}) == 6
+        assert configs[0] == {"lr": 0.01, "batch_size": 128, "seed": 0}
+        assert spec.config_at(3) == configs[3]  # stable
+        with pytest.raises(IndexError):
+            spec.config_at(6)
+
+    def test_command_interpolation(self):
+        spec = SweepSpec.from_dict(SPEC)
+        cmd = spec.command_for({"lr": 0.01, "batch_size": 128, "seed": 0})
+        assert cmd[0] == sys.executable
+        assert cmd[1] == "examples/demo.py"
+        assert "--dry_run" in cmd
+        assert "--lr=0.01" in cmd and "--batch_size=128" in cmd
+
+    def test_yaml_cli_count(self, tmp_path):
+        import yaml
+        spec_path = tmp_path / "sweep.yml"
+        spec_path.write_text(yaml.safe_dump(SPEC))
+        out = subprocess.run(
+            [sys.executable, "-m", "tpudist.launch.sweep", "count", str(spec_path)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0
+        assert out.stdout.strip() == "6"
+
+    def test_repo_sweeper_yml_parses(self):
+        spec = SweepSpec.from_yaml(REPO / "launch" / "sweeper.yml")
+        assert spec.count() == 12
+        cfg = spec.config_at(0)
+        assert set(cfg) == {"lr", "batch_size", "seed"}

@@ -35,11 +35,16 @@ def checkpoint_dir_for(
     scratch_dir: Optional[str] = None, exp_name: Optional[str] = None
 ) -> Path:
     """The reference's directory contract (``job_submitter.sh:157-159``):
-    ``${scratch_dir}/${exp_name}/checkpoints``, with env-var fallbacks on
-    the same names the launcher exports (SURVEY.md §5.6)."""
+    ``${scratch_dir}/[${project_name}/]${exp_name}/checkpoints``, with
+    env-var fallbacks on the same names the launcher exports (SURVEY.md
+    §5.6).  ``project_name`` (exported by ``launch/job_submitter.sh``)
+    namespaces experiments from different checkouts; when unset the path
+    matches the reference exactly."""
     scratch = scratch_dir or os.environ.get("scratch_dir", "scratch")
     exp = exp_name or os.environ.get("exp_name", "default_exp")
-    return Path(scratch) / exp / "checkpoints"
+    project = os.environ.get("project_name")
+    base = Path(scratch) / project if project else Path(scratch)
+    return base / exp / "checkpoints"
 
 
 class CheckpointManager:

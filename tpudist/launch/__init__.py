@@ -1,0 +1,26 @@
+"""Launch layer — the TPU-native replacement for the reference's L2/L3 bash
+system (``hpc_files/`` + ``interactive_job_cmds/``, SURVEY.md §2.2, §7.7).
+
+Components:
+
+- :mod:`tpudist.launch.run` — ``tpurun`` (``python -m tpudist.launch``): the
+  torchrun-equivalent per-node process agent.  Spawns N worker processes with
+  the ``TPUDIST_*`` env contract, supervises them, captures crash records,
+  and implements ``--max-restarts`` whole-job restart with backoff
+  (``torchrun_launcher.sh:16-19`` parity — JAX's coordination service is not
+  per-process elastic, so restarts are whole-node-agent, SURVEY.md §5.3).
+- :mod:`tpudist.launch.staging` — data-staging tarball contract
+  (``job_submitter.sh:166-174`` create side; ``torchrun_launcher.sh:35-40``
+  extract side).
+- :mod:`tpudist.launch.sweep` — W&B-style grid sweeps without the W&B server:
+  YAML grid spec, combination counting (``count_sweeps.bash`` parity), and a
+  local agent that runs the i-th configuration
+  (``sweeper.yml`` / ``sweep_cmd.txt`` parity).
+
+The cluster-facing bash front door (SLURM ``job_submitter`` equivalent and a
+gcloud TPU-pod ``--worker=all`` dispatcher) lives in ``launch/`` at the repo
+root, mirroring the reference's ``hpc_files/`` placement.
+"""
+
+from tpudist.launch.staging import create_tarball, extract_tarballs  # noqa: F401
+from tpudist.launch.sweep import SweepSpec  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from tpudist.launch.run import main
+
+sys.exit(main())

@@ -1,0 +1,249 @@
+"""``tpurun`` — per-node process agent (torchrun equivalent).
+
+Replaces the reference's ``torchrun_launcher.sh`` + the torchrun binary
+itself (SURVEY.md §2.2 B2, §3.1):
+
+- rendezvous: ``--coordinator host:port`` is the c10d ``--rdzv_endpoint``
+  analog; ``--standalone`` (implied when ``--nnodes 1``) picks a free
+  localhost port like torchrun's ``--standalone``
+  (``torchrun_launcher.sh:13-14``).
+- env contract: workers receive ``TPUDIST_COORDINATOR`` /
+  ``TPUDIST_NUM_PROCESSES`` / ``TPUDIST_PROCESS_ID`` /
+  ``TPUDIST_LOCAL_RANK`` / ``TPUDIST_LOCAL_WORLD_SIZE`` (consumed by
+  ``tpudist.runtime.bootstrap.resolve_process_context`` priority 2).
+- elasticity: ``--max-restarts`` (default 3 like
+  ``torchrun_launcher.sh:19``) relaunches the *whole local worker group*
+  with exponential backoff when any worker fails.  JAX's coordination
+  service is not per-process elastic, so this is whole-group semantics
+  (SURVEY.md §5.3); on multi-node jobs the peer agents' workers die on
+  coordinator loss and their agents restart them too, converging on a
+  fresh rendezvous for the same ``--run-id``.
+- crash records: workers decorated with ``tpudist.utils.record.record``
+  write structured tracebacks to ``TPUDIST_ERROR_FILE``; the agent
+  collects and surfaces the *first* failure (the ``@record`` +
+  elastic-error-file pattern, ``demo.py:14,156``).
+- data staging: ``--stage-data a.tar.gz,b.tar.gz`` extracts into the
+  job-local tmpdir before workers start (``torchrun_launcher.sh:35-40``).
+- command validation: like ``torchrun_launcher.sh:23-25`` the worker
+  command must start with ``python`` (or be a ``-m`` module invocation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from tpudist.runtime.bootstrap import find_free_port
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="tpudist per-node process agent (torchrun equivalent)",
+    )
+    p.add_argument("--nprocs", "--nproc-per-node", dest="nprocs", type=int, default=1,
+                   help="worker processes on this node (torchrun --nproc_per_node)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--coordinator", "--rdzv-endpoint", dest="coordinator", default=None,
+                   help="host:port of process 0's coordination service")
+    p.add_argument("--standalone", action="store_true",
+                   help="single-node: rendezvous on a free localhost port")
+    p.add_argument("--run-id", default=None,
+                   help="job-scoped rendezvous id (torchrun --rdzv_id=$SLURM_JOB_ID)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="whole-group restarts on worker failure "
+                        "(torchrun_launcher.sh:19 default)")
+    p.add_argument("--restart-backoff", type=float, default=5.0,
+                   help="base seconds between restarts (doubles each retry)")
+    p.add_argument("--stage-data", default=None,
+                   help="comma-separated tarballs extracted into the job tmpdir "
+                        "before workers start")
+    p.add_argument("--tmpdir", default=None,
+                   help="job-local scratch (default: $TPUDIST_TMPDIR or a fresh "
+                        "tempdir); exported to workers as TPUDIST_TMPDIR")
+    p.add_argument("--error-dir", default=None,
+                   help="directory for per-rank crash records (default: tmpdir)")
+    p.add_argument("--no-python-check", action="store_true",
+                   help="allow worker commands that do not start with 'python'")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker command: python script.py [args...]")
+    return p
+
+
+def _validate_cmd(cmd: List[str], allow_any: bool) -> List[str]:
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        raise SystemExit("tpurun: no worker command given")
+    if not allow_any and not os.path.basename(cmd[0]).startswith("python"):
+        # torchrun_launcher.sh:23-25 — "the job command must start with python".
+        raise SystemExit(
+            f"tpurun: worker command must start with 'python' (got {cmd[0]!r}); "
+            "pass --no-python-check to override"
+        )
+    return cmd
+
+
+def _worker_env(base: Dict[str, str], *, coordinator: Optional[str], world: int,
+                rank: int, local_rank: int, nprocs: int, run_id: str,
+                restart_count: int, error_template: str, tmpdir: str) -> Dict[str, str]:
+    env = dict(base)
+    env.update({
+        "TPUDIST_NUM_PROCESSES": str(world),
+        "TPUDIST_PROCESS_ID": str(rank),
+        "TPUDIST_LOCAL_RANK": str(local_rank),
+        "TPUDIST_LOCAL_WORLD_SIZE": str(nprocs),
+        "TPUDIST_RUN_ID": run_id,
+        "TPUDIST_RESTART_COUNT": str(restart_count),
+        "TPUDIST_ERROR_FILE": error_template,
+        "TPUDIST_TMPDIR": tmpdir,
+    })
+    if coordinator:
+        env["TPUDIST_COORDINATOR"] = coordinator
+    return env
+
+
+def _read_crash_records(error_template: str, world: int) -> List[dict]:
+    records = []
+    for path in sorted(glob.glob(error_template.replace("%r", "*"))):
+        try:
+            with open(path) as f:
+                records.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    records.sort(key=lambda r: r.get("timestamp", 0))
+    return records
+
+
+def _terminate(procs: List[subprocess.Popen], grace_s: float = 10.0) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace_s
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
+                 run_id: str, restart_count: int, error_template: str,
+                 tmpdir: str) -> int:
+    """Launch the local worker group once; return 0 iff all workers exit 0."""
+    procs: List[subprocess.Popen] = []
+    base_env = dict(os.environ)
+    for i in range(args.nprocs):
+        rank = args.node_rank * args.nprocs + i
+        env = _worker_env(base_env, coordinator=coordinator, world=world,
+                          rank=rank, local_rank=i, nprocs=args.nprocs,
+                          run_id=run_id, restart_count=restart_count,
+                          error_template=error_template, tmpdir=tmpdir)
+        procs.append(subprocess.Popen(cmd, env=env))
+    failed_rc = 0
+    try:
+        live = list(procs)
+        while live:
+            for p in list(live):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                live.remove(p)
+                if rc != 0:
+                    failed_rc = rc
+                    # One worker down ⇒ the group is done (the coordination
+                    # service cannot re-admit a lone restarted process).
+                    _terminate(live)
+                    live = []
+                    break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        _terminate(procs)
+        raise
+    return failed_rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = _validate_cmd(args.cmd, args.no_python_check)
+    if args.nprocs < 1 or args.nnodes < 1 or not 0 <= args.node_rank < args.nnodes:
+        raise SystemExit(
+            f"tpurun: invalid topology nprocs={args.nprocs} nnodes={args.nnodes} "
+            f"node_rank={args.node_rank}")
+
+    world = args.nnodes * args.nprocs
+    standalone = args.standalone or (args.nnodes == 1 and args.coordinator is None)
+    if standalone:
+        coordinator = f"127.0.0.1:{find_free_port()}" if world > 1 else ""
+    else:
+        if not args.coordinator:
+            raise SystemExit("tpurun: --coordinator required for multi-node jobs "
+                             "(or pass --standalone)")
+        coordinator = args.coordinator
+
+    from tpudist.launch.staging import job_tmpdir
+
+    run_id = args.run_id or os.environ.get("SLURM_JOB_ID") or f"tpurun-{os.getpid()}"
+    tmpdir = args.tmpdir or job_tmpdir()
+    owns_tmpdir = tmpdir is None
+    if owns_tmpdir:
+        tmpdir = tempfile.mkdtemp(prefix=f"tpudist_{run_id}_")
+        # Job-lifetime scratch: remove on agent exit only when we created it
+        # (a scheduler-provided dir is the scheduler's to clean).
+        import atexit
+        import shutil
+        atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
+    tmpdir = str(tmpdir)
+    os.makedirs(tmpdir, exist_ok=True)
+    error_dir = args.error_dir or tmpdir
+    os.makedirs(error_dir, exist_ok=True)
+
+    if args.stage_data:
+        from tpudist.launch.staging import extract_tarballs
+        extract_tarballs(args.stage_data.split(","), tmpdir)
+
+    max_attempts = args.max_restarts + 1
+    for attempt in range(max_attempts):
+        error_template = os.path.join(error_dir, f"error_attempt{attempt}_rank%r.json")
+        if attempt > 0:
+            backoff = args.restart_backoff * (2 ** (attempt - 1))
+            print(f"[tpurun] restarting worker group "
+                  f"(attempt {attempt + 1}/{max_attempts}) in {backoff:.1f}s",
+                  file=sys.stderr)
+            time.sleep(backoff)
+            if standalone and world > 1:
+                # Fresh rendezvous port: the dead service may linger in TIME_WAIT.
+                coordinator = f"127.0.0.1:{find_free_port()}"
+        rc = _run_attempt(cmd, args, coordinator, world, run_id, attempt,
+                          error_template, tmpdir)
+        if rc == 0:
+            return 0
+        records = _read_crash_records(error_template, world)
+        if records:
+            first = records[0]
+            print(f"[tpurun] first failure: rank {first.get('process_id')} "
+                  f"{first.get('exc_type')}: {first.get('message')}",
+                  file=sys.stderr)
+            tb = first.get("traceback")
+            if tb:
+                print(tb, file=sys.stderr)
+        else:
+            print(f"[tpurun] worker group failed (exit {rc}); no crash record "
+                  f"written (segfault or unhandled signal?)", file=sys.stderr)
+    print(f"[tpurun] giving up after {max_attempts} attempts", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Data-staging tarball contract.
+
+Create side: ``job_submitter.sh:166-174`` tars each ``--data`` path into the
+experiment's scratch dir *once* (skips when the tarball already exists).
+Extract side: ``torchrun_launcher.sh:35-40`` / ``standard_job.sh:19-24``
+untar every staged tarball into node-local scratch (``SLURM_TMPDIR``),
+timing the extraction.  Same semantics here, in Python so the TPU pod
+launcher (no SLURM) can reuse it.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import time
+from pathlib import Path
+from typing import Iterable, List
+
+
+def create_tarball(data_path: str | Path, out_dir: str | Path,
+                   overwrite: bool = False) -> Path:
+    """Tar ``data_path`` into ``out_dir/<name>.tar``; skip if already staged."""
+    data_path = Path(data_path)
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    out = out_dir / f"{data_path.name}.tar"
+    if out.exists() and not overwrite:
+        return out
+    tmp = out.with_suffix(".tar.partial")
+    with tarfile.open(tmp, "w") as tf:
+        tf.add(data_path, arcname=data_path.name)
+    tmp.rename(out)  # atomic publish: never expose a half-written tarball
+    return out
+
+
+def extract_tarballs(tarballs: Iterable[str | Path], dest: str | Path) -> List[Path]:
+    """Extract each tarball into ``dest``; returns extraction roots."""
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    roots: List[Path] = []
+    for tb in tarballs:
+        tb = Path(str(tb).strip())
+        if not tb.exists():
+            raise FileNotFoundError(f"staged tarball not found: {tb}")
+        t0 = time.time()
+        with tarfile.open(tb) as tf:
+            tf.extractall(dest, filter="data")
+            names = tf.getnames()
+        top = dest / names[0].split("/")[0] if names else dest
+        roots.append(top)
+        print(f"[staging] extracted {tb.name} -> {dest} "
+              f"({time.time() - t0:.1f}s)")
+    return roots
+
+
+def job_tmpdir() -> Path | None:
+    """The job-scoped node-local scratch dir, or None when no launcher or
+    scheduler provided one.  Only *per-job* dirs qualify (``TPUDIST_TMPDIR``
+    exported by tpurun/dispatcher, SLURM's per-job ``SLURM_TMPDIR``) — the
+    generic ``TMPDIR`` is shared across jobs and would collide, so callers
+    without a per-job dir should mkdtemp instead (tpurun does)."""
+    for var in ("TPUDIST_TMPDIR", "SLURM_TMPDIR"):
+        v = os.environ.get(var)
+        if v:
+            return Path(v)
+    return None

@@ -1,0 +1,165 @@
+"""Hyperparameter sweeps — W&B-sweep-shaped, server-optional.
+
+The reference drives sweeps through the W&B server: ``sweeper.yml`` defines a
+grid (``sweeper.yml:1-41``), ``count_sweeps.bash`` multiplies the value
+counts to size the SLURM array (``count_sweeps.bash:4-16``), and each array
+task runs ``wandb agent --count 1 …`` (``sweep_cmd.txt:1``) which pulls one
+configuration and execs the command template.
+
+This module reproduces the whole pattern locally: the same YAML schema
+(``program`` / ``method`` / ``metric`` / ``parameters: {p: {values: […]}}`` /
+``command`` template with ``${program}``/``${args}``/``${env}``
+interpolation), deterministic grid expansion, a ``count`` command for array
+sizing, and an ``agent --index i`` that runs the i-th configuration — so a
+SLURM array task or a loop over TPU pod workers replaces the W&B server
+round-trip.  When wandb *is* installed and a sweep id is given, ``agent``
+delegates to the real ``wandb agent --count 1`` for full parity.
+
+CLI::
+
+    python -m tpudist.launch.sweep count  sweeper.yml
+    python -m tpudist.launch.sweep show   sweeper.yml --index 3
+    python -m tpudist.launch.sweep agent  sweeper.yml --index $SLURM_ARRAY_TASK_ID
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import random
+import string
+import subprocess
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepSpec:
+    program: str
+    method: str  # grid | random
+    parameters: Dict[str, List[Any]]  # name -> candidate values (ordered)
+    command: List[str]
+    metric: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_yaml(cls, path: str | Path) -> "SweepSpec":
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "SweepSpec":
+        params: Dict[str, List[Any]] = {}
+        for name, spec in (raw.get("parameters") or {}).items():
+            if isinstance(spec, dict):
+                if "values" in spec:
+                    params[name] = list(spec["values"])
+                elif "value" in spec:
+                    params[name] = [spec["value"]]
+                else:
+                    raise ValueError(
+                        f"parameter {name!r}: only values/value grids are "
+                        f"supported (got keys {sorted(spec)})")
+            else:
+                params[name] = [spec]
+        command = raw.get("command") or ["python", "${program}", "${args}"]
+        return cls(
+            program=raw.get("program", ""),
+            method=raw.get("method", "grid"),
+            parameters=params,
+            command=[str(c) for c in command],
+            metric=raw.get("metric"),
+        )
+
+    def count(self) -> int:
+        """Grid size — ``count_sweeps.bash:4-16`` parity (product of value
+        counts)."""
+        n = 1
+        for values in self.parameters.values():
+            n *= len(values)
+        return n
+
+    def config_at(self, index: int, seed: int = 0) -> Dict[str, Any]:
+        """The index-th configuration.  Grid order is deterministic (product
+        order over parameters in YAML order, last varying fastest); ``random``
+        draws with a seeded RNG so array tasks are reproducible."""
+        if self.method == "random":
+            rng = random.Random((seed << 20) ^ index)
+            return {k: rng.choice(v) for k, v in self.parameters.items()}
+        n = self.count()
+        if not 0 <= index < n:
+            raise IndexError(f"sweep index {index} out of range [0,{n})")
+        # Mixed-radix decode (last parameter varies fastest — itertools.product
+        # order) without materializing the grid.
+        config: Dict[str, Any] = {}
+        rem = index
+        for name in reversed(list(self.parameters)):
+            values = self.parameters[name]
+            rem, i = divmod(rem, len(values))
+            config[name] = values[i]
+        return {k: config[k] for k in self.parameters}
+
+    def command_for(self, config: Dict[str, Any],
+                    env: Optional[Dict[str, str]] = None) -> List[str]:
+        """Render the command template (``sweeper.yml:21-41`` interpolation:
+        ``${program}``, ``${args}``, ``${env}``, plus ``${VAR}`` from env)."""
+        env = {**os.environ, **(env or {})}
+        args = [f"--{k}={v}" for k, v in config.items()]
+        out: List[str] = []
+        for tok in self.command:
+            if tok == "${args}":
+                out.extend(args)
+            elif tok == "${program}":
+                out.append(self.program)
+            elif tok == "${env}":
+                continue  # "/usr/bin/env" marker in wandb templates — drop
+            elif tok in ("${interpreter}", "python"):
+                out.append(sys.executable)
+            else:
+                out.append(string.Template(tok).safe_substitute(env))
+        return out
+
+    def run_index(self, index: int, extra_env: Optional[Dict[str, str]] = None) -> int:
+        config = self.config_at(index)
+        cmd = self.command_for(config)
+        env = {**os.environ, **(extra_env or {}),
+               "TPUDIST_SWEEP_INDEX": str(index),
+               "TPUDIST_SWEEP_CONFIG": repr(config)}
+        print(f"[sweep] index {index}/{self.count()}: {config}")
+        return subprocess.call(cmd, env=env)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tpudist-sweep")
+    p.add_argument("action", choices=["count", "show", "agent"])
+    p.add_argument("spec", help="sweep YAML (sweeper.yml schema)")
+    p.add_argument("--index", type=int, default=None,
+                   help="configuration index (e.g. $SLURM_ARRAY_TASK_ID)")
+    p.add_argument("--wandb-sweep-id", default=None,
+                   help="delegate to `wandb agent --count 1 <id>` when wandb "
+                        "is installed (full reference parity)")
+    args = p.parse_args(argv)
+    spec = SweepSpec.from_yaml(args.spec)
+    if args.action == "count":
+        print(spec.count())
+        return 0
+    index = args.index
+    if index is None:
+        index = int(os.environ.get("SLURM_ARRAY_TASK_ID", 0))
+    if args.action == "show":
+        print(spec.config_at(index))
+        print(" ".join(spec.command_for(spec.config_at(index))))
+        return 0
+    if args.wandb_sweep_id:
+        # sweep_cmd.txt:1 — `wandb agent --count 1 USER/PROJECT/SWEEPID`.
+        return subprocess.call([sys.executable, "-m", "wandb", "agent",
+                                "--count", "1", args.wandb_sweep_id])
+    return spec.run_index(index)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
