@@ -1,0 +1,10 @@
+#!/bin/bash
+# Per-node scratch cleanup job — tpudist equivalent of the reference's
+# plai_cleanups/plai_cleanup.sh (B13, SURVEY.md §2.2): delete this user's
+# leftover node-local scratch from crashed jobs.
+set -euo pipefail
+
+scratch_root="${scratch_root:-/tmp}"
+echo "cleaning ${scratch_root}/tpudist_* (user ${USER}) on $(hostname)"
+find "${scratch_root}" -maxdepth 1 -name 'tpudist_*' -user "${USER}" \
+  -exec rm -rf {} + 2>/dev/null || true

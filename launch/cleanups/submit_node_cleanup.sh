@@ -1,0 +1,17 @@
+#!/bin/bash
+# Submit one cleanup job per node — tpudist equivalent of the reference's
+# plai_cleanups/submit_plai_cleanup (B13): array of per-node sbatch jobs
+# deleting leftover node-local scratch.
+#
+#   bash launch/cleanups/submit_node_cleanup.sh node1 node2 …
+#   bash launch/cleanups/submit_node_cleanup.sh $(sinfo -h -o %n)
+set -euo pipefail
+
+[[ $# -ge 1 ]] || { echo "usage: $0 NODE [NODE…]" >&2; exit 2; }
+here="$(cd "$(dirname "$0")" && pwd)"
+
+for node in "$@"; do
+  sbatch --job-name="tpudist-cleanup-${node}" --nodelist="${node}" \
+    --time=00:05:00 --mem=256M --output=/dev/null \
+    "${here}/node_cleanup.sh"
+done

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Containerized distributed dispatcher — tpudist equivalent of the
+# reference's singularity_hpc_files/distributed_dispatcher.sh (B7, SURVEY.md
+# §2.2): one containerized task per rank via a single srun; rank derivation
+# happens inside the container from the forwarded SLURM env contract
+# (bootstrap priority 4), the same "distribution is left to the payload"
+# stance as the reference (:3-6).
+set -euo pipefail
+
+export MASTER_ADDR="$(hostname)"
+export MASTER_PORT="${MASTER_PORT:-2345}"
+export WORLD_SIZE="${SLURM_NTASKS:?}"
+export TASKS_PER_NODE="${SLURM_NTASKS_PER_NODE:-1}"
+
+# $0 under sbatch is SLURM's spool copy — resolve the sibling script through
+# the job payload's source_dir instead.
+rc=0
+srun bash "${source_dir:?}/launch/container/standard_job.sh" || rc=$?
+
+# Remove each node's shared staging dir (image + data) now that every task
+# on it has finished; per-task dirs were cleaned by the tasks themselves.
+srun --ntasks="${SLURM_NNODES:-1}" --ntasks-per-node=1 \
+  bash -c 'rm -rf "${SLURM_TMPDIR:-/tmp}/tpudist_${SLURM_JOB_ID}_shared"' || true
+exit "${rc}"

@@ -1,0 +1,68 @@
+#!/bin/bash
+# Containerized job task — tpudist equivalent of the reference's
+# singularity_hpc_files/standard_job.sh (B6, SURVEY.md §2.2): image to
+# node-local disk, per-job overlay dirs, run the container with bind mounts
+# and forwarded env, clean up.  May run as MANY tasks per node (container
+# distributed mode): node-shared work (image rsync, data extraction) is done
+# once by SLURM_LOCALID 0 behind a sentinel; overlays are per-task.
+#
+# Env payload (from job_submitter.sh): cmd, source_dir, scratch_dir,
+# exp_name, project_name, staged_tarballs, WANDB_API_KEY, sif_path.
+set -euo pipefail
+
+sif_path="${sif_path:?path to .sif image}"
+job_id="${SLURM_JOB_ID:-$$}"
+local_id="${SLURM_LOCALID:-0}"
+task_id="${SLURM_PROCID:-0}"
+
+# Node-shared dir: image + extracted data, staged once per node.  Not
+# trap-cleaned (sibling tasks may outlive this one); the dispatcher removes
+# it per-node after srun returns, and launch/cleanups/ catches crashes.
+shared="${SLURM_TMPDIR:-/tmp}/tpudist_${job_id}_shared"
+# Per-task dir: overlays + workdir, safe to clean on our own exit.
+task_tmp="${SLURM_TMPDIR:-/tmp}/tpudist_${job_id}_task${task_id}"
+mkdir -p "${shared}" "${task_tmp}"
+trap 'rm -rf "${task_tmp}"' EXIT
+
+local_sif="${shared}/$(basename "${sif_path}")"
+sentinel="${shared}/.staged"
+if [[ "${local_id}" == "0" ]]; then
+  # Image to node-local disk first — container startup off shared FS is slow
+  # (reference singularity standard_job.sh:19-21).
+  time rsync -a "${sif_path}" "${local_sif}"
+  if [[ -n "${staged_tarballs:-}" ]]; then
+    IFS=',' read -ra tbs <<< "${staged_tarballs}"
+    for tb in "${tbs[@]}"; do time tar -xf "${tb}" -C "${shared}"; done
+  fi
+  touch "${sentinel}"
+else
+  while [[ ! -f "${sentinel}" ]]; do sleep 1; done
+fi
+
+# Per-job overlay dirs (reference :30-62): writable tmp/home/workdir so the
+# image itself stays read-only.
+workdir="${task_tmp}/workdir"
+home_overlay="${task_tmp}/home_overlay"
+tmp_overlay="${task_tmp}/tmp_overlay"
+mkdir -p "${workdir}" "${home_overlay}" "${tmp_overlay}"
+
+# Forward the launch contract into the container (reference :74-78
+# SINGULARITYENV_* pattern).
+export SINGULARITYENV_WANDB_API_KEY="${WANDB_API_KEY:-}"
+export SINGULARITYENV_TPUDIST_WORKDIR="${workdir}"
+export SINGULARITYENV_TPUDIST_TMPDIR="${shared}"
+for var in SLURM_JOB_ID SLURM_PROCID SLURM_LOCALID SLURM_NTASKS \
+           SLURM_NTASKS_PER_NODE MASTER_ADDR MASTER_PORT WORLD_SIZE \
+           TASKS_PER_NODE NODE_RANK; do
+  [[ -n "${!var:-}" ]] && export "SINGULARITYENV_${var}=${!var}"
+done
+
+# --nv is CUDA-only; TPU chips enter the container by binding the accel
+# device nodes when present.
+tpu_binds=()
+for dev in /dev/accel*; do [[ -e "${dev}" ]] && tpu_binds+=(--bind "${dev}"); done
+
+singularity run --cleanenv --no-home --contain --writable-tmpfs \
+  "${tpu_binds[@]}" \
+  --bind "${scratch_dir:?}","${shared}","${tmp_overlay}:/tmp","${home_overlay}:${HOME}","${workdir}" \
+  "${local_sif}" ${cmd:?}

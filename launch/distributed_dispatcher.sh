@@ -21,7 +21,20 @@ chips="${chips_per_node:-1}"
 export TPUDIST_TMPDIR="${SLURM_TMPDIR:-/tmp/tpudist_${SLURM_JOB_ID}}"
 [[ -z "${SLURM_TMPDIR:-}" ]] && trap 'rm -rf "${TPUDIST_TMPDIR}"' EXIT
 
-echo "dispatcher: ${num_nodes} nodes, ${chips} chips/node, coordinator ${coordinator}"
+echo "dispatcher: ${num_nodes} nodes, ${chips} chips/node, coordinator ${coordinator}," \
+     "workflow ${workflow:-tpurun}"
+
+# trainer workflow (reference lightning path, distributed_dispatcher.sh:38 +
+# SURVEY.md §3.4): ONE srun spawning nodes×chips tasks; every task runs the
+# trainer launcher and the framework derives ranks from the SLURM env
+# contract.  The sbatch was shaped with --ntasks-per-node=chips by
+# job_submitter (reference job_submitter.sh:288).
+if [[ "${workflow:-tpurun}" == "trainer" ]]; then
+  export MASTER_ADDR MASTER_PORT
+  srun bash launch/trainer_launcher.sh \
+    "${num_nodes}" "${chips}" "${staged_tarballs:-}"
+  exit $?
+fi
 
 node_rank=0
 for node in "${nodes[@]}"; do

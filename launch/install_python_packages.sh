@@ -1,0 +1,32 @@
+#!/bin/bash
+# Virtualenv bootstrap job — tpudist equivalent of the reference's
+# hpc_files/install_python_packages.sh (B8, SURVEY.md §2.2): builds the
+# project environment on a compute node so training jobs only activate it.
+# Prefers an existing project definition (pyproject.toml editable install);
+# falls back to requirements.txt.  TPU wheels: jax[tpu] from the libtpu
+# release index when chips are present, plain jax otherwise.
+set -euo pipefail
+
+cd "${source_dir:?}"
+venv_dir="${venv_dir:-${source_dir}/virtual_env}"
+
+if [[ ! -d "${venv_dir}" ]]; then
+  python3 -m venv "${venv_dir}"
+fi
+# shellcheck disable=SC1091
+source "${venv_dir}/bin/activate"
+pip install --upgrade pip
+
+if [[ -f pyproject.toml ]]; then
+  pip install -e .
+elif [[ -f requirements.txt ]]; then
+  pip install -r requirements.txt
+fi
+
+# TPU runtime wheels (no-op on CPU-only nodes; the reference pinned its CUDA
+# wheel index the same way, Pipfile:6-9).
+if [[ -e /dev/accel0 || -n "${TPU_NAME:-}" ]]; then
+  pip install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+fi
+
+python -c "import jax; print('jax', jax.__version__, 'devices', jax.device_count())"

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Interactive model-split session — tpudist equivalent of the reference's
+# interactive_job_cmds/salloc_one_model_multi_gpu_torchrun.sh (B11, SURVEY.md
+# §2.2): one process per node, TWO chips per process, the model's layer
+# stages sharded across the two chips (DP across nodes × model-split within).
+# The reference asserted exactly 2 GPUs per task
+# (demo_one_model_multi_gpu.py:89); here the (data, model=2) mesh encodes it.
+#
+#   salloc --nodes=2 --ntasks-per-node=1 --gres=tpu:2 ...   (or 2 chips/VM)
+#   bash launch/interactive/salloc_model_split.sh
+set -euo pipefail
+export OMP_NUM_THREADS=1
+
+[[ -f "${HOME}/wandb_credentials.txt" ]] && \
+  export WANDB_API_KEY="$(head -n1 "${HOME}/wandb_credentials.txt")"
+
+export WORLD_SIZE="${SLURM_NNODES:?run inside an salloc allocation}"
+export TASKS_PER_NODE=1    # one process per node, both chips visible to it
+export MASTER_ADDR="$(hostname)"
+export MASTER_PORT="${MASTER_PORT:-2345}"
+
+nodes=($(scontrol show hostname "${SLURM_JOB_NODELIST}"))
+iters="${ITERS:-200}"
+
+node_rank=0
+for node in "${nodes[@]}"; do
+  NODE_RANK="${node_rank}" srun -w "${node}" -N1 -n1 \
+    python examples/demo_model_split.py --use_node_rank \
+    --dry_run --total_iterations "${iters}" --seed 0 \
+    > "model_split_output.out.${node_rank}" 2>&1 &
+  node_rank=$((node_rank + 1))
+done
+wait
+echo "model-split run done -> model_split_output.out.*"

@@ -1,0 +1,86 @@
+#!/bin/bash
+# Interactive launch matrix — tpudist equivalent of the reference's
+# interactive_job_cmds/salloc_torchrun.sh (B9, SURVEY.md §2.2): inside an
+# `salloc` allocation, run the SAME training through four launch/backend
+# combinations, each writing its own output file, for cross-path consistency
+# checking by eyeball (the reference's de-facto integration test, §4).
+#
+#   salloc --nodes=2 --ntasks-per-node=4 ...
+#   bash launch/interactive/salloc_tpurun.sh
+set -euo pipefail
+export OMP_NUM_THREADS=1    # salloc_torchrun.sh:3 discipline
+
+[[ -f "${HOME}/wandb_credentials.txt" ]] && \
+  export WANDB_API_KEY="$(head -n1 "${HOME}/wandb_credentials.txt")"
+
+export WORLD_SIZE="${SLURM_NTASKS:?run inside an salloc allocation}"
+export TASKS_PER_NODE="${SLURM_NTASKS_PER_NODE:-1}"
+
+nodes=($(scontrol show hostname "${SLURM_JOB_NODELIST}"))
+num_nodes="${#nodes[@]}"
+export MASTER_ADDR="$(hostname)"
+export MASTER_PORT="${MASTER_PORT:-2345}"
+
+echo "nodes: ${nodes[*]}"
+echo "master: ${MASTER_ADDR}:${MASTER_PORT}, world ${WORLD_SIZE}, ${TASKS_PER_NODE}/node"
+
+iters="${ITERS:-200}"
+common_flags=(--dry_run --total_iterations "${iters}" --seed 0)
+
+# ── 1. Raw per-node srun + env bootstrap (--use_node_rank) ──────────────────
+# The reference's "individual" path (salloc_torchrun.sh:40-49): no managed
+# launcher; each process computes rank = NODE_RANK*TASKS_PER_NODE+LOCAL_RANK.
+node_rank=0
+for node in "${nodes[@]}"; do
+  NODE_RANK="${node_rank}" srun -w "${node}" -N1 -n "${TASKS_PER_NODE}" \
+    python examples/demo.py --use_node_rank "${common_flags[@]}" \
+    > "demo_individual_output.out.${node_rank}" 2>&1 &
+  node_rank=$((node_rank + 1))
+done
+wait
+echo "1/4 raw env bootstrap done -> demo_individual_output.out.*"
+
+# ── 2. tpurun agent rendezvous (the torchrun-equivalent path) ───────────────
+# salloc_torchrun.sh:60-66 analog: one agent per node, c10d-style run id.
+node_rank=0
+for node in "${nodes[@]}"; do
+  srun -w "${node}" -N1 -n1 \
+    python -m tpudist.launch \
+      --nprocs "${TASKS_PER_NODE}" --nnodes "${num_nodes}" \
+      --node-rank "${node_rank}" \
+      --coordinator "${MASTER_ADDR}:${MASTER_PORT}" \
+      --run-id "${SLURM_JOB_ID}" --max-restarts 3 \
+      -- python examples/demo.py "${common_flags[@]}" \
+    > "demo_tpurun_output.out.${node_rank}" 2>&1 &
+  node_rank=$((node_rank + 1))
+done
+wait
+echo "2/4 tpurun rendezvous done -> demo_tpurun_output.out.*"
+
+# ── 3. MPI bootstrap (salloc_torchrun.sh:86-90 analog) ──────────────────────
+# One fabric (MPI) bootstraps the other (JAX coordination service): rank 0
+# broadcasts its hostname + a free port via mpi4py, then every rank calls
+# jax.distributed.initialize.  Requires mpi4py + a working mpiexec.
+if command -v mpiexec >/dev/null 2>&1; then
+  mpiexec -np "${WORLD_SIZE}" \
+    python examples/demo_mpi_bootstrap.py "${common_flags[@]}" \
+    > demo_mpi_output.out 2>&1 || echo "(mpi path failed — see demo_mpi_output.out)"
+  echo "3/4 mpi bootstrap done -> demo_mpi_output.out"
+else
+  echo "3/4 skipped: no mpiexec on PATH"
+fi
+
+# ── 4. host metric backend (salloc_torchrun.sh:94-95 Gloo analog) ───────────
+# Same training, but per-iteration loss reduction over the host/DCN fabric
+# instead of on-device ICI collectives.
+node_rank=0
+for node in "${nodes[@]}"; do
+  NODE_RANK="${node_rank}" srun -w "${node}" -N1 -n "${TASKS_PER_NODE}" \
+    python examples/demo.py --use_node_rank --backend host "${common_flags[@]}" \
+    > "demo_host_output.out.${node_rank}" 2>&1 &
+  node_rank=$((node_rank + 1))
+done
+wait
+echo "4/4 host-backend done -> demo_host_output.out.*"
+
+echo "all four launch paths complete; compare final losses across outputs"

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Interactive trainer-facade session — tpudist equivalent of the reference's
+# interactive_job_cmds/salloc_lightning.sh (B10, SURVEY.md §2.2): run the
+# Trainer entry point under srun with both metric backends (the reference ran
+# Lightning with PL_TORCH_DISTRIBUTED_BACKEND=nccl then =gloo,
+# salloc_lightning.sh:51-67).
+#
+#   salloc --nodes=N --ntasks-per-node=G ...
+#   bash launch/interactive/salloc_trainer.sh
+set -euo pipefail
+export OMP_NUM_THREADS=1
+
+[[ -f "${HOME}/wandb_credentials.txt" ]] && \
+  export WANDB_API_KEY="$(head -n1 "${HOME}/wandb_credentials.txt")"
+
+export WORLD_SIZE="${SLURM_NTASKS:?run inside an salloc allocation}"
+export TASKS_PER_NODE="${SLURM_NTASKS_PER_NODE:-1}"
+export MASTER_ADDR="$(hostname)"
+export MASTER_PORT="${MASTER_PORT:-2345}"
+
+iters="${ITERS:-200}"
+
+# Trainer requires one task per chip (the Lightning shape, §3.4): rank
+# derivation rides the SLURM env contract inside the framework.
+echo "trainer over ici metric backend"
+srun python examples/demo_trainer.py \
+  --dry_run --total_iterations "${iters}" --backend ici \
+  > trainer_ici_output.out 2>&1
+echo "-> trainer_ici_output.out"
+
+echo "trainer over host metric backend"
+srun python examples/demo_trainer.py \
+  --dry_run --total_iterations "${iters}" --backend host \
+  > trainer_host_output.out 2>&1
+echo "-> trainer_host_output.out"

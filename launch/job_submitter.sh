@@ -21,6 +21,10 @@
 #   -x FILE      experiment config file (one-line command; default
 #                launch/experiment_configurations.txt)
 #   -S FILE      sweep spec YAML (sweep jobs; default launch/sweeper.yml)
+#   -W WORKFLOW  distributed workflow: tpurun (per-node agent) | trainer
+#                (one task per chip, SLURM-env rank derivation)  (default tpurun)
+#   -C SIF       run inside a Singularity image (container job scripts)
+#   -i           submit a virtualenv-install job first and wait for it
 #   -n           no-confirm (skip the interactive prompt)
 #   -h           help
 set -euo pipefail
@@ -32,11 +36,14 @@ job_type="standard"; cpus=4; gpus=0; nodes=1; walltime="02:00:00"; mem="16G"
 partition=""; account=""; data_paths=""
 scratch_dir="${SCRATCH:-$HOME/scratch}"
 exp_name="exp_$(date +%Y%m%d_%H%M%S)"
-exp_configs_path="launch/experiment_configurations.txt"
+exp_configs_path=""
 sweep_spec="launch/sweeper.yml"
+workflow="tpurun"
+sif_path=""
+install_env=0
 confirm=1
 
-while getopts "j:c:g:N:t:m:p:a:d:s:e:x:S:nh" opt; do
+while getopts "j:c:g:N:t:m:p:a:d:s:e:x:S:W:C:inh" opt; do
   case "${opt}" in
     j) job_type="${OPTARG}" ;;
     c) cpus="${OPTARG}" ;;
@@ -51,14 +58,29 @@ while getopts "j:c:g:N:t:m:p:a:d:s:e:x:S:nh" opt; do
     e) exp_name="${OPTARG}" ;;
     x) exp_configs_path="${OPTARG}" ;;
     S) sweep_spec="${OPTARG}" ;;
+    W) workflow="${OPTARG}" ;;
+    C) sif_path="${OPTARG}" ;;
+    i) install_env=1 ;;
     n) confirm=0 ;;
-    h) sed -n '2,30p' "$0"; exit 0 ;;
+    h) cat "$(dirname "$0")/.help_message.txt"; exit 0 ;;
     *) echo "unknown flag; -h for help" >&2; exit 2 ;;
   esac
 done
 
 case "${job_type}" in standard|distributed|sweep) ;; *)
   echo "job_submitter: -j must be standard|distributed|sweep" >&2; exit 2 ;; esac
+case "${workflow}" in tpurun|trainer) ;; *)
+  echo "job_submitter: -W must be tpurun|trainer" >&2; exit 2 ;; esac
+
+# Per-workflow default config file (reference torchrun_configs.txt /
+# lightning_configs.txt split, job_submitter.sh:296-300).
+if [[ -z "${exp_configs_path}" ]]; then
+  case "${job_type}/${workflow}" in
+    distributed/trainer) exp_configs_path="launch/trainer_configs.txt" ;;
+    distributed/tpurun)  exp_configs_path="launch/distributed_configs.txt" ;;
+    *)                   exp_configs_path="launch/experiment_configurations.txt" ;;
+  esac
+fi
 
 # Experiment workspace: checkpoints + output dirs (job_submitter.sh:157-163).
 exp_dir="${scratch_dir}/${project_name}/${exp_name}"
@@ -78,6 +100,18 @@ if [[ -n "${data_paths}" ]]; then
   done
 fi
 
+# Optional virtualenv bootstrap: submit the install job and poll squeue until
+# it leaves the queue (reference job_submitter.sh:184-245 + B8).
+if [[ "${install_env}" -eq 1 ]]; then
+  install_out="${exp_dir}/hpc_outputs/install-%j.out"
+  install_id="$(sbatch --parsable --job-name="${project_name}-install" \
+    --time=00:30:00 --mem=4G --cpus-per-task=2 --output="${install_out}" \
+    --export="ALL,source_dir=${source_dir}" launch/install_python_packages.sh)"
+  echo "waiting for install job ${install_id}…"
+  while squeue -h -j "${install_id}" 2>/dev/null | grep -q .; do sleep 10; done
+  echo "install job ${install_id} finished"
+fi
+
 # The one-line experiment command (job_submitter.sh:300).
 cmd="$(tr -d '\n\r\\' < "${exp_configs_path}")"
 
@@ -94,9 +128,14 @@ sbatch_cmd=(
 [[ -n "${account}"   ]] && sbatch_cmd+=(--account="${account}")
 [[ "${gpus}" -gt 0   ]] && sbatch_cmd+=(--gres="gpu:${gpus}")
 
-payload="ALL,cmd=${cmd},source_dir=${source_dir},scratch_dir=${scratch_dir}"
+# cmd and the tarball list may contain commas, which sbatch's --export parser
+# splits on — ship them via the exported environment (ALL) and keep only
+# comma-free scalars in the explicit payload.
+export cmd
+export staged_tarballs="${staged}"
+payload="ALL,source_dir=${source_dir},scratch_dir=${scratch_dir}"
 payload+=",exp_name=${exp_name},project_name=${project_name}"
-payload+=",staged_tarballs=${staged},WANDB_API_KEY=${wandb_key}"
+payload+=",WANDB_API_KEY=${wandb_key}"
 
 case "${job_type}" in
   sweep)
@@ -110,11 +149,17 @@ case "${job_type}" in
     hpc_file="launch/standard_job.sh"
     ;;
   distributed)
-    # torchrun-style: ONE agent task per node that forks the workers itself
-    # (job_submitter.sh:290-291: ntasks-per-node=1, cpus *= chips).
     chips=$(( gpus > 0 ? gpus : 1 ))
-    sbatch_cmd+=(--ntasks-per-node=1 --cpus-per-task="$((cpus * chips))")
-    payload+=",chips_per_node=${chips}"
+    if [[ "${workflow}" == "trainer" ]]; then
+      # trainer workflow: one task per chip, ranks derived from SLURM env
+      # (reference lightning shape, job_submitter.sh:288).
+      sbatch_cmd+=(--ntasks-per-node="${chips}" --cpus-per-task="${cpus}")
+    else
+      # tpurun workflow: ONE agent task per node that forks the workers
+      # itself (job_submitter.sh:290-291: ntasks-per-node=1, cpus *= chips).
+      sbatch_cmd+=(--ntasks-per-node=1 --cpus-per-task="$((cpus * chips))")
+    fi
+    payload+=",chips_per_node=${chips},workflow=${workflow}"
     hpc_file="launch/distributed_dispatcher.sh"
     ;;
   standard)
@@ -122,6 +167,22 @@ case "${job_type}" in
     hpc_file="launch/standard_job.sh"
     ;;
 esac
+
+# Container jobs swap in the singularity job scripts (reference
+# job_submitter.sh:266,286 virtualenv/singularity branch).
+if [[ -n "${sif_path}" ]]; then
+  payload+=",sif_path=${sif_path}"
+  case "${job_type}" in
+    distributed)
+      # One containerized task per rank; ranks derive from forwarded SLURM
+      # env — so undo the tpurun shape (1 fat agent task with cpus×chips).
+      sbatch_cmd=("${sbatch_cmd[@]/--ntasks-per-node=1/--ntasks-per-node=${chips}}")
+      sbatch_cmd=("${sbatch_cmd[@]/--cpus-per-task=$((cpus * chips))/--cpus-per-task=${cpus}}")
+      hpc_file="launch/container/distributed_dispatcher.sh"
+      ;;
+    *) hpc_file="launch/container/standard_job.sh" ;;
+  esac
+fi
 sbatch_cmd+=(--export="${payload}")
 
 echo "sbatch ${sbatch_cmd[*]} ${hpc_file}"

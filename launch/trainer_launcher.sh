@@ -1,0 +1,45 @@
+#!/bin/bash
+# Per-task trainer launcher — tpudist equivalent of the reference's
+# virtual_env_hpc_files/distributed_scripts/lightning_launcher.sh (B3,
+# SURVEY.md §2.2).  Runs once per srun task (one task per chip-group); the
+# framework process derives its rank from the SLURM env contract
+# (tpudist/runtime/bootstrap.py priority 4 — SLURM_PROCID/SLURM_LOCALID +
+# MASTER_ADDR/MASTER_PORT exported by the dispatcher), the way Lightning
+# infers rank/world from SLURM in the reference (§3.4).
+#
+# Args: $1 = nnodes, $2 = chips per node, $3 = comma-separated tarballs ("" ok)
+# Env:  cmd (the experiment command), MASTER_ADDR/MASTER_PORT, TPUDIST_TMPDIR
+set -euo pipefail
+
+nnodes="${1:?nnodes}"; chips="${2:?chips per node}"; tarballs="${3:-}"
+
+# The launcher owns topology: strip any user-passed topology flags and assert
+# the authoritative ones (lightning_launcher.sh:12-14 sed-strip + re-append
+# discipline).  --torchrun / --use_node_rank would redirect rank derivation
+# away from the SLURM contract this mode relies on.
+run_cmd="$(sed -E 's/--(torchrun|use_node_rank)([[:space:]]|$)/ /g' <<< "${cmd:?}")"
+# cmd must be a python program (torchrun_launcher.sh:23-25 parity; basename so
+# absolute interpreter paths pass too).
+first_tok="$(basename "${run_cmd%% *}")"
+[[ "${first_tok}" == python* ]] || { echo "cmd must start with python" >&2; exit 2; }
+
+export WORLD_SIZE="$((nnodes * chips))"
+export TASKS_PER_NODE="${chips}"
+
+# Stage data into node-local scratch exactly once per node: every task checks,
+# only SLURM_LOCALID 0 extracts, others wait on the sentinel
+# (torchrun_launcher.sh:35-40 staging contract, made multi-task-safe).
+if [[ -n "${tarballs}" ]]; then
+  tmp="${TPUDIST_TMPDIR:?}"
+  mkdir -p "${tmp}"
+  sentinel="${tmp}/.staged"
+  if [[ "${SLURM_LOCALID:-0}" == "0" ]]; then
+    IFS=',' read -ra tbs <<< "${tarballs}"
+    for tb in "${tbs[@]}"; do time tar -xf "${tb}" -C "${tmp}"; done
+    touch "${sentinel}"
+  else
+    while [[ ! -f "${sentinel}" ]]; do sleep 1; done
+  fi
+fi
+
+exec ${run_cmd}

@@ -1,0 +1,182 @@
+"""Bash launch-layer tests: syntax-check every script and exercise
+``job_submitter.sh`` end-to-end against stub SLURM binaries, verifying the
+emitted ``sbatch`` shape per job type/workflow (the reference's
+``job_submitter.sh:254-344`` branching, SURVEY.md §2.2 B1/B3/B6-B8)."""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPTS = sorted((REPO / "launch").rglob("*.sh"))
+
+
+@pytest.mark.parametrize("script", SCRIPTS, ids=lambda p: str(p.relative_to(REPO)))
+def test_bash_syntax(script):
+    subprocess.run(["bash", "-n", str(script)], check=True)
+
+
+def _make_stub(bin_dir: Path, name: str, body: str) -> None:
+    p = bin_dir / name
+    p.write_text("#!/bin/bash\n" + body)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+
+
+@pytest.fixture
+def slurm_stubs(tmp_path):
+    """Fake sbatch/squeue/scontrol on PATH; sbatch records its argv."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "sbatch_calls.log"
+    _make_stub(bin_dir, "sbatch",
+               # Record argv AND the env-shipped payload (cmd/staged_tarballs
+               # ride the exported environment, not --export — see the comma
+               # note in job_submitter.sh).
+               f'echo "$@" cmd=[${{cmd:-}}] staged=[${{staged_tarballs:-}}] >> "{log}"\n'
+               'for a in "$@"; do [[ "$a" == "--parsable" ]] && { echo 1234; exit 0; }; done\n'
+               'echo "Submitted batch job 1234"\n')
+    _make_stub(bin_dir, "squeue", "exit 0\n")  # empty queue → install poll returns
+    _make_stub(bin_dir, "scontrol", "echo node001\n")
+    env = dict(os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}")
+    return env, log
+
+
+def _submit(env, tmp_path, *flags):
+    return subprocess.run(
+        ["bash", "launch/job_submitter.sh", "-n", "-s", str(tmp_path / "scratch"),
+         "-e", "exp", *flags],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+
+
+class TestJobSubmitter:
+    def test_standard_job_shape(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "standard")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "launch/standard_job.sh" in call
+        assert "--ntasks-per-node=1" in call
+        # Experiment workspace provisioned (job_submitter.sh:157-163 parity).
+        exp = tmp_path / "scratch" / "repo" / "exp"
+        assert (exp / "checkpoints").is_dir() and (exp / "hpc_outputs").is_dir()
+
+    def test_distributed_tpurun_shape(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "distributed", "-N", "2", "-g", "4", "-c", "2")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "launch/distributed_dispatcher.sh" in call
+        # ntasks-per-node=1, cpus multiplied by chips (job_submitter.sh:290-291).
+        assert "--ntasks-per-node=1" in call
+        assert "--cpus-per-task=8" in call
+        assert "chips_per_node=4" in call and "workflow=tpurun" in call
+        assert "--nodes=2" in call
+
+    def test_distributed_trainer_shape(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "distributed", "-W", "trainer",
+                    "-N", "2", "-g", "4")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        # Lightning shape: one task per chip (job_submitter.sh:288 parity).
+        assert "--ntasks-per-node=4" in call
+        assert "workflow=trainer" in call
+        # Per-workflow default config file, shipped via the environment.
+        assert "cmd=[python examples/demo_trainer.py" in call
+
+    def test_sweep_array_sized_from_grid(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "sweep")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        # launch/sweeper.yml grid = 3*2*2 = 12 → array 0-11, throttled %10.
+        assert "--array=0-11%10" in call
+        assert "sweep_spec=" in call
+
+    def test_multiple_tarballs_survive_export(self, slurm_stubs, tmp_path):
+        """Comma-separated tarball lists must ride the environment — sbatch
+        --export would split them (and any cmd containing commas)."""
+        env, log = slurm_stubs
+        (tmp_path / "da").mkdir()
+        (tmp_path / "db").mkdir()
+        r = _submit(env, tmp_path, "-j", "standard",
+                    "-d", f"{tmp_path}/da,{tmp_path}/db")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "staged=[" in call
+        staged = call.split("staged=[")[1].split("]")[0]
+        assert staged.endswith("da.tar," + str(tmp_path / "scratch")
+                               + "/repo/exp/data/db.tar")
+        assert "staged_tarballs" not in call.split("--export=")[1].split()[0]
+
+    def test_container_mode_swaps_job_scripts(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "distributed", "-g", "2",
+                    "-C", "/images/tpudist.sif")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "launch/container/distributed_dispatcher.sh" in call
+        assert "sif_path=/images/tpudist.sif" in call
+        assert "--ntasks-per-node=2" in call  # one containerized task per rank
+        # tpurun's cpus×chips multiplier must be undone for per-rank tasks.
+        assert "--cpus-per-task=4" in call and "--cpus-per-task=8" not in call
+
+    def test_install_env_polls_queue(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "standard", "-i")
+        assert r.returncode == 0, r.stderr
+        calls = log.read_text().splitlines()
+        assert any("install_python_packages.sh" in c for c in calls)
+        assert any("standard_job.sh" in c for c in calls)
+        assert "install job 1234 finished" in r.stdout
+
+    def test_bad_job_type_rejected(self, slurm_stubs, tmp_path):
+        env, _ = slurm_stubs
+        assert _submit(env, tmp_path, "-j", "bogus").returncode == 2
+        assert _submit(env, tmp_path, "-j", "distributed",
+                       "-W", "bogus").returncode == 2
+
+    def test_help_prints_usage(self, slurm_stubs, tmp_path):
+        env, _ = slurm_stubs
+        r = subprocess.run(["bash", "launch/job_submitter.sh", "-h"],
+                           cwd=REPO, env=env, capture_output=True, text=True)
+        assert r.returncode == 0
+        assert "-W WORKFLOW" in r.stdout and "tpurun" in r.stdout
+
+
+class TestTrainerLauncher:
+    def test_strips_topology_flags_and_exports_contract(self, tmp_path):
+        """lightning_launcher.sh:12-14 parity: launcher-owned topology."""
+        worker = tmp_path / "worker.py"
+        worker.write_text(
+            "import json, os, sys\n"
+            "print(json.dumps({'argv': sys.argv[1:],\n"
+            "  'world': os.environ['WORLD_SIZE'],\n"
+            "  'tpn': os.environ['TASKS_PER_NODE']}))\n"
+        )
+        env = dict(
+            os.environ,
+            cmd=f"{sys.executable} {worker} --use_node_rank --seed 0 --torchrun",
+        )
+        r = subprocess.run(
+            ["bash", "launch/trainer_launcher.sh", "2", "4", ""],
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert "--use_node_rank" not in out["argv"]
+        assert "--torchrun" not in out["argv"]
+        assert "--seed" in out["argv"]
+        assert out["world"] == "8" and out["tpn"] == "4"
+
+    def test_rejects_non_python_cmd(self):
+        env = dict(os.environ, cmd="bash -c true")
+        r = subprocess.run(["bash", "launch/trainer_launcher.sh", "1", "1", ""],
+                           cwd=REPO, env=env, capture_output=True, text=True)
+        assert r.returncode == 2

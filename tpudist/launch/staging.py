@@ -44,7 +44,12 @@ def extract_tarballs(tarballs: Iterable[str | Path], dest: str | Path) -> List[P
             raise FileNotFoundError(f"staged tarball not found: {tb}")
         t0 = time.time()
         with tarfile.open(tb) as tf:
-            tf.extractall(dest, filter="data")
+            try:
+                tf.extractall(dest, filter="data")
+            except TypeError:
+                # Python <3.10.12 predates the filter= kwarg; these tarballs
+                # are our own staging artifacts, so plain extraction is fine.
+                tf.extractall(dest)
             names = tf.getnames()
         top = dest / names[0].split("/")[0] if names else dest
         roots.append(top)
