@@ -22,7 +22,13 @@ shared="${SLURM_TMPDIR:-/tmp}/tpudist_${job_id}_shared"
 # Per-task dir: overlays + workdir, safe to clean on our own exit.
 task_tmp="${SLURM_TMPDIR:-/tmp}/tpudist_${job_id}_task${task_id}"
 mkdir -p "${shared}" "${task_tmp}"
-trap 'rm -rf "${task_tmp}"' EXIT
+# Single-task jobs (the -j standard container path) own the shared dir too;
+# multi-task jobs leave it for the dispatcher's per-node cleanup pass.
+if [[ "${SLURM_NTASKS:-1}" -le 1 ]]; then
+  trap 'rm -rf "${task_tmp}" "${shared}"' EXIT
+else
+  trap 'rm -rf "${task_tmp}"' EXIT
+fi
 
 local_sif="${shared}/$(basename "${sif_path}")"
 sentinel="${shared}/.staged"
@@ -36,7 +42,15 @@ if [[ "${local_id}" == "0" ]]; then
   fi
   touch "${sentinel}"
 else
-  while [[ ! -f "${sentinel}" ]]; do sleep 1; done
+  # Bounded wait — fail fast if the LOCALID-0 staging task died.
+  waited=0
+  while [[ ! -f "${sentinel}" ]]; do
+    sleep 1; waited=$((waited + 1))
+    if [[ "${waited}" -ge "${TPUDIST_STAGE_TIMEOUT:-600}" ]]; then
+      echo "staging sentinel never appeared (rank-0 staging failed?)" >&2
+      exit 1
+    fi
+  done
 fi
 
 # Per-job overlay dirs (reference :30-62): writable tmp/home/workdir so the

@@ -76,6 +76,7 @@ case "${workflow}" in tpurun|trainer) ;; *)
 # lightning_configs.txt split, job_submitter.sh:296-300).
 if [[ -z "${exp_configs_path}" ]]; then
   case "${job_type}/${workflow}" in
+    sweep/*)             exp_configs_path="launch/sweep_cmd.txt" ;;
     distributed/trainer) exp_configs_path="launch/trainer_configs.txt" ;;
     distributed/tpurun)  exp_configs_path="launch/distributed_configs.txt" ;;
     *)                   exp_configs_path="launch/experiment_configurations.txt" ;;
@@ -108,7 +109,21 @@ if [[ "${install_env}" -eq 1 ]]; then
     --time=00:30:00 --mem=4G --cpus-per-task=2 --output="${install_out}" \
     --export="ALL,source_dir=${source_dir}" launch/install_python_packages.sh)"
   echo "waiting for install job ${install_id}…"
-  while squeue -h -j "${install_id}" 2>/dev/null | grep -q .; do sleep 10; done
+  # A failing squeue is NOT job completion — retry transient scheduler
+  # errors, give up after 30 consecutive failures.
+  squeue_fails=0
+  while true; do
+    if q_out="$(squeue -h -j "${install_id}" 2>/dev/null)"; then
+      squeue_fails=0
+      [[ -z "${q_out}" ]] && break
+    else
+      squeue_fails=$((squeue_fails + 1))
+      if [[ "${squeue_fails}" -ge 30 ]]; then
+        echo "squeue unreachable while waiting for install job" >&2; exit 1
+      fi
+    fi
+    sleep 10
+  done
   echo "install job ${install_id} finished"
 fi
 
@@ -175,9 +190,20 @@ if [[ -n "${sif_path}" ]]; then
   case "${job_type}" in
     distributed)
       # One containerized task per rank; ranks derive from forwarded SLURM
-      # env — so undo the tpurun shape (1 fat agent task with cpus×chips).
-      sbatch_cmd=("${sbatch_cmd[@]/--ntasks-per-node=1/--ntasks-per-node=${chips}}")
-      sbatch_cmd=("${sbatch_cmd[@]/--cpus-per-task=$((cpus * chips))/--cpus-per-task=${cpus}}")
+      # env.  Only the tpurun shape (1 fat agent task with cpus×chips) needs
+      # undoing — rebuild those two elements exactly rather than pattern-
+      # substituting (a substring pattern would corrupt e.g. `=16` → `=166`).
+      if [[ "${workflow}" == "tpurun" ]]; then
+        rebuilt=()
+        for el in "${sbatch_cmd[@]}"; do
+          case "${el}" in
+            --ntasks-per-node=1) rebuilt+=("--ntasks-per-node=${chips}") ;;
+            --cpus-per-task=*)   rebuilt+=("--cpus-per-task=${cpus}") ;;
+            *)                   rebuilt+=("${el}") ;;
+          esac
+        done
+        sbatch_cmd=("${rebuilt[@]}")
+      fi
       hpc_file="launch/container/distributed_dispatcher.sh"
       ;;
     *) hpc_file="launch/container/standard_job.sh" ;;

@@ -18,10 +18,10 @@ if [[ -n "${staged_tarballs:-}" ]]; then
   for tb in "${tbs[@]}"; do time tar -xf "${tb}" -C "${TPUDIST_TMPDIR}"; done
 fi
 
+# Sweep jobs: cmd comes from launch/sweep_cmd.txt with a ${sweep_spec}
+# placeholder; the agent picks its configuration index from
+# SLURM_ARRAY_TASK_ID (one array task = one configuration, §3.5).
 if [[ -n "${sweep_spec:-}" ]]; then
-  # One array task = one sweep configuration (§3.5 sweep path).
-  python -m tpudist.launch.sweep agent "${sweep_spec}" \
-    --index "${SLURM_ARRAY_TASK_ID:-0}"
-else
-  ${cmd:?}
+  cmd="${cmd//'${sweep_spec}'/${sweep_spec}}"
 fi
+${cmd:?}

@@ -38,7 +38,16 @@ if [[ -n "${tarballs}" ]]; then
     for tb in "${tbs[@]}"; do time tar -xf "${tb}" -C "${tmp}"; done
     touch "${sentinel}"
   else
-    while [[ ! -f "${sentinel}" ]]; do sleep 1; done
+    # Bounded wait: if the staging task died before touching the sentinel,
+    # fail fast instead of idling the allocation until walltime.
+    waited=0
+    while [[ ! -f "${sentinel}" ]]; do
+      sleep 1; waited=$((waited + 1))
+      if [[ "${waited}" -ge "${TPUDIST_STAGE_TIMEOUT:-600}" ]]; then
+        echo "staging sentinel never appeared (rank-0 staging failed?)" >&2
+        exit 1
+      fi
+    done
   fi
 fi
 

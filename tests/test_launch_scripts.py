@@ -98,6 +98,9 @@ class TestJobSubmitter:
         # launch/sweeper.yml grid = 3*2*2 = 12 → array 0-11, throttled %10.
         assert "--array=0-11%10" in call
         assert "sweep_spec=" in call
+        # Sweep cmd comes from sweep_cmd.txt with the spec placeholder
+        # expanded by standard_job.sh at run time.
+        assert "cmd=[python -m tpudist.launch.sweep agent ${sweep_spec}]" in call
 
     def test_multiple_tarballs_survive_export(self, slurm_stubs, tmp_path):
         """Comma-separated tarball lists must ride the environment — sbatch
@@ -126,6 +129,33 @@ class TestJobSubmitter:
         assert "--ntasks-per-node=2" in call  # one containerized task per rank
         # tpurun's cpus×chips multiplier must be undone for per-rank tasks.
         assert "--cpus-per-task=4" in call and "--cpus-per-task=8" not in call
+
+    def test_container_trainer_keeps_task_shape(self, slurm_stubs, tmp_path):
+        """Container mode must not rewrite the trainer workflow's task count
+        (a substring substitution once corrupted =16 into =166)."""
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "distributed", "-W", "trainer",
+                    "-g", "16", "-C", "/images/t.sif")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "--ntasks-per-node=16" in call
+        assert "--ntasks-per-node=166" not in call
+
+    def test_standard_job_expands_sweep_placeholder(self, tmp_path):
+        """standard_job.sh substitutes ${sweep_spec} into the sweep command."""
+        worker = tmp_path / "worker.py"
+        worker.write_text("import sys; print('ARGS:' + ','.join(sys.argv[1:]))\n")
+        env = dict(
+            os.environ,
+            source_dir=str(REPO),
+            cmd=f"{sys.executable} {worker} ${{sweep_spec}}",
+            sweep_spec="/specs/grid.yml",
+            SLURM_TMPDIR=str(tmp_path),
+        )
+        r = subprocess.run(["bash", "launch/standard_job.sh"],
+                           cwd=REPO, env=env, capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "ARGS:/specs/grid.yml" in r.stdout
 
     def test_install_env_polls_queue(self, slurm_stubs, tmp_path):
         env, log = slurm_stubs

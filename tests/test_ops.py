@@ -1,0 +1,90 @@
+"""Pallas kernel correctness tests (interpreter mode on the CPU mesh),
+checked against the dense XLA references — the pattern SURVEY.md §4
+prescribes for doing better than the reference's zero-test strategy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.ops import flash_attention, fused_mlp, mlp_reference, pad_params
+from tpudist.parallel import attention_reference
+
+
+class TestFlashAttention:
+    def _qkv(self, seq=256, batch=2, heads=2, d=64, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return tuple(
+            jax.random.normal(k, (batch, heads, seq, d), jnp.float32) for k in ks
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = self._qkv()
+        out = flash_attention(q, k, v, causal, 128, 128, True)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_small_seq_clamps_blocks(self):
+        q, k, v = self._qkv(seq=64)
+        out = flash_attention(q, k, v, False, 128, 128, True)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_reference(self):
+        q, k, v = self._qkv(seq=128)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, 64, 64, True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_indivisible_seq_raises(self):
+        q, k, v = self._qkv(seq=100)
+        with pytest.raises(ValueError, match="divide"):
+            flash_attention(q, k, v, False, 64, 64, True)
+
+
+class TestFusedMLP:
+    def _toy_weights(self, seed=0):
+        """The reference MLP shape: 2→10→10→10→10→1 (toy_model_and_data.py)."""
+        dims = [2, 10, 10, 10, 10, 1]
+        ks = jax.random.split(jax.random.PRNGKey(seed), len(dims) - 1)
+        return [
+            (jax.random.normal(k, (i, o)) / np.sqrt(i), jnp.zeros((o,)))
+            for k, i, o in zip(ks, dims[:-1], dims[1:])
+        ]
+
+    def test_matches_reference(self):
+        weights = self._toy_weights()
+        x = jax.random.normal(jax.random.PRNGKey(1), (512, 2))
+        padded, _, d_out = pad_params(weights)
+        out = fused_mlp(x, padded, d_out, interpret=True)
+        ref = mlp_reference(x, weights)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_batch_tiling(self):
+        weights = self._toy_weights()
+        x = jax.random.normal(jax.random.PRNGKey(1), (1024, 2))
+        padded, _, d_out = pad_params(weights)
+        out = fused_mlp(x, padded, d_out, block_batch=256, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(mlp_reference(x, weights)),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_indivisible_batch_raises(self):
+        weights = self._toy_weights()
+        padded, _, d_out = pad_params(weights)
+        x = jnp.zeros((300, 2))
+        with pytest.raises(ValueError, match="divide"):
+            fused_mlp(x, padded, d_out, block_batch=256, interpret=True)

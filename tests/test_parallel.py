@@ -1,0 +1,229 @@
+"""Parallelism-strategy tests on the 8-device virtual mesh: every strategy
+is checked numerically against its single-device dense reference, forward
+AND backward (the construct must train, not just infer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.parallel import (
+    MoEStats,
+    attention_reference,
+    init_mlp_params,
+    make_moe,
+    make_pipeline,
+    make_ring_attention,
+    make_tp_mlp,
+    mlp_param_sharding,
+)
+from tpudist.runtime.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_STAGE
+
+
+@pytest.fixture()
+def seq_mesh(devices):
+    return Mesh(np.asarray(devices), axis_names=(AXIS_SEQ,))
+
+
+@pytest.fixture()
+def model_mesh(devices):
+    return Mesh(np.asarray(devices), axis_names=(AXIS_MODEL,))
+
+
+@pytest.fixture()
+def stage_mesh(devices):
+    return Mesh(np.asarray(devices[:4]), axis_names=(AXIS_STAGE,))
+
+
+class TestRingAttention:
+    def _qkv(self, seq=64, batch=2, heads=4, d=16, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (batch, heads, seq, d)
+        return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, seq_mesh, causal):
+        q, k, v = self._qkv()
+        ring = make_ring_attention(seq_mesh, causal=causal)
+        out = ring(q, k, v)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_reference(self, seq_mesh):
+        """The ring formulation must train: grads through ppermute + online
+        softmax equal the dense-attention grads."""
+        q, k, v = self._qkv(seq=32)
+        ring = make_ring_attention(seq_mesh, causal=True)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring(q, k, v) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_sharded_inputs_stay_sharded(self, seq_mesh):
+        """Device-placement check: with inputs laid out on the seq axis the
+        output is seq-sharded too — no implicit gather of the long axis."""
+        q, k, v = self._qkv()
+        spec = NamedSharding(seq_mesh, P(None, None, AXIS_SEQ, None))
+        q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
+        out = make_ring_attention(seq_mesh)(q, k, v)
+        assert out.sharding.spec == P(None, None, AXIS_SEQ, None)
+
+    def test_seq_not_divisible_raises(self, seq_mesh):
+        q, k, v = self._qkv(seq=60)  # 60 % 8 != 0
+        with pytest.raises(Exception):
+            make_ring_attention(seq_mesh)(q, k, v)
+
+
+class TestTensorParallel:
+    def _reference(self, params, x):
+        h = jax.nn.gelu(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def test_matches_dense(self, model_mesh):
+        params = init_mlp_params(jax.random.PRNGKey(0), d_model=32, d_hidden=128)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 32))
+        tp = make_tp_mlp(model_mesh)
+        np.testing.assert_allclose(
+            np.asarray(tp(params, x)), np.asarray(self._reference(params, x)),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_gradients_match_dense(self, model_mesh):
+        params = init_mlp_params(jax.random.PRNGKey(0), d_model=16, d_hidden=64)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+        tp = make_tp_mlp(model_mesh)
+        g_tp = jax.grad(lambda p: jnp.sum(tp(p, x) ** 2))(params)
+        g_ref = jax.grad(lambda p: jnp.sum(self._reference(p, x) ** 2))(params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(g_tp[k]), np.asarray(g_ref[k]),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_weights_actually_sharded(self, model_mesh):
+        """w1 columns / w2 rows live on distinct devices (the "verify stages
+        actually place on distinct chips" concern, SURVEY.md §7 hard part e)."""
+        params = init_mlp_params(jax.random.PRNGKey(0), d_model=32, d_hidden=128)
+        sharded = jax.device_put(params, mlp_param_sharding(model_mesh, params))
+        assert sharded["w1"].sharding.spec == P(None, AXIS_MODEL)
+        assert sharded["w2"].sharding.spec == P(AXIS_MODEL, None)
+        # 128 hidden / 8 devices = 16-column shards per device.
+        shard = sharded["w1"].addressable_shards[0]
+        assert shard.data.shape == (32, 16)
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+class TestPipeline:
+    def _stacked_params(self, n_stages, d, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), n_stages)
+        return {
+            "w": jnp.stack([jax.random.normal(k, (d, d)) / np.sqrt(d) for k in ks]),
+            "b": jnp.zeros((n_stages, d)),
+        }
+
+    def _reference(self, stacked, x):
+        for i in range(stacked["w"].shape[0]):
+            x = _stage_fn({"w": stacked["w"][i], "b": stacked["b"][i]}, x)
+        return x
+
+    @pytest.mark.parametrize("num_micro", [4, 8])
+    def test_matches_sequential(self, stage_mesh, num_micro):
+        d = 16
+        stacked = self._stacked_params(4, d)
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, d))
+        pipe = make_pipeline(stage_mesh, _stage_fn, num_microbatches=num_micro)
+        np.testing.assert_allclose(
+            np.asarray(pipe(stacked, x)), np.asarray(self._reference(stacked, x)),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_gradients_match_sequential(self, stage_mesh):
+        d = 8
+        stacked = self._stacked_params(4, d)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, d))
+        pipe = make_pipeline(stage_mesh, _stage_fn, num_microbatches=4)
+        g_pipe = jax.grad(lambda p: jnp.sum(pipe(p, x) ** 2))(stacked)
+        g_ref = jax.grad(lambda p: jnp.sum(self._reference(p, x) ** 2))(stacked)
+        for k in stacked:
+            np.testing.assert_allclose(np.asarray(g_pipe[k]), np.asarray(g_ref[k]),
+                                       atol=1e-4, rtol=1e-4)
+
+
+def _expert_fn(params, tokens):
+    return jax.nn.relu(tokens @ params["w"]) @ params["wo"]
+
+
+class TestMoE:
+    def _params(self, d=16, hidden=32, n_experts=8, seed=0):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return {
+            "router": jax.random.normal(k1, (d, n_experts)),
+            "experts": {
+                "w": jax.random.normal(k2, (n_experts, d, hidden)) / np.sqrt(d),
+                "wo": jax.random.normal(k3, (n_experts, hidden, d)) / np.sqrt(hidden),
+            },
+        }
+
+    def _reference(self, params, x, capacity):
+        """Dense routing with the same capacity-drop semantics."""
+        probs = jax.nn.softmax(x @ params["router"], axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        out = jnp.zeros_like(x)
+        counts = {}
+        for t in range(x.shape[0]):
+            e = int(idx[t])
+            counts[e] = counts.get(e, 0)
+            if counts[e] < capacity:
+                ex = jax.tree.map(lambda a, e=e: a[e], params["experts"])
+                out = out.at[t].set(gate[t] * _expert_fn(ex, x[t][None])[0])
+            counts[e] += 1
+        return out
+
+    def test_matches_dense_routing(self, model_mesh):
+        d, tokens = 16, 64
+        params = self._params(d=d)
+        x = jax.random.normal(jax.random.PRNGKey(1), (tokens, d))
+        capacity = int(1.25 * tokens / 8 + 0.5)
+        moe = make_moe(model_mesh, _expert_fn)
+        out, stats = moe(params, x)
+        ref = self._reference(params, x, capacity)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        assert isinstance(stats, MoEStats)
+        assert 0.0 <= float(stats.dropped_fraction) <= 1.0
+        np.testing.assert_allclose(float(jnp.sum(stats.expert_load)), 1.0,
+                                   atol=1e-6)
+
+    def test_trains(self, model_mesh):
+        """Router + experts receive nonzero gradients through the dispatch."""
+        params = self._params()
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+        moe = make_moe(model_mesh, _expert_fn)
+        g = jax.grad(lambda p: jnp.sum(moe(p, x)[0] ** 2))(params)
+        assert float(jnp.abs(g["router"]).sum()) > 0
+        assert float(jnp.abs(g["experts"]["w"]).sum()) > 0
+
+
+class TestComposedMesh:
+    def test_dp_times_sp_attention(self, devices):
+        """2×4 (data × seq) mesh: batch and sequence sharded simultaneously."""
+        mesh = Mesh(np.asarray(devices).reshape(2, 4),
+                    axis_names=(AXIS_DATA, AXIS_SEQ))
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (4, 2, 32, 8)) for kk in ks)
+        ring = make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)

@@ -1,2 +1,16 @@
-"""Pallas TPU kernels for hot ops (flash attention, fused MLP) with jnp
-reference implementations used as CPU fallbacks and in correctness tests."""
+"""Pallas TPU kernels for hot ops, with XLA reference implementations used
+as fallbacks and in correctness tests (interpret mode on CPU).
+
+- :mod:`flash_attention` — blockwise online-softmax attention; pairs with
+  ``tpudist.parallel.ring_attention`` (ring shards between chips, flash
+  blocks within a chip).
+- :mod:`fused_mlp` — the toy workload's 5-layer MLP in one kernel, weights
+  zero-padded to lane-aligned tiles, activations pinned in VMEM.
+"""
+
+from tpudist.ops.flash_attention import flash_attention  # noqa: F401
+from tpudist.ops.fused_mlp import (  # noqa: F401
+    fused_mlp,
+    mlp_reference,
+    pad_params,
+)

@@ -1,0 +1,158 @@
+"""Pallas TPU flash attention (blockwise online-softmax) kernel.
+
+The single-chip hot op behind the long-context path: materializes no
+``[seq, seq]`` score matrix — Q blocks stream from HBM into VMEM per grid
+step, K/V blocks are walked with a ``fori_loop`` carrying the (m, l, acc)
+online-softmax triple, both matmuls per block land on the MXU.  Combined
+with :mod:`tpudist.parallel.ring_attention` (which rotates K/V between
+chips), this covers intra-chip blocking while the ring covers inter-chip
+sharding.
+
+Backward: ``jax.custom_vjp`` whose bwd recomputes attention with the dense
+XLA formulation and differentiates that — flash recompute-style memory
+behavior on the forward, XLA-fused gradients on the backward.  The fwd/bwd
+outputs match ``attention_reference`` exactly (see tests).
+
+No reference counterpart (the reference has no attention and ships no
+kernels of its own — SURVEY.md §0, §5.7); this is TPU-native capability.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpudist.parallel.ring_attention import attention_reference
+
+_MASK_VALUE = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float):
+    """One grid step: one Q block against every K/V block of its (b,h) row.
+
+    Ref shapes: q/o ``[1, block_q, d]``; k/v ``[1, seq_k, d]`` (whole row in
+    VMEM — block over KV too if seq outgrows VMEM; the ring shards first).
+    """
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    num_kv = seq_k // block_k
+    qi = pl.program_id(1)
+
+    def body(kv, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kv * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kv * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kv * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _MASK_VALUE)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # Blocks strictly above the diagonal are fully masked — skip them.
+        num_live = jnp.minimum(
+            ((qi + 1) * block_q + block_k - 1) // block_k, num_kv
+        )
+        m, l, acc = lax.fori_loop(0, num_live, body, (m0, l0, acc0))
+    else:
+        m, l, acc = lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
+    batch, heads, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    if seq_q % bq or seq_k % bk:
+        raise ValueError(
+            f"seq lengths ({seq_q}, {seq_k}) must divide block sizes ({bq}, {bk})"
+        )
+    scale = d ** -0.5
+    bh = batch * heads
+    qr = q.reshape(bh, seq_q, d)
+    kr = k.reshape(bh, seq_k, d)
+    vr = v.reshape(bh, seq_k, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=bk, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        grid=(bh, seq_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, seq_q, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over ``[batch, heads, seq, head_dim]`` inputs.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
+    testing); on TPU leave it False.
+    """
+    return _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        functools.partial(attention_reference, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

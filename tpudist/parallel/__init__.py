@@ -1,7 +1,33 @@
-"""Parallelism building blocks: DP (shard_map formulation), tensor parallel,
-pipeline, ring-attention sequence parallel, MoE expert parallel.
+"""Parallelism building blocks beyond plain data parallelism.
 
-Populated incrementally; the pjit DP formulation lives in
-``tpudist.train.step`` (parameters replicated, batch sharded — XLA inserts
-the gradient all-reduce).
+The pjit DP formulation (parameters replicated, batch sharded, XLA inserts
+the gradient all-reduce) lives in ``tpudist.train.step``; the 2-stage
+model-split parity shape in ``tpudist.models.split_mlp``.  This package
+holds the scalable strategies on the 4-axis mesh
+(``tpudist.runtime.mesh``):
+
+- :mod:`ring_attention` — sequence/context parallelism (``seq`` axis):
+  blockwise attention with K/V rotating over ICI via ``ppermute``.
+- :mod:`tensor_parallel` — Megatron-style column/row linear pairs
+  (``model`` axis), both pjit-spec and explicit-``psum`` forms.
+- :mod:`pipeline` — microbatched GPipe schedule (``stage`` axis) with
+  activations hopping the ring inside one jitted ``lax.scan``.
+- :mod:`moe` — capacity-based top-1 expert parallelism with a single
+  fused ``all_to_all`` each way (``model`` axis as the expert group).
 """
+
+from tpudist.parallel.ring_attention import (  # noqa: F401
+    attention_reference,
+    make_ring_attention,
+    ring_attention_shard,
+)
+from tpudist.parallel.tensor_parallel import (  # noqa: F401
+    column_spec,
+    init_mlp_params,
+    make_tp_mlp,
+    mlp_param_sharding,
+    row_spec,
+    tp_mlp_shard,
+)
+from tpudist.parallel.pipeline import make_pipeline, pipeline_shard  # noqa: F401
+from tpudist.parallel.moe import MoEStats, make_moe, moe_shard  # noqa: F401

@@ -1,0 +1,142 @@
+"""Expert parallelism: top-1 switch-routing MoE with ``all_to_all``
+token exchange over the ``model`` (expert) mesh axis.
+
+Absent from the reference (SURVEY.md §2.4: EP "not required for parity");
+provided as the TPU-native extension.  Design, TPU-first:
+
+- **capacity-based dispatch**: every device sends exactly
+  ``capacity`` token slots to every expert — static shapes, no
+  data-dependent gathers, so XLA can tile the expert matmuls on the MXU;
+  overflow tokens are dropped (standard Switch-Transformer semantics) and
+  their outputs fall back to zero, surfaced via the returned stats.
+- **one `lax.all_to_all` each way**: dispatch and return ride a single
+  fused ICI collective rather than per-expert sends.
+- differentiable: routing probabilities multiply the combined output
+  (straight-through on the argmax route), so router + experts train.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.runtime.mesh import AXIS_MODEL
+
+# ExpertFn: (expert_params, tokens [slots, d]) -> [slots, d]
+ExpertFn = Callable[[dict, jax.Array], jax.Array]
+
+
+class MoEStats(NamedTuple):
+    """Per-shard routing observability (host-side metrics material)."""
+
+    dropped_fraction: jax.Array  # scalar: tokens that overflowed capacity
+    expert_load: jax.Array  # [n_experts]: fraction routed to each expert
+
+
+def _one_hot_dispatch(router_logits, n_experts, capacity):
+    """Build the [tokens, experts, capacity] dispatch/combine tensors."""
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [tokens]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    expert_1h = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
+    # Position of each token within its expert's queue (prefix count).
+    pos_in_expert = jnp.cumsum(expert_1h, axis=0) * expert_1h - expert_1h
+    pos = jnp.sum(pos_in_expert, axis=-1)  # [tokens]
+    kept = pos < capacity
+
+    dispatch = (
+        expert_1h[:, :, None].astype(jnp.float32)
+        * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+        * kept[:, None, None]
+    )  # [tokens, experts, capacity]
+    combine = dispatch * gate[:, None, None]
+    stats = MoEStats(
+        dropped_fraction=1.0 - jnp.mean(kept.astype(jnp.float32)),
+        expert_load=jnp.mean(expert_1h.astype(jnp.float32), axis=0),
+    )
+    return dispatch, combine, stats
+
+
+def moe_shard(
+    params: dict,
+    x: jax.Array,
+    *,
+    expert_fn: ExpertFn,
+    capacity_factor: float = 1.25,
+    axis_name: str = AXIS_MODEL,
+):
+    """Shard-local MoE body (call inside ``shard_map``).
+
+    ``params = {'router': [d, n_experts], 'experts': pytree with leading
+    local-expert axis}``; ``x: [local_tokens, d]``.  One expert per device
+    (n_experts == axis size); generalizing to k experts/device only changes
+    the reshape arithmetic.
+    """
+    n_experts = lax.axis_size(axis_name)
+    tokens = x.shape[0]
+    capacity = int(capacity_factor * tokens / n_experts + 0.5)
+
+    dispatch, combine, stats = _one_hot_dispatch(
+        x @ params["router"], n_experts, capacity
+    )
+    # [tokens, experts, cap] × [tokens, d] -> [experts, cap, d]
+    expert_inputs = jnp.einsum("tec,td->ecd", dispatch, x)
+    # Exchange: each device keeps rows for ITS expert from every peer.
+    # -> [peers, cap, d] on each device (split experts, concat peers).
+    expert_inputs = lax.all_to_all(
+        expert_inputs, axis_name, split_axis=0, concat_axis=0
+    )
+    local_expert = jax.tree.map(lambda a: a[0], params["experts"])
+    expert_out = expert_fn(
+        local_expert, expert_inputs.reshape(-1, x.shape[-1])
+    ).reshape(expert_inputs.shape)
+    # Return trip: rows go back to their source device.
+    expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0, concat_axis=0)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    # Stats become job-global means so every shard returns the same value
+    # (replicated out-spec) — the host logs them off the compiled path, the
+    # reference's metric-reduction discipline (SURVEY.md §5.5).
+    stats = MoEStats(*(lax.pmean(s, axis_name) for s in stats))
+    return out, stats
+
+
+def make_moe(
+    mesh: Mesh,
+    expert_fn: ExpertFn,
+    *,
+    axis_name: str = AXIS_MODEL,
+    batch_axis: str | None = None,
+    capacity_factor: float = 1.25,
+):
+    """Jitted global-view MoE layer over ``mesh``.
+
+    ``params['experts']`` arrives stacked ``[n_experts, ...]`` sharded over
+    ``axis_name``; ``x: [tokens, d]`` sharded over ``batch_axis`` (or
+    replicated).  Returns ``(y, MoEStats)`` with per-shard stats.
+    """
+    def body(params, x):
+        out, stats = moe_shard(
+            params, x,
+            expert_fn=expert_fn,
+            capacity_factor=capacity_factor,
+            axis_name=axis_name,
+        )
+        if batch_axis is not None:
+            stats = MoEStats(*(lax.pmean(s, batch_axis) for s in stats))
+        return out, stats
+
+    param_specs = {"router": P(), "experts": P(axis_name)}
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P(batch_axis, None)),
+        out_specs=(P(batch_axis, None), MoEStats(P(), P())),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

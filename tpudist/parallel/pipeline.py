@@ -1,0 +1,138 @@
+"""Pipeline parallelism: microbatched GPipe-style schedule over the
+``stage`` mesh axis.
+
+The reference's only model parallelism is a manual 2-stage vertical split
+with the activation hand-carried between two GPUs inside ``forward``
+(``demo_one_model_multi_gpu.py:36-42``) — no microbatching, no schedule.
+The TPU-native generalization here runs N stages on N devices with
+``lax.ppermute`` moving activations stage-to-stage over ICI and a rotating
+microbatch schedule, all inside one jitted ``shard_map``:
+
+- each device holds ONE stage's params (sharded on the ``stage`` axis);
+- the loop runs ``num_microbatches + num_stages - 1`` ticks (pipeline
+  fill + drain); at every tick each device applies its stage to the
+  activation it holds, then the activations rotate one hop;
+- compiler-friendly: the tick loop is a ``lax.scan`` over stacked
+  microbatches, static shapes throughout, no data-dependent control flow;
+- differentiable end-to-end (ppermute transposes to the reverse ring), so
+  the same code trains — unlike hand-written send/recv schedules.
+
+For the reference's exact 2-stage shape (parity), see
+``tpudist.models.split_mlp`` which expresses it as layer sharding instead;
+this module is the scalable schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.runtime.mesh import AXIS_STAGE
+
+# StageFn: (stage_params, activation [micro_batch, d]) -> activation
+StageFn = Callable[[dict, jax.Array], jax.Array]
+
+
+def pipeline_shard(
+    stage_params,
+    x_microbatches: jax.Array,
+    *,
+    stage_fn: StageFn,
+    axis_name: str = AXIS_STAGE,
+) -> jax.Array:
+    """Shard-local GPipe body (call inside ``shard_map``).
+
+    ``stage_params``: this device's stage weights, arriving as a
+    size-1-leading-axis block of the ``[n_stages, ...]`` stack (shard_map
+    keeps the sharded dim).  ``x_microbatches``:
+    ``[num_micro, micro_size, d]`` — the full input lives on stage 0; other
+    stages ignore their copy (shard_map replicates it when the caller
+    passes ``P(None, ...)``; pass it sharded over stages to save memory and
+    only stage 0's block is read).
+
+    Returns ``[num_micro, micro_size, d]`` of final-stage outputs, valid on
+    the LAST stage (other stages return zeros) — the caller's out_spec
+    gathers from the stage axis.
+    """
+    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    n_stages = lax.axis_size(axis_name)
+    my_stage = lax.axis_index(axis_name)
+    num_micro = x_microbatches.shape[0]
+    micro_shape = x_microbatches.shape[1:]
+    total_ticks = num_micro + n_stages - 1
+
+    # Shift perm: stage i -> i+1 (last stage's output falls off the end).
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outputs = carry  # state: activation this device holds
+        # Stage 0 feeds a fresh microbatch while any remain; other stages
+        # use what arrived from the left neighbor.
+        feed_idx = jnp.minimum(t, num_micro - 1)
+        fresh = lax.dynamic_index_in_dim(
+            x_microbatches, feed_idx, axis=0, keepdims=False
+        )
+        inp = jnp.where(my_stage == 0, fresh, state)
+        out = stage_fn(stage_params, inp)
+
+        # Last stage banks its result for microbatch (t - n_stages + 1).
+        bank_idx = t - (n_stages - 1)
+        is_valid = jnp.logical_and(my_stage == n_stages - 1, bank_idx >= 0)
+        outputs = lax.cond(
+            is_valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out, jnp.maximum(bank_idx, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    init_state = jnp.zeros(micro_shape, x_microbatches.dtype)
+    init_out = jnp.zeros((num_micro,) + micro_shape, x_microbatches.dtype)
+    (_, outputs), _ = lax.scan(
+        tick, (init_state, init_out), jnp.arange(total_ticks)
+    )
+    # Only the last stage holds real outputs; psum broadcasts them so the
+    # result is replicated over the stage axis (cheap: zeros elsewhere).
+    return lax.psum(outputs, axis_name)
+
+
+def make_pipeline(
+    mesh: Mesh,
+    stage_fn: StageFn,
+    *,
+    axis_name: str = AXIS_STAGE,
+    num_microbatches: int = 4,
+):
+    """Jitted global-view pipeline.
+
+    ``stage_params`` arrive with a leading stage axis (``[n_stages, ...]``,
+    sharded over ``axis_name``); input ``x: [batch, d]`` is split into
+    ``num_microbatches`` equal microbatches (batch must divide evenly —
+    the reference's equal-batch contract, ``demo.py:113``).
+    """
+
+    def global_fn(stage_params, x):
+        num_micro = num_microbatches
+        micro = x.shape[0] // num_micro
+        xm = x.reshape((num_micro, micro) + x.shape[1:])
+        body = functools.partial(
+            pipeline_shard, stage_fn=stage_fn, axis_name=axis_name
+        )
+        out = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis_name), P()),
+            out_specs=P(),
+            check_vma=False,  # psum makes the output replicated
+        )(stage_params, xm)
+        return out.reshape((num_micro * micro,) + out.shape[2:])
+
+    return jax.jit(global_fn)

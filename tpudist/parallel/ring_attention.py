@@ -1,0 +1,150 @@
+"""Ring-attention sequence/context parallelism.
+
+Long-context scaling: the sequence axis is sharded over the mesh's ``seq``
+axis, each device holds one Q/K/V block, and K/V blocks rotate around the
+ring with ``jax.lax.ppermute`` (one ICI hop per step) while each device
+accumulates its Q block's attention with an online-softmax update — the
+blockwise formulation of Liu et al.'s Ring Attention.  Peak memory per
+device is O(seq/num_devices), so context length scales linearly with ring
+size at constant per-chip memory.
+
+The reference has no attention anywhere (its model is a 5-layer MLP on
+2-dim inputs — ``toy_model_and_data.py:12-22``; SURVEY.md §5.7 records
+sequence parallelism as absent), so this module is a capability extension,
+designed TPU-first:
+
+- every op inside the shard-local body is ``jnp``/``lax`` — XLA fuses the
+  softmax-rescale chain and keeps the two matmuls per step on the MXU;
+- the ring hop is ``lax.ppermute`` over the named axis, which XLA lowers to
+  neighbor ICI transfers that overlap with the block's compute;
+- the whole construct is differentiable (ppermute's transpose is the
+  reverse permutation), so the same code path trains.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.runtime.mesh import AXIS_SEQ
+
+# Finite stand-in for -inf: keeps exp() NaN-free when a whole row is masked
+# (a fully-masked KV block contributes exp(NEG - m_finite) == 0).
+_MASK_VALUE = -1e30
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Plain softmax attention — the single-device ground truth.
+
+    Shapes: ``q, k, v: [batch, heads, seq, head_dim]``.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        q_len, k_len = scores.shape[-2], scores.shape[-1]
+        qi = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
+        kj = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
+        scores = jnp.where(qi >= kj, scores, _MASK_VALUE)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
+def _block_update(q, k, v, m, l, o, *, scale, mask=None):
+    """One online-softmax accumulation step over a KV block.
+
+    ``m`` row-max, ``l`` normalizer sum, ``o`` unnormalized output — the
+    (m, l, o) running triple of blockwise/flash attention.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _MASK_VALUE)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = AXIS_SEQ,
+    causal: bool = False,
+) -> jax.Array:
+    """Shard-local ring attention body (call inside ``shard_map``).
+
+    Each device holds contiguous blocks ``q, k, v: [b, h, seq_shard, d]`` of
+    the globally seq-sharded arrays.  K/V travel the ring; at step ``t`` this
+    device processes the block that originated on rank ``(i - t) mod n``, so
+    step 0 is its own (diagonal) block — which guarantees the first processed
+    block is never fully masked under causal attention.
+    """
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    block = q.shape[-2]
+
+    m = jnp.full(q.shape[:-1], _MASK_VALUE, q.dtype)
+    l = jnp.zeros(q.shape[:-1], q.dtype)
+    o = jnp.zeros_like(q)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    for step in range(axis_size):
+        kv_idx = (my_idx - step) % axis_size
+        mask = None
+        if causal:
+            q_pos = my_idx * block + lax.broadcasted_iota(
+                jnp.int32, (block, block), 0
+            )
+            k_pos = kv_idx * block + lax.broadcasted_iota(
+                jnp.int32, (block, block), 1
+            )
+            mask = q_pos >= k_pos
+        m, l, o = _block_update(q, k, v, m, l, o, scale=scale, mask=mask)
+        if step + 1 < axis_size:
+            # One ICI hop: K/V move to the right neighbor while the next
+            # step's compute is still queued — XLA overlaps the two.
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    return o / l[..., None]
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_SEQ,
+    causal: bool = False,
+    batch_axis: Optional[str] = None,
+):
+    """Jitted global-view ring attention over ``mesh``.
+
+    Inputs/outputs are global ``[batch, heads, seq, head_dim]`` arrays with
+    ``seq`` sharded over ``axis_name`` (and optionally ``batch`` over
+    ``batch_axis``).  Sequence length must divide evenly by the ring size
+    (the equal-block contract, like the reference's equal-batch assumption
+    ``demo.py:113``).
+    """
+    spec = P(batch_axis, None, axis_name, None)
+    body = functools.partial(
+        ring_attention_shard, axis_name=axis_name, causal=causal
+    )
+    sharded = jax.shard_map(
+        lambda q, k, v: body(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return jax.jit(sharded)
