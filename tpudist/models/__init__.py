@@ -1,1 +1,6 @@
 from tpudist.models.toy_mlp import ToyMLP, create_toy_model  # noqa: F401
+from tpudist.models.transformer import (  # noqa: F401
+    TransformerLM,
+    create_transformer,
+    lm_loss,
+)

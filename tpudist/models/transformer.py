@@ -1,0 +1,125 @@
+"""Decoder-only Transformer LM — the long-context model family.
+
+The reference's only model is a 5-layer MLP on 2-dim inputs
+(``toy_model_and_data.py:12-22``); this family is the capability extension
+that gives the sequence-parallel machinery (``tpudist.parallel``) and the
+Pallas attention kernel (``tpudist.ops``) a real consumer, designed
+TPU-first:
+
+- **pluggable attention op**: the block calls an injected
+  ``attention_fn(q, k, v) -> out`` over ``[batch, heads, seq, head_dim]``.
+  Three interchangeable implementations ship: the dense XLA reference
+  (:func:`tpudist.parallel.attention_reference`), the Pallas flash kernel
+  (:func:`tpudist.ops.flash_attention`), and ring attention over a
+  ``seq``-sharded mesh (:func:`tpudist.parallel.make_ring_attention`) —
+  all numerically identical (tests assert it), so single-chip and
+  multi-chip long-context runs share one model definition.
+- **static shapes, pre-LN, bias-free projections** — the standard
+  XLA-friendly decoder block; everything jits into one program.
+- DP×SP training: batch sharded over ``data``, sequence over ``seq``; the
+  ring closure carries its own shard_map, the rest of the network is
+  elementwise/feature-contracting so pjit keeps activations sharded as
+  ``P(data, seq, None)`` throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpudist.parallel.ring_attention import attention_reference
+
+AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def _default_attention(q, k, v):
+    return attention_reference(q, k, v, causal=True)
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    attention_fn: AttentionFn
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dh = self.d_model // self.n_heads
+        h = nn.LayerNorm(use_bias=False)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [b, s, d] -> [b, h, s, dh]
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.n_heads, dh).transpose(0, 2, 1, 3)
+
+        attn = self.attention_fn(heads(q), heads(k), heads(v))
+        b, nh, s, _ = attn.shape
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
+        x = x + nn.Dense(self.d_model, use_bias=False, name="proj")(attn)
+
+        h = nn.LayerNorm(use_bias=False)(x)
+        h = nn.Dense(self.d_ff, use_bias=False, name="wi")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(self.d_model, use_bias=False, name="wo")(h)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: token + learned position embeddings, N pre-LN blocks,
+    tied-free output head."""
+
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_len: int = 2048
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """``tokens: [batch, seq] int32`` → logits ``[batch, seq, vocab]``."""
+        attn = self.attention_fn or _default_attention
+        seq = tokens.shape[1]
+        x = nn.Embed(self.vocab, self.d_model, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
+            jnp.arange(seq, dtype=jnp.int32)
+        )
+        x = x + pos[None]
+        for i in range(self.n_layers):
+            x = Block(
+                self.d_model, self.n_heads, self.d_ff, attn, name=f"block_{i}"
+            )(x)
+        x = nn.LayerNorm(use_bias=False)(x)
+        return nn.Dense(self.vocab, use_bias=False, name="head")(x)
+
+
+def create_transformer(
+    rng: jax.Array,
+    *,
+    seq_len: int = 128,
+    attention_fn: Optional[AttentionFn] = None,
+    **kwargs,
+):
+    """Init a TransformerLM; returns ``(module, params)``.  Same same-rng
+    cross-process replication contract as :func:`create_toy_model`.
+
+    Init always runs through the dense attention twin: parameter shapes do
+    not depend on the attention op, and a sharded ring op would reject the
+    size-1 dummy batch (not divisible by the mesh's data axis).
+    """
+    module = TransformerLM(attention_fn=attention_fn, **kwargs)
+    init_module = TransformerLM(attention_fn=None, **kwargs)
+    params = init_module.init(rng, jnp.zeros((1, seq_len), jnp.int32))
+    return module, params
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy (mean over all predicted positions)."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

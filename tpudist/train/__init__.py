@@ -6,3 +6,8 @@ from tpudist.train.step import (  # noqa: F401
     mse_loss,
 )
 from tpudist.train.loop import TrainLoopConfig, run_training  # noqa: F401
+from tpudist.train.lm import (  # noqa: F401
+    init_lm_state,
+    make_lm_train_step,
+    token_sharding,
+)

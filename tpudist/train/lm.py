@@ -1,0 +1,66 @@
+"""Jitted LM train step for the Transformer family — the long-context
+sibling of :mod:`tpudist.train.step`.
+
+Same design stance (no wrapper object, one pure jitted function, explicit
+shardings) on a 2-D ``(data, seq)`` mesh: the token batch is sharded over
+BOTH axes (``P(data, seq)``), parameters and optimizer state are
+replicated, ring attention inside the model handles the sequence-sharded
+contraction, and XLA inserts the gradient all-reduce over both mesh axes.
+Per-chip activation memory is O(batch/data_n × seq/seq_n) — context length
+scales with the ``seq`` axis at constant memory, which is the point.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.models.transformer import lm_loss
+from tpudist.runtime.mesh import AXIS_DATA, AXIS_SEQ
+from tpudist.train.step import ModelState
+
+
+def token_sharding(mesh: Mesh) -> NamedSharding:
+    """``[batch, seq]`` tokens sharded over every mesh axis present."""
+    data = AXIS_DATA if AXIS_DATA in mesh.axis_names else None
+    seq = AXIS_SEQ if AXIS_SEQ in mesh.axis_names else None
+    return NamedSharding(mesh, P(data, seq))
+
+
+def init_lm_state(params, tx: optax.GradientTransformation) -> ModelState:
+    return ModelState(params=params, opt_state=tx.init(params))
+
+
+def make_lm_train_step(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    donate_state: bool = True,
+):
+    """Build ``step(state, tokens) -> (state, loss)``, compiled once.
+
+    ``apply_fn(params, tokens) -> logits`` is the TransformerLM apply with
+    whatever attention op the caller injected (ring for multi-chip).
+    """
+    repl = NamedSharding(mesh, P())
+    tok_shard = token_sharding(mesh)
+
+    def step(state: ModelState, tokens):
+        def loss_of(params):
+            return lm_loss(apply_fn(params, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return ModelState(params=new_params, opt_state=new_opt), loss
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, tok_shard),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate_state else (),
+    )
