@@ -38,7 +38,12 @@ def build_loader(args, *, seed: int) -> ShardedLoader:
         seed=seed,
         mode=args.dataloader,
     )
-    return ShardedLoader(data, batch_size=args.batch_size, plan=plan)
+    # --num_workers > 0 selects the native prefetching pool (the reference's
+    # DataLoader worker semantics, demo.py:150), falling back silently.
+    from tpudist.data import make_loader
+
+    return make_loader(data, args.batch_size, plan,
+                       num_workers=getattr(args, "num_workers", 0))
 
 
 def build_training(args, mesh, *, state_sharding_fn=None):

@@ -19,6 +19,8 @@ def test_entry_compiles():
     assert out.shape == (256, 1)
 
 
-@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
 def test_dryrun_multichip(n):
+    # 3: odd device counts must survive both sharding regimes (the toy
+    # regime falls back to model_size=1; the LM regime skips).
     graft.dryrun_multichip(n)

@@ -13,8 +13,10 @@ Mapping of reference flags onto the TPU runtime:
   derivation is contract-autodetected here, so it is a no-op.
 - ``--use_node_rank`` — identical semantics (``demo.py:38-39``).
 - ``--seed`` — random 32-bit default (``argument_parser.py:18``).
-- ``--num_workers`` — accepted; the host loader is synchronous numpy (no
-  worker processes to configure), so >0 is a no-op.
+- ``--num_workers`` — same semantics: >0 enables background batch assembly
+  via the native C++ gather pool (``tpudist.data.native_loader``); 0 keeps
+  the synchronous numpy loader.  Threads instead of the reference's worker
+  *processes*, so none of its forkserver/fd-sharing hazards apply.
 - ``--dry_run`` — offline metrics mode (``demo.py:160-161``).
 
 Plus training-shape flags (fixed constants in the reference):
@@ -47,7 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "runtime init and broadcasts it (see "
                         "runtime.seeding.resolve_shared_seed)")
     p.add_argument("--num_workers", default=0, type=int,
-                   help="compat no-op: host loader is synchronous")
+                   help=">0: native background batch assembly (C++ gather "
+                        "pool); 0: synchronous numpy loader")
     p.add_argument("--dry_run", action="store_true",
                    help="offline metrics (no wandb network/credentials)")
     p.add_argument("--total_iterations", default=1000, type=int)

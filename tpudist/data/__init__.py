@@ -1,3 +1,8 @@
 from tpudist.data.toy import ToyData, make_toy_data  # noqa: F401
 from tpudist.data.sharding import ShardPlan, epoch_indices  # noqa: F401
 from tpudist.data.loader import ShardedLoader, shard_batch  # noqa: F401
+from tpudist.data.native_loader import (  # noqa: F401
+    PrefetchingLoader,
+    make_loader,
+    native_available,
+)

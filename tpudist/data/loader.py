@@ -12,8 +12,9 @@ Design differences, deliberately TPU-first:
   epoch), not from worker processes; there is no fork/forkserver hazard to
   work around (the reference needed ``forkserver`` + ``file_system`` sharing,
   ``demo.py:163-170``).
-- Optional C++-accelerated batch assembly via ``tpudist.ops.native`` when the
-  shared library is built (Task: native data path); numpy fallback otherwise.
+- Optional C++-accelerated batch assembly via
+  ``tpudist.data.native_loader`` (``--num_workers > 0``); numpy fallback
+  otherwise.
 """
 
 from __future__ import annotations
