@@ -1,0 +1,41 @@
+"""Benchmark-harness mechanics on the virtual mesh: each harness must run
+end-to-end and emit well-formed JSON (real numbers come from hardware)."""
+
+import sys
+
+import pytest
+
+
+class TestScaling:
+    def test_rungs_and_summary(self, capsys):
+        sys.path.insert(0, "benchmarks")
+        from benchmarks.scaling import main
+
+        results = main(["--world-sizes", "1,4", "--chunks", "2", "--window", "4",
+                        "--batch-per-chip", "32"])
+        assert [r["world_size"] for r in results] == [1, 4]
+        assert results[0]["efficiency_vs_1"] == 1.0
+        assert all(r["regime"] == "virtual-cpu" for r in results)
+        assert all(r["per_chip"] > 0 for r in results)
+
+
+class TestLossParity:
+    def test_all_entry_points_match(self):
+        from benchmarks.loss_parity import main
+
+        summary = main(["--iters", "120", "--tolerance", "0.5"])
+        assert summary["parity"], summary
+        # Everyone should be in the toy problem's convergence basin.
+        assert summary["worst_mean_loss"] < 1.5, summary
+
+
+class TestLongContext:
+    def test_ring_rungs_run(self):
+        from benchmarks.long_context import main
+
+        results = main(["--seq-lens", "128", "--seq-shards", "1,4",
+                        "--batch", "4", "--steps", "2", "--d-model", "64",
+                        "--n-layers", "1"])
+        assert len(results) == 2
+        assert all(r["tokens_per_sec"] > 0 for r in results)
+        assert results[1]["block_per_chip"] == 32
