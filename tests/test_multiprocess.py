@@ -120,3 +120,49 @@ def test_torchrun_style_env_contract(tmp_path, monkeypatch):
     recs = [json.load(open(f)) for f in sorted(out_dir.glob("ok*.json"))]
     assert len(recs) == 2
     assert all(r["source"] == "torchrun" for r in recs)
+
+
+HYBRID_WORKER = """
+    import json, os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # 2 virtual devices per process -> a 2-host x 2-chip "pod".
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+
+    import jax
+    from tpudist.runtime import bootstrap
+    from tpudist.runtime.mesh import MeshConfig, make_hybrid_mesh
+
+    ctx = bootstrap.initialize()
+    mesh = make_hybrid_mesh(MeshConfig(data=-1, model=2))
+    # data axis = 2 (one per host, over DCN); model axis = 2 (within host,
+    # over ICI): each data row must be one process's devices.
+    assert dict(zip(mesh.axis_names, mesh.devices.shape))["data"] == 2
+    for row in mesh.devices.reshape(2, -1):
+        procs = {d.process_index for d in row}
+        assert len(procs) == 1, f"model axis crossed hosts: {procs}"
+    out = os.path.join(os.environ["OUT_DIR"], f"hy{ctx.process_id}.json")
+    json.dump({"rank": ctx.process_id}, open(out, "w"))
+    bootstrap.shutdown()
+"""
+
+
+def test_hybrid_mesh_keeps_ici_axes_within_host(tmp_path, monkeypatch):
+    """2 processes x 2 devices: the hybrid mesh must put the model axis
+    inside each process (ICI) and the data axis across processes (DCN)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(HYBRID_WORKER))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    for var in list(os.environ):
+        if var.startswith(("TPUDIST_", "SLURM_", "OMPI_")) or var in (
+                "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK"):
+            monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OUT_DIR", str(out_dir))
+    monkeypatch.setenv("PYTHONPATH", str(REPO))
+    rc = tpurun_main(["--nprocs", "2", "--max-restarts", "0",
+                      "--tmpdir", str(tmp_path / "scratch"),
+                      "--", sys.executable, str(worker)])
+    assert rc == 0
+    assert len(list(out_dir.glob("hy*.json"))) == 2

@@ -126,3 +126,16 @@ def test_mesh_config_validation():
         MeshConfig(data=3, stage=1, seq=1, model=1).resolve(8)
     with pytest.raises(ValueError):
         MeshConfig(data=-1, stage=-1).resolve(8)
+
+
+def test_hybrid_mesh_single_process_falls_back(devices):
+    """Single-process: make_hybrid_mesh must equal the plain mesh layout
+    (DCN placement only matters across hosts)."""
+    from tpudist.runtime.mesh import MeshConfig, make_hybrid_mesh, make_mesh
+
+    cfg = MeshConfig(data=-1, model=2)
+    hybrid = make_hybrid_mesh(cfg)
+    plain = make_mesh(cfg)
+    assert hybrid.axis_names == plain.axis_names
+    assert hybrid.devices.shape == plain.devices.shape
+    assert (hybrid.devices == plain.devices).all()

@@ -4,7 +4,11 @@ from tpudist.runtime.bootstrap import (  # noqa: F401
     initialize,
     shutdown,
 )
-from tpudist.runtime.mesh import MeshConfig, make_mesh  # noqa: F401
+from tpudist.runtime.mesh import (  # noqa: F401
+    MeshConfig,
+    make_hybrid_mesh,
+    make_mesh,
+)
 from tpudist.runtime.seeding import (  # noqa: F401
     per_process_seed,
     fold_in_process,

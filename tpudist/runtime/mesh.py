@@ -85,6 +85,56 @@ def make_mesh(
     return Mesh(dev_array, axis_names=tuple(axis_names))
 
 
+def make_hybrid_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    axis_names: Sequence[str] = ALL_AXES,
+) -> Mesh:
+    """Multi-host mesh with DCN/ICI-aware device placement.
+
+    On a multi-host pod the two fabrics differ by ~an order of magnitude:
+    ICI links chips within a slice, DCN links hosts.  This helper assigns
+    the ``data`` axis (bandwidth-light: one gradient all-reduce per step)
+    across hosts over DCN and keeps ``stage``/``seq``/``model`` (bandwidth-
+    hungry: activations every layer) inside a host on ICI, via
+    ``mesh_utils.create_hybrid_device_mesh`` — the scaling-book layout.
+
+    Requires the ``data`` axis size to be divisible by the process count;
+    single-process jobs fall back to :func:`make_mesh` (nothing to place).
+    """
+    devices = jax.devices()
+    n_procs = max(d.process_index for d in devices) + 1
+    config = (config or MeshConfig()).resolve(len(devices))
+    if n_procs == 1:
+        return make_mesh(config, axis_names=axis_names)
+
+    from jax.experimental import mesh_utils
+
+    # Granule = what DCN separates: distinct TPU slices when present
+    # (multi-slice pods), else processes (multi-host single slice, or the
+    # CPU test rig).
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    process_is_granule = n_slices <= 1
+    n_granules = n_procs if process_is_granule else n_slices
+
+    sizes = config.axis_sizes()
+    if sizes["data"] % n_granules != 0:
+        raise ValueError(
+            f"hybrid mesh: data axis {sizes['data']} not divisible by "
+            f"{n_granules} DCN granules (the data axis is the DCN axis)"
+        )
+    dcn_shape = [1] * len(axis_names)
+    ici_shape = [sizes[a] for a in axis_names]
+    data_pos = list(axis_names).index(AXIS_DATA)
+    dcn_shape[data_pos] = n_granules
+    ici_shape[data_pos] = sizes["data"] // n_granules
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=devices,
+        process_is_granule=process_is_granule,
+    )
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
 def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1-D all-data mesh — the DDP-equivalent default (SURVEY.md §2.4)."""
     if devices is None:
