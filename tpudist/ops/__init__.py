@@ -8,7 +8,10 @@ as fallbacks and in correctness tests (interpret mode on CPU).
   zero-padded to lane-aligned tiles, activations pinned in VMEM.
 """
 
-from tpudist.ops.flash_attention import flash_attention  # noqa: F401
+from tpudist.ops.flash_attention import (  # noqa: F401
+    blockwise_attention,
+    flash_attention,
+)
 from tpudist.ops.fused_mlp import (  # noqa: F401
     fused_mlp,
     mlp_reference,

@@ -1,17 +1,20 @@
 """Pallas TPU flash attention (blockwise online-softmax) kernel.
 
 The single-chip hot op behind the long-context path: materializes no
-``[seq, seq]`` score matrix — Q blocks stream from HBM into VMEM per grid
-step, K/V blocks are walked with a ``fori_loop`` carrying the (m, l, acc)
-online-softmax triple, both matmuls per block land on the MXU.  Combined
-with :mod:`tpudist.parallel.ring_attention` (which rotates K/V between
-chips), this covers intra-chip blocking while the ring covers inter-chip
-sharding.
+``[seq, seq]`` score matrix — the grid is (batch·heads, q_block, kv_block)
+with KV innermost, the (m, l, acc) online-softmax state lives in VMEM
+scratch across each Q row's KV sweep, and only one [block_k, d] K/V tile
+is VMEM-resident at a time (sequence length is bounded by HBM, not VMEM);
+both matmuls per block land on the MXU.  Combined with
+:mod:`tpudist.parallel.ring_attention` (which rotates K/V between chips),
+this covers intra-chip blocking while the ring covers inter-chip sharding.
 
-Backward: ``jax.custom_vjp`` whose bwd recomputes attention with the dense
-XLA formulation and differentiates that — flash recompute-style memory
-behavior on the forward, XLA-fused gradients on the backward.  The fwd/bwd
-outputs match ``attention_reference`` exactly (see tests).
+Backward: ``jax.custom_vjp`` whose bwd differentiates a *blockwise*
+XLA formulation (``lax.scan`` over KV blocks with the same online-softmax
+update, each block under ``jax.checkpoint``) — so the backward also peaks
+at O(seq · block) memory instead of materializing the [seq, seq] score
+matrix, and long-context training fits on one chip.  Fwd and bwd match
+``attention_reference`` numerically (see tests).
 
 No reference counterpart (the reference has no attention and ships no
 kernels of its own — SURVEY.md §0, §5.7); this is TPU-native capability.
@@ -27,28 +30,42 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpudist.parallel.ring_attention import attention_reference
+from tpudist.parallel.ring_attention import (
+    _block_update,
+    _causal_mask,
+    attention_reference,
+)
 
 _MASK_VALUE = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
-    """One grid step: one Q block against every K/V block of its (b,h) row.
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, causal: bool, scale: float):
+    """One (bh, q_block, kv_block) grid step.
 
-    Ref shapes: q/o ``[1, block_q, d]``; k/v ``[1, seq_k, d]`` (whole row in
-    VMEM — block over KV too if seq outgrows VMEM; the ring shards first).
+    The grid's KV dimension is innermost (TPU grids run sequentially), so
+    the (m, l, acc) online-softmax state lives in VMEM scratch across the
+    KV sweep of each Q block; only one [block_k, d] K/V tile is resident at
+    a time — sequence length is bounded by HBM, not VMEM.
     """
-    q = q_ref[0].astype(jnp.float32) * scale
-    block_q, d = q.shape
-    seq_k = k_ref.shape[1]
-    num_kv = seq_k // block_k
     qi = pl.program_id(1)
+    kv = pl.program_id(2)
+    nkv = pl.num_programs(2)
 
-    def body(kv, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kv * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kv * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kv == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: blocks fully above the diagonal contribute nothing — skip.
+    live = (qi + 1) * block_q > kv * block_k if causal else kv >= 0
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
@@ -58,27 +75,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _MASK_VALUE)
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1)
-        acc_new = acc * correction[:, None] + jnp.dot(
+        m_ref[:, 0] = m_new
+        l_ref[:, 0] = l * correction + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * correction[:, None] + jnp.dot(
             p, v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((block_q,), _MASK_VALUE, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    if causal:
-        # Blocks strictly above the diagonal are fully masked — skip them.
-        num_live = jnp.minimum(
-            ((qi + 1) * block_q + block_k - 1) // block_k, num_kv
-        )
-        m, l, acc = lax.fori_loop(0, num_live, body, (m0, l0, acc0))
-    else:
-        m, l, acc = lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # Last KV block of this Q row: normalize and emit.
+    last = jnp.minimum(
+        nkv - 1, ((qi + 1) * block_q - 1) // block_k
+    ) if causal else nkv - 1
+
+    @pl.when(kv == last)
+    def _():
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
 
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
@@ -88,7 +103,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     bk = min(block_k, seq_k)
     if seq_q % bq or seq_k % bk:
         raise ValueError(
-            f"seq lengths ({seq_q}, {seq_k}) must divide block sizes ({bq}, {bk})"
+            f"block sizes ({bq}, {bk}) must divide seq lengths ({seq_q}, {seq_k})"
         )
     scale = d ** -0.5
     bh = batch * heads
@@ -97,22 +112,27 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     vr = v.reshape(bh, seq_k, d)
 
     kernel = functools.partial(
-        _flash_kernel, block_k=bk, causal=causal, scale=scale
+        _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale
     )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-        grid=(bh, seq_q // bq),
+        grid=(bh, seq_q // bq, seq_k // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # m (running row max)
+            pltpu.VMEM((bq, 1), jnp.float32),   # l (running normalizer)
+            pltpu.VMEM((bq, d), jnp.float32),   # acc (unnormalized out)
+        ],
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(batch, heads, seq_q, d)
@@ -139,6 +159,53 @@ def flash_attention(
     )
 
 
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_k: int = 128,
+) -> jax.Array:
+    """Memory-efficient attention in plain XLA: ``lax.scan`` over KV blocks
+    carrying the (m, l, o) online-softmax triple, each block's work wrapped
+    in ``jax.checkpoint``.  Numerically identical to
+    :func:`attention_reference`; peak memory O(seq·block) forward AND
+    backward (XLA differentiates the scan and remat recomputes per-block
+    scores instead of saving them).  Used as the value function behind
+    :func:`flash_attention`'s custom VJP; also usable directly on platforms
+    without Pallas."""
+    scale = q.shape[-1] ** -0.5
+    seq_k = k.shape[2]
+    bk = min(block_k, seq_k)
+    if seq_k % bk:
+        raise ValueError(f"block size {bk} must divide seq_k {seq_k}")
+    num_kv = seq_k // bk
+    q_len = q.shape[2]
+
+    # [num_kv, b, h, bk, d] blocks, scanned over axis 0.
+    kb = jnp.moveaxis(k.reshape(k.shape[0], k.shape[1], num_kv, bk, -1), 2, 0)
+    vb = jnp.moveaxis(v.reshape(v.shape[0], v.shape[1], num_kv, bk, -1), 2, 0)
+
+    # One shared implementation of the numerically-sensitive softmax-rescale
+    # math: ring_attention's _block_update/_causal_mask (so the flash
+    # backward can never drift from the ring forward).
+    @jax.checkpoint
+    def body(carry, blk):
+        m, l, o = carry
+        kv_i, kt, vt = blk
+        mask = _causal_mask(0, kv_i * bk, q_len, bk) if causal else None
+        return _block_update(q, kt, vt, m, l, o, scale=scale, mask=mask), None
+
+    m0 = jnp.full(q.shape[:-1], _MASK_VALUE, q.dtype)
+    l0 = jnp.zeros(q.shape[:-1], q.dtype)
+    o0 = jnp.zeros_like(q)
+    (m, l, o), _ = lax.scan(
+        body, (m0, l0, o0), (jnp.arange(num_kv), kb, vb)
+    )
+    return o / l[..., None]
+
+
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     out = _flash_forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
@@ -150,7 +217,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
     q, k, v = residuals
     _, vjp = jax.vjp(
-        functools.partial(attention_reference, causal=causal), q, k, v
+        functools.partial(blockwise_attention, causal=causal, block_k=block_k),
+        q, k, v,
     )
     return vjp(g)
 

@@ -76,6 +76,12 @@ def _block_update(q, k, v, m, l, o, *, scale, mask=None):
     return m_new, l_new, o_new
 
 
+def _causal_mask(q_off, k_off, bq: int, bk: int):
+    q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
 def ring_attention_shard(
     q: jax.Array,
     k: jax.Array,
@@ -83,6 +89,7 @@ def ring_attention_shard(
     *,
     axis_name: str = AXIS_SEQ,
     causal: bool = False,
+    inner_block: Optional[int] = None,
 ) -> jax.Array:
     """Shard-local ring attention body (call inside ``shard_map``).
 
@@ -91,29 +98,64 @@ def ring_attention_shard(
     device processes the block that originated on rank ``(i - t) mod n``, so
     step 0 is its own (diagonal) block — which guarantees the first processed
     block is never fully masked under causal attention.
+
+    ``inner_block``: when set, each ring step's KV shard is consumed by a
+    rematerialized ``lax.scan`` of ``inner_block``-wide sub-blocks instead
+    of one [shard, shard] score matrix — peak per-device attention memory
+    drops from O(shard²) to O(shard·inner_block), which is what lets very
+    long shards (many thousands of tokens per chip) train.
     """
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     block = q.shape[-2]
 
-    m = jnp.full(q.shape[:-1], _MASK_VALUE, q.dtype)
-    l = jnp.zeros(q.shape[:-1], q.dtype)
+    # pcast-to-varying: the carries join a scan whose outputs vary over the
+    # seq axis (they mix in the sharded q/k/v), so the initial values must
+    # carry the same varying-manual-axes type.
+    m = lax.pcast(jnp.full(q.shape[:-1], _MASK_VALUE, q.dtype),
+                  (axis_name,), to="varying")
+    l = lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), (axis_name,), to="varying")
     o = jnp.zeros_like(q)
+    q_off = my_idx * block
+
+    def consume_shard(kv_idx, k, v, m, l, o):
+        """Fold one ring step's KV shard into the (m, l, o) carry."""
+        if inner_block is None:
+            mask = _causal_mask(q_off, kv_idx * block, block, block) \
+                if causal else None
+            return _block_update(q, k, v, m, l, o, scale=scale, mask=mask)
+        nb = block // inner_block
+        if block % inner_block:
+            raise ValueError(
+                f"inner_block {inner_block} must divide seq shard {block}"
+            )
+        kb = jnp.moveaxis(
+            k.reshape(*k.shape[:-2], nb, inner_block, k.shape[-1]), -3, 0
+        )
+        vb = jnp.moveaxis(
+            v.reshape(*v.shape[:-2], nb, inner_block, v.shape[-1]), -3, 0
+        )
+
+        @jax.checkpoint
+        def sub(carry, blk):
+            m, l, o = carry
+            sub_i, kt, vt = blk
+            mask = None
+            if causal:
+                mask = _causal_mask(
+                    q_off, kv_idx * block + sub_i * inner_block,
+                    block, inner_block,
+                )
+            return _block_update(q, kt, vt, m, l, o, scale=scale, mask=mask), None
+
+        (m, l, o), _ = lax.scan(sub, (m, l, o), (jnp.arange(nb), kb, vb))
+        return m, l, o
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     for step in range(axis_size):
         kv_idx = (my_idx - step) % axis_size
-        mask = None
-        if causal:
-            q_pos = my_idx * block + lax.broadcasted_iota(
-                jnp.int32, (block, block), 0
-            )
-            k_pos = kv_idx * block + lax.broadcasted_iota(
-                jnp.int32, (block, block), 1
-            )
-            mask = q_pos >= k_pos
-        m, l, o = _block_update(q, k, v, m, l, o, scale=scale, mask=mask)
+        m, l, o = consume_shard(kv_idx, k, v, m, l, o)
         if step + 1 < axis_size:
             # One ICI hop: K/V move to the right neighbor while the next
             # step's compute is still queued — XLA overlaps the two.
@@ -128,6 +170,7 @@ def make_ring_attention(
     axis_name: str = AXIS_SEQ,
     causal: bool = False,
     batch_axis: Optional[str] = None,
+    inner_block: Optional[int] = None,
 ):
     """Jitted global-view ring attention over ``mesh``.
 
@@ -139,7 +182,8 @@ def make_ring_attention(
     """
     spec = P(batch_axis, None, axis_name, None)
     body = functools.partial(
-        ring_attention_shard, axis_name=axis_name, causal=causal
+        ring_attention_shard, axis_name=axis_name, causal=causal,
+        inner_block=inner_block,
     )
     sharded = jax.shard_map(
         lambda q, k, v: body(q, k, v),
