@@ -46,6 +46,9 @@ def get_args(argv=None):
     p.add_argument("--seq_len", default=512, type=int)
     p.add_argument("--seq_shards", default=1, type=int,
                    help="size of the seq mesh axis (ring length)")
+    p.add_argument("--inner_block", default=None, type=int,
+                   help="sub-block the ring's per-shard KV consumption "
+                        "(O(shard*inner) attention memory for long shards)")
     p.add_argument("--vocab", default=64, type=int)
     p.add_argument("--d_model", default=128, type=int)
     p.add_argument("--n_layers", default=2, type=int)
@@ -73,9 +76,10 @@ def main() -> None:
     )
 
     attention = (
-        make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA)
+        make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA,
+                            inner_block=args.inner_block)
         if args.seq_shards > 1
-        else None  # dense path on a single seq shard
+        else None  # single seq shard: length-aware default (dense/flash)
     )
     module, params = create_transformer(
         jax.random.PRNGKey(args.seed),

@@ -36,7 +36,70 @@ AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
 
 def _default_attention(q, k, v):
-    return attention_reference(q, k, v, causal=True)
+    """Platform/length-aware single-device attention: dense XLA for short
+    sequences (lowest dispatch overhead), the Pallas flash kernel on TPU /
+    the blockwise XLA formulation elsewhere once the [seq, seq] score
+    matrix would dominate memory (>2048 tokens)."""
+    seq = q.shape[2]
+    if seq <= 2048 or seq % 512:
+        return attention_reference(q, k, v, causal=True)
+    if jax.devices()[0].platform == "tpu":
+        from tpudist.ops import flash_attention
+
+        return flash_attention(q, k, v, True, 512, 512, False)
+    from tpudist.ops import blockwise_attention
+
+    return blockwise_attention(q, k, v, causal=True, block_k=512)
+
+
+def moe_expert_fn(params, tokens):
+    """The expert used by the MoE FFN: relu(x·w)·wo — shared between the
+    sharded execution path (``tpudist.parallel.moe``) and the dense
+    reference below, so they cannot drift."""
+    return jax.nn.relu(tokens @ params["w"]) @ params["wo"]
+
+
+def dense_moe_reference(params, tokens):
+    """Single-device MoE execution: every expert computed for every token,
+    combined by the top-1 gate.  Matches ``moe_shard`` exactly when no
+    token overflows capacity; used at init time and on unsharded runs."""
+    probs = jax.nn.softmax(tokens @ params["router"], axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    h = jax.nn.relu(jnp.einsum("td,edf->tef", tokens, params["experts"]["w"]))
+    y_all = jnp.einsum("tef,efd->ted", h, params["experts"]["wo"])
+    pick = jax.nn.one_hot(idx, probs.shape[-1], dtype=tokens.dtype)
+    return jnp.einsum("ted,te->td", y_all, pick * gate[:, None])
+
+
+class MoEFFN(nn.Module):
+    """Switch-style FFN: top-1 routed experts.  ``moe_fn`` (built with
+    :func:`tpudist.parallel.make_moe` over a ``model``-axis mesh) runs the
+    expert-parallel path; without it the dense reference executes — same
+    parameters either way, so init and single-device runs need no mesh."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    moe_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        init = nn.initializers.lecun_normal()
+        params = {
+            "router": self.param("router", init, (d, self.n_experts)),
+            "experts": {
+                "w": self.param("w", init, (self.n_experts, d, self.d_ff)),
+                "wo": self.param("wo", init, (self.n_experts, self.d_ff, d)),
+            },
+        }
+        tokens = x.reshape(b * s, d)
+        if self.moe_fn is not None:
+            y, _stats = self.moe_fn(params, tokens)
+        else:
+            y = dense_moe_reference(params, tokens)
+        return y.reshape(b, s, d)
 
 
 class Block(nn.Module):
@@ -44,6 +107,8 @@ class Block(nn.Module):
     n_heads: int
     d_ff: int
     attention_fn: AttentionFn
+    n_experts: int = 0  # 0 = dense FFN; >0 = MoE FFN with that many experts
+    moe_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -62,6 +127,9 @@ class Block(nn.Module):
         x = x + nn.Dense(self.d_model, use_bias=False, name="proj")(attn)
 
         h = nn.LayerNorm(use_bias=False)(x)
+        if self.n_experts > 0:
+            return x + MoEFFN(self.d_model, self.d_ff, self.n_experts,
+                              self.moe_fn, name="moe")(h)
         h = nn.Dense(self.d_ff, use_bias=False, name="wi")(h)
         h = nn.gelu(h)
         return x + nn.Dense(self.d_model, use_bias=False, name="wo")(h)
@@ -78,6 +146,8 @@ class TransformerLM(nn.Module):
     d_ff: int = 512
     max_len: int = 2048
     attention_fn: Optional[AttentionFn] = None
+    n_experts: int = 0  # >0: MoE FFN in every block (expert parallelism)
+    moe_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -91,7 +161,9 @@ class TransformerLM(nn.Module):
         x = x + pos[None]
         for i in range(self.n_layers):
             x = Block(
-                self.d_model, self.n_heads, self.d_ff, attn, name=f"block_{i}"
+                self.d_model, self.n_heads, self.d_ff, attn,
+                n_experts=self.n_experts, moe_fn=self.moe_fn,
+                name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False)(x)
         return nn.Dense(self.vocab, use_bias=False, name="head")(x)
@@ -112,7 +184,8 @@ def create_transformer(
     size-1 dummy batch (not divisible by the mesh's data axis).
     """
     module = TransformerLM(attention_fn=attention_fn, **kwargs)
-    init_module = TransformerLM(attention_fn=None, **kwargs)
+    init_kwargs = {k: v for k, v in kwargs.items() if k != "moe_fn"}
+    init_module = TransformerLM(attention_fn=None, **init_kwargs)
     params = init_module.init(rng, jnp.zeros((1, seq_len), jnp.int32))
     return module, params
 
