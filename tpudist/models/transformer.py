@@ -169,6 +169,46 @@ class TransformerLM(nn.Module):
         return nn.Dense(self.vocab, use_bias=False, name="head")(x)
 
 
+def transformer_tp_sharding(mesh, tree, *, axis_name: str = "model"):
+    """Megatron-style tensor-parallel layout for a TransformerLM state
+    pytree (params or a whole ``ModelState`` including optimizer moments —
+    matching is by path, and Adam's moments mirror the param tree).
+
+    Per block: ``qkv`` column-split (attention heads land whole on each
+    device), ``proj`` row-split, ``wi`` column-split, ``wo`` row-split; MoE
+    expert stacks split on the expert axis; embeddings/norms/head
+    replicated.  Under ``jit`` the XLA SPMD partitioner inserts the
+    all-reduces these seams imply — the pjit-spec formulation of
+    ``tpudist.parallel.tensor_parallel``, applied to the whole model.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    col = P(None, axis_name)
+    row = P(axis_name, None)
+
+    def spec_for(path) -> P:
+        keys = [k for k in (getattr(e, "key", getattr(e, "name", None))
+                            for e in path) if isinstance(k, str)]
+        if "moe" in keys:
+            if keys[-1] in ("w", "wo"):
+                return P(axis_name)  # expert-stack leading axis
+            return P()  # router replicated
+        if "kernel" in keys:
+            if "qkv" in keys or "wi" in keys:
+                return col
+            if "proj" in keys or "wo" in keys:
+                return row
+        return P()
+
+    def shard_for(path, leaf):
+        spec = spec_for(path)
+        if getattr(leaf, "ndim", 0) < len(spec):
+            spec = P()  # scalars/odd-rank leaves (e.g. Adam's count)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(shard_for, tree)
+
+
 def create_transformer(
     rng: jax.Array,
     *,

@@ -40,14 +40,22 @@ def make_lm_train_step(
     mesh: Mesh,
     *,
     donate_state: bool = True,
+    state_sharding=None,
 ):
     """Build ``step(state, tokens) -> (state, loss)``, compiled once.
 
     ``apply_fn(params, tokens) -> logits`` is the TransformerLM apply with
     whatever attention op the caller injected (ring for multi-chip).
+
+    ``state_sharding`` (a pytree of ``NamedSharding`` matching the
+    ``ModelState``, e.g. from
+    :func:`tpudist.models.transformer.transformer_tp_sharding`) overrides
+    the default replicated parameter layout — tensor parallelism composed
+    with the data/seq sharding of the batch.
     """
     repl = NamedSharding(mesh, P())
     tok_shard = token_sharding(mesh)
+    state_out = repl if state_sharding is None else state_sharding
 
     def step(state: ModelState, tokens):
         def loss_of(params):
@@ -60,7 +68,7 @@ def make_lm_train_step(
 
     return jax.jit(
         step,
-        in_shardings=(repl, tok_shard),
-        out_shardings=(repl, repl),
+        in_shardings=(state_out, tok_shard),
+        out_shardings=(state_out, repl),
         donate_argnums=(0,) if donate_state else (),
     )
