@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""3-D parallel LM training — data × sequence × tensor parallelism composed
+on one mesh.
+
+The deepest composition the framework offers in one entry point: the token
+batch shards over ``data``, ring attention rotates K/V over ``seq``, and
+the Transformer's weights are Megatron-split over ``model``
+(``transformer_tp_sharding``) with XLA inserting the implied collectives.
+No reference counterpart (SURVEY.md §2.4 lists TP/SP as absent there);
+this is the capability target the mesh design builds toward.
+
+Run (single host, virtual 8-chip mesh → 2×2×2):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/demo_3d_parallel.py --dry_run --seq_shards 2 \
+    --model_shards 2 --total_iterations 100
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from demo_long_context import make_batch  # noqa: E402
+
+from tpudist.config import build_parser, get_args as parse_args  # noqa: E402
+from tpudist.models import create_transformer  # noqa: E402
+from tpudist.models.transformer import transformer_tp_sharding  # noqa: E402
+from tpudist.parallel import make_ring_attention  # noqa: E402
+from tpudist.runtime import initialize, resolve_shared_seed  # noqa: E402
+from tpudist.runtime.mesh import (  # noqa: E402
+    AXIS_DATA,
+    MeshConfig,
+    make_mesh,
+)
+from tpudist.runtime.rank_logging import rank_print  # noqa: E402
+from tpudist.train import init_lm_state, make_lm_train_step, token_sharding  # noqa: E402
+from tpudist.utils import init_metrics  # noqa: E402
+from tpudist.utils.record import record  # noqa: E402
+
+
+def get_args(argv=None):
+    p = build_parser()
+    p.add_argument("--seq_len", default=256, type=int)
+    p.add_argument("--seq_shards", default=2, type=int)
+    p.add_argument("--model_shards", default=2, type=int)
+    p.add_argument("--vocab", default=64, type=int)
+    p.add_argument("--d_model", default=128, type=int)
+    p.add_argument("--n_layers", default=2, type=int)
+    p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
+    return parse_args(argv, parser=p)
+
+
+@record
+def main() -> None:
+    args = get_args()
+    ctx = initialize(use_node_rank=args.use_node_rank)
+    args.seed = resolve_shared_seed(args.seed)
+
+    mesh = make_mesh(
+        MeshConfig(data=-1, seq=args.seq_shards, model=args.model_shards),
+        axis_names=("data", "seq", "model"),
+    )
+    rank_print(f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    attention = (
+        make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA)
+        if args.seq_shards > 1 else None
+    )
+    module, params = create_transformer(
+        jax.random.PRNGKey(args.seed),
+        seq_len=args.seq_len,
+        attention_fn=attention,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        max_len=args.seq_len,
+    )
+    tx = optax.adam(args.lr)
+    state = init_lm_state(params, tx)
+    sharding = transformer_tp_sharding(mesh, state)
+    state = jax.device_put(state, sharding)
+    step = make_lm_train_step(module.apply, tx, mesh, state_sharding=sharding)
+
+    logger = init_metrics(args.project, args.group or "demo_3d_parallel",
+                          dry_run=args.dry_run)
+    rng = np.random.default_rng(args.seed)
+    tok_shard = token_sharding(mesh)
+    loss = None
+    for it in range(args.total_iterations):
+        tokens = jax.device_put(
+            make_batch(rng, args.batch_size, args.seq_len, args.vocab), tok_shard
+        )
+        state, loss = step(state, tokens)
+        if it % args.log_every == 0:
+            logger.log({"loss/lm": float(loss), "iteration": it})
+    final = float(loss)
+    logger.finish()
+    rank_print(f"final lm loss: {final:.4f}")
+    if ctx.is_distributed:
+        from tpudist.runtime import shutdown
+
+        shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -224,31 +224,47 @@ class TestMoETransformer:
         assert float(loss) < first * 0.7, (first, float(loss))
 
 
-class TestLongContextExample:
-    def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
-        """In-process run on the virtual mesh (the test_entrypoints pattern)."""
-        import importlib.util
-        import sys
-        from pathlib import Path
+def _run_example(name, argv, tmp_path, monkeypatch, capsys):
+    """In-process example run on the virtual mesh (test_entrypoints pattern)."""
+    import importlib.util
+    import sys
+    from pathlib import Path
 
-        examples = Path(__file__).resolve().parent.parent / "examples"
-        spec = importlib.util.spec_from_file_location(
-            "demo_long_context", examples / "demo_long_context.py"
-        )
+    examples = Path(__file__).resolve().parent.parent / "examples"
+    sys.path.insert(0, str(examples))
+    try:
+        spec = importlib.util.spec_from_file_location(name, examples / f"{name}.py")
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
 
         monkeypatch.chdir(tmp_path)
-        monkeypatch.setattr(sys, "argv", [
-            "prog", "--dry_run", "--seq_shards", "4", "--seq_len", "64",
-            "--d_model", "64", "--total_iterations", "60",
-            "--batch_size", "8", "--seed", "0", "--log_every", "20",
-        ])
+        monkeypatch.setattr(sys, "argv", ["prog"] + argv)
         import tpudist.runtime.bootstrap as bs
 
         bs._INITIALIZED_CTX = None
         mod.main()
-        out = capsys.readouterr().out
-        assert "final lm loss" in out
-        final = float(out.split("final lm loss:")[1].split()[0])
-        assert final < 2.0, out
+    finally:
+        sys.path.remove(str(examples))
+    out = capsys.readouterr().out
+    assert "final lm loss" in out
+    return float(out.split("final lm loss:")[1].split()[0])
+
+
+class TestLongContextExample:
+    def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
+        final = _run_example("demo_long_context", [
+            "--dry_run", "--seq_shards", "4", "--seq_len", "64",
+            "--d_model", "64", "--total_iterations", "60",
+            "--batch_size", "8", "--seed", "0", "--log_every", "20",
+        ], tmp_path, monkeypatch, capsys)
+        assert final < 2.0
+
+
+class Test3DParallelExample:
+    def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
+        final = _run_example("demo_3d_parallel", [
+            "--dry_run", "--seq_shards", "2", "--model_shards", "2",
+            "--seq_len", "64", "--d_model", "64", "--total_iterations", "60",
+            "--batch_size", "8", "--seed", "0", "--log_every", "20",
+        ], tmp_path, monkeypatch, capsys)
+        assert final < 2.0

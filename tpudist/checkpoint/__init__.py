@@ -18,5 +18,6 @@ scaffolding implies, TPU-natively via Orbax:
 from tpudist.checkpoint.manager import (  # noqa: F401
     CheckpointConfig,
     CheckpointManager,
+    abstract_like,
     checkpoint_dir_for,
 )
