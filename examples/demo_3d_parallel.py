@@ -39,7 +39,7 @@ from tpudist.runtime.mesh import (  # noqa: E402
 )
 from tpudist.runtime.rank_logging import rank_print  # noqa: E402
 from tpudist.train import init_lm_state, make_lm_train_step, token_sharding  # noqa: E402
-from tpudist.utils import init_metrics  # noqa: E402
+from tpudist.utils import init_metrics, trace  # noqa: E402
 from tpudist.utils.record import record  # noqa: E402
 
 
@@ -91,13 +91,15 @@ def main() -> None:
     rng = np.random.default_rng(args.seed)
     tok_shard = token_sharding(mesh)
     loss = None
-    for it in range(args.total_iterations):
-        tokens = jax.device_put(
-            make_batch(rng, args.batch_size, args.seq_len, args.vocab), tok_shard
-        )
-        state, loss = step(state, tokens)
-        if it % args.log_every == 0:
-            logger.log({"loss/lm": float(loss), "iteration": it})
+    with trace(args.profile_dir):
+        for it in range(args.total_iterations):
+            tokens = jax.device_put(
+                make_batch(rng, args.batch_size, args.seq_len, args.vocab),
+                tok_shard,
+            )
+            state, loss = step(state, tokens)
+            if it % args.log_every == 0:
+                logger.log({"loss/lm": float(loss), "iteration": it})
     final = float(loss)
     logger.finish()
     rank_print(f"final lm loss: {final:.4f}")

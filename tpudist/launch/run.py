@@ -144,6 +144,20 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
     """Launch the local worker group once; return 0 iff all workers exit 0."""
     procs: List[subprocess.Popen] = []
     base_env = dict(os.environ)
+    if args.nprocs > 1 and (
+        os.path.exists("/dev/accel0") or base_env.get("TPU_NAME")
+    ) and not any(k.startswith("TPU_") and "VISIBLE" in k for k in base_env):
+        # The standard JAX shape on TPU hosts is ONE process per host that
+        # sees all local chips (what distributed_dispatcher/tpu_pod_run
+        # launch); N workers would all try to claim every chip.  Honor the
+        # request (the operator may have set per-chip topology envs another
+        # way) but say so.
+        print(
+            f"[tpurun] warning: {args.nprocs} workers on a TPU host without "
+            "per-process chip binding (TPU_VISIBLE_* env); TPU jobs normally "
+            "run 1 process/host — see launch/README.md",
+            file=sys.stderr,
+        )
     for i in range(args.nprocs):
         rank = args.node_rank * args.nprocs + i
         env = _worker_env(base_env, coordinator=coordinator, world=world,
