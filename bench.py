@@ -59,20 +59,24 @@ def main() -> None:
         rng.integers(0, n_samples, size=(window, batch)).astype(np.int32), repl
     )
 
-    # warmup / compile
-    for _ in range(8):
+    # warmup / compile.  Sync point is a VALUE FETCH of the final loss, not
+    # block_until_ready: on remote-execution platforms (axon tunnel)
+    # block_until_ready can return before the device has executed, which
+    # silently times dispatch instead of compute.  Fetching a scalar that
+    # depends on the whole chain cannot lie.
+    for _ in range(3):
         states, losses = chunk_step(states, x_all, y_all, idx)
-    jax.block_until_ready(losses)
+    float(losses["model_X"][-1])
 
     # Adaptive duration: keep timing until ≥1s has elapsed so the number is
-    # stable (a fixed small chunk count gave ±2x run-to-run noise).
+    # stable.
     total_chunks = 0
     t0 = time.perf_counter()
     while True:
-        for _ in range(64):
+        for _ in range(8):
             states, losses = chunk_step(states, x_all, y_all, idx)
-        jax.block_until_ready(losses)
-        total_chunks += 64
+        float(losses["model_X"][-1])
+        total_chunks += 8
         dt = time.perf_counter() - t0
         if dt >= 1.0:
             break

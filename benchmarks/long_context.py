@@ -66,13 +66,15 @@ def measure(seq_len: int, seq_shards: int, *, batch: int, steps: int,
         ).astype(np.int32),
         token_sharding(mesh),
     )
+    # Sync via value fetch — block_until_ready can return before remote
+    # execution finishes on tunneled platforms (see bench.py).
     for _ in range(2):
         state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
+    float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     return {

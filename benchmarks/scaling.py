@@ -70,13 +70,15 @@ def measure_rung(devices, *, batch_per_chip: int, window: int, chunks: int,
         repl,
     )
 
+    # Sync via value fetch — block_until_ready can return before remote
+    # execution finishes on tunneled platforms (see bench.py).
     for _ in range(warmup):
         states, losses = step(states, x_all, y_all, idx)
-    jax.block_until_ready(losses)
+    float(losses["model_X"][-1])
     t0 = time.perf_counter()
     for _ in range(chunks):
         states, losses = step(states, x_all, y_all, idx)
-    jax.block_until_ready(losses)
+    float(losses["model_X"][-1])
     dt = time.perf_counter() - t0
 
     sps = batch * window * chunks / dt

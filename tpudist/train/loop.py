@@ -40,8 +40,8 @@ class TrainLoopConfig:
     # semantics, demo.py:119-121); only the blocking fetch is deferred, so
     # the device stays ahead of the host (SURVEY.md §3.1 "hot spots").
     # 256 (vs the earlier 32): on a real v5e chip the toy step costs
-    # ~0.7 µs inside a 256+-long scan vs ~8.3 µs at window 32 — host
-    # dispatch dominates short windows (measured round 1).
+    # ~41 µs inside a 512-long scan vs ~60 µs at window 32 (value-fetch-
+    # synced timing) — longer windows amortize per-step overhead ~1.5x.
     sync_every: int = 256
     # Device-cached scan path: opt-out plus an HBM budget — the dataset is
     # replicated per device, so only datasets under this cap take the path.
