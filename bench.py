@@ -45,9 +45,9 @@ def main() -> None:
     )
 
     batch = 256 * n_chips  # reference: batch 256 per rank (demo.py:145)
-    window = 256           # TrainLoopConfig.sync_every default (the
-    #                        production loop's scan window; the recorded
-    #                        baseline predates the 32→256 window tuning)
+    window = 256           # TrainLoopConfig.sync_every default — the
+    #                        production loop's scan window; BENCH_BASELINE.json
+    #                        is recorded at this same window (apples-to-apples)
     from tpudist.data import make_toy_data
 
     data = make_toy_data(seed=0)  # the 512-sample reference dataset

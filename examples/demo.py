@@ -57,6 +57,7 @@ def main() -> None:
             states, step, loader, mesh, logger, loop_cfg,
             ckpt=ckpt, start_iteration=start, chunk_step_fn=chunk_step,
         )
+    loader.close()  # joins native prefetch workers when --num_workers > 0
     if ckpt is not None:
         ckpt.close()
     print(f"[rank {ctx.process_id}] final losses: {losses}")

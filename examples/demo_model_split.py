@@ -61,6 +61,7 @@ def main() -> None:
     )
     logger = build_logger(args, default_group="demo_model_split")
     states, losses = run_training(states, step, loader, mesh, logger, loop_cfg, chunk_step_fn=chunk_step)
+    loader.close()
     print(f"[rank {ctx.process_id}] final losses: {losses}")
     shutdown()
 

@@ -45,6 +45,7 @@ def main() -> None:
     mesh = data_parallel_mesh()
     states, step, loader, loop_cfg, chunk_step = build_training(args, mesh)
     states, losses = run_training(states, step, loader, mesh, logger=None, config=loop_cfg, chunk_step_fn=chunk_step)
+    loader.close()
     rank_print(f"final losses: {losses}")
     shutdown()
 

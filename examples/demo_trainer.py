@@ -71,6 +71,7 @@ def main() -> None:
     module = ToyTrainerModule()
     loader = build_loader(args, seed=args.seed)
     losses = trainer.fit(module, loader)
+    loader.close()
     print(f"final losses: {losses}")
     trainer.teardown()
 

@@ -69,6 +69,11 @@ class ShardedLoader:
                 return
             yield self.dataset.x[sel], self.dataset.y[sel]
 
+    def close(self) -> None:
+        """Release loader resources.  No-op for the synchronous loader;
+        the native PrefetchingLoader joins its C++ worker threads here —
+        callers can close any loader unconditionally after training."""
+
 
 def shard_batch(batch, sharding):
     """Place a per-process host batch as a global sharded array.

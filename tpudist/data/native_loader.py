@@ -151,11 +151,13 @@ class PrefetchingLoader(ShardedLoader):
     """ShardedLoader with native background batch assembly.
 
     Yields the same ``(x, y)`` batches in the same order as the synchronous
-    loader.  The yielded arrays live in a ring of ``prefetch_depth + 1``
-    reused buffers sized so the batch being yielded is never concurrently
-    written; a yielded batch is overwritten once the consumer advances to
-    the next iteration — consume it immediately (the training loop's very
-    next action is the host→device transfer, which copies).
+    loader.  Batch assembly happens in a ring of ``prefetch_depth + 1``
+    reused buffers sized so the batch being materialized is never
+    concurrently written; the yielded arrays are **copies** of the ring
+    slot, upholding ShardedLoader's contract of independent batches.  (A
+    zero-copy yield would alias a slot the C++ pool later overwrites —
+    JAX's CPU client can do zero-copy ``device_put`` on aligned numpy
+    arrays, which would silently corrupt training data on CPU runs.)
     """
 
     def __init__(self, dataset, batch_size, plan, *, num_workers: int = 2,
@@ -202,7 +204,7 @@ class PrefetchingLoader(ShardedLoader):
                 jobs, _sel, slot, n = inflight.pop(0)
                 for j in jobs:
                     self._pool.wait(j)
-                out = tuple(dst[:n] for dst in slot)
+                out = tuple(dst[:n].copy() for dst in slot)
                 nxt = i + self.prefetch_depth
                 if nxt < len(starts):
                     submit(nxt)

@@ -75,7 +75,7 @@ def fused_mlp(
     batch, d_in = x.shape
     bb = min(block_batch, batch)
     if batch % bb:
-        raise ValueError(f"batch {batch} must divide block_batch {bb}")
+        raise ValueError(f"block_batch {bb} must divide batch {batch}")
     xp = jnp.zeros((batch, LANE), x.dtype).at[:, :d_in].set(x)
 
     kernel = functools.partial(_fused_kernel, n_layers=n_layers)
