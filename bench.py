@@ -1,19 +1,26 @@
 #!/usr/bin/env python3
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Workload: the reference's implicit benchmark definition (BASELINE.md —
-the reference publishes no numbers, so this harness establishes them):
-the `demo.py` hot loop — two ToyMLPs, Adam(1e-3), batch 256 per chip,
-data-parallel over all local devices — measured as samples/sec/chip.
+Headline workload: the reference's implicit benchmark definition
+(BASELINE.md — the reference publishes no numbers, so this harness
+establishes them): the `demo.py` hot loop — two ToyMLPs, Adam(1e-3),
+batch 256 per chip, data-parallel over all local devices — measured as
+samples/sec/chip.
 
 Since the reference's published baseline is empty, ``vs_baseline`` is
 reported against this repo's own recorded north-star figure when present
 (``BENCH_BASELINE.json``), else 1.0 (we ARE the baseline).
+
+The toy MLP measures dispatch/loop overhead, not TPU muscle, so the
+harness also times the Transformer LM family — with analytic-FLOPs MFU
+accounting (:mod:`tpudist.utils.flops`) — and snapshots everything to
+``BENCH_EXTENDED.json`` next to this file.  stdout stays one JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 from pathlib import Path
 
@@ -22,12 +29,21 @@ import numpy as np
 import optax
 
 
-def main() -> None:
+def _sync(x) -> float:
+    """Sync point is a VALUE FETCH of a scalar depending on the whole
+    chain, not block_until_ready: on remote-execution platforms (axon
+    tunnel) block_until_ready can return before the device has executed,
+    which silently times dispatch instead of compute."""
+    return float(np.asarray(x).ravel()[-1])
+
+
+def bench_toy() -> dict:
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from tpudist.data import make_toy_data
+    from tpudist.models import create_toy_model
     from tpudist.runtime.mesh import data_parallel_mesh
     from tpudist.train import init_model_states, make_scanned_train_step
-    from tpudist.models import create_toy_model
 
     n_chips = jax.local_device_count()
     mesh = data_parallel_mesh()
@@ -48,7 +64,6 @@ def main() -> None:
     window = 256           # TrainLoopConfig.sync_every default — the
     #                        production loop's scan window; BENCH_BASELINE.json
     #                        is recorded at this same window (apples-to-apples)
-    from tpudist.data import make_toy_data
 
     data = make_toy_data(seed=0)  # the 512-sample reference dataset
     n_samples = len(data)
@@ -59,30 +74,115 @@ def main() -> None:
         rng.integers(0, n_samples, size=(window, batch)).astype(np.int32), repl
     )
 
-    # warmup / compile.  Sync point is a VALUE FETCH of the final loss, not
-    # block_until_ready: on remote-execution platforms (axon tunnel)
-    # block_until_ready can return before the device has executed, which
-    # silently times dispatch instead of compute.  Fetching a scalar that
-    # depends on the whole chain cannot lie.
-    for _ in range(3):
+    for _ in range(3):  # warmup / compile
         states, losses = chunk_step(states, x_all, y_all, idx)
-    float(losses["model_X"][-1])
+    _sync(losses["model_X"])
 
-    # Adaptive duration: keep timing until ≥1s has elapsed so the number is
-    # stable.
+    # Adaptive duration: keep timing until >=1s has elapsed so the number
+    # is stable.
     total_chunks = 0
     t0 = time.perf_counter()
     while True:
         for _ in range(8):
             states, losses = chunk_step(states, x_all, y_all, idx)
-        float(losses["model_X"][-1])
+        _sync(losses["model_X"])
         total_chunks += 8
         dt = time.perf_counter() - t0
         if dt >= 1.0:
             break
 
-    samples_per_sec = batch * window * total_chunks / dt
-    per_chip = samples_per_sec / n_chips
+    per_chip = batch * window * total_chunks / dt / n_chips
+    return {
+        "metric": "toy_mlp_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+    }
+
+
+def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
+             n_layers: int, n_heads: int, d_ff: int, vocab: int = 256,
+             steps: int = 5) -> dict:
+    """Time the TransformerLM train step and report tokens/sec/chip + MFU."""
+    from tpudist.models import create_transformer
+    from tpudist.runtime.mesh import data_parallel_mesh
+    from tpudist.train import init_lm_state, make_lm_train_step, token_sharding
+    from tpudist.utils import chip_peak_flops, mfu, transformer_train_flops
+
+    n_chips = jax.local_device_count()
+    mesh = data_parallel_mesh()
+    module, params = create_transformer(
+        jax.random.PRNGKey(0), seq_len=seq_len, vocab=vocab, d_model=d_model,
+        n_layers=n_layers, n_heads=n_heads, d_ff=d_ff, max_len=seq_len,
+    )
+    tx = optax.adam(3e-4)
+    state = init_lm_state(params, tx)
+    step = make_lm_train_step(module.apply, tx, mesh)
+    tokens = jax.device_put(
+        np.random.default_rng(0).integers(0, vocab, size=(batch, seq_len))
+        .astype(np.int32),
+        token_sharding(mesh),
+    )
+
+    for _ in range(2):  # warmup / compile
+        state, loss = step(state, tokens)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, tokens)
+    _sync(loss)
+    step_s = (time.perf_counter() - t0) / steps
+
+    flops = transformer_train_flops(
+        batch=batch, seq_len=seq_len, d_model=d_model, n_layers=n_layers,
+        d_ff=d_ff, vocab=vocab,
+    )
+    peak = chip_peak_flops()
+    util = mfu(flops, step_s, n_chips, peak)
+    return {
+        "metric": f"lm_{name}_tokens_per_sec_per_chip",
+        "value": round(batch * seq_len / step_s / n_chips, 1),
+        "unit": "tokens/sec/chip",
+        "step_ms": round(step_s * 1e3, 2),
+        "config": {"batch": batch, "seq_len": seq_len, "d_model": d_model,
+                   "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
+                   "vocab": vocab},
+        "model_flops_per_step": flops,
+        "mfu_pct": round(util * 100, 2) if util is not None else None,
+        "peak_flops_per_chip": peak,
+    }
+
+
+def main() -> None:
+    results = {"device_kind": jax.devices()[0].device_kind,
+               "n_chips": jax.local_device_count()}
+
+    toy = bench_toy()
+    results["toy"] = toy
+
+    # MXU-dense LM config: matmul-dominated, the MFU yardstick.
+    try:
+        results["lm_dense"] = bench_lm(
+            name="dense", batch=8, seq_len=2048, d_model=512, n_layers=4,
+            n_heads=8, d_ff=2048,
+        )
+    except Exception as e:  # keep the headline alive on small hosts
+        results["lm_dense"] = {"error": repr(e)}
+        print(f"# lm_dense failed: {e!r}", file=sys.stderr)
+
+    # Long-context LM config (BASELINE.md's measured row): flash-attention
+    # regime, attention-dominated — tracks the kernel round over round.
+    try:
+        results["lm_long_context"] = bench_lm(
+            name="long_context", batch=4, seq_len=8192, d_model=256,
+            n_layers=4, n_heads=4, d_ff=1024,
+        )
+    except Exception as e:
+        results["lm_long_context"] = {"error": repr(e)}
+        print(f"# lm_long_context failed: {e!r}", file=sys.stderr)
+
+    (Path(__file__).parent / "BENCH_EXTENDED.json").write_text(
+        json.dumps(results, indent=2) + "\n"
+    )
 
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
     vs = 1.0
@@ -90,20 +190,11 @@ def main() -> None:
         try:
             recorded = json.loads(baseline_path.read_text()).get("value")
             if recorded:
-                vs = per_chip / recorded
+                vs = toy["value"] / recorded
         except Exception:
             pass
 
-    print(
-        json.dumps(
-            {
-                "metric": "toy_mlp_samples_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    print(json.dumps({**toy, "vs_baseline": round(vs, 3)}))
 
 
 if __name__ == "__main__":

@@ -77,10 +77,19 @@ def measure(seq_len: int, seq_shards: int, *, batch: int, steps: int,
     float(loss)
     dt = time.perf_counter() - t0
 
+    from tpudist.utils import chip_peak_flops, mfu, transformer_train_flops
+
+    flops = transformer_train_flops(
+        batch=batch, seq_len=seq_len, d_model=d_model, n_layers=n_layers,
+        d_ff=module.d_ff, vocab=module.vocab,
+    )
+    util = mfu(flops, dt / steps, data_size * seq_shards, chip_peak_flops())
     return {
         "seq_len": seq_len,
         "seq_shards": seq_shards,
         "tokens_per_sec": round(batch * seq_len * steps / dt, 1),
+        "model_flops_per_step": flops,
+        "mfu_pct": round(util * 100, 2) if util is not None else None,
         "block_per_chip": seq_len // seq_shards,
         "regime": "virtual-cpu" if devices[0].platform == "cpu" else "hardware",
     }

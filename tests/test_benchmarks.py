@@ -39,3 +39,44 @@ class TestLongContext:
         assert len(results) == 2
         assert all(r["tokens_per_sec"] > 0 for r in results)
         assert results[1]["block_per_chip"] == 32
+
+
+class TestFlopsAccounting:
+    def test_transformer_flops_formula(self):
+        from tpudist.utils import transformer_train_flops
+
+        # One layer, no attention-vs-ffn surprises: check against the
+        # hand-expanded formula for small numbers.
+        b, s, d, f, v, L = 2, 8, 4, 16, 10, 1
+        fwd = L * (8 * b * s * d * d + 2 * b * s * s * d + 4 * b * s * d * f) \
+            + 2 * b * s * d * v
+        got = transformer_train_flops(batch=b, seq_len=s, d_model=d,
+                                      n_layers=L, d_ff=f, vocab=v)
+        assert got == 3.0 * fwd
+        # Full attention doubles only the s^2 term.
+        full = transformer_train_flops(batch=b, seq_len=s, d_model=d,
+                                       n_layers=L, d_ff=f, vocab=v,
+                                       causal=False)
+        assert full - got == 3.0 * 2 * b * s * s * d
+        # fwd_only is exactly a third of the train count.
+        assert transformer_train_flops(batch=b, seq_len=s, d_model=d,
+                                       n_layers=L, d_ff=f, vocab=v,
+                                       fwd_only=True) == fwd
+
+    def test_mfu_and_peak(self):
+        from tpudist.utils import chip_peak_flops, mfu
+
+        # Virtual CPU devices have no recorded peak -> MFU is None.
+        assert chip_peak_flops() is None
+        assert mfu(1e12, 0.1, 1, None) is None
+        # With an explicit peak the ratio is exact.
+        assert mfu(1e12, 0.1, 1, 1e13) == pytest.approx(1.0)
+        assert mfu(1e12, 0.1, 4, 1e13) == pytest.approx(0.25)
+
+    def test_long_context_rows_carry_mfu_fields(self):
+        from benchmarks.long_context import main
+
+        rows = main(["--seq-lens", "64", "--seq-shards", "1", "--batch", "2",
+                     "--steps", "1", "--d-model", "32", "--n-layers", "1"])
+        assert rows[0]["model_flops_per_step"] > 0
+        assert rows[0]["mfu_pct"] is None  # virtual CPU: no peak known

@@ -1,2 +1,7 @@
+from tpudist.utils.flops import (  # noqa: F401
+    chip_peak_flops,
+    mfu,
+    transformer_train_flops,
+)
 from tpudist.utils.metrics import MetricsLogger, init_metrics  # noqa: F401
 from tpudist.utils.profiling import StageTimer, trace  # noqa: F401

@@ -1,0 +1,87 @@
+"""Analytic FLOPs accounting + MFU for the benchmark harnesses.
+
+The reference publishes no efficiency numbers at all (SURVEY.md §6 —
+``/root/reference/README.md`` is four lines); this repo owns its baseline,
+so the baseline carries model-FLOPs-utilization: a throughput number alone
+cannot say whether a step is 5% or 50% of what the chip can do.
+
+Conventions (the standard accounting, e.g. the PaLM appendix / scaling-book
+formulation):
+
+- one multiply-add = 2 FLOPs;
+- backward pass = 2x forward (one pass for activations, one for weights);
+- causal attention does half the score/value work of full attention;
+- embedding lookups, norms, softmax and other vector work are omitted —
+  MXU matmul FLOPs dominate and MFU is conventionally model-FLOPs only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Peak dense-matmul throughput per chip, bf16, FLOP/s.  Keyed by
+# ``jax.Device.device_kind``.  Sources: public TPU spec sheets (v4 275T,
+# v5e 197T, v5p 459T, v6e 918T bf16).
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def chip_peak_flops(device=None) -> Optional[float]:
+    """bf16 peak FLOP/s for ``device`` (default: first visible device);
+    None when unknown (e.g. the CPU virtual mesh)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    return PEAK_BF16_FLOPS.get(getattr(device, "device_kind", ""))
+
+
+def transformer_train_flops(
+    *,
+    batch: int,
+    seq_len: int,
+    d_model: int,
+    n_layers: int,
+    d_ff: int,
+    vocab: int,
+    causal: bool = True,
+    fwd_only: bool = False,
+) -> float:
+    """Analytic matmul FLOPs for one TransformerLM train step
+    (:class:`tpudist.models.transformer.TransformerLM` shapes: fused qkv,
+    proj, wi/wo FFN, untied head).
+
+    Per block forward: qkv ``6*b*s*d^2`` + proj ``2*b*s*d^2`` + attention
+    ``4*b*s^2*d`` (scores + values; halved when causal) + FFN ``4*b*s*d*f``.
+    Head: ``2*b*s*d*V``.  Train = 3x forward.  A top-1 capacity MoE FFN has
+    the same per-token FLOPs as the dense FFN (each token visits one
+    expert), so this formula covers the MoE variant too (router matmul is
+    O(b*s*d*E), negligible).
+    """
+    b, s, d, f, v = batch, seq_len, d_model, d_ff, vocab
+    attn_factor = 2.0 if causal else 4.0
+    per_block = 8 * b * s * d * d + attn_factor * b * s * s * d + 4 * b * s * d * f
+    fwd = n_layers * per_block + 2 * b * s * d * v
+    return fwd if fwd_only else 3.0 * fwd
+
+
+def mfu(
+    flops_per_step: float,
+    step_seconds: float,
+    n_chips: int,
+    peak_per_chip: Optional[float] = None,
+) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1]; None when the chip peak is
+    unknown (virtual CPU devices)."""
+    if peak_per_chip is None:
+        peak_per_chip = chip_peak_flops()
+    if not peak_per_chip or step_seconds <= 0:
+        return None
+    return flops_per_step / step_seconds / (n_chips * peak_per_chip)
