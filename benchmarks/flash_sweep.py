@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Flash-attention kernel tuning sweep: dense XLA vs Pallas blocks.
+
+Times causal attention forward (and optionally fwd+bwd) at the demo shapes
+(head_dim 64) across (block_q, block_k) and prints one JSON line per
+configuration.  Run on the real chip; value-fetch synced (see bench.py).
+
+Usage:
+  python benchmarks/flash_sweep.py --seq 2048 --blocks 256x256,512x512
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time(fn, *args, steps=10):
+    """Per-application seconds for ``fn``, measured as ONE dispatched XLA
+    program that chains ``steps`` serially-dependent applications via
+    lax.scan — per-call dispatch through the remote-execution tunnel is
+    tens of ms, far more than the kernel itself, so timing separate calls
+    measures the tunnel, not the op."""
+    from jax import lax
+
+    q0, rest = args[0], args[1:]
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def chained(length, q, *rest):
+        def body(carry, _):
+            out = fn(carry, *rest)
+            # feed the output back as q: same [b, h, s, d] shape, forces
+            # serial execution of every application
+            return out.reshape(carry.shape).astype(carry.dtype), ()
+
+        final, _ = lax.scan(body, q, (), length=length)
+        return final.sum()  # fetch one scalar, not MBs through the tunnel
+
+    def once(length):
+        out = chained(length, q0, *rest)
+        float(jax.device_get(out))
+
+    once(1)       # compile short program
+    once(steps)   # compile long program
+
+    # Two-point measurement: (t_long - t_short) cancels the fixed
+    # dispatch/fetch overhead of the tunnel; min-of-repeats rejects
+    # contention spikes (the tunnel is shared and noisy).
+    short = long_ = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        once(1)
+        short = min(short, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        once(steps)
+        long_ = min(long_, time.perf_counter() - t0)
+    return (long_ - short) / (steps - 1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", default=2048, type=int)
+    p.add_argument("--batch", default=4, type=int)
+    p.add_argument("--heads", default=4, type=int)
+    p.add_argument("--head-dim", default=64, type=int)
+    p.add_argument("--blocks", default="128x128,256x256,256x512,512x512,512x1024,1024x1024")
+    p.add_argument("--steps", default=10, type=int)
+    p.add_argument("--grad", action="store_true", help="time fwd+bwd too")
+    p.add_argument("--skip-dense", action="store_true")
+    args = p.parse_args(argv)
+
+    from tpudist.ops import flash_attention
+    from tpudist.parallel.ring_attention import attention_reference
+
+    rng = np.random.default_rng(0)
+    shape = (args.batch, args.heads, args.seq, args.head_dim)
+    q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    results = []
+
+    def report(name, secs):
+        row = {"kernel": name, "seq": args.seq, "ms": round(secs * 1e3, 3)}
+        results.append(row)
+        print(json.dumps(row))
+
+    if not args.skip_dense:
+        dense = jax.jit(lambda a, b, c: attention_reference(a, b, c, causal=True))
+        report("dense_xla_fwd", _time(dense, q, k, v, steps=args.steps))
+        if args.grad:
+            dense_g = jax.jit(jax.grad(
+                lambda a, b, c: attention_reference(a, b, c, causal=True).sum()
+            ))
+            report("dense_xla_fwdbwd", _time(dense_g, q, k, v, steps=args.steps))
+
+    for spec in args.blocks.split(","):
+        bq, bk = (int(x) for x in spec.split("x"))
+        if args.seq % bq or args.seq % bk:
+            continue
+        fl = jax.jit(lambda a, b, c, bq=bq, bk=bk:
+                     flash_attention(a, b, c, True, bq, bk, False))
+        report(f"flash_{bq}x{bk}_fwd", _time(fl, q, k, v, steps=args.steps))
+        if args.grad:
+            fl_g = jax.jit(jax.grad(
+                lambda a, b, c, bq=bq, bk=bk:
+                flash_attention(a, b, c, True, bq, bk, False).sum()
+            ))
+            report(f"flash_{bq}x{bk}_fwdbwd", _time(fl_g, q, k, v, steps=args.steps))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,17 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, rtol=5e-5)
 
+    @pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (64, 256), (256, 64)])
+    def test_unequal_blocks_causal(self, bq, bk):
+        """The causal dead-block DMA-elision index map depends on
+        block_q != block_k arithmetic ((i+1)*bq-1)//bk — cover both
+        wide-K and wide-Q tiles."""
+        q, k, v = self._qkv(seq=256)
+        out = flash_attention(q, k, v, True, bq, bk, True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
     def test_indivisible_seq_raises(self):
         q, k, v = self._qkv(seq=100)
         with pytest.raises(ValueError, match="divide"):

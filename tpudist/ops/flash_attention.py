@@ -114,6 +114,27 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale
     )
+
+    if causal:
+        # Dead KV blocks (fully above the diagonal) re-map to the row's last
+        # live block: Pallas elides the DMA when a block index repeats
+        # between consecutive grid steps, so the dead tail of each Q row
+        # costs neither fetch bandwidth nor a compute pass (the kernel's
+        # ``live`` predicate is already false there).
+        def kv_index(b, i, j):
+            return (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+    else:
+        def kv_index(b, i, j):
+            return (b, j, 0)
+
+    # Whole-kernel cost for the XLA scheduler (matmul mult-add = 2 FLOPs;
+    # exp per score entry; causal does half the score work).
+    work = bh * seq_q * seq_k * (0.5 if causal else 1.0)
+    cost = pl.CostEstimate(
+        flops=int(4 * work * d),
+        transcendentals=int(work),
+        bytes_accessed=int(qr.size + kr.size + vr.size + qr.size) * 4,
+    )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
@@ -121,10 +142,8 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
@@ -133,6 +152,11 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, 1), jnp.float32),   # l (running normalizer)
             pltpu.VMEM((bq, d), jnp.float32),   # acc (unnormalized out)
         ],
+        compiler_params=pltpu.CompilerParams(
+            # bh and q rows are independent; only the KV sweep accumulates.
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=cost,
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(batch, heads, seq_q, d)
