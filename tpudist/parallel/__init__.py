@@ -30,4 +30,10 @@ from tpudist.parallel.tensor_parallel import (  # noqa: F401
     tp_mlp_shard,
 )
 from tpudist.parallel.pipeline import make_pipeline, pipeline_shard  # noqa: F401
+from tpudist.parallel.pipeline_lm import (  # noqa: F401
+    make_pp_lm_apply,
+    pp_state_sharding,
+    stack_block_params,
+    unstack_block_params,
+)
 from tpudist.parallel.moe import MoEStats, make_moe, moe_shard  # noqa: F401

@@ -55,9 +55,11 @@ def pipeline_shard(
     passes ``P(None, ...)``; pass it sharded over stages to save memory and
     only stage 0's block is read).
 
-    Returns ``[num_micro, micro_size, d]`` of final-stage outputs, valid on
-    the LAST stage (other stages return zeros) — the caller's out_spec
-    gathers from the stage axis.
+    Returns ``[num_micro, micro_size, d]`` — valid on the LAST stage,
+    zeros elsewhere.  Callers gather with a stage-axis out_spec and slice
+    the last stage's block (see :func:`make_pipeline`): XLA then moves one
+    stage's data instead of all-reducing the whole ``n_stages`` stack,
+    which is what a ``psum`` broadcast would do.
     """
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     n_stages = lax.axis_size(axis_name)
@@ -99,9 +101,7 @@ def pipeline_shard(
     (_, outputs), _ = lax.scan(
         tick, (init_state, init_out), jnp.arange(total_ticks)
     )
-    # Only the last stage holds real outputs; psum broadcasts them so the
-    # result is replicated over the stage axis (cheap: zeros elsewhere).
-    return lax.psum(outputs, axis_name)
+    return outputs
 
 
 def make_pipeline(
@@ -123,16 +123,23 @@ def make_pipeline(
         num_micro = num_microbatches
         micro = x.shape[0] // num_micro
         xm = x.reshape((num_micro, micro) + x.shape[1:])
-        body = functools.partial(
-            pipeline_shard, stage_fn=stage_fn, axis_name=axis_name
-        )
+
+        def body(sp, xmb):
+            return pipeline_shard(
+                sp, xmb, stage_fn=stage_fn, axis_name=axis_name
+            )[None]
+
+        # Leading stage axis on the output; slicing the last block makes
+        # XLA move one stage's data (a broadcast from the final stage)
+        # instead of all-reducing zeros from every other stage.
         out = jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis_name), P()),
-            out_specs=P(),
-            check_vma=False,  # psum makes the output replicated
+            out_specs=P(axis_name),
+            check_vma=False,  # inputs arrive replicated; ppermute varies them
         )(stage_params, xm)
+        out = out[-1]
         return out.reshape((num_micro * micro,) + out.shape[2:])
 
     return jax.jit(global_fn)

@@ -1,0 +1,197 @@
+"""Pipeline-parallel TransformerLM: the GPipe schedule of
+:mod:`tpudist.parallel.pipeline` applied to the LM block stack, composed
+with data parallelism on a ``(data, stage)`` mesh.
+
+Placement: token/position embeddings and the final-norm/head run
+replicated on every device (they are a sliver of the FLOPs; replicating
+them avoids two extra pipeline hops), while the N transformer blocks are
+stacked ``[n_stages, layers_per_stage, ...]`` and sharded one stage per
+device along the ``stage`` axis.  Activations move stage-to-stage with
+``lax.ppermute`` over ICI; the whole schedule — fill, steady state, drain
+— is one ``lax.scan`` inside one jitted ``shard_map``, differentiable
+end-to-end (the backward is the reverse-ring schedule XLA derives).
+
+The reference's only model parallelism is the manual 2-stage split of
+``demo_one_model_multi_gpu.py:17-42``; this is its scalable TPU-native
+generalization, and it composes with DP the same way the reference's
+DDP(model-split) composition does (``demo_one_model_multi_gpu.py:96-98``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.parallel.pipeline import pipeline_shard
+from tpudist.runtime.mesh import AXIS_DATA, AXIS_STAGE
+
+# NOTE: tpudist.models.transformer is imported lazily inside the builders —
+# it imports tpudist.parallel for the attention references, so a module-level
+# import here would be circular.
+
+
+class _LMEmbed(nn.Module):
+    """Embedding head whose param names match TransformerLM's tree."""
+
+    vocab: int
+    d_model: int
+    max_len: int
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab, self.d_model, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)
+        )
+        return x + pos[None]
+
+
+class _LMHead(nn.Module):
+    """Final norm + vocab projection, names matching TransformerLM."""
+
+    vocab: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(use_bias=False)(x)  # -> 'LayerNorm_0'
+        return nn.Dense(self.vocab, use_bias=False, name="head")(x)
+
+
+_EMBED_KEYS = ("tok_embed", "pos_embed")
+_HEAD_KEYS = ("LayerNorm_0", "head")
+
+
+def stack_block_params(params, n_stages: int):
+    """TransformerLM params → pipeline layout.
+
+    Returns ``{"blocks": stacked, "rest": {...}}`` where ``stacked`` leaves
+    have shape ``[n_stages, layers_per_stage, ...]`` (stage axis sharded,
+    inner axis walked sequentially per stage) and ``rest`` holds the
+    embeddings/norm/head unchanged.
+    """
+    p = dict(params["params"])
+    block_keys = sorted(
+        (k for k in p if k.startswith("block_")),
+        key=lambda k: int(k.split("_")[1]),
+    )
+    n_layers = len(block_keys)
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} blocks do not split into {n_stages} stages")
+    per_stage = n_layers // n_stages
+    blocks = [p.pop(k) for k in block_keys]
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves).reshape(
+            (n_stages, per_stage) + leaves[0].shape
+        ),
+        *blocks,
+    )
+    return {"blocks": stacked, "rest": p}
+
+
+def unstack_block_params(pp_params):
+    """Inverse of :func:`stack_block_params` (checkpoint/parity interop)."""
+    stacked = pp_params["blocks"]
+    shape = jax.tree.leaves(stacked)[0].shape
+    n_stages, per_stage = shape[0], shape[1]
+    p = dict(pp_params["rest"])
+    for s in range(n_stages):
+        for j in range(per_stage):
+            p[f"block_{s * per_stage + j}"] = jax.tree.map(
+                lambda a, s=s, j=j: a[s, j], stacked
+            )
+    return {"params": p}
+
+
+def pp_state_sharding(mesh: Mesh, tree, *, axis_name: str = AXIS_STAGE):
+    """Shardings for a pipeline ``ModelState`` pytree: every leaf under a
+    ``blocks`` key is stage-sharded on its leading axis, everything else
+    (embeddings, head, Adam's scalar count) replicated."""
+    staged = NamedSharding(mesh, P(axis_name))
+    repl = NamedSharding(mesh, P())
+
+    def shard_for(path, leaf):
+        keys = [getattr(e, "key", getattr(e, "name", None)) for e in path]
+        if "blocks" in keys and getattr(leaf, "ndim", 0) >= 1:
+            return staged
+        return repl
+
+    return jax.tree_util.tree_map_with_path(shard_for, tree)
+
+
+def make_pp_lm_apply(
+    mesh: Mesh,
+    module,  # a tpudist.models.transformer.TransformerLM
+    *,
+    n_stages: int,
+    num_microbatches: int = 4,
+    axis_name: str = AXIS_STAGE,
+    data_axis: Optional[str] = AXIS_DATA,
+):
+    """Build ``apply(pp_params, tokens) -> logits`` with the block stack
+    pipelined over ``axis_name`` and the batch sharded over ``data_axis``.
+
+    ``pp_params`` comes from :func:`stack_block_params`.  Feed the result
+    to :func:`tpudist.train.make_lm_train_step` together with
+    :func:`pp_state_sharding` — the loss/grad/optimizer path needs no
+    pipeline awareness.
+    """
+    from tpudist.models.transformer import Block, _default_attention
+
+    block_mod = Block(
+        module.d_model, module.n_heads, module.d_ff,
+        module.attention_fn or _default_attention,
+        n_experts=module.n_experts, moe_fn=module.moe_fn,
+    )
+    embed_mod = _LMEmbed(module.vocab, module.d_model, module.max_len)
+    head_mod = _LMHead(module.vocab)
+
+    def stage_fn(stage_params, x):
+        # stage_params leaves: [layers_per_stage, ...]; apply sequentially.
+        per_stage = jax.tree.leaves(stage_params)[0].shape[0]
+        for j in range(per_stage):
+            layer = jax.tree.map(lambda a, j=j: a[j], stage_params)
+            x = block_mod.apply({"params": layer}, x)
+        return x
+
+    data_in_spec = P(None, data_axis) if data_axis else P()
+    out_spec = (
+        P(axis_name, None, data_axis) if data_axis else P(axis_name)
+    )
+
+    def apply(pp_params, tokens):
+        rest = pp_params["rest"]
+        x = embed_mod.apply(
+            {"params": {k: rest[k] for k in _EMBED_KEYS}}, tokens
+        )
+        b, s, d = x.shape
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch {b} must divide into {num_microbatches} microbatches"
+            )
+        xm = x.reshape(num_microbatches, b // num_microbatches, s, d)
+
+        def body(sp, xmb):
+            return pipeline_shard(
+                sp, xmb, stage_fn=stage_fn, axis_name=axis_name
+            )[None]
+
+        out = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis_name), data_in_spec),
+            out_specs=out_spec,
+            check_vma=False,  # replicated inputs; ppermute varies them
+        )(pp_params["blocks"], xm)
+        # Last stage's block only — one stage's data moves, not a psum of
+        # the whole [n_stages, ...] stack.
+        x = out[-1].reshape(b, s, d)
+        return head_mod.apply(
+            {"params": {k: rest[k] for k in _HEAD_KEYS}}, x
+        )
+
+    return apply
