@@ -52,6 +52,10 @@ def get_args(argv=None):
     p.add_argument("--vocab", default=64, type=int)
     p.add_argument("--d_model", default=128, type=int)
     p.add_argument("--n_layers", default=2, type=int)
+    p.add_argument("--moe_experts", default=0, type=int,
+                   help="replace the dense FFN with a top-1 MoE of this "
+                        "many experts, expert-parallel over a model mesh "
+                        "axis of the same size (requires --seq_shards 1)")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -69,7 +73,10 @@ def main() -> None:
     ctx = initialize(use_node_rank=args.use_node_rank)
     args.seed = resolve_shared_seed(args.seed)
 
-    mesh = make_mesh(MeshConfig(data=-1, seq=args.seq_shards))
+    if args.moe_experts > 0 and args.seq_shards > 1:
+        raise SystemExit("--moe_experts composes with dp, not sp: use --seq_shards 1")
+    mesh = make_mesh(MeshConfig(data=-1, seq=args.seq_shards,
+                                model=max(args.moe_experts, 1)))
     rank_print(
         f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
         f"seq_len={args.seq_len} (block {args.seq_len // args.seq_shards}/chip)"
@@ -81,6 +88,12 @@ def main() -> None:
         if args.seq_shards > 1
         else None  # single seq shard: length-aware default (dense/flash)
     )
+    moe_fn = None
+    if args.moe_experts > 0:
+        from tpudist.models.transformer import moe_expert_fn
+        from tpudist.parallel import make_moe
+
+        moe_fn = make_moe(mesh, moe_expert_fn, batch_axis=AXIS_DATA)
     module, params = create_transformer(
         jax.random.PRNGKey(args.seed),
         seq_len=args.seq_len,
@@ -89,10 +102,13 @@ def main() -> None:
         d_model=args.d_model,
         n_layers=args.n_layers,
         max_len=args.seq_len,
+        n_experts=args.moe_experts,
+        moe_fn=moe_fn,
     )
     tx = optax.adam(args.lr)
     state = init_lm_state(params, tx)
-    step = make_lm_train_step(module.apply, tx, mesh)
+    step = make_lm_train_step(module.apply, tx, mesh,
+                              aux=args.moe_experts > 0)
 
     logger = init_metrics(args.project, args.group or "demo_long_context",
                           dry_run=args.dry_run)
@@ -105,9 +121,20 @@ def main() -> None:
                 make_batch(rng, args.batch_size, args.seq_len, args.vocab),
                 tok_shard,
             )
-            state, loss = step(state, tokens)
+            if args.moe_experts > 0:
+                state, loss, aux = step(state, tokens)
+            else:
+                state, loss = step(state, tokens)
+                aux = {}
             if it % args.log_every == 0:
-                logger.log({"loss/lm": float(loss), "iteration": it})
+                row = {"loss/lm": float(loss), "iteration": it}
+                if "moe_dropped_fraction" in aux:
+                    row["moe/dropped_fraction"] = float(
+                        aux["moe_dropped_fraction"]
+                    )
+                    load = np.asarray(aux["moe_expert_load"])
+                    row["moe/load_max"] = float(load.max())
+                logger.log(row)
     final = float(loss)
     logger.finish()
     rank_print(f"final lm loss: {final:.4f}")

@@ -96,7 +96,14 @@ class MoEFFN(nn.Module):
         }
         tokens = x.reshape(b * s, d)
         if self.moe_fn is not None:
-            y, _stats = self.moe_fn(params, tokens)
+            y, stats = self.moe_fn(params, tokens)
+            # Routing observability: collected by train steps built with
+            # ``aux=True`` (make_lm_train_step) and logged host-side — the
+            # reference's reduce-then-log-on-rank-0 discipline (SURVEY.md
+            # §5.5) applied to expert load.
+            self.sow("intermediates", "moe_dropped_fraction",
+                     stats.dropped_fraction)
+            self.sow("intermediates", "moe_expert_load", stats.expert_load)
         else:
             y = dense_moe_reference(params, tokens)
         return y.reshape(b, s, d)

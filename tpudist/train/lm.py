@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -41,6 +42,7 @@ def make_lm_train_step(
     *,
     donate_state: bool = True,
     state_sharding=None,
+    aux: bool = False,
 ):
     """Build ``step(state, tokens) -> (state, loss)``, compiled once.
 
@@ -52,23 +54,60 @@ def make_lm_train_step(
     :func:`tpudist.models.transformer.transformer_tp_sharding`) overrides
     the default replicated parameter layout — tensor parallelism composed
     with the data/seq sharding of the batch.
+
+    ``aux=True`` runs the model with flax ``intermediates`` collection and
+    returns ``step(state, tokens) -> (state, loss, aux_dict)`` where
+    ``aux_dict`` carries MoE routing stats averaged over layers
+    (``moe_dropped_fraction`` scalar, ``moe_expert_load`` ``[n_experts]``)
+    — empty when the model sows nothing.  Requires ``apply_fn`` to accept
+    flax's ``mutable=`` kwarg (i.e. a ``Module.apply``).
     """
     repl = NamedSharding(mesh, P())
     tok_shard = token_sharding(mesh)
     state_out = repl if state_sharding is None else state_sharding
 
-    def step(state: ModelState, tokens):
-        def loss_of(params):
-            return lm_loss(apply_fn(params, tokens), tokens)
+    def _collect_aux(inters) -> dict:
+        by_name: dict = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(inters)[0]:
+            keys = [getattr(e, "key", getattr(e, "name", None)) for e in path]
+            for name in ("moe_dropped_fraction", "moe_expert_load"):
+                if name in keys:
+                    by_name.setdefault(name, []).append(leaf)
+        return {
+            name: jnp.mean(jnp.stack(vals), axis=0)
+            for name, vals in by_name.items()
+        }
 
-        loss, grads = jax.value_and_grad(loss_of)(state.params)
+    def step(state: ModelState, tokens):
+        if aux:
+            def loss_of(params):
+                logits, mut = apply_fn(
+                    params, tokens, mutable=["intermediates"]
+                )
+                return lm_loss(logits, tokens), mut["intermediates"]
+
+            (loss, inters), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(state.params)
+        else:
+            def loss_of(params):
+                return lm_loss(apply_fn(params, tokens), tokens)
+
+            loss, grads = jax.value_and_grad(loss_of)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        return ModelState(params=new_params, opt_state=new_opt), loss
+        new_state = ModelState(params=new_params, opt_state=new_opt)
+        if aux:
+            return new_state, loss, _collect_aux(inters)
+        return new_state, loss
 
+    if aux:
+        out_shardings = (state_out, repl, None)  # aux: XLA-chosen (replicated scalars)
+    else:
+        out_shardings = (state_out, repl)
     return jax.jit(
         step,
         in_shardings=(state_out, tok_shard),
-        out_shardings=(state_out, repl),
+        out_shardings=out_shardings,
         donate_argnums=(0,) if donate_state else (),
     )
