@@ -223,6 +223,40 @@ class TestMoETransformer:
                 first = float(loss)
         assert float(loss) < first * 0.7, (first, float(loss))
 
+    def test_moe_aux_stats(self, devices):
+        """aux=True surfaces routing stats (sown intermediates) host-side:
+        dropped_fraction in [0,1], expert_load a distribution over experts."""
+        from tpudist.models.transformer import moe_expert_fn
+        from tpudist.parallel import make_moe
+        from tpudist.runtime.mesh import AXIS_MODEL
+
+        mesh = Mesh(np.asarray(devices).reshape(4, 2),
+                    axis_names=(AXIS_DATA, AXIS_MODEL))
+        moe_fn = make_moe(mesh, moe_expert_fn, batch_axis=AXIS_DATA,
+                          capacity_factor=2.0)
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32, moe_fn=moe_fn,
+            **dict(CFG, n_experts=2))
+        tx = optax.adam(1e-3)
+        state = init_lm_state(params, tx)
+        step = make_lm_train_step(module.apply, tx, mesh, aux=True)
+        tokens = jax.device_put(_tokens(batch=8, seq=32),
+                                token_sharding(mesh))
+        state, loss, aux = step(state, tokens)
+        assert set(aux) == {"moe_dropped_fraction", "moe_expert_load"}
+        dropped = float(aux["moe_dropped_fraction"])
+        load = np.asarray(aux["moe_expert_load"])
+        assert 0.0 <= dropped <= 1.0
+        assert load.shape == (2,)
+        np.testing.assert_allclose(load.sum(), 1.0, atol=1e-5)
+        # Dense (non-MoE) model sows nothing: aux comes back empty.
+        dense_mod, dense_params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32, **CFG)
+        dense_step = make_lm_train_step(dense_mod.apply, tx, mesh, aux=True)
+        _, _, dense_aux = dense_step(
+            init_lm_state(dense_params, tx), tokens)
+        assert dense_aux == {}
+
 
 def _run_example(name, argv, tmp_path, monkeypatch, capsys):
     """In-process example run on the virtual mesh (test_entrypoints pattern)."""

@@ -84,7 +84,8 @@ def make_lm_train_step(
                 logits, mut = apply_fn(
                     params, tokens, mutable=["intermediates"]
                 )
-                return lm_loss(logits, tokens), mut["intermediates"]
+                # flax omits the collection entirely when nothing was sown
+                return lm_loss(logits, tokens), mut.get("intermediates", {})
 
             (loss, inters), grads = jax.value_and_grad(
                 loss_of, has_aux=True
