@@ -45,6 +45,9 @@ class ToyTrainerModule(TrainerModule):
 
 def get_args(argv=None):
     p = build_parser()
+    p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
+                   help="bf16 = fp32 master weights, bf16 compute "
+                        "(the Lightning precision= analog)")
     p.set_defaults(batch_size=128)  # lightning variant: batch 128 (:50)
     return parse_args(argv, parser=p)
 
@@ -59,7 +62,7 @@ def main() -> None:
     trainer = Trainer(
         max_steps=args.total_iterations,
         strategy="dp",
-        precision="fp32",
+        precision=args.precision,
         log_every=args.log_every,
         metric_backend=MetricBackend(args.backend),
         project=args.project,
@@ -67,6 +70,9 @@ def main() -> None:
         dry_run=args.dry_run,
         seed=args.seed,
         use_node_rank=args.use_node_rank,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
     )
     module = ToyTrainerModule()
     loader = build_loader(args, seed=args.seed)

@@ -162,3 +162,74 @@ def test_trainer_convergence(monkeypatch, tmp_path):
     loader = mod.build_loader(args, seed=0)
     losses = trainer.fit(mod.ToyTrainerModule(), loader)
     assert all(v < 0.6 for v in losses.values()), losses
+
+
+def test_trainer_bf16(monkeypatch, tmp_path):
+    """precision='bf16' (fp32 master weights, bf16 compute) converges on the
+    toy problem and lands within mixed-precision tolerance of fp32."""
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, str(EXAMPLES))
+    mod = load_example("demo_trainer")
+    import tpudist.runtime.bootstrap as bs
+
+    from tpudist.trainer import Trainer
+
+    args = mod.get_args(["--dry_run", "--total_iterations", "600", "--seed", "0"])
+    finals = {}
+    for precision in ("fp32", "bf16"):
+        bs._INITIALIZED_CTX = None
+        trainer = Trainer(max_steps=600, dry_run=True, seed=0,
+                          progress_bar=False, group=f"prec_{precision}",
+                          precision=precision)
+        loader = mod.build_loader(args, seed=0)
+        finals[precision] = trainer.fit(mod.ToyTrainerModule(), loader)
+    # converged (ideal MSE on the noisy quadratic is 0.25)
+    assert all(v < 0.6 for v in finals["bf16"].values()), finals
+    # bf16 vs fp32: same optimum, looser numerics
+    for k, v32 in finals["fp32"].items():
+        assert abs(finals["bf16"][k] - v32) < 0.15, finals
+
+
+def test_trainer_checkpoint_resume(monkeypatch, tmp_path):
+    """Trainer.fit saves on its cadence and a resume=True run continues from
+    the saved iteration instead of restarting."""
+    import json
+
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, str(EXAMPLES))
+    mod = load_example("demo_trainer")
+    import tpudist.runtime.bootstrap as bs
+
+    from tpudist.trainer import Trainer
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    args = mod.get_args(["--dry_run", "--total_iterations", "600", "--seed", "0"])
+
+    bs._INITIALIZED_CTX = None
+    first = Trainer(max_steps=200, dry_run=True, seed=0, progress_bar=False,
+                    group="resume_a", checkpoint_dir=ckpt_dir,
+                    checkpoint_every=100)
+    first.fit(mod.ToyTrainerModule(), mod.build_loader(args, seed=0))
+
+    from tpudist.checkpoint import CheckpointConfig, CheckpointManager
+
+    probe = CheckpointManager(CheckpointConfig(directory=ckpt_dir))
+    assert probe.latest_step == 200  # final save at the loop end
+
+    bs._INITIALIZED_CTX = None
+    second = Trainer(max_steps=600, dry_run=True, seed=0, progress_bar=False,
+                     group="resume_b", checkpoint_dir=ckpt_dir,
+                     checkpoint_every=100, resume=True)
+    losses = second.fit(mod.ToyTrainerModule(), mod.build_loader(args, seed=0))
+    assert all(v < 0.6 for v in losses.values()), losses
+
+    rows = [json.loads(l) for l in
+            (tmp_path / "runs" / "resume_b" / "metrics.jsonl").read_text().splitlines()]
+    loss_rows = [r for r in rows if any(k.startswith("loss/") for k in r)]
+    # continued from iteration 200: only 400 of the 600 iterations ran
+    assert len(loss_rows) == 400, len(loss_rows)
+
+    bs._INITIALIZED_CTX = None
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Trainer(resume=True).fit(mod.ToyTrainerModule(),
+                                 mod.build_loader(args, seed=0))

@@ -12,8 +12,8 @@ with named axes carrying the parallelism meaning:
 - ``model`` — tensor parallelism (the TPU-idiomatic way to put one model on
   several chips)
 
-Expert parallelism reuses ``('data', 'seq')`` as the expert group (DeepSpeed-
-MoE style); see ``tpudist.parallel.moe``.
+Expert parallelism routes over ``model`` (one expert group per tensor-axis
+slice, Switch-Transformer style); see ``tpudist.parallel.moe``.
 """
 
 from __future__ import annotations

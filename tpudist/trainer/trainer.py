@@ -76,10 +76,40 @@ class Trainer:
     seed: Optional[int] = 0  # None → rank-0 draw broadcast job-wide
     use_node_rank: bool = False
     progress_bar: bool = True
+    # Checkpointing (the demos' --checkpoint_dir/--checkpoint_every/--resume
+    # contract, reference dir layout job_submitter.sh:157-159): a directory
+    # enables periodic saves; resume=True restores the latest step and
+    # continues the loop from its saved iteration.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0
+    resume: bool = False
+
+    def _resolve_checkpoint_dir(self) -> Optional[str]:
+        """Explicit dir, else the launcher's env contract
+        (``${scratch_dir}/${exp_name}/checkpoints``, job_submitter.sh
+        exports) when checkpointing was requested — same resolution as the
+        plain demos (``examples/common.py`` build_checkpointing)."""
+        if self.checkpoint_dir is not None:
+            return self.checkpoint_dir
+        if self.checkpoint_every > 0 or self.resume:
+            import os
+
+            from tpudist.checkpoint import checkpoint_dir_for
+
+            if "scratch_dir" in os.environ or "exp_name" in os.environ:
+                return str(checkpoint_dir_for())
+        return None
 
     def fit(self, module: TrainerModule, loader) -> Dict[str, float]:
         """Own the whole run: init runtime, build mesh + compiled step,
         train, tear down.  Returns the final per-model losses."""
+        ckpt_dir = self._resolve_checkpoint_dir()
+        if self.resume and ckpt_dir is None:
+            raise ValueError(
+                "resume=True needs a checkpoint location: pass "
+                "checkpoint_dir or export scratch_dir/exp_name "
+                "(launcher contract)"
+            )
         initialize(use_node_rank=self.use_node_rank)
         seed = resolve_shared_seed(self.seed)
         if self.strategy == "dp":
@@ -118,6 +148,20 @@ class Trainer:
             apply_fns, tx, mesh, loss_fn=module.loss, state_sharding=state_sharding
         )
 
+        ckpt = None
+        start_iteration = 0
+        if ckpt_dir is not None:
+            from tpudist.checkpoint import CheckpointConfig, CheckpointManager
+            from tpudist.checkpoint.manager import abstract_like
+
+            ckpt = CheckpointManager(CheckpointConfig(
+                directory=ckpt_dir,
+                save_every=self.checkpoint_every,
+            ))
+            if self.resume and ckpt.latest_step is not None:
+                states, meta = ckpt.restore(abstract_like(states))
+                start_iteration = int(meta.get("iteration", 0))
+
         logger: MetricsLogger = init_metrics(
             project=self.project, group=self.group or "trainer", dry_run=self.dry_run
         )
@@ -127,7 +171,15 @@ class Trainer:
             metric_backend=self.metric_backend,
             progress_bar=self.progress_bar,
         )
-        states, losses = run_training(states, step, loader, mesh, logger, cfg, chunk_step_fn=chunk_step)
+        try:
+            states, losses = run_training(
+                states, step, loader, mesh, logger, cfg,
+                ckpt=ckpt, start_iteration=start_iteration,
+                chunk_step_fn=chunk_step,
+            )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         self.final_states = states
         return losses
 
