@@ -127,12 +127,16 @@ def main(argv=None) -> list:
 
     if results:
         top = results[-1]
+        # The ≥80% efficiency target is a statement about hardware scaling;
+        # virtual-cpu rungs share one machine's cores, so their ratios only
+        # validate mechanics — report no verdict there.
         print(json.dumps({
             "summary": "dp_scaling",
             "max_world_size": top["world_size"],
             "efficiency_vs_1": top["efficiency_vs_1"],
             "target": 0.8,
-            "meets_target": top["efficiency_vs_1"] >= 0.8,
+            "meets_target": (top["efficiency_vs_1"] >= 0.8
+                             if top["regime"] == "hardware" else None),
             "regime": top["regime"],
         }))
     return results

@@ -92,27 +92,21 @@ def build_checkpointing(args, states):
 
     Returns ``(ckpt_manager_or_None, states, start_iteration)``.
     """
-    import os
-
-    from tpudist.checkpoint import CheckpointConfig, CheckpointManager, checkpoint_dir_for
-    from tpudist.checkpoint.manager import abstract_like
-
-    directory = args.checkpoint_dir
-    if directory is None and (args.checkpoint_every > 0 or args.resume):
-        if "scratch_dir" in os.environ or "exp_name" in os.environ:
-            directory = str(checkpoint_dir_for())
-    if directory is None:
-        if args.resume:
-            raise SystemExit(
-                "--resume needs a checkpoint location: pass --checkpoint_dir "
-                "or export scratch_dir/exp_name (launcher contract)"
-            )
-        return None, states, 0
-    mgr = CheckpointManager(
-        CheckpointConfig(directory=directory, save_every=args.checkpoint_every)
+    from tpudist.checkpoint import (
+        resolve_checkpoint_location,
+        setup_checkpointing,
     )
-    start = 0
-    if args.resume and mgr.latest_step is not None:
-        states, meta = mgr.restore(abstract_like(states))
-        start = int(meta.get("iteration", 0))
-    return mgr, states, start
+
+    try:
+        directory = resolve_checkpoint_location(
+            args.checkpoint_dir, save_every=args.checkpoint_every,
+            resume=args.resume,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if directory is None:
+        return None, states, 0
+    return setup_checkpointing(
+        states, directory, save_every=args.checkpoint_every,
+        resume=args.resume,
+    )

@@ -196,6 +196,9 @@ def test_trainer_checkpoint_resume(monkeypatch, tmp_path):
     import json
 
     monkeypatch.chdir(tmp_path)
+    # the env contract would silently resolve a checkpoint dir
+    monkeypatch.delenv("scratch_dir", raising=False)
+    monkeypatch.delenv("exp_name", raising=False)
     sys.path.insert(0, str(EXAMPLES))
     mod = load_example("demo_trainer")
     import tpudist.runtime.bootstrap as bs

@@ -20,4 +20,6 @@ from tpudist.checkpoint.manager import (  # noqa: F401
     CheckpointManager,
     abstract_like,
     checkpoint_dir_for,
+    resolve_checkpoint_location,
+    setup_checkpointing,
 )

@@ -122,6 +122,46 @@ class CheckpointManager:
         self._mgr.close()
 
 
+def resolve_checkpoint_location(
+    directory: Optional[str], *, save_every: int = 0, resume: bool = False
+) -> Optional[str]:
+    """Resolve where checkpoints live: explicit ``directory`` wins, else the
+    launcher's env contract (``scratch_dir``/``exp_name`` exported by
+    ``launch/job_submitter.sh``) when checkpointing was requested.  Returns
+    ``None`` when checkpointing is off; raises ``ValueError`` when resume is
+    requested with no resolvable location.  The single source of truth for
+    both the plain demos and the Trainer facade."""
+    if directory is not None:
+        return directory
+    if (save_every > 0 or resume) and (
+        "scratch_dir" in os.environ or "exp_name" in os.environ
+    ):
+        return str(checkpoint_dir_for())
+    if resume:
+        raise ValueError(
+            "resume needs a checkpoint location: pass --checkpoint_dir / "
+            "checkpoint_dir or export scratch_dir/exp_name (launcher "
+            "contract)"
+        )
+    return None
+
+
+def setup_checkpointing(
+    states: Any, directory: str, *, save_every: int = 0, resume: bool = False
+) -> Tuple["CheckpointManager", Any, int]:
+    """Build the manager over a resolved ``directory``; on resume, restore
+    the latest step into the current states' layout.  Returns
+    ``(manager, states, start_iteration)``."""
+    mgr = CheckpointManager(
+        CheckpointConfig(directory=directory, save_every=save_every)
+    )
+    start = 0
+    if resume and mgr.latest_step is not None:
+        states, meta = mgr.restore(abstract_like(states))
+        start = int(meta.get("iteration", 0))
+    return mgr, states, start
+
+
 def abstract_like(states: Any) -> Any:
     """``jax.ShapeDtypeStruct`` pytree (with shardings) mirroring ``states`` —
     the restore target that tells Orbax the current mesh layout."""

@@ -84,32 +84,20 @@ class Trainer:
     checkpoint_every: int = 0
     resume: bool = False
 
-    def _resolve_checkpoint_dir(self) -> Optional[str]:
-        """Explicit dir, else the launcher's env contract
-        (``${scratch_dir}/${exp_name}/checkpoints``, job_submitter.sh
-        exports) when checkpointing was requested — same resolution as the
-        plain demos (``examples/common.py`` build_checkpointing)."""
-        if self.checkpoint_dir is not None:
-            return self.checkpoint_dir
-        if self.checkpoint_every > 0 or self.resume:
-            import os
-
-            from tpudist.checkpoint import checkpoint_dir_for
-
-            if "scratch_dir" in os.environ or "exp_name" in os.environ:
-                return str(checkpoint_dir_for())
-        return None
-
     def fit(self, module: TrainerModule, loader) -> Dict[str, float]:
         """Own the whole run: init runtime, build mesh + compiled step,
         train, tear down.  Returns the final per-model losses."""
-        ckpt_dir = self._resolve_checkpoint_dir()
-        if self.resume and ckpt_dir is None:
-            raise ValueError(
-                "resume=True needs a checkpoint location: pass "
-                "checkpoint_dir or export scratch_dir/exp_name "
-                "(launcher contract)"
-            )
+        from tpudist.checkpoint import (
+            resolve_checkpoint_location,
+            setup_checkpointing,
+        )
+
+        # Resolve (and validate resume config) before any runtime side
+        # effects — same env-contract resolution as the plain demos.
+        ckpt_dir = resolve_checkpoint_location(
+            self.checkpoint_dir, save_every=self.checkpoint_every,
+            resume=self.resume,
+        )
         initialize(use_node_rank=self.use_node_rank)
         seed = resolve_shared_seed(self.seed)
         if self.strategy == "dp":
@@ -132,12 +120,19 @@ class Trainer:
             # cast at apply time so grads come back fp32 for the optimizer
             import jax.numpy as jnp
 
+            def _cast(tree, dtype):
+                # floats only — integer inputs (token ids) and non-float
+                # leaves pass through untouched
+                return jax.tree.map(
+                    lambda a: a.astype(dtype)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                    else a, tree)
+
             def _bf16(f):
                 def wrapped(p, x):
-                    p16 = jax.tree.map(
-                        lambda a: a.astype(jnp.bfloat16)
-                        if a.dtype == jnp.float32 else a, p)
-                    return f(p16, x.astype(jnp.bfloat16)).astype(jnp.float32)
+                    return _cast(
+                        f(_cast(p, jnp.bfloat16), _cast(x, jnp.bfloat16)),
+                        jnp.float32)
                 return wrapped
 
             apply_fns = {k: _bf16(f) for k, f in apply_fns.items()}
@@ -151,16 +146,10 @@ class Trainer:
         ckpt = None
         start_iteration = 0
         if ckpt_dir is not None:
-            from tpudist.checkpoint import CheckpointConfig, CheckpointManager
-            from tpudist.checkpoint.manager import abstract_like
-
-            ckpt = CheckpointManager(CheckpointConfig(
-                directory=ckpt_dir,
-                save_every=self.checkpoint_every,
-            ))
-            if self.resume and ckpt.latest_step is not None:
-                states, meta = ckpt.restore(abstract_like(states))
-                start_iteration = int(meta.get("iteration", 0))
+            ckpt, states, start_iteration = setup_checkpointing(
+                states, ckpt_dir, save_every=self.checkpoint_every,
+                resume=self.resume,
+            )
 
         logger: MetricsLogger = init_metrics(
             project=self.project, group=self.group or "trainer", dry_run=self.dry_run
