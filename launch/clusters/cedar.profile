@@ -1,0 +1,8 @@
+# match: cedar*
+# ComputeCanada-style cluster: allocation accounting is mandatory (set
+# your default account here), the scheduler provides a per-job
+# SLURM_TMPDIR on node-local disk (so no cluster_tmpdir override), and
+# scratch lives on the shared filesystem under ~/scratch (the reference's
+# cedar branches, job_submitter.sh:180-182,321-327).
+cluster_account="${CLUSTER_ACCOUNT:-def-${USER:-$(id -un)}}"
+cluster_mem="32G"

@@ -1,0 +1,8 @@
+# match: borg*
+# UBC PLAI-style cluster: jobs get no scheduler-managed tmpdir, but every
+# node has a local SSD at /scratch-ssd — stage data and scratch there
+# (the reference's per-cluster tmpdir branch + plai_cleanups, SURVEY.md
+# §2.2 B13; node_tmpdir subdirs are removed on job exit, and
+# launch/cleanups/ sweeps leftovers).
+cluster_partition="plai"
+cluster_tmpdir="/scratch-ssd/${USER:-$(id -un)}"

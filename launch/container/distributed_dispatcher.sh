@@ -19,6 +19,8 @@ srun bash "${source_dir:?}/launch/container/standard_job.sh" || rc=$?
 
 # Remove each node's shared staging dir (image + data) now that every task
 # on it has finished; per-task dirs were cleaned by the tasks themselves.
+# Same base resolution as standard_job.sh: profile node_tmpdir > scheduler
+# tmpdir > /tmp.
 srun --ntasks="${SLURM_NNODES:-1}" --ntasks-per-node=1 \
-  bash -c 'rm -rf "${SLURM_TMPDIR:-/tmp}/tpudist_${SLURM_JOB_ID}_shared"' || true
+  bash -c 'rm -rf "${node_tmpdir:-${SLURM_TMPDIR:-/tmp}}/tpudist_${SLURM_JOB_ID}_shared"' || true
 exit "${rc}"

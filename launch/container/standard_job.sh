@@ -15,12 +15,17 @@ job_id="${SLURM_JOB_ID:-$$}"
 local_id="${SLURM_LOCALID:-0}"
 task_id="${SLURM_PROCID:-0}"
 
+# Fast node-local base: cluster-profile node_tmpdir first (clusters whose
+# local SSD is not SLURM_TMPDIR — launch/clusters/), then the scheduler
+# tmpdir, then /tmp.
+tmp_base="${node_tmpdir:-${SLURM_TMPDIR:-/tmp}}"
+mkdir -p "${tmp_base}"
 # Node-shared dir: image + extracted data, staged once per node.  Not
 # trap-cleaned (sibling tasks may outlive this one); the dispatcher removes
 # it per-node after srun returns, and launch/cleanups/ catches crashes.
-shared="${SLURM_TMPDIR:-/tmp}/tpudist_${job_id}_shared"
+shared="${tmp_base}/tpudist_${job_id}_shared"
 # Per-task dir: overlays + workdir, safe to clean on our own exit.
-task_tmp="${SLURM_TMPDIR:-/tmp}/tpudist_${job_id}_task${task_id}"
+task_tmp="${tmp_base}/tpudist_${job_id}_task${task_id}"
 mkdir -p "${shared}" "${task_tmp}"
 # Single-task jobs (the -j standard container path) own the shared dir too;
 # multi-task jobs leave it for the dispatcher's per-node cleanup pass.

@@ -18,8 +18,10 @@ chips="${chips_per_node:-1}"
 
 # Per-job node-local scratch (standard_job.sh:13-16 PLAI pattern); cleaned
 # on exit even when a worker group fails (only when we created it ourselves).
-export TPUDIST_TMPDIR="${SLURM_TMPDIR:-/tmp/tpudist_${SLURM_JOB_ID}}"
-[[ -z "${SLURM_TMPDIR:-}" ]] && trap 'rm -rf "${TPUDIST_TMPDIR}"' EXIT
+# "allnodes": every node's agent stages into its own local disk, so the
+# cleanup fans out over the allocation (see launch/lib.sh).
+source launch/lib.sh
+tpudist_tmpdir "${SLURM_JOB_ID}" allnodes
 
 echo "dispatcher: ${num_nodes} nodes, ${chips} chips/node, coordinator ${coordinator}," \
      "workflow ${workflow:-tpurun}"

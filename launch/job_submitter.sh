@@ -21,9 +21,21 @@
 #   -x FILE      experiment config file (one-line command; default
 #                launch/experiment_configurations.txt)
 #   -S FILE      sweep spec YAML (sweep jobs; default launch/sweeper.yml)
+#   -I SWEEPID   W&B *server* sweep id (entity/project/id): array tasks run
+#                `wandb agent --count 1` against the server instead of the
+#                local grid (reference job_submitter.sh:259-265 flow; the
+#                interactive prompt asks when -I is omitted on a sweep job)
+#   -R N         number of sweep runs = array size for -I server sweeps
+#                (the reference's "how many runs" prompt)
 #   -W WORKFLOW  distributed workflow: tpurun (per-node agent) | trainer
 #                (one task per chip, SLURM-env rank derivation)  (default tpurun)
 #   -C SIF       run inside a Singularity image (container job scripts)
+#   -P PROFILE   cluster profile: a name under launch/clusters/ (sans
+#                .profile), a path, or "none".  Default: auto-detect by
+#                matching this host against each profile's "# match:" glob
+#                (the reference's per-cluster hostname branches,
+#                job_submitter.sh:180-182,267-271,321-327, as data files
+#                instead of inline special cases)
 #   -i           submit a virtualenv-install job first and wait for it
 #   -n           no-confirm (skip the interactive prompt)
 #   -h           help
@@ -42,24 +54,31 @@ workflow="tpurun"
 sif_path=""
 install_env=0
 confirm=1
+profile=""
+wandb_sweep_id=""
+sweep_runs=""
+user_cpus=0; user_mem=0; user_walltime=0; user_scratch=0
 
-while getopts "j:c:g:N:t:m:p:a:d:s:e:x:S:W:C:inh" opt; do
+while getopts "j:c:g:N:t:m:p:a:d:s:e:x:S:W:C:P:I:R:inh" opt; do
   case "${opt}" in
     j) job_type="${OPTARG}" ;;
-    c) cpus="${OPTARG}" ;;
+    c) cpus="${OPTARG}"; user_cpus=1 ;;
     g) gpus="${OPTARG}" ;;
     N) nodes="${OPTARG}" ;;
-    t) walltime="${OPTARG}" ;;
-    m) mem="${OPTARG}" ;;
+    t) walltime="${OPTARG}"; user_walltime=1 ;;
+    m) mem="${OPTARG}"; user_mem=1 ;;
     p) partition="${OPTARG}" ;;
     a) account="${OPTARG}" ;;
     d) data_paths="${OPTARG}" ;;
-    s) scratch_dir="${OPTARG}" ;;
+    s) scratch_dir="${OPTARG}"; user_scratch=1 ;;
     e) exp_name="${OPTARG}" ;;
     x) exp_configs_path="${OPTARG}" ;;
     S) sweep_spec="${OPTARG}" ;;
     W) workflow="${OPTARG}" ;;
     C) sif_path="${OPTARG}" ;;
+    P) profile="${OPTARG}" ;;
+    I) wandb_sweep_id="${OPTARG}" ;;
+    R) sweep_runs="${OPTARG}" ;;
     i) install_env=1 ;;
     n) confirm=0 ;;
     h) cat "$(dirname "$0")/.help_message.txt"; exit 0 ;;
@@ -71,6 +90,51 @@ case "${job_type}" in standard|distributed|sweep) ;; *)
   echo "job_submitter: -j must be standard|distributed|sweep" >&2; exit 2 ;; esac
 case "${workflow}" in tpurun|trainer) ;; *)
   echo "job_submitter: -W must be tpurun|trainer" >&2; exit 2 ;; esac
+
+# ---- cluster profile ---------------------------------------------------
+# A profile is a sourced bash fragment under launch/clusters/ that adapts
+# the submission to one cluster: scheduler defaults (cluster_partition/
+# _account/_mem/_walltime/_cpus — applied only where the user passed no
+# explicit flag), extra sbatch flags (cluster_sbatch_extra array), a
+# node-local fast-disk root (cluster_tmpdir → node_tmpdir for the job
+# scripts), and a scratch root (cluster_scratch).  Auto-detected by the
+# "# match: <glob>" header against this hostname unless -P selects one.
+cluster_partition=""; cluster_account=""; cluster_mem=""; cluster_walltime=""
+cluster_cpus=""; cluster_tmpdir=""; cluster_scratch=""; cluster_sbatch_extra=()
+profile_file=""
+clusters_dir="${TPUDIST_CLUSTERS_DIR:-$(dirname "$0")/clusters}"
+if [[ "${profile}" == "none" ]]; then
+  :
+elif [[ -n "${profile}" ]]; then
+  profile_file="${clusters_dir}/${profile}.profile"
+  [[ -f "${profile_file}" ]] || profile_file="${profile}"
+  [[ -f "${profile_file}" ]] || {
+    echo "job_submitter: no cluster profile '${profile}' (looked in ${clusters_dir})" >&2
+    exit 2
+  }
+else
+  host_fqdn="$(hostname -f 2>/dev/null || hostname)"
+  for f in "${clusters_dir}"/*.profile; do
+    [[ -e "${f}" ]] || continue
+    pat="$(sed -n 's/^# match: *//p' "${f}" | head -n1)"
+    # shellcheck disable=SC2053  # glob match against the declared pattern
+    if [[ -n "${pat}" && ( "${host_fqdn}" == ${pat} || "$(hostname)" == ${pat} ) ]]; then
+      profile_file="${f}"; break
+    fi
+  done
+fi
+if [[ -n "${profile_file}" ]]; then
+  echo "cluster profile: ${profile_file}"
+  # shellcheck disable=SC1090
+  source "${profile_file}"
+  [[ -n "${cluster_partition}" && -z "${partition}" ]] && partition="${cluster_partition}"
+  [[ -n "${cluster_account}"   && -z "${account}"   ]] && account="${cluster_account}"
+  [[ -n "${cluster_mem}"      && "${user_mem}" -eq 0      ]] && mem="${cluster_mem}"
+  [[ -n "${cluster_walltime}" && "${user_walltime}" -eq 0 ]] && walltime="${cluster_walltime}"
+  [[ -n "${cluster_cpus}"     && "${user_cpus}" -eq 0     ]] && cpus="${cluster_cpus}"
+  [[ -n "${cluster_scratch}"  && "${user_scratch}" -eq 0  ]] && scratch_dir="${cluster_scratch}"
+fi
+# ------------------------------------------------------------------------
 
 # Per-workflow default config file (reference torchrun_configs.txt /
 # lightning_configs.txt split, job_submitter.sh:296-300).
@@ -142,6 +206,7 @@ sbatch_cmd=(
 [[ -n "${partition}" ]] && sbatch_cmd+=(--partition="${partition}")
 [[ -n "${account}"   ]] && sbatch_cmd+=(--account="${account}")
 [[ "${gpus}" -gt 0   ]] && sbatch_cmd+=(--gres="gpu:${gpus}")
+[[ "${#cluster_sbatch_extra[@]}" -gt 0 ]] && sbatch_cmd+=("${cluster_sbatch_extra[@]}")
 
 # cmd and the tarball list may contain commas, which sbatch's --export parser
 # splits on — ship them via the exported environment (ALL) and keep only
@@ -151,13 +216,40 @@ export staged_tarballs="${staged}"
 payload="ALL,source_dir=${source_dir},scratch_dir=${scratch_dir}"
 payload+=",exp_name=${exp_name},project_name=${project_name}"
 payload+=",WANDB_API_KEY=${wandb_key}"
+[[ -n "${cluster_tmpdir}" ]] && payload+=",node_tmpdir=${cluster_tmpdir}"
 
 case "${job_type}" in
   sweep)
-    # Array job sized by the sweep grid (job_submitter.sh:259-271 pattern,
-    # but the grid size comes from the spec — no interactive prompt needed).
-    n_sweeps="$(python -m tpudist.launch.sweep count "${sweep_spec}")"
-    echo "sweep grid size: ${n_sweeps}"
+    # Two sweep modes (reference job_submitter.sh:259-271):
+    #   server — -I entity/project/id (prompted for when interactive): each
+    #     array task runs `wandb agent --count 1` against the W&B server;
+    #     array size = -R runs (the reference's "how many runs" prompt).
+    #   local  — no id: the array is sized by the spec's grid and each task
+    #     runs its own configuration index, no server round-trip.
+    # Prompts only on a real terminal — piped stdin (echo y | …) must keep
+    # feeding the final confirm, not be eaten as a sweep id.
+    if [[ -z "${wandb_sweep_id}" && "${confirm}" -eq 1 && -t 0 ]]; then
+      read -r -p "W&B server sweep id (empty = local grid sweep): " wandb_sweep_id
+    fi
+    if [[ -n "${wandb_sweep_id}" ]]; then
+      if [[ -z "${sweep_runs}" && "${confirm}" -eq 1 && -t 0 ]]; then
+        read -r -p "number of sweep runs: " sweep_runs
+      fi
+      [[ "${sweep_runs}" =~ ^[1-9][0-9]*$ ]] || {
+        echo "job_submitter: a server sweep (-I) needs -R <runs>, a positive integer (got '${sweep_runs}')" >&2
+        exit 2
+      }
+      n_sweeps="${sweep_runs}"
+      echo "server sweep ${wandb_sweep_id}: ${n_sweeps} runs"
+      payload+=",WANDB_SWEEP_ID=${wandb_sweep_id}"
+    else
+      n_sweeps="$(python -m tpudist.launch.sweep count "${sweep_spec}")"
+      echo "sweep grid size: ${n_sweeps}"
+      # --export=ALL forwards the submitter's whole environment: blank any
+      # ambient WANDB_SWEEP_ID so local grid tasks can't be hijacked into
+      # server agents.
+      payload+=",WANDB_SWEEP_ID="
+    fi
     sbatch_cmd+=(--array="0-$((n_sweeps - 1))%10" --cpus-per-task="${cpus}" --ntasks-per-node=1)
     [[ "${sweep_spec}" = /* ]] || sweep_spec="${source_dir}/${sweep_spec}"
     payload+=",sweep_spec=${sweep_spec}"

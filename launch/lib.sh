@@ -1,0 +1,34 @@
+# Shared launch-layer helpers (sourced, not executed).
+
+# tpudist_tmpdir <job_id> [allnodes]
+#
+# Resolve + create the node-local scratch dir as TPUDIST_TMPDIR and
+# register cleanup for dirs this job created itself:
+#   - a cluster profile's node_tmpdir (launch/clusters/) takes precedence —
+#     clusters whose fast local disk is NOT what SLURM_TMPDIR points at
+#     declare it there (the reference's per-cluster /scratch-ssd branch,
+#     standard_job.sh:13-16),
+#   - else a scheduler-owned SLURM_TMPDIR is used as-is and never removed,
+#   - else a /tmp fallback is created and removed.
+# Scope "allnodes" (dispatchers): workers stage into this path on EVERY
+# node's local disk, so cleanup fans out over the allocation via srun
+# instead of only running on the batch node.
+tpudist_tmpdir() {
+  local job_id="$1" scope="${2:-local}" created=0
+  if [[ -n "${node_tmpdir:-}" ]]; then
+    export TPUDIST_TMPDIR="${node_tmpdir}/tpudist_${job_id}"
+    created=1
+  else
+    export TPUDIST_TMPDIR="${SLURM_TMPDIR:-/tmp/tpudist_${job_id}}"
+    [[ -z "${SLURM_TMPDIR:-}" ]] && created=1
+  fi
+  if [[ "${created}" -eq 1 ]]; then
+    if [[ "${scope}" == "allnodes" && -n "${SLURM_JOB_NODELIST:-}" ]]; then
+      trap 'srun --ntasks="${SLURM_NNODES:-1}" --ntasks-per-node=1 \
+        rm -rf "${TPUDIST_TMPDIR}" 2>/dev/null || rm -rf "${TPUDIST_TMPDIR}"' EXIT
+    else
+      trap 'rm -rf "${TPUDIST_TMPDIR}"' EXIT
+    fi
+  fi
+  mkdir -p "${TPUDIST_TMPDIR}"
+}

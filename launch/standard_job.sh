@@ -6,12 +6,11 @@
 set -euo pipefail
 
 cd "${source_dir:?}"
-export TPUDIST_TMPDIR="${SLURM_TMPDIR:-/tmp/tpudist_${SLURM_JOB_ID:-$$}}"
-mkdir -p "${TPUDIST_TMPDIR}"
 # Cleanup must survive a failing cmd (standard_job.sh:29-31 discipline, but
-# via EXIT trap so set -e cannot skip it). Never remove a scheduler-owned
-# SLURM_TMPDIR — only the /tmp dir we created ourselves.
-[[ -z "${SLURM_TMPDIR:-}" ]] && trap 'rm -rf "${TPUDIST_TMPDIR}"' EXIT
+# via EXIT trap so set -e cannot skip it); node_tmpdir (cluster profile)
+# overrides the scheduler tmpdir — see launch/lib.sh.
+source launch/lib.sh
+tpudist_tmpdir "${SLURM_JOB_ID:-$$}"
 
 if [[ -n "${staged_tarballs:-}" ]]; then
   IFS=',' read -ra tbs <<< "${staged_tarballs}"

@@ -217,3 +217,39 @@ class TestSweep:
         assert spec.count() == 12
         cfg = spec.config_at(0)
         assert set(cfg) == {"lr", "batch_size", "seed"}
+
+    def test_agent_delegates_to_wandb_on_server_sweep(self, tmp_path,
+                                                      monkeypatch):
+        """WANDB_SWEEP_ID in the env (how job_submitter -j sweep -I ships
+        the server sweep) makes the agent exec `wandb agent --count 1 <id>`
+        instead of the local grid (sweep_cmd.txt:1 parity)."""
+        import yaml
+
+        import tpudist.launch.sweep as sweep_mod
+
+        spec_path = tmp_path / "sweep.yml"
+        spec_path.write_text(yaml.safe_dump(SPEC))
+        calls = []
+        monkeypatch.setattr(sweep_mod.subprocess, "call",
+                            lambda cmd, **kw: calls.append(cmd) or 0)
+        monkeypatch.setenv("WANDB_SWEEP_ID", "ent/proj/ab12cd")
+        rc = sweep_mod.main(["agent", str(spec_path)])
+        assert rc == 0
+        assert len(calls) == 1
+        assert calls[0][-4:] == ["agent", "--count", "1", "ent/proj/ab12cd"]
+
+        # an explicit --index pins the run to the local grid even with the
+        # ambient env var (a leftover WANDB_SWEEP_ID must not hijack it)
+        calls.clear()
+        rc = sweep_mod.main(["agent", str(spec_path), "--index", "2"])
+        assert rc == 0
+        assert len(calls) == 1
+        assert "--dry_run" in calls[0]  # rendered local command template
+
+        # without the env (and no flag): local grid agent runs the command
+        calls.clear()
+        monkeypatch.delenv("WANDB_SWEEP_ID")
+        rc = sweep_mod.main(["agent", str(spec_path), "--index", "2"])
+        assert rc == 0
+        assert len(calls) == 1
+        assert "--dry_run" in calls[0]

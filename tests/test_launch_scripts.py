@@ -13,7 +13,8 @@ from pathlib import Path
 import pytest
 
 REPO = Path(__file__).resolve().parent.parent
-SCRIPTS = sorted((REPO / "launch").rglob("*.sh"))
+SCRIPTS = sorted((REPO / "launch").rglob("*.sh")) + sorted(
+    (REPO / "launch" / "clusters").glob("*.profile"))
 
 
 @pytest.mark.parametrize("script", SCRIPTS, ids=lambda p: str(p.relative_to(REPO)))
@@ -101,6 +102,9 @@ class TestJobSubmitter:
         # Sweep cmd comes from sweep_cmd.txt with the spec placeholder
         # expanded by standard_job.sh at run time.
         assert "cmd=[python -m tpudist.launch.sweep agent ${sweep_spec}]" in call
+        # Local sweeps blank any ambient WANDB_SWEEP_ID (--export=ALL would
+        # otherwise forward it and hijack every task into a server agent).
+        assert "WANDB_SWEEP_ID=," in call
 
     def test_multiple_tarballs_survive_export(self, slurm_stubs, tmp_path):
         """Comma-separated tarball lists must ride the environment — sbatch
@@ -140,6 +144,74 @@ class TestJobSubmitter:
         call = log.read_text()
         assert "--ntasks-per-node=16" in call
         assert "--ntasks-per-node=166" not in call
+
+    def test_cluster_profile_applies(self, slurm_stubs, tmp_path):
+        """-P plai: partition default + node-local SSD tmpdir ride the
+        submission (the reference's hostname branches as data files)."""
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "standard", "-P", "plai")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "--partition=plai" in call
+        assert "node_tmpdir=/scratch-ssd/" in call
+
+    def test_cluster_profile_explicit_flags_win(self, slurm_stubs, tmp_path):
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "standard", "-P", "plai",
+                    "-p", "other")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "--partition=other" in call
+        assert "--partition=plai" not in call
+
+    def test_cluster_profile_autodetect_and_none(self, slurm_stubs, tmp_path):
+        """A profile whose '# match:' glob covers this host is picked up
+        with no -P flag; -P none disables it."""
+        env, log = slurm_stubs
+        cdir = tmp_path / "clusters"
+        cdir.mkdir()
+        (cdir / "anyhost.profile").write_text(
+            "# match: *\n"
+            'cluster_mem="99G"\n'
+            "cluster_sbatch_extra=(--qos=testq)\n"
+        )
+        env2 = dict(env, TPUDIST_CLUSTERS_DIR=str(cdir))
+        r = _submit(env2, tmp_path, "-j", "standard")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "--mem=99G" in call and "--qos=testq" in call
+
+        log.write_text("")
+        r = _submit(env2, tmp_path, "-j", "standard", "-P", "none")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "--mem=16G" in call and "--qos=testq" not in call
+
+    def test_unknown_cluster_profile_rejected(self, slurm_stubs, tmp_path):
+        env, _ = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "standard", "-P", "nosuch")
+        assert r.returncode != 0
+        assert "no cluster profile" in r.stderr
+
+    def test_server_sweep_shape(self, slurm_stubs, tmp_path):
+        """-I <id> -R <runs>: array sized by runs, WANDB_SWEEP_ID shipped so
+        every task's sweep agent delegates to `wandb agent --count 1`
+        (reference job_submitter.sh:259-271 + sweep_cmd.txt flow)."""
+        env, log = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "sweep",
+                    "-I", "ent/proj/ab12cd", "-R", "20")
+        assert r.returncode == 0, r.stderr
+        call = log.read_text()
+        assert "--array=0-19%10" in call
+        assert "WANDB_SWEEP_ID=ent/proj/ab12cd" in call
+        assert "launch/standard_job.sh" in call
+
+    def test_server_sweep_requires_runs_noninteractive(self, slurm_stubs,
+                                                       tmp_path):
+        env, _ = slurm_stubs
+        r = _submit(env, tmp_path, "-j", "sweep", "-I", "ent/proj/ab12cd")
+        assert r.returncode != 0
+        assert "-R" in r.stderr
 
     def test_standard_job_expands_sweep_placeholder(self, tmp_path):
         """standard_job.sh substitutes ${sweep_spec} into the sweep command."""

@@ -141,12 +141,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="configuration index (e.g. $SLURM_ARRAY_TASK_ID)")
     p.add_argument("--wandb-sweep-id", default=None,
                    help="delegate to `wandb agent --count 1 <id>` when wandb "
-                        "is installed (full reference parity)")
+                        "is installed (full reference parity).  Falls back "
+                        "to $WANDB_SWEEP_ID — how `job_submitter.sh -j "
+                        "sweep -I <id>` ships the server sweep to every "
+                        "array task — unless an explicit --index pins this "
+                        "run to the local grid")
     args = p.parse_args(argv)
     spec = SweepSpec.from_yaml(args.spec)
     if args.action == "count":
         print(spec.count())
         return 0
+    sweep_id = args.wandb_sweep_id
+    if sweep_id is None and args.index is None:
+        # env fallback only when nothing pins this run to the local grid —
+        # an explicit --index always means "run MY configuration"
+        sweep_id = os.environ.get("WANDB_SWEEP_ID") or None
     index = args.index
     if index is None:
         index = int(os.environ.get("SLURM_ARRAY_TASK_ID", 0))
@@ -154,10 +163,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(spec.config_at(index))
         print(" ".join(spec.command_for(spec.config_at(index))))
         return 0
-    if args.wandb_sweep_id:
+    if sweep_id:
         # sweep_cmd.txt:1 — `wandb agent --count 1 USER/PROJECT/SWEEPID`.
         return subprocess.call([sys.executable, "-m", "wandb", "agent",
-                                "--count", "1", args.wandb_sweep_id])
+                                "--count", "1", sweep_id])
     return spec.run_index(index)
 
 
