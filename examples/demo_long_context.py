@@ -56,6 +56,9 @@ def get_args(argv=None):
                    help="replace the dense FFN with a top-1 MoE of this "
                         "many experts, expert-parallel over a model mesh "
                         "axis of the same size (requires --seq_shards 1)")
+    p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
+                   help="bf16 = f32 master weights, bf16 compute (MXU-"
+                        "native throughput)")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -104,6 +107,7 @@ def main() -> None:
         max_len=args.seq_len,
         n_experts=args.moe_experts,
         moe_fn=moe_fn,
+        dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
     )
     tx = optax.adam(args.lr)
     state = init_lm_state(params, tx)

@@ -63,13 +63,17 @@ def dense_moe_reference(params, tokens):
     """Single-device MoE execution: every expert computed for every token,
     combined by the top-1 gate.  Matches ``moe_shard`` exactly when no
     token overflows capacity; used at init time and on unsharded runs."""
-    probs = jax.nn.softmax(tokens @ params["router"], axis=-1)
+    # Routing in f32 (precision-sensitive), expert matmuls in the compute
+    # dtype — mirrors moe_shard's discipline exactly.
+    probs = jax.nn.softmax((tokens @ params["router"]).astype(jnp.float32),
+                           axis=-1)
     idx = jnp.argmax(probs, axis=-1)
     gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
     h = jax.nn.relu(jnp.einsum("td,edf->tef", tokens, params["experts"]["w"]))
     y_all = jnp.einsum("tef,efd->ted", h, params["experts"]["wo"])
     pick = jax.nn.one_hot(idx, probs.shape[-1], dtype=tokens.dtype)
-    return jnp.einsum("ted,te->td", y_all, pick * gate[:, None])
+    return jnp.einsum("ted,te->td", y_all,
+                      pick * gate.astype(tokens.dtype)[:, None])
 
 
 class MoEFFN(nn.Module):
@@ -82,6 +86,7 @@ class MoEFFN(nn.Module):
     d_ff: int
     n_experts: int
     moe_fn: Optional[Callable] = None
+    dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -94,7 +99,11 @@ class MoEFFN(nn.Module):
                 "wo": self.param("wo", init, (self.n_experts, self.d_ff, d)),
             },
         }
-        tokens = x.reshape(b * s, d)
+        # Same mixed-precision contract as the Dense layers: f32 master
+        # params cast to the compute dtype here; the routing softmax inside
+        # both execution paths upcasts to f32.
+        params = jax.tree.map(lambda a: a.astype(self.dtype), params)
+        tokens = x.reshape(b * s, d).astype(self.dtype)
         if self.moe_fn is not None:
             y, stats = self.moe_fn(params, tokens)
             # Routing observability: collected by train steps built with
@@ -116,12 +125,16 @@ class Block(nn.Module):
     attention_fn: AttentionFn
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE FFN with that many experts
     moe_fn: Optional[Callable] = None
+    dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         dh = self.d_model // self.n_heads
-        h = nn.LayerNorm(use_bias=False)(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv")(h)
+        # LayerNorm statistics in f32 for stability; projections compute in
+        # ``dtype`` (flax casts inputs + the f32 master params at apply).
+        h = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv",
+                       dtype=self.dtype)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):  # [b, s, d] -> [b, h, s, dh]
@@ -131,15 +144,18 @@ class Block(nn.Module):
         attn = self.attention_fn(heads(q), heads(k), heads(v))
         b, nh, s, _ = attn.shape
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
-        x = x + nn.Dense(self.d_model, use_bias=False, name="proj")(attn)
+        x = x + nn.Dense(self.d_model, use_bias=False, name="proj",
+                         dtype=self.dtype)(attn)
 
-        h = nn.LayerNorm(use_bias=False)(x)
+        h = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
         if self.n_experts > 0:
             return x + MoEFFN(self.d_model, self.d_ff, self.n_experts,
-                              self.moe_fn, name="moe")(h)
-        h = nn.Dense(self.d_ff, use_bias=False, name="wi")(h)
+                              self.moe_fn, dtype=self.dtype, name="moe")(h)
+        h = nn.Dense(self.d_ff, use_bias=False, name="wi",
+                     dtype=self.dtype)(h)
         h = nn.gelu(h)
-        return x + nn.Dense(self.d_model, use_bias=False, name="wo")(h)
+        return x + nn.Dense(self.d_model, use_bias=False, name="wo",
+                            dtype=self.dtype)(h)
 
 
 class TransformerLM(nn.Module):
@@ -155,14 +171,21 @@ class TransformerLM(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     n_experts: int = 0  # >0: MoE FFN in every block (expert parallelism)
     moe_fn: Optional[Callable] = None
+    # Compute dtype.  bf16 = mixed precision: f32 master params (flax
+    # param_dtype default) cast to bf16 at apply, matmuls at bf16 MXU
+    # throughput, f32 LayerNorm/softmax/loss — grads land f32 for the
+    # optimizer.  The Lightning ``precision=`` analog for the LM family.
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
         """``tokens: [batch, seq] int32`` → logits ``[batch, seq, vocab]``."""
         attn = self.attention_fn or _default_attention
         seq = tokens.shape[1]
-        x = nn.Embed(self.vocab, self.d_model, name="tok_embed")(tokens)
-        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
+        x = nn.Embed(self.vocab, self.d_model, name="tok_embed",
+                     dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
+                       dtype=self.dtype)(
             jnp.arange(seq, dtype=jnp.int32)
         )
         x = x + pos[None]
@@ -170,10 +193,11 @@ class TransformerLM(nn.Module):
             x = Block(
                 self.d_model, self.n_heads, self.d_ff, attn,
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
-                name=f"block_{i}",
+                dtype=self.dtype, name=f"block_{i}",
             )(x)
-        x = nn.LayerNorm(use_bias=False)(x)
-        return nn.Dense(self.vocab, use_bias=False, name="head")(x)
+        x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab, use_bias=False, name="head",
+                        dtype=self.dtype)(x)
 
 
 def transformer_tp_sharding(mesh, tree, *, axis_name: str = "model"):

@@ -63,10 +63,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # MXU operands stay in the input dtype (bf16 runs at bf16 MXU
+        # throughput); accumulation is always f32 via preferred_element_type.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -83,7 +85,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         m_ref[:, 0] = m_new
         l_ref[:, 0] = l * correction + jnp.sum(p, axis=-1)
         acc_ref[:] = acc_ref[:] * correction[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
     # Last KV block of this Q row: normalize and emit.
@@ -133,7 +135,8 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     cost = pl.CostEstimate(
         flops=int(4 * work * d),
         transcendentals=int(work),
-        bytes_accessed=int(qr.size + kr.size + vr.size + qr.size) * 4,
+        bytes_accessed=int(qr.size + kr.size + vr.size + qr.size)
+        * q.dtype.itemsize,
     )
     out = pl.pallas_call(
         kernel,
@@ -221,13 +224,13 @@ def blockwise_attention(
         mask = _causal_mask(0, kv_i * bk, q_len, bk) if causal else None
         return _block_update(q, kt, vt, m, l, o, scale=scale, mask=mask), None
 
-    m0 = jnp.full(q.shape[:-1], _MASK_VALUE, q.dtype)
-    l0 = jnp.zeros(q.shape[:-1], q.dtype)
-    o0 = jnp.zeros_like(q)
+    m0 = jnp.full(q.shape[:-1], _MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
     (m, l, o), _ = lax.scan(
         body, (m0, l0, o0), (jnp.arange(num_kv), kb, vb)
     )
-    return o / l[..., None]
+    return (o / l[..., None]).astype(q.dtype)
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):

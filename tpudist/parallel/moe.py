@@ -39,8 +39,10 @@ class MoEStats(NamedTuple):
 
 
 def _one_hot_dispatch(router_logits, n_experts, capacity):
-    """Build the [tokens, experts, capacity] dispatch/combine tensors."""
-    probs = jax.nn.softmax(router_logits, axis=-1)
+    """Build the [tokens, experts, capacity] dispatch/combine tensors.
+    Routing probabilities are computed in f32 whatever the compute dtype
+    (argmax ties and gate scales are precision-sensitive)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # [tokens]
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
 
@@ -85,8 +87,10 @@ def moe_shard(
     dispatch, combine, stats = _one_hot_dispatch(
         x @ params["router"], n_experts, capacity
     )
-    # [tokens, experts, cap] × [tokens, d] -> [experts, cap, d]
-    expert_inputs = jnp.einsum("tec,td->ecd", dispatch, x)
+    # [tokens, experts, cap] × [tokens, d] -> [experts, cap, d].  The f32
+    # dispatch/combine masks are cast to the compute dtype so the einsums
+    # (and the expert matmuls they feed) stay on the bf16 MXU path.
+    expert_inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
     # Exchange: each device keeps rows for ITS expert from every peer.
     # -> [peers, cap, d] on each device (split experts, concat peers).
     expert_inputs = lax.all_to_all(
@@ -98,7 +102,8 @@ def moe_shard(
     ).reshape(expert_inputs.shape)
     # Return trip: rows go back to their source device.
     expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0, concat_axis=0)
-    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype),
+                     expert_out)
     # Stats become job-global means so every shard returns the same value
     # (replicated out-spec) — the host logs them off the compiled path, the
     # reference's metric-reduction discipline (SURVEY.md §5.5).

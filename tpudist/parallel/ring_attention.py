@@ -50,29 +50,39 @@ def attention_reference(
     Shapes: ``q, k, v: [batch, heads, seq, head_dim]``.
     """
     scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    # Mixed-precision discipline (a no-op for f32 inputs): MXU operands in
+    # the input dtype, score accumulation + softmax in f32, output cast back.
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         q_len, k_len = scores.shape[-2], scores.shape[-1]
         qi = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
         kj = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
         scores = jnp.where(qi >= kj, scores, _MASK_VALUE)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def _block_update(q, k, v, m, l, o, *, scale, mask=None):
     """One online-softmax accumulation step over a KV block.
 
     ``m`` row-max, ``l`` normalizer sum, ``o`` unnormalized output — the
-    (m, l, o) running triple of blockwise/flash attention.
+    (m, l, o) running triple of blockwise/flash attention.  The carry is
+    f32 whatever the input dtype (mixed-precision discipline: MXU operands
+    in the input dtype, accumulation in f32).
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask, s, _MASK_VALUE)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     correction = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
     l_new = l * correction + jnp.sum(p, axis=-1)
-    o_new = o * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o_new = o * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
     return m_new, l_new, o_new
 
 
@@ -113,10 +123,11 @@ def ring_attention_shard(
     # pcast-to-varying: the carries join a scan whose outputs vary over the
     # seq axis (they mix in the sharded q/k/v), so the initial values must
     # carry the same varying-manual-axes type.
-    m = lax.pcast(jnp.full(q.shape[:-1], _MASK_VALUE, q.dtype),
+    m = lax.pcast(jnp.full(q.shape[:-1], _MASK_VALUE, jnp.float32),
                   (axis_name,), to="varying")
-    l = lax.pcast(jnp.zeros(q.shape[:-1], q.dtype), (axis_name,), to="varying")
-    o = jnp.zeros_like(q)
+    l = lax.pcast(jnp.zeros(q.shape[:-1], jnp.float32),
+                  (axis_name,), to="varying")
+    o = lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
     q_off = my_idx * block
 
     def consume_shard(kv_idx, k, v, m, l, o):
@@ -161,7 +172,7 @@ def ring_attention_shard(
             # step's compute is still queued — XLA overlaps the two.
             k = lax.ppermute(k, axis_name, perm)
             v = lax.ppermute(v, axis_name, perm)
-    return o / l[..., None]
+    return (o / l[..., None]).astype(q.dtype)
 
 
 def make_ring_attention(
