@@ -59,6 +59,9 @@ def get_args(argv=None):
     p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
                    help="bf16 = f32 master weights, bf16 compute (MXU-"
                         "native throughput)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3-style fully-sharded params + optimizer "
+                        "state over the data axis (1/n state memory/chip)")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -111,8 +114,19 @@ def main() -> None:
     )
     tx = optax.adam(args.lr)
     state = init_lm_state(params, tx)
+    state_sharding = None
+    if args.fsdp:
+        from tpudist.parallel import fsdp_sharding, state_bytes_per_device
+
+        state_sharding = fsdp_sharding(mesh, state)
+        state = jax.device_put(state, state_sharding)
+        rank_print(
+            f"fsdp: {state_bytes_per_device(state, state_sharding) / 2**20:.1f}"
+            " MiB state/chip (ZeRO-3 layout)"
+        )
     step = make_lm_train_step(module.apply, tx, mesh,
-                              aux=args.moe_experts > 0)
+                              aux=args.moe_experts > 0,
+                              state_sharding=state_sharding)
 
     logger = init_metrics(args.project, args.group or "demo_long_context",
                           dry_run=args.dry_run)

@@ -243,3 +243,102 @@ class TestComposedMesh:
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+
+class TestFSDP:
+    """ZeRO-3-style fully-sharded state: same math as replicated DP, 1/n
+    state memory per chip."""
+
+    def _setup(self, mesh):
+        import optax
+
+        from tpudist.models import create_transformer
+        from tpudist.train import init_lm_state, make_lm_train_step, token_sharding
+
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32,
+            vocab=32, d_model=64, n_layers=2, n_heads=4, d_ff=128, max_len=32,
+        )
+        tx = optax.adam(1e-3)
+        state = init_lm_state(params, tx)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 32, size=(8, 32)), jnp.int32)
+        tokens = jax.device_put(tokens, token_sharding(mesh))
+        return module, tx, state, tokens, make_lm_train_step
+
+    def test_loss_matches_replicated(self, devices):
+        from tpudist.parallel import fsdp_sharding
+
+        mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+        module, tx, state, tokens, make_step = self._setup(mesh)
+
+        repl_step = make_step(module.apply, tx, mesh, donate_state=False)
+        fs = fsdp_sharding(mesh, state)
+        fstate = jax.device_put(state, fs)
+        fsdp_step = make_step(module.apply, tx, mesh, donate_state=False,
+                              state_sharding=fs)
+        for _ in range(3):
+            state, loss_r = repl_step(state, tokens)
+            fstate, loss_f = fsdp_step(fstate, tokens)
+            np.testing.assert_allclose(float(loss_r), float(loss_f),
+                                       rtol=2e-6, atol=2e-6)
+
+    def test_state_actually_sharded(self, devices):
+        from tpudist.parallel import fsdp_sharding, state_bytes_per_device
+
+        mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+        module, tx, state, _, _ = self._setup(mesh)
+        fs = fsdp_sharding(mesh, state)
+        fstate = jax.device_put(state, fs)
+
+        # A block kernel [64, 192] shards 192 -> 24 per device; its Adam
+        # moments shard identically (they mirror the param tree).
+        k = fstate.params["params"]["block_0"]["qkv"]["kernel"]
+        assert k.sharding.spec != P()
+        assert k.addressable_shards[0].data.size == k.size // 8
+        mu = fstate.opt_state[0].mu["params"]["block_0"]["qkv"]["kernel"]
+        assert mu.addressable_shards[0].data.size == mu.size // 8
+
+        # Analytic accounting: near-1/8 of the replicated footprint (small
+        # leaves replicate).
+        total = sum(l.size * l.dtype.itemsize
+                    for l in jax.tree.leaves(state))
+        per_dev = state_bytes_per_device(state, fs)
+        assert per_dev < total * 0.25, (per_dev, total)
+
+    def test_composes_with_tp(self, devices):
+        """merge_shardings: TP specs where they exist, FSDP elsewhere —
+        trains on a (data, model) mesh."""
+        import optax
+
+        from tpudist.models import create_transformer
+        from tpudist.models.transformer import transformer_tp_sharding
+        from tpudist.parallel import fsdp_sharding, merge_shardings
+        from tpudist.train import init_lm_state, make_lm_train_step, token_sharding
+
+        mesh = Mesh(np.asarray(devices).reshape(4, 2),
+                    axis_names=(AXIS_DATA, AXIS_MODEL))
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32,
+            vocab=32, d_model=64, n_layers=2, n_heads=4, d_ff=128, max_len=32,
+        )
+        tx = optax.adam(1e-3)
+        state = init_lm_state(params, tx)
+        merged = merge_shardings(transformer_tp_sharding(mesh, state),
+                                 fsdp_sharding(mesh, state))
+        mstate = jax.device_put(state, merged)
+        step = make_lm_train_step(module.apply, tx, mesh,
+                                  state_sharding=merged)
+        tokens = jax.device_put(
+            jnp.asarray(np.random.default_rng(0).integers(0, 32, size=(8, 32)),
+                        jnp.int32),
+            token_sharding(mesh))
+        first = None
+        for _ in range(10):
+            mstate, loss = step(mstate, tokens)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first, (first, float(loss))
+        # embeddings (TP-replicated) got the FSDP treatment
+        emb = mstate.params["params"]["tok_embed"]["embedding"]
+        assert emb.sharding.spec != P()

@@ -14,6 +14,8 @@ holds the scalable strategies on the 4-axis mesh
   activations hopping the ring inside one jitted ``lax.scan``.
 - :mod:`moe` — capacity-based top-1 expert parallelism with a single
   fused ``all_to_all`` each way (``model`` axis as the expert group).
+- :mod:`fsdp` — ZeRO-3-style fully-sharded state layout over the ``data``
+  axis (XLA inserts the all-gather/reduce-scatter pair).
 """
 
 from tpudist.parallel.ring_attention import (  # noqa: F401
@@ -37,3 +39,8 @@ from tpudist.parallel.pipeline_lm import (  # noqa: F401
     unstack_block_params,
 )
 from tpudist.parallel.moe import MoEStats, make_moe, moe_shard  # noqa: F401
+from tpudist.parallel.fsdp import (  # noqa: F401
+    fsdp_sharding,
+    merge_shardings,
+    state_bytes_per_device,
+)
