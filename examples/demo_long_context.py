@@ -53,9 +53,15 @@ def get_args(argv=None):
     p.add_argument("--d_model", default=128, type=int)
     p.add_argument("--n_layers", default=2, type=int)
     p.add_argument("--moe_experts", default=0, type=int,
-                   help="replace the dense FFN with a top-1 MoE of this "
+                   help="replace the dense FFN with a routed MoE of this "
                         "many experts, expert-parallel over a model mesh "
                         "axis of the same size (requires --seq_shards 1)")
+    p.add_argument("--moe_topk", default=1, type=int,
+                   help="experts per token (1 = Switch raw gate, >1 = "
+                        "Mixtral-style renormalized gates)")
+    p.add_argument("--moe_balance", default=0.0, type=float,
+                   help="weight of the Switch/GShard load-balancing aux "
+                        "loss added to the LM loss (e.g. 0.01)")
     p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
                    help="bf16 = f32 master weights, bf16 compute (MXU-"
                         "native throughput)")
@@ -81,6 +87,12 @@ def main() -> None:
 
     if args.moe_experts > 0 and args.seq_shards > 1:
         raise SystemExit("--moe_experts composes with dp, not sp: use --seq_shards 1")
+    if args.moe_experts > 0 and not 1 <= args.moe_topk <= args.moe_experts:
+        raise SystemExit(
+            f"--moe_topk {args.moe_topk} must be in [1, {args.moe_experts}]"
+            " (= --moe_experts)")
+    if args.moe_experts == 0 and (args.moe_topk != 1 or args.moe_balance):
+        raise SystemExit("--moe_topk/--moe_balance need --moe_experts > 0")
     mesh = make_mesh(MeshConfig(data=-1, seq=args.seq_shards,
                                 model=max(args.moe_experts, 1)))
     rank_print(
@@ -99,7 +111,8 @@ def main() -> None:
         from tpudist.models.transformer import moe_expert_fn
         from tpudist.parallel import make_moe
 
-        moe_fn = make_moe(mesh, moe_expert_fn, batch_axis=AXIS_DATA)
+        moe_fn = make_moe(mesh, moe_expert_fn, batch_axis=AXIS_DATA,
+                          k=args.moe_topk)
     module, params = create_transformer(
         jax.random.PRNGKey(args.seed),
         seq_len=args.seq_len,
@@ -126,7 +139,8 @@ def main() -> None:
         )
     step = make_lm_train_step(module.apply, tx, mesh,
                               aux=args.moe_experts > 0,
-                              state_sharding=state_sharding)
+                              state_sharding=state_sharding,
+                              moe_balance_weight=args.moe_balance)
 
     logger = init_metrics(args.project, args.group or "demo_long_context",
                           dry_run=args.dry_run)
@@ -152,6 +166,7 @@ def main() -> None:
                     )
                     load = np.asarray(aux["moe_expert_load"])
                     row["moe/load_max"] = float(load.max())
+                    row["moe/balance_loss"] = float(aux["moe_balance_loss"])
                 logger.log(row)
     final = float(loss)
     logger.finish()

@@ -239,16 +239,26 @@ class TestMoETransformer:
             **dict(CFG, n_experts=2))
         tx = optax.adam(1e-3)
         state = init_lm_state(params, tx)
-        step = make_lm_train_step(module.apply, tx, mesh, aux=True)
+        step = make_lm_train_step(module.apply, tx, mesh, aux=True,
+                                  donate_state=False)
         tokens = jax.device_put(_tokens(batch=8, seq=32),
                                 token_sharding(mesh))
         state, loss, aux = step(state, tokens)
-        assert set(aux) == {"moe_dropped_fraction", "moe_expert_load"}
+        assert set(aux) == {"moe_dropped_fraction", "moe_expert_load",
+                            "moe_balance_loss"}
         dropped = float(aux["moe_dropped_fraction"])
         load = np.asarray(aux["moe_expert_load"])
         assert 0.0 <= dropped <= 1.0
         assert load.shape == (2,)
         np.testing.assert_allclose(load.sum(), 1.0, atol=1e-5)
+        assert 0.9 <= float(aux["moe_balance_loss"]) <= 2.0
+        # moe_balance_weight > 0 with aux=False: grads include the balance
+        # term, the 2-tuple contract and reported-loss semantics hold.
+        bal_step = make_lm_train_step(module.apply, tx, mesh,
+                                      moe_balance_weight=0.01)
+        bstate, bloss = bal_step(init_lm_state(params, tx), tokens)
+        assert np.isfinite(float(bloss))
+
         # Dense (non-MoE) model sows nothing: aux comes back empty.
         dense_mod, dense_params = create_transformer(
             jax.random.PRNGKey(0), seq_len=32, **CFG)

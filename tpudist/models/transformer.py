@@ -113,6 +113,9 @@ class MoEFFN(nn.Module):
             self.sow("intermediates", "moe_dropped_fraction",
                      stats.dropped_fraction)
             self.sow("intermediates", "moe_expert_load", stats.expert_load)
+            # Differentiable Switch/GShard balance loss — added to the LM
+            # loss by make_lm_train_step(moe_balance_weight=...).
+            self.sow("intermediates", "moe_balance_loss", stats.balance_loss)
         else:
             y = dense_moe_reference(params, tokens)
         return y.reshape(b, s, d)

@@ -1,5 +1,5 @@
-"""Expert parallelism: top-1 switch-routing MoE with ``all_to_all``
-token exchange over the ``model`` (expert) mesh axis.
+"""Expert parallelism: top-k routed MoE (k=1 Switch, k>1 Mixtral/GShard)
+with ``all_to_all`` token exchange over the ``model`` (expert) mesh axis.
 
 Absent from the reference (SURVEY.md §2.4: EP "not required for parity");
 provided as the TPU-native extension.  Design, TPU-first:
@@ -7,12 +7,14 @@ provided as the TPU-native extension.  Design, TPU-first:
 - **capacity-based dispatch**: every device sends exactly
   ``capacity`` token slots to every expert — static shapes, no
   data-dependent gathers, so XLA can tile the expert matmuls on the MXU;
-  overflow tokens are dropped (standard Switch-Transformer semantics) and
-  their outputs fall back to zero, surfaced via the returned stats.
+  overflow assignments are dropped (standard Switch-Transformer
+  semantics) and their outputs fall back to zero, surfaced via the
+  returned stats.
 - **one `lax.all_to_all` each way**: dispatch and return ride a single
   fused ICI collective rather than per-expert sends.
 - differentiable: routing probabilities multiply the combined output
-  (straight-through on the argmax route), so router + experts train.
+  (straight-through on the top-k route), so router + experts train; the
+  Switch/GShard balance auxiliary rides ``MoEStats``.
 """
 
 from __future__ import annotations
@@ -32,35 +34,59 @@ ExpertFn = Callable[[dict, jax.Array], jax.Array]
 
 
 class MoEStats(NamedTuple):
-    """Per-shard routing observability (host-side metrics material)."""
+    """Per-shard routing observability (host-side metrics material) plus
+    the differentiable load-balancing auxiliary loss."""
 
-    dropped_fraction: jax.Array  # scalar: tokens that overflowed capacity
-    expert_load: jax.Array  # [n_experts]: fraction routed to each expert
+    # NOTE: at k>1 the fractions below are over the k·tokens ASSIGNMENTS,
+    # not over tokens.
+    dropped_fraction: jax.Array  # scalar: assignments that overflowed capacity
+    expert_load: jax.Array  # [n_experts]: fraction of assignments per expert
+    balance_loss: jax.Array  # scalar: Switch/GShard aux loss (1.0 = uniform)
 
 
-def _one_hot_dispatch(router_logits, n_experts, capacity):
-    """Build the [tokens, experts, capacity] dispatch/combine tensors.
-    Routing probabilities are computed in f32 whatever the compute dtype
-    (argmax ties and gate scales are precision-sensitive)."""
+def _topk_dispatch(router_logits, n_experts, capacity, k=1):
+    """Build the [tokens, experts, capacity] dispatch/combine tensors for
+    top-``k`` routing.  Routing probabilities are computed in f32 whatever
+    the compute dtype (argmax ties and gate scales are precision-sensitive).
+
+    ``k=1``: Switch semantics — the raw top probability gates the output.
+    ``k>1``: Mixtral/GShard semantics — the k gates renormalize to sum 1.
+    Capacity queues fill in choice-major priority (every token's first
+    choice is placed before any second choice), the standard GShard order.
+
+    The returned ``balance_loss`` is the Switch §2.2 / GShard auxiliary:
+    ``n_experts · Σ_e f_e · P_e`` with ``f_e`` the fraction of assignments
+    routed to expert *e* and ``P_e`` its mean router probability — 1.0 at
+    perfect balance, differentiable through ``P_e``.
+    """
+    t = router_logits.shape[0]
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [tokens]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    gate_vals, expert_idx = lax.top_k(probs, k)  # [tokens, k]
+    if k > 1:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
-    expert_1h = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
-    # Position of each token within its expert's queue (prefix count).
-    pos_in_expert = jnp.cumsum(expert_1h, axis=0) * expert_1h - expert_1h
-    pos = jnp.sum(pos_in_expert, axis=-1)  # [tokens]
+    # Choice-major flattening: [k·tokens] with all first choices leading.
+    flat_idx = expert_idx.T.reshape(-1)
+    one_hot = jax.nn.one_hot(flat_idx, n_experts, dtype=jnp.int32)
+    pos_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot - one_hot
+    pos = jnp.sum(pos_in_expert, axis=-1)  # [k·tokens]
     kept = pos < capacity
 
-    dispatch = (
-        expert_1h[:, :, None].astype(jnp.float32)
+    disp_flat = (
+        one_hot[:, :, None].astype(jnp.float32)
         * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
         * kept[:, None, None]
-    )  # [tokens, experts, capacity]
-    combine = dispatch * gate[:, None, None]
+    )  # [k·tokens, experts, capacity]
+    disp_kt = disp_flat.reshape(k, t, n_experts, capacity)
+    dispatch = jnp.sum(disp_kt, axis=0)  # distinct experts per token: 0/1
+    combine = jnp.einsum("ktec,tk->tec", disp_kt, gate_vals)
+
+    load = jnp.mean(one_hot.astype(jnp.float32), axis=0)  # f_e over choices
+    balance = n_experts * jnp.sum(load * jnp.mean(probs, axis=0))
     stats = MoEStats(
         dropped_fraction=1.0 - jnp.mean(kept.astype(jnp.float32)),
-        expert_load=jnp.mean(expert_1h.astype(jnp.float32), axis=0),
+        expert_load=load,
+        balance_loss=balance,
     )
     return dispatch, combine, stats
 
@@ -72,20 +98,21 @@ def moe_shard(
     expert_fn: ExpertFn,
     capacity_factor: float = 1.25,
     axis_name: str = AXIS_MODEL,
+    k: int = 1,
 ):
     """Shard-local MoE body (call inside ``shard_map``).
 
     ``params = {'router': [d, n_experts], 'experts': pytree with leading
     local-expert axis}``; ``x: [local_tokens, d]``.  One expert per device
-    (n_experts == axis size); generalizing to k experts/device only changes
-    the reshape arithmetic.
+    (n_experts == axis size); ``k`` routes each token to its top-k experts
+    (capacity scales with k so the fair share per expert is unchanged).
     """
     n_experts = lax.axis_size(axis_name)
     tokens = x.shape[0]
-    capacity = int(capacity_factor * tokens / n_experts + 0.5)
+    capacity = int(capacity_factor * k * tokens / n_experts + 0.5)
 
-    dispatch, combine, stats = _one_hot_dispatch(
-        x @ params["router"], n_experts, capacity
+    dispatch, combine, stats = _topk_dispatch(
+        x @ params["router"], n_experts, capacity, k=k
     )
     # [tokens, experts, cap] × [tokens, d] -> [experts, cap, d].  The f32
     # dispatch/combine masks are cast to the compute dtype so the einsums
@@ -118,12 +145,14 @@ def make_moe(
     axis_name: str = AXIS_MODEL,
     batch_axis: str | None = None,
     capacity_factor: float = 1.25,
+    k: int = 1,
 ):
     """Jitted global-view MoE layer over ``mesh``.
 
     ``params['experts']`` arrives stacked ``[n_experts, ...]`` sharded over
     ``axis_name``; ``x: [tokens, d]`` sharded over ``batch_axis`` (or
-    replicated).  Returns ``(y, MoEStats)`` with per-shard stats.
+    replicated).  ``k`` selects top-k routing.  Returns ``(y, MoEStats)``
+    with job-global stats (``balance_loss`` stays differentiable).
     """
     def body(params, x):
         out, stats = moe_shard(
@@ -131,6 +160,7 @@ def make_moe(
             expert_fn=expert_fn,
             capacity_factor=capacity_factor,
             axis_name=axis_name,
+            k=k,
         )
         if batch_axis is not None:
             stats = MoEStats(*(lax.pmean(s, batch_axis) for s in stats))
@@ -141,7 +171,7 @@ def make_moe(
         body,
         mesh=mesh,
         in_specs=(param_specs, P(batch_axis, None)),
-        out_specs=(P(batch_axis, None), MoEStats(P(), P())),
+        out_specs=(P(batch_axis, None), MoEStats(P(), P(), P())),
         check_vma=False,
     )
     return jax.jit(sharded)

@@ -43,6 +43,7 @@ def make_lm_train_step(
     donate_state: bool = True,
     state_sharding=None,
     aux: bool = False,
+    moe_balance_weight: float = 0.0,
 ):
     """Build ``step(state, tokens) -> (state, loss)``, compiled once.
 
@@ -58,19 +59,27 @@ def make_lm_train_step(
     ``aux=True`` runs the model with flax ``intermediates`` collection and
     returns ``step(state, tokens) -> (state, loss, aux_dict)`` where
     ``aux_dict`` carries MoE routing stats averaged over layers
-    (``moe_dropped_fraction`` scalar, ``moe_expert_load`` ``[n_experts]``)
-    — empty when the model sows nothing.  Requires ``apply_fn`` to accept
-    flax's ``mutable=`` kwarg (i.e. a ``Module.apply``).
+    (``moe_dropped_fraction`` scalar, ``moe_expert_load`` ``[n_experts]``,
+    ``moe_balance_loss`` scalar) — empty when the model sows nothing.
+    Requires ``apply_fn`` to accept flax's ``mutable=`` kwarg (i.e. a
+    ``Module.apply``).
+
+    ``moe_balance_weight`` > 0 adds that multiple of the mean sown
+    ``moe_balance_loss`` (the differentiable Switch/GShard auxiliary) to
+    the training loss — router load balancing trains even when ``aux`` is
+    False; the reported loss stays the plain LM cross entropy.
     """
     repl = NamedSharding(mesh, P())
     tok_shard = token_sharding(mesh)
     state_out = repl if state_sharding is None else state_sharding
+    need_inters = aux or moe_balance_weight > 0.0
 
     def _collect_aux(inters) -> dict:
         by_name: dict = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(inters)[0]:
             keys = [getattr(e, "key", getattr(e, "name", None)) for e in path]
-            for name in ("moe_dropped_fraction", "moe_expert_load"):
+            for name in ("moe_dropped_fraction", "moe_expert_load",
+                         "moe_balance_loss"):
                 if name in keys:
                     by_name.setdefault(name, []).append(leaf)
         return {
@@ -79,15 +88,22 @@ def make_lm_train_step(
         }
 
     def step(state: ModelState, tokens):
-        if aux:
+        if need_inters:
             def loss_of(params):
                 logits, mut = apply_fn(
                     params, tokens, mutable=["intermediates"]
                 )
                 # flax omits the collection entirely when nothing was sown
-                return lm_loss(logits, tokens), mut.get("intermediates", {})
+                collected = _collect_aux(mut.get("intermediates", {}))
+                lm = lm_loss(logits, tokens)
+                total = lm
+                if moe_balance_weight > 0.0 and "moe_balance_loss" in collected:
+                    total = total + moe_balance_weight * collected[
+                        "moe_balance_loss"]
+                # grads flow from total; the reported loss stays plain LM CE
+                return total, (lm, collected)
 
-            (loss, inters), grads = jax.value_and_grad(
+            (_, (loss, collected)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(state.params)
         else:
@@ -99,7 +115,7 @@ def make_lm_train_step(
         new_params = optax.apply_updates(state.params, updates)
         new_state = ModelState(params=new_params, opt_state=new_opt)
         if aux:
-            return new_state, loss, _collect_aux(inters)
+            return new_state, loss, collected
         return new_state, loss
 
     if aux:
