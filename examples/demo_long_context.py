@@ -68,6 +68,12 @@ def get_args(argv=None):
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3-style fully-sharded params + optimizer "
                         "state over the data axis (1/n state memory/chip)")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position encoding instead of the learned "
+                        "position table (length-extrapolating)")
+    p.add_argument("--accum_steps", default=1, type=int,
+                   help="gradient-accumulation microbatches per optimizer "
+                        "step (peak activation memory / accum_steps)")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -124,6 +130,7 @@ def main() -> None:
         n_experts=args.moe_experts,
         moe_fn=moe_fn,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+        rope=args.rope,
     )
     tx = optax.adam(args.lr)
     state = init_lm_state(params, tx)
@@ -140,7 +147,8 @@ def main() -> None:
     step = make_lm_train_step(module.apply, tx, mesh,
                               aux=args.moe_experts > 0,
                               state_sharding=state_sharding,
-                              moe_balance_weight=args.moe_balance)
+                              moe_balance_weight=args.moe_balance,
+                              accum_steps=args.accum_steps)
 
     logger = init_metrics(args.project, args.group or "demo_long_context",
                           dry_run=args.dry_run)

@@ -164,11 +164,15 @@ class TestPipeline:
             atol=1e-5, rtol=1e-5,
         )
 
-    def test_gradients_match_sequential(self, stage_mesh):
+    @pytest.mark.parametrize("remat", [False, True])
+    def test_gradients_match_sequential(self, stage_mesh, remat):
+        """remat=True recomputes stage forwards in the backward — same
+        gradients, O(boundaries) activation memory."""
         d = 8
         stacked = self._stacked_params(4, d)
         x = jax.random.normal(jax.random.PRNGKey(1), (16, d))
-        pipe = make_pipeline(stage_mesh, _stage_fn, num_microbatches=4)
+        pipe = make_pipeline(stage_mesh, _stage_fn, num_microbatches=4,
+                             remat=remat)
         g_pipe = jax.grad(lambda p: jnp.sum(pipe(p, x) ** 2))(stacked)
         g_ref = jax.grad(lambda p: jnp.sum(self._reference(p, x) ** 2))(stacked)
         for k in stacked:

@@ -268,6 +268,95 @@ class TestMoETransformer:
         assert dense_aux == {}
 
 
+class TestGradAccumulation:
+    def test_matches_full_batch(self, devices):
+        """accum_steps=4 == full-batch step: identical reported loss and
+        near-identical updated params (summation order only)."""
+        mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+        module, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                            **CFG)
+        tx = optax.adam(1e-3)
+        tokens = jax.device_put(_tokens(batch=16, seq=32),
+                                token_sharding(mesh))
+
+        full = make_lm_train_step(module.apply, tx, mesh, donate_state=False)
+        acc = make_lm_train_step(module.apply, tx, mesh, donate_state=False,
+                                 accum_steps=4)
+        s_full, l_full = full(init_lm_state(params, tx), tokens)
+        s_acc, l_acc = acc(init_lm_state(params, tx), tokens)
+        np.testing.assert_allclose(float(l_full), float(l_acc),
+                                   rtol=1e-5, atol=1e-5)
+        for a, b in zip(jax.tree.leaves(s_full.params),
+                        jax.tree.leaves(s_acc.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_indivisible_batch_raises(self, devices):
+        mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+        module, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                            **CFG)
+        tx = optax.adam(1e-3)
+        step = make_lm_train_step(module.apply, tx, mesh, accum_steps=3)
+        tokens = jax.device_put(_tokens(batch=16, seq=32),
+                                token_sharding(mesh))
+        with pytest.raises(ValueError, match="accum"):
+            step(init_lm_state(params, tx), tokens)
+
+
+class TestRoPE:
+    def test_causality_and_no_pos_table(self):
+        module, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                            rope=True, **CFG)
+        assert "pos_embed" not in params["params"]
+        tokens = _tokens(batch=2, seq=32)
+        out = module.apply(params, tokens)
+        # future-token perturbation cannot change past logits
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % CFG["vocab"])
+        out2 = module.apply(params, tokens2)
+        np.testing.assert_allclose(np.asarray(out[:, :-1]),
+                                   np.asarray(out2[:, :-1]),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_relative_encoding(self):
+        """RoPE scores depend on relative offsets: a sequence prefixed by
+        padding produces the same causal attention pattern shifted — check
+        via the model's shift property on a repeating input."""
+        from tpudist.models.transformer import rope_rotate
+
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 8, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 8, 16))
+        qr, kr = rope_rotate(q), rope_rotate(k)
+        # score(i, j) after rotation equals score computed with both
+        # positions shifted by the same amount: rotate a length-16 copy
+        # where rows occupy positions 8..15 instead of 0..7.
+        pad = jnp.zeros_like(q)
+        q16 = jnp.concatenate([pad, q], axis=2)
+        k16 = jnp.concatenate([pad, k], axis=2)
+        qr16, kr16 = rope_rotate(q16), rope_rotate(k16)
+        s_base = jnp.einsum("bhqd,bhkd->bhqk", qr, kr)
+        s_shift = jnp.einsum("bhqd,bhkd->bhqk", qr16[:, :, 8:], kr16[:, :, 8:])
+        np.testing.assert_allclose(np.asarray(s_base), np.asarray(s_shift),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_ring_agrees_with_dense_under_rope(self, devices):
+        """Rotation happens in the global view, so seq-sharded ring
+        attention and dense agree on a rope model."""
+        mesh = Mesh(np.asarray(devices).reshape(2, 4),
+                    axis_names=(AXIS_DATA, AXIS_SEQ))
+        tokens = _tokens(batch=4, seq=64)
+        dense_mod, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=64, rope=True, **CFG)
+        ring_mod, _ = create_transformer(
+            jax.random.PRNGKey(0), seq_len=64, rope=True,
+            attention_fn=make_ring_attention(mesh, causal=True,
+                                             batch_axis=AXIS_DATA),
+            **CFG)
+        np.testing.assert_allclose(
+            np.asarray(dense_mod.apply(params, tokens)),
+            np.asarray(ring_mod.apply(params, tokens)),
+            atol=2e-4, rtol=2e-4)
+
+
 class TestMixedPrecision:
     def test_bf16_forward_close_to_f32(self):
         """Same f32 master params: bf16 compute tracks the f32 logits
@@ -412,6 +501,28 @@ class TestPipelineParallelTransformer:
         out = pp_apply(stack_block_params(params, n_stages=4), tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
+
+    def test_pp_apply_rope_remat(self, devices):
+        """RoPE (no pos table) + stage remat through the pipeline path."""
+        from tpudist.parallel import make_pp_lm_apply, stack_block_params
+
+        mesh = self._mesh(devices)
+        cfg = dict(vocab=32, d_model=32, n_layers=4, n_heads=2, d_ff=64,
+                   max_len=32)
+        module, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                            rope=True, **cfg)
+        tokens = _tokens(batch=8, seq=32)
+        ref = module.apply(params, tokens)
+        pp_apply = make_pp_lm_apply(mesh, module, n_stages=4,
+                                    num_microbatches=2, remat=True)
+        pp_params = stack_block_params(params, n_stages=4)
+        out = pp_apply(pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        # differentiable with remat on
+        g = jax.grad(lambda p: float(0) + lm_loss(pp_apply(p, tokens),
+                                                  tokens))(pp_params)
+        assert float(jnp.abs(jax.tree.leaves(g["blocks"])[0]).sum()) > 0
 
     def test_pp_training_matches_replicated(self, devices):
         """DP×PP training (template: TestTensorParallelTransformer): same

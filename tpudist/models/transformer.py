@@ -52,6 +52,26 @@ def _default_attention(q, k, v):
     return blockwise_attention(q, k, v, causal=True, block_k=512)
 
 
+def rope_rotate(x: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over ``[batch, heads, seq, head_dim]``.
+
+    Angles are computed in f32 (precision-sensitive at long context) on the
+    GLOBAL sequence axis — callers apply it before any seq sharding, so
+    ring-attention shards see correct absolute positions.  Half-split
+    rotation (GPT-NeoX convention).
+    """
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(x.shape[-2], dtype=jnp.float32)[:, None] * freqs[None]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    # rotate in f32 (position precision at long context), cast back after
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
 def moe_expert_fn(params, tokens):
     """The expert used by the MoE FFN: relu(x·w)·wo — shared between the
     sharded execution path (``tpudist.parallel.moe``) and the dense
@@ -129,6 +149,7 @@ class Block(nn.Module):
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE FFN with that many experts
     moe_fn: Optional[Callable] = None
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
+    rope: bool = False  # rotary q/k position encoding (no learned pos table)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -144,7 +165,10 @@ class Block(nn.Module):
             b, s, _ = t.shape
             return t.reshape(b, s, self.n_heads, dh).transpose(0, 2, 1, 3)
 
-        attn = self.attention_fn(heads(q), heads(k), heads(v))
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.rope:
+            q, k = rope_rotate(q), rope_rotate(k)
+        attn = self.attention_fn(q, k, v)
         b, nh, s, _ = attn.shape
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
         x = x + nn.Dense(self.d_model, use_bias=False, name="proj",
@@ -179,6 +203,9 @@ class TransformerLM(nn.Module):
     # throughput, f32 LayerNorm/softmax/loss — grads land f32 for the
     # optimizer.  The Lightning ``precision=`` analog for the LM family.
     dtype: jnp.dtype = jnp.float32
+    # Rotary position encoding on q/k instead of the learned position
+    # table — length-extrapolating, the modern long-context default.
+    rope: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -187,16 +214,17 @@ class TransformerLM(nn.Module):
         seq = tokens.shape[1]
         x = nn.Embed(self.vocab, self.d_model, name="tok_embed",
                      dtype=self.dtype)(tokens)
-        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
-                       dtype=self.dtype)(
-            jnp.arange(seq, dtype=jnp.int32)
-        )
-        x = x + pos[None]
+        if not self.rope:
+            pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
+                           dtype=self.dtype)(
+                jnp.arange(seq, dtype=jnp.int32)
+            )
+            x = x + pos[None]
         for i in range(self.n_layers):
             x = Block(
                 self.d_model, self.n_heads, self.d_ff, attn,
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
-                dtype=self.dtype, name=f"block_{i}",
+                dtype=self.dtype, rope=self.rope, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
         return nn.Dense(self.vocab, use_bias=False, name="head",

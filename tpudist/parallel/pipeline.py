@@ -44,6 +44,7 @@ def pipeline_shard(
     *,
     stage_fn: StageFn,
     axis_name: str = AXIS_STAGE,
+    remat: bool = False,
 ) -> jax.Array:
     """Shard-local GPipe body (call inside ``shard_map``).
 
@@ -62,6 +63,12 @@ def pipeline_shard(
     which is what a ``psum`` broadcast would do.
     """
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    if remat:
+        # Recompute each tick's stage forward during the backward instead
+        # of stashing its internals: per-device activation memory drops to
+        # the tick *boundaries* the scan already carries — the memory
+        # property a hand-scheduled 1F1B buys, obtained compiler-side.
+        stage_fn = jax.checkpoint(stage_fn)
     n_stages = lax.axis_size(axis_name)
     my_stage = lax.axis_index(axis_name)
     num_micro = x_microbatches.shape[0]
@@ -110,6 +117,7 @@ def make_pipeline(
     *,
     axis_name: str = AXIS_STAGE,
     num_microbatches: int = 4,
+    remat: bool = False,
 ):
     """Jitted global-view pipeline.
 
@@ -126,7 +134,7 @@ def make_pipeline(
 
         def body(sp, xmb):
             return pipeline_shard(
-                sp, xmb, stage_fn=stage_fn, axis_name=axis_name
+                sp, xmb, stage_fn=stage_fn, axis_name=axis_name, remat=remat
             )[None]
 
         # Leading stage axis on the output; slicing the last block makes

@@ -41,25 +41,34 @@ class _LMEmbed(nn.Module):
     vocab: int
     d_model: int
     max_len: int
+    rope: bool = False  # rope models carry no pos_embed table
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, tokens):
-        x = nn.Embed(self.vocab, self.d_model, name="tok_embed")(tokens)
-        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
-            jnp.arange(tokens.shape[1], dtype=jnp.int32)
-        )
-        return x + pos[None]
+        x = nn.Embed(self.vocab, self.d_model, name="tok_embed",
+                     dtype=self.dtype)(tokens)
+        if not self.rope:
+            pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
+                           dtype=self.dtype)(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            )
+            x = x + pos[None]
+        return x
 
 
 class _LMHead(nn.Module):
     """Final norm + vocab projection, names matching TransformerLM."""
 
     vocab: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = nn.LayerNorm(use_bias=False)(x)  # -> 'LayerNorm_0'
-        return nn.Dense(self.vocab, use_bias=False, name="head")(x)
+        # same precision split as TransformerLM: f32 norm, dtype projection
+        x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)  # 'LayerNorm_0'
+        return nn.Dense(self.vocab, use_bias=False, name="head",
+                        dtype=self.dtype)(x)
 
 
 _EMBED_KEYS = ("tok_embed", "pos_embed")
@@ -131,6 +140,7 @@ def make_pp_lm_apply(
     num_microbatches: int = 4,
     axis_name: str = AXIS_STAGE,
     data_axis: Optional[str] = AXIS_DATA,
+    remat: bool = False,
 ):
     """Build ``apply(pp_params, tokens) -> logits`` with the block stack
     pipelined over ``axis_name`` and the batch sharded over ``data_axis``.
@@ -146,9 +156,11 @@ def make_pp_lm_apply(
         module.d_model, module.n_heads, module.d_ff,
         module.attention_fn or _default_attention,
         n_experts=module.n_experts, moe_fn=module.moe_fn,
+        dtype=module.dtype, rope=module.rope,
     )
-    embed_mod = _LMEmbed(module.vocab, module.d_model, module.max_len)
-    head_mod = _LMHead(module.vocab)
+    embed_mod = _LMEmbed(module.vocab, module.d_model, module.max_len,
+                         rope=module.rope, dtype=module.dtype)
+    head_mod = _LMHead(module.vocab, dtype=module.dtype)
 
     def stage_fn(stage_params, x):
         # stage_params leaves: [layers_per_stage, ...]; apply sequentially.
@@ -166,7 +178,8 @@ def make_pp_lm_apply(
     def apply(pp_params, tokens):
         rest = pp_params["rest"]
         x = embed_mod.apply(
-            {"params": {k: rest[k] for k in _EMBED_KEYS}}, tokens
+            {"params": {k: rest[k] for k in _EMBED_KEYS if k in rest}},
+            tokens
         )
         b, s, d = x.shape
         if b % num_microbatches:
@@ -177,7 +190,7 @@ def make_pp_lm_apply(
 
         def body(sp, xmb):
             return pipeline_shard(
-                sp, xmb, stage_fn=stage_fn, axis_name=axis_name
+                sp, xmb, stage_fn=stage_fn, axis_name=axis_name, remat=remat
             )[None]
 
         out = jax.shard_map(

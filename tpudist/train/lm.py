@@ -17,6 +17,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudist.models.transformer import lm_loss
@@ -44,6 +45,7 @@ def make_lm_train_step(
     state_sharding=None,
     aux: bool = False,
     moe_balance_weight: float = 0.0,
+    accum_steps: int = 1,
 ):
     """Build ``step(state, tokens) -> (state, loss)``, compiled once.
 
@@ -68,6 +70,12 @@ def make_lm_train_step(
     ``moe_balance_loss`` (the differentiable Switch/GShard auxiliary) to
     the training loss — router load balancing trains even when ``aux`` is
     False; the reported loss stays the plain LM cross entropy.
+
+    ``accum_steps`` > 1 splits the batch into that many microbatches and
+    accumulates their gradients in a ``lax.scan`` before the single
+    optimizer update — big effective batches at 1/``accum_steps`` peak
+    activation memory, numerics equal to the full-batch step up to
+    summation order.  Batch size must divide evenly.
     """
     repl = NamedSharding(mesh, P())
     tok_shard = token_sharding(mesh)
@@ -87,15 +95,14 @@ def make_lm_train_step(
             for name, vals in by_name.items()
         }
 
-    def step(state: ModelState, tokens):
+    def grad_of(params, toks):
+        """((lm_loss, collected), grads) for one microbatch."""
         if need_inters:
-            def loss_of(params):
-                logits, mut = apply_fn(
-                    params, tokens, mutable=["intermediates"]
-                )
+            def loss_of(p):
+                logits, mut = apply_fn(p, toks, mutable=["intermediates"])
                 # flax omits the collection entirely when nothing was sown
                 collected = _collect_aux(mut.get("intermediates", {}))
-                lm = lm_loss(logits, tokens)
+                lm = lm_loss(logits, toks)
                 total = lm
                 if moe_balance_weight > 0.0 and "moe_balance_loss" in collected:
                     total = total + moe_balance_weight * collected[
@@ -103,14 +110,41 @@ def make_lm_train_step(
                 # grads flow from total; the reported loss stays plain LM CE
                 return total, (lm, collected)
 
-            (_, (loss, collected)), grads = jax.value_and_grad(
+            (_, out), grads = jax.value_and_grad(
                 loss_of, has_aux=True
-            )(state.params)
-        else:
-            def loss_of(params):
-                return lm_loss(apply_fn(params, tokens), tokens)
+            )(params)
+            return out, grads
 
-            loss, grads = jax.value_and_grad(loss_of)(state.params)
+        def loss_of(p):
+            return lm_loss(apply_fn(p, toks), toks)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        return (loss, {}), grads
+
+    def step(state: ModelState, tokens):
+        if accum_steps == 1:
+            (loss, collected), grads = grad_of(state.params, tokens)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} must divide into {accum_steps} accum steps"
+                )
+            chunks = tokens.reshape(accum_steps, b // accum_steps,
+                                    *tokens.shape[1:])
+            acc_shape = jax.eval_shape(grad_of, state.params, chunks[0])
+            acc0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                acc_shape)
+
+            def body(acc, chunk):
+                out = grad_of(state.params, chunk)
+                return jax.tree.map(jnp.add, acc, out), None
+
+            ((loss, collected), grads), _ = lax.scan(body, acc0, chunks)
+            scale = 1.0 / accum_steps
+            loss = loss * scale
+            collected = jax.tree.map(lambda a: a * scale, collected)
+            grads = jax.tree.map(lambda g: g * scale, grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = ModelState(params=new_params, opt_state=new_opt)
