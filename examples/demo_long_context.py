@@ -74,6 +74,9 @@ def get_args(argv=None):
     p.add_argument("--accum_steps", default=1, type=int,
                    help="gradient-accumulation microbatches per optimizer "
                         "step (peak activation memory / accum_steps)")
+    p.add_argument("--generate", default=0, type=int,
+                   help="after training, greedy-decode this many tokens "
+                        "from a prompt through the KV cache and print them")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -179,6 +182,20 @@ def main() -> None:
     final = float(loss)
     logger.finish()
     rank_print(f"final lm loss: {final:.4f}")
+    if args.generate > 0:
+        if jax.process_count() > 1:
+            # trained params span hosts (non-addressable from any one
+            # process); decoding is a single-host activity
+            rank_print("--generate skipped on multi-host runs")
+        else:
+            from tpudist.models import generate as lm_generate
+
+            prompt = make_batch(np.random.default_rng(args.seed + 1), 1,
+                                8, args.vocab)
+            out = lm_generate(module, state.params, jnp.asarray(prompt),
+                              max_new=args.generate)
+            rank_print(f"prompt {prompt[0].tolist()} -> "
+                       f"{np.asarray(out)[0, 8:].tolist()}")
     if ctx.is_distributed:
         from tpudist.runtime import shutdown
 

@@ -4,3 +4,8 @@ from tpudist.models.transformer import (  # noqa: F401
     create_transformer,
     lm_loss,
 )
+from tpudist.models.generate import (  # noqa: F401
+    decode_logits,
+    generate,
+    make_decode_step,
+)

@@ -1,0 +1,135 @@
+"""Autoregressive generation for TransformerLM via a KV cache.
+
+No reference counterpart (the reference trains a toy MLP and never
+samples); this completes the LM family with the standard inference path,
+TPU-first:
+
+- ONE compiled program: the whole decode loop is a ``lax.scan`` whose body
+  is the single-token cached forward — no per-token dispatch, no dynamic
+  shapes (the K/V cache is ``[max_len]`` with a mask cursor, see
+  ``Block._decode_attention``).
+- prompt consumption is teacher-forced inside the same scan (prefill and
+  decode share one program; at toy scale a separate batched prefill isn't
+  worth a second compilation).
+- works for both position encodings: learned tables read the cache's
+  position counter; RoPE rotates each token at its absolute offset.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def make_decode_step(module, params):
+    """Return ``(init_cache, step)``: ``init_cache(batch)`` builds a fresh
+    all-zeros KV cache, ``step(cache, tok[b,1]) -> (cache, logits[b,vocab])``
+    is the compiled single-token forward.
+
+    The cache covers ``module.max_len`` positions; exceeding it silently
+    attends over garbage — ``generate``/``decode_logits`` guard the budget.
+    """
+    # The sharded MoE closure (if any) cannot split a single decode token
+    # over its batch axis; the dense reference is numerically identical
+    # (same contract as create_transformer's init).
+    dec = module.clone(decode=True, moe_fn=None)
+
+    def step(cache, tok):
+        logits, mut = dec.apply(
+            {"params": params["params"], "cache": cache},
+            tok, mutable=["cache"],
+        )
+        return mut["cache"], logits[:, -1].astype(jnp.float32)
+
+    def init_cache(batch: int):
+        # eval_shape: the cache STRUCTURE without materializing a second
+        # parameter set (flax init would allocate + run a forward).  A
+        # fresh cache is all-zeros (K/V empty, cursors at 0).
+        shapes = jax.eval_shape(
+            dec.init, jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
+        )["cache"]
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    return init_cache, step
+
+
+def generate(
+    module,
+    params,
+    prompt: jax.Array,
+    max_new: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample ``max_new`` tokens after ``prompt [batch, plen]``.
+
+    ``temperature == 0`` is greedy argmax; otherwise categorical sampling
+    at that temperature.  Returns the full ``[batch, plen + max_new]``
+    sequence (prompt included).  The entire loop — prompt teacher-forcing
+    plus sampling — is one jitted ``lax.scan``.
+    """
+    batch, plen = prompt.shape
+    total = plen + max_new
+    if total > module.max_len:
+        raise ValueError(
+            f"prompt {plen} + max_new {max_new} exceeds the model's "
+            f"max_len {module.max_len} (the KV-cache size)"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    init_cache, step = make_decode_step(module, params)
+    cache0 = init_cache(batch)
+
+    def pick(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    @jax.jit
+    def run(cache, prompt, key):
+        def body(carry, i):
+            cache, tok, key = carry
+            cache, logits = step(cache, tok)
+            key, sub = jax.random.split(key)
+            sampled = pick(logits, sub)
+            # teacher-force while the next position is still in the prompt
+            forced = lax.dynamic_index_in_dim(
+                prompt, jnp.minimum(i + 1, plen - 1), axis=1, keepdims=False
+            )
+            nxt = jnp.where(i + 1 < plen, forced, sampled)
+            return (cache, nxt[:, None], key), nxt
+
+        (_, _, _), out = lax.scan(
+            body, (cache, prompt[:, :1], key), jnp.arange(total - 1)
+        )
+        return jnp.concatenate([prompt[:, :1], out.T], axis=1)
+
+    return run(cache0, prompt, rng)
+
+
+def decode_logits(module, params, tokens: jax.Array) -> jax.Array:
+    """Teacher-forced per-position logits through the KV-cache path —
+    must match ``module.apply(params, tokens)`` exactly (the consistency
+    oracle for the cache implementation; tests assert it)."""
+    batch, seq = tokens.shape
+    if seq > module.max_len:
+        raise ValueError(
+            f"sequence {seq} exceeds the model's max_len {module.max_len} "
+            "(the KV-cache size)"
+        )
+    init_cache, step = make_decode_step(module, params)
+
+    @jax.jit
+    def run(cache, tokens):
+        def body(cache, tok):
+            cache, logits = step(cache, tok[:, None])
+            return cache, logits
+
+        _, logits = lax.scan(body, cache, tokens.T)
+        return jnp.swapaxes(logits, 0, 1)  # [batch, seq, vocab]
+
+    return run(init_cache(batch), tokens)

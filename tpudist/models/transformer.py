@@ -52,17 +52,20 @@ def _default_attention(q, k, v):
     return blockwise_attention(q, k, v, causal=True, block_k=512)
 
 
-def rope_rotate(x: jax.Array, base: float = 10000.0) -> jax.Array:
+def rope_rotate(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
     """Rotary position embedding over ``[batch, heads, seq, head_dim]``.
 
     Angles are computed in f32 (precision-sensitive at long context) on the
     GLOBAL sequence axis — callers apply it before any seq sharding, so
     ring-attention shards see correct absolute positions.  Half-split
-    rotation (GPT-NeoX convention).
+    rotation (GPT-NeoX convention).  ``offset`` (static or traced scalar)
+    shifts positions — the KV-cache decode path rotates single tokens at
+    their absolute position.
     """
     half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(x.shape[-2], dtype=jnp.float32)[:, None] * freqs[None]
+    positions = offset + jnp.arange(x.shape[-2], dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None]
     sin, cos = jnp.sin(angles), jnp.cos(angles)
     # rotate in f32 (position precision at long context), cast back after
     x1 = x[..., :half].astype(jnp.float32)
@@ -150,6 +153,10 @@ class Block(nn.Module):
     moe_fn: Optional[Callable] = None
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
     rope: bool = False  # rotary q/k position encoding (no learned pos table)
+    # Autoregressive decode mode: single-token inputs attend over a
+    # ``max_len`` K/V cache carried in the flax "cache" collection.
+    decode: bool = False
+    max_len: int = 2048  # cache length (decode only)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -166,9 +173,12 @@ class Block(nn.Module):
             return t.reshape(b, s, self.n_heads, dh).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if self.rope:
-            q, k = rope_rotate(q), rope_rotate(k)
-        attn = self.attention_fn(q, k, v)
+        if self.decode:
+            attn = self._decode_attention(q, k, v)
+        else:
+            if self.rope:
+                q, k = rope_rotate(q), rope_rotate(k)
+            attn = self.attention_fn(q, k, v)
         b, nh, s, _ = attn.shape
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
         x = x + nn.Dense(self.d_model, use_bias=False, name="proj",
@@ -183,6 +193,38 @@ class Block(nn.Module):
         h = nn.gelu(h)
         return x + nn.Dense(self.d_model, use_bias=False, name="wo",
                             dtype=self.dtype)(h)
+
+    def _decode_attention(self, q, k, v):
+        """Single-token cached attention: write this step's K/V at the
+        cache cursor, attend causally over the filled prefix.  Static
+        shapes ([max_len] cache, mask instead of slicing) keep the decode
+        step one compiled program."""
+        b, nh, s, dh = q.shape
+        if s != 1:
+            raise ValueError(f"decode consumes one token at a time, got {s}")
+        ck = self.variable("cache", "k", jnp.zeros,
+                           (b, nh, self.max_len, dh), self.dtype)
+        cv = self.variable("cache", "v", jnp.zeros,
+                           (b, nh, self.max_len, dh), self.dtype)
+        ci = self.variable("cache", "idx",
+                           lambda: jnp.zeros((), jnp.int32))
+        pos = ci.value
+        if self.rope:
+            q = rope_rotate(q, offset=pos)
+            k = rope_rotate(k, offset=pos)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(self.dtype), (0, 0, pos, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(self.dtype), (0, 0, pos, 0))
+        ci.value = pos + 1
+        scale = dh ** -0.5
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
+                            preferred_element_type=jnp.float32) * scale
+        live = jnp.arange(self.max_len) <= pos
+        scores = jnp.where(live[None, None, None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w.astype(self.dtype), cv.value,
+                          preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -206,6 +248,9 @@ class TransformerLM(nn.Module):
     # Rotary position encoding on q/k instead of the learned position
     # table — length-extrapolating, the modern long-context default.
     rope: bool = False
+    # KV-cache decode mode (see tpudist.models.generate): one token per
+    # call, positions tracked in the flax "cache" collection.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -215,16 +260,22 @@ class TransformerLM(nn.Module):
         x = nn.Embed(self.vocab, self.d_model, name="tok_embed",
                      dtype=self.dtype)(tokens)
         if not self.rope:
+            if self.decode:
+                pi = self.variable("cache", "pos",
+                                   lambda: jnp.zeros((), jnp.int32))
+                positions = pi.value + jnp.arange(seq, dtype=jnp.int32)
+                pi.value = pi.value + seq
+            else:
+                positions = jnp.arange(seq, dtype=jnp.int32)
             pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
-                           dtype=self.dtype)(
-                jnp.arange(seq, dtype=jnp.int32)
-            )
+                           dtype=self.dtype)(positions)
             x = x + pos[None]
         for i in range(self.n_layers):
             x = Block(
                 self.d_model, self.n_heads, self.d_ff, attn,
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
-                dtype=self.dtype, rope=self.rope, name=f"block_{i}",
+                dtype=self.dtype, rope=self.rope, decode=self.decode,
+                max_len=self.max_len, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
         return nn.Dense(self.vocab, use_bias=False, name="head",
