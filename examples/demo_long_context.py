@@ -77,6 +77,13 @@ def get_args(argv=None):
     p.add_argument("--generate", default=0, type=int,
                    help="after training, greedy-decode this many tokens "
                         "from a prompt through the KV cache and print them")
+    p.add_argument("--data_path", default=None, type=str,
+                   help="tokenized corpus (.npy or raw binary token "
+                        "stream); default: the synthetic increment-chain "
+                        "task")
+    p.add_argument("--data_dtype", default=None, type=str,
+                   help="raw-binary token dtype (default uint16; .npy "
+                        "files carry their own)")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -157,12 +164,44 @@ def main() -> None:
                           dry_run=args.dry_run)
     rng = np.random.default_rng(args.seed)
     tok_shard = token_sharding(mesh)
+    corpus = None
+    corpus_windows = None
+    if args.data_path is not None:
+        from tpudist.data import make_lm_loader
+
+        # per-process shard of the corpus windows; each process contributes
+        # its own rows of the globally-sharded batch (device_put_global)
+        corpus_windows, corpus = make_lm_loader(
+            args.data_path, seq_len=args.seq_len,
+            batch_size=args.batch_size, dtype=args.data_dtype,
+            num_shards=jax.process_count(), shard_id=jax.process_index(),
+            seed=args.seed, mode=args.dataloader,
+        )
+        max_tok = int(np.max(corpus_windows.tokens))
+        if max_tok >= args.vocab:
+            raise SystemExit(
+                f"--data_path holds token id {max_tok} but --vocab is "
+                f"{args.vocab}: raise --vocab (embedding gathers clamp "
+                "silently)"
+            )
+
+    def place(batch):
+        """Synthetic batches are identical on every process (shared-seed
+        rng) so a plain transfer slices consistently; corpus shards are
+        per-process-DISJOINT and must assemble via process-local data."""
+        if corpus is not None:
+            from tpudist.comm.collectives import device_put_global
+
+            return device_put_global(np.asarray(batch), tok_shard)
+        return jax.device_put(batch, tok_shard)
+
     loss = None
     with trace(args.profile_dir):
         for it in range(args.total_iterations):
-            tokens = jax.device_put(
-                make_batch(rng, args.batch_size, args.seq_len, args.vocab),
-                tok_shard,
+            tokens = place(
+                next(corpus) if corpus is not None
+                else make_batch(rng, args.batch_size, args.seq_len,
+                                args.vocab),
             )
             if args.moe_experts > 0:
                 state, loss, aux = step(state, tokens)
@@ -190,8 +229,13 @@ def main() -> None:
         else:
             from tpudist.models import generate as lm_generate
 
-            prompt = make_batch(np.random.default_rng(args.seed + 1), 1,
-                                8, args.vocab)
+            if corpus_windows is not None:
+                # prompt from the training distribution: the first 8
+                # tokens of the corpus's first window
+                prompt = corpus_windows.gather(np.zeros(1, np.int64))[:, :8]
+            else:
+                prompt = make_batch(np.random.default_rng(args.seed + 1), 1,
+                                    8, args.vocab)
             out = lm_generate(module, state.params, jnp.asarray(prompt),
                               max_new=args.generate)
             rank_print(f"prompt {prompt[0].tolist()} -> "

@@ -6,3 +6,9 @@ from tpudist.data.native_loader import (  # noqa: F401
     make_loader,
     native_available,
 )
+from tpudist.data.lm import (  # noqa: F401
+    TokenWindows,
+    lm_batches,
+    make_lm_loader,
+    open_token_stream,
+)

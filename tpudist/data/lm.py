@@ -1,0 +1,132 @@
+"""Tokenized-corpus data path for the LM family.
+
+The reference's only dataset is 512 synthetic regression samples
+(``toy_model_and_data.py:27-36``); the LM family needs a real corpus
+format.  TPU-first design:
+
+- the corpus is ONE flat token stream on disk (``.npy`` of any integer
+  dtype, or a raw little-endian binary given ``--vocab``-appropriate
+  ``dtype``), opened with ``np.memmap`` — no RAM proportional to corpus
+  size, and byte-offset windows are O(1) to slice;
+- a "sample" is a ``seq_len``-token window at stride ``seq_len`` —
+  :func:`tpudist.models.transformer.lm_loss` shifts internally, so the
+  window IS both inputs and targets (the demos' batch shape);
+- window order reuses :class:`tpudist.data.sharding.ShardPlan` — the same
+  seeded per-epoch permutation + strided shard assignment that gives the
+  toy path its DistributedSampler determinism (``demo.py:96-98,139-154``),
+  so every process draws disjoint windows and re-shuffles each epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+from tpudist.data.sharding import ShardPlan, epoch_indices
+
+
+def open_token_stream(path: str | Path, dtype: Optional[str] = None) -> np.ndarray:
+    """Memory-map a 1-D token stream.
+
+    ``.npy`` files carry their own dtype/shape (loaded with
+    ``mmap_mode="r"``); anything else is treated as a raw binary stream of
+    ``dtype`` (default ``uint16`` — vocabularies ≤ 65536, GPT-2-style).
+    """
+    path = Path(path)
+    if path.suffix == ".npy":
+        arr = np.load(path, mmap_mode="r")
+        if arr.ndim != 1:
+            raise ValueError(f"{path}: expected a 1-D token stream, got {arr.shape}")
+        return arr
+    return np.memmap(path, dtype=np.dtype(dtype or "uint16"), mode="r")
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenWindows:
+    """Window addressing over a token stream: sample i covers
+    ``[i·seq_len, (i+1)·seq_len)``."""
+
+    tokens: np.ndarray
+    seq_len: int
+
+    def __post_init__(self):
+        if len(self.tokens) < self.seq_len:
+            raise ValueError(
+                f"stream of {len(self.tokens)} tokens is shorter than one "
+                f"window ({self.seq_len})"
+            )
+
+    def __len__(self) -> int:
+        return len(self.tokens) // self.seq_len
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        """``[len(idx), seq_len]`` int32 batch of windows."""
+        starts = idx.astype(np.int64) * self.seq_len
+        offsets = np.arange(self.seq_len, dtype=np.int64)
+        return np.asarray(
+            self.tokens[starts[:, None] + offsets[None, :]], dtype=np.int32
+        )
+
+
+def lm_batches(
+    windows: TokenWindows,
+    plan: ShardPlan,
+    batch_size: int,
+    *,
+    start_epoch: int = 0,
+) -> Iterator[np.ndarray]:
+    """Endless stream of ``[batch_size, seq_len]`` int32 batches.
+
+    Deterministic: epoch e's window order is ``epoch_indices(plan, e)``
+    (same on every process; each takes its own shard), consumed in
+    ``batch_size`` chunks with the ragged tail dropped (the equal-batch
+    contract, ``demo.py:113``).
+    """
+    # validate EAGERLY (a generator body would defer this to first next())
+    if plan.samples_per_shard < batch_size:
+        raise ValueError(
+            f"shard holds {plan.samples_per_shard} windows — fewer than "
+            f"one batch of {batch_size}; the stream would never yield "
+            "(shrink batch_size/seq_len or grow the corpus)"
+        )
+
+    def gen():
+        epoch = start_epoch
+        while True:
+            idx = epoch_indices(plan, epoch)
+            for i in range(0, len(idx) - batch_size + 1, batch_size):
+                yield windows.gather(idx[i : i + batch_size])
+            epoch += 1
+
+    return gen()
+
+
+def make_lm_loader(
+    path: str | Path,
+    *,
+    seq_len: int,
+    batch_size: int,
+    num_shards: int = 1,
+    shard_id: int = 0,
+    seed: int = 0,
+    dtype: Optional[str] = None,
+    mode: str = "distributed",
+):
+    """One-call corpus loader: ``(windows, batches_iterator)``.
+
+    ``batch_size`` is per shard (per process); batches come back
+    ``[batch, seq_len]`` int32, ready for
+    :func:`tpudist.models.transformer.lm_loss` (which shifts internally).
+    """
+    windows = TokenWindows(open_token_stream(path, dtype), seq_len)
+    plan = ShardPlan(
+        num_samples=len(windows),
+        num_shards=num_shards,
+        shard_id=shard_id,
+        seed=seed,
+        mode=mode,
+    )
+    return windows, lm_batches(windows, plan, batch_size)
