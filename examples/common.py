@@ -4,7 +4,6 @@
 from __future__ import annotations
 
 import jax
-import optax
 
 from tpudist.comm.collectives import MetricBackend
 from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
@@ -52,8 +51,13 @@ def build_training(args, mesh, *, state_sharding_fn=None):
     ``state_sharding_fn(mesh, states) -> sharding pytree`` overrides the
     default replicated parameter layout (used by the model-split demo).
     """
+    from tpudist.train import build_optimizer
+
     models = build_two_models(args.seed)
-    tx = optax.adam(args.lr)  # demo.py:80-81
+    # demo.py:80-81 (Adam), plus the shared schedule contract
+    tx = build_optimizer(args.lr, schedule=args.lr_schedule,
+                         warmup_steps=args.warmup_steps,
+                         total_steps=args.total_iterations)
     states = init_model_states(models, tx)
     state_sharding = None
     if state_sharding_fn is not None:

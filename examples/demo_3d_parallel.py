@@ -23,7 +23,6 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
-import optax  # noqa: E402
 
 from demo_long_context import make_batch  # noqa: E402
 
@@ -80,7 +79,11 @@ def main() -> None:
         n_layers=args.n_layers,
         max_len=args.seq_len,
     )
-    tx = optax.adam(args.lr)
+    from tpudist.train import build_optimizer
+
+    tx = build_optimizer(args.lr, schedule=args.lr_schedule,
+                         warmup_steps=args.warmup_steps,
+                         total_steps=args.total_iterations)
     state = init_lm_state(params, tx)
     sharding = transformer_tp_sharding(mesh, state)
     state = jax.device_put(state, sharding)

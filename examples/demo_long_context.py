@@ -28,7 +28,6 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-import optax  # noqa: E402
 
 from tpudist.config import build_parser, get_args as parse_args  # noqa: E402
 from tpudist.models import create_transformer  # noqa: E402
@@ -84,6 +83,11 @@ def get_args(argv=None):
     p.add_argument("--data_dtype", default=None, type=str,
                    help="raw-binary token dtype (default uint16; .npy "
                         "files carry their own)")
+    p.add_argument("--eval_fraction", default=0.0, type=float,
+                   help="hold out this tail fraction of --data_path "
+                        "windows for evaluation")
+    p.add_argument("--eval_every", default=50, type=int,
+                   help="evaluate the held-out set every N iterations")
     p.set_defaults(batch_size=8, total_iterations=300, lr=3e-4)
     return parse_args(argv, parser=p)
 
@@ -142,7 +146,11 @@ def main() -> None:
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         rope=args.rope,
     )
-    tx = optax.adam(args.lr)
+    from tpudist.train import build_optimizer
+
+    tx = build_optimizer(args.lr, schedule=args.lr_schedule,
+                         warmup_steps=args.warmup_steps,
+                         total_steps=args.total_iterations)
     state = init_lm_state(params, tx)
     state_sharding = None
     if args.fsdp:
@@ -171,11 +179,12 @@ def main() -> None:
 
         # per-process shard of the corpus windows; each process contributes
         # its own rows of the globally-sharded batch (device_put_global)
-        corpus_windows, corpus = make_lm_loader(
+        corpus_windows, corpus, eval_idx = make_lm_loader(
             args.data_path, seq_len=args.seq_len,
             batch_size=args.batch_size, dtype=args.data_dtype,
             num_shards=jax.process_count(), shard_id=jax.process_index(),
             seed=args.seed, mode=args.dataloader,
+            eval_fraction=args.eval_fraction,
         )
         max_tok = int(np.max(corpus_windows.tokens))
         if max_tok >= args.vocab:
@@ -195,6 +204,29 @@ def main() -> None:
             return device_put_global(np.asarray(batch), tok_shard)
         return jax.device_put(batch, tok_shard)
 
+    eval_step = None
+    if corpus is not None and len(eval_idx) >= args.batch_size:
+        from tpudist.train import make_lm_eval_step
+
+        eval_step = make_lm_eval_step(
+            module.apply, mesh,
+            params_sharding=None if state_sharding is None
+            else state_sharding.params,
+        )
+        # fixed held-out batches (up to 4), identical on every process;
+        # placed through the same global-assembly path as training batches
+        # so the data-axis divisibility contract matches multi-host
+        n_eval_batches = min(4, len(eval_idx) // args.batch_size)
+        eval_batches = [
+            place(corpus_windows.gather(
+                eval_idx[i * args.batch_size:(i + 1) * args.batch_size]))
+            for i in range(n_eval_batches)
+        ]
+
+        def eval_loss(params):
+            return float(np.mean([float(eval_step(params, b))
+                                  for b in eval_batches]))
+
     loss = None
     with trace(args.profile_dir):
         for it in range(args.total_iterations):
@@ -208,8 +240,11 @@ def main() -> None:
             else:
                 state, loss = step(state, tokens)
                 aux = {}
-            if it % args.log_every == 0:
+            do_eval = eval_step is not None and it % args.eval_every == 0
+            if it % args.log_every == 0 or do_eval:
                 row = {"loss/lm": float(loss), "iteration": it}
+                if do_eval:
+                    row["loss/eval"] = eval_loss(state.params)
                 if "moe_dropped_fraction" in aux:
                     row["moe/dropped_fraction"] = float(
                         aux["moe_dropped_fraction"]

@@ -117,11 +117,88 @@ class TestEndToEnd:
         near zero — the full --data_path path."""
         path, _ = _chain_file(tmp_path, n_tokens=16384, vocab=16)
         mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
-        _, batches = make_lm_loader(path, seq_len=32, batch_size=8, seed=0)
+        _, batches, _ = make_lm_loader(path, seq_len=32, batch_size=8, seed=0)
         module, params = create_transformer(
             jax.random.PRNGKey(0), seq_len=32, rope=True,
             vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
         tx = optax.adam(3e-3)
+        state = init_lm_state(params, tx)
+        step = make_lm_train_step(module.apply, tx, mesh)
+        for _ in range(150):
+            state, loss = step(
+                state, jax.device_put(jnp.asarray(next(batches)),
+                                      token_sharding(mesh)))
+        assert float(loss) < 0.3, float(loss)
+
+
+class TestEvalSplit:
+    def test_holdout_disjoint_from_training(self, tmp_path):
+        path, _ = _random_file(tmp_path)
+        w, train_iter, eval_idx = make_lm_loader(
+            path, seq_len=64, batch_size=4, eval_fraction=0.25)
+        n = len(w)
+        assert len(eval_idx) == int(n * 0.25)
+        assert eval_idx.min() == n - len(eval_idx)  # contiguous tail
+        eval_rows = {tuple(r) for r in w.gather(eval_idx).tolist()}
+        # two epochs of training batches never touch the held-out tail
+        per_epoch = (n - len(eval_idx)) // 4
+        for _ in range(2 * per_epoch):
+            batch = next(train_iter)
+            assert eval_rows.isdisjoint({tuple(r) for r in batch.tolist()})
+
+    def test_bad_fraction_rejected(self, tmp_path):
+        path, _ = _random_file(tmp_path)
+        with pytest.raises(ValueError, match="eval_fraction"):
+            make_lm_loader(path, seq_len=64, batch_size=4, eval_fraction=1.0)
+
+
+class TestOptimAndEvalStep:
+    def test_schedules_shape(self):
+        from tpudist.train import build_schedule
+
+        assert build_schedule(1e-3) == 1e-3
+        cos = build_schedule(1e-3, schedule="cosine", total_steps=100)
+        assert abs(float(cos(0)) - 1e-3) < 1e-9
+        assert float(cos(100)) < 1.5e-4  # decayed to ~min_lr_ratio
+        wc = build_schedule(1e-3, schedule="warmup_cosine",
+                            warmup_steps=10, total_steps=100)
+        assert float(wc(0)) == 0.0
+        assert abs(float(wc(10)) - 1e-3) < 1e-9
+        assert float(wc(100)) <= 1.01e-4 + 1e-9
+        with pytest.raises(ValueError, match="unknown lr schedule"):
+            build_schedule(1e-3, schedule="linear")
+
+    def test_eval_step_matches_train_loss(self, tmp_path, devices):
+        """Eval loss on the training batch equals the train step's
+        reported loss before the update."""
+        from tpudist.train import make_lm_eval_step
+
+        mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32,
+            vocab=16, d_model=32, n_layers=1, n_heads=2, d_ff=64, max_len=32)
+        tx = optax.adam(1e-3)
+        state = init_lm_state(params, tx)
+        step = make_lm_train_step(module.apply, tx, mesh, donate_state=False)
+        ev = make_lm_eval_step(module.apply, mesh)
+        tokens = jax.device_put(
+            jnp.asarray(np.random.default_rng(0).integers(0, 16, (8, 32)),
+                        jnp.int32), token_sharding(mesh))
+        _, train_loss = step(state, tokens)
+        np.testing.assert_allclose(float(ev(params, tokens)),
+                                   float(train_loss), rtol=1e-6)
+
+    def test_warmup_cosine_trains(self, tmp_path, devices):
+        from tpudist.train import build_optimizer
+
+        path, _ = _chain_file(tmp_path, n_tokens=16384, vocab=16)
+        mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+        _, batches, _ = make_lm_loader(path, seq_len=32, batch_size=8, seed=0)
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32, rope=True,
+            vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+        tx = build_optimizer(6e-3, schedule="warmup_cosine",
+                             warmup_steps=20, total_steps=150)
         state = init_lm_state(params, tx)
         step = make_lm_train_step(module.apply, tx, mesh)
         for _ in range(150):

@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", default=256, type=int,
                    help="per-process batch size")
     p.add_argument("--lr", default=1e-3, type=float)
+    p.add_argument("--lr_schedule",
+                   choices=["constant", "cosine", "warmup_cosine"],
+                   default="constant",
+                   help="learning-rate schedule over --total_iterations")
+    p.add_argument("--warmup_steps", default=0, type=int,
+                   help="linear warmup steps (warmup_cosine)")
     p.add_argument("--log_every", default=1, type=int)
     p.add_argument("--project", default="tpudist", type=str)
     p.add_argument("--group", default=None, type=str)

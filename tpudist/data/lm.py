@@ -114,19 +114,34 @@ def make_lm_loader(
     seed: int = 0,
     dtype: Optional[str] = None,
     mode: str = "distributed",
+    eval_fraction: float = 0.0,
 ):
-    """One-call corpus loader: ``(windows, batches_iterator)``.
+    """One-call corpus loader: ``(windows, train_iterator, eval_indices)``.
 
     ``batch_size`` is per shard (per process); batches come back
     ``[batch, seq_len]`` int32, ready for
     :func:`tpudist.models.transformer.lm_loss` (which shifts internally).
+
+    ``eval_fraction`` > 0 holds out the corpus TAIL (the last fraction of
+    windows — a contiguous held-out region, no shuffling leakage) from the
+    training stream; the held-out window indices come back as
+    ``eval_indices`` (`np.ndarray`, empty when 0) for
+    ``windows.gather``-built eval batches.
     """
+    if not 0.0 <= eval_fraction < 1.0:
+        raise ValueError(f"eval_fraction {eval_fraction} must be in [0, 1)")
     windows = TokenWindows(open_token_stream(path, dtype), seq_len)
+    n = len(windows)
+    n_eval = int(n * eval_fraction)
+    n_train = n - n_eval
+    if n_train < 1:
+        raise ValueError("eval_fraction leaves no training windows")
     plan = ShardPlan(
-        num_samples=len(windows),
+        num_samples=n_train,
         num_shards=num_shards,
         shard_id=shard_id,
         seed=seed,
         mode=mode,
     )
-    return windows, lm_batches(windows, plan, batch_size)
+    eval_idx = np.arange(n_train, n, dtype=np.int64)
+    return windows, lm_batches(windows, plan, batch_size), eval_idx

@@ -8,6 +8,12 @@ from tpudist.train.step import (  # noqa: F401
 from tpudist.train.loop import TrainLoopConfig, run_training  # noqa: F401
 from tpudist.train.lm import (  # noqa: F401
     init_lm_state,
+    make_lm_eval_step,
     make_lm_train_step,
     token_sharding,
+)
+from tpudist.train.optim import (  # noqa: F401
+    SCHEDULES,
+    build_optimizer,
+    build_schedule,
 )

@@ -36,6 +36,30 @@ def init_lm_state(params, tx: optax.GradientTransformation) -> ModelState:
     return ModelState(params=params, opt_state=tx.init(params))
 
 
+def make_lm_eval_step(
+    apply_fn: Callable,
+    mesh: Mesh,
+    *,
+    params_sharding=None,
+):
+    """Jitted no-grad evaluation: ``eval_step(params, tokens) -> loss``.
+
+    Same sharded-batch contract as the train step; ``params_sharding``
+    matches whatever layout the train step keeps (replicated default, or
+    e.g. an FSDP/TP sharding tree for ``ModelState.params``)."""
+    repl = NamedSharding(mesh, P())
+    p_shard = repl if params_sharding is None else params_sharding
+
+    def eval_step(params, tokens):
+        return lm_loss(apply_fn(params, tokens), tokens)
+
+    return jax.jit(
+        eval_step,
+        in_shardings=(p_shard, token_sharding(mesh)),
+        out_shardings=repl,
+    )
+
+
 def make_lm_train_step(
     apply_fn: Callable,
     tx: optax.GradientTransformation,

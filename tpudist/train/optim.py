@@ -1,0 +1,63 @@
+"""Optimizer construction with learning-rate schedules.
+
+The reference pins Adam(1e-3) everywhere (``demo.py:80-81``); real LM
+training needs warmup + decay.  One helper owns the mapping from the
+shared CLI contract (``--lr/--lr_schedule/--warmup_steps``) to an optax
+transformation so every entry point and the Trainer agree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import optax
+
+SCHEDULES = ("constant", "cosine", "warmup_cosine")
+
+
+def build_schedule(
+    lr: float,
+    *,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int = 1000,
+    min_lr_ratio: float = 0.1,
+) -> Union[float, Callable]:
+    """An optax schedule (or plain float for ``constant``).
+
+    - ``constant``: fixed ``lr``.
+    - ``cosine``: cosine decay from ``lr`` to ``lr·min_lr_ratio`` over
+      ``total_steps``.
+    - ``warmup_cosine``: linear 0 → ``lr`` over ``warmup_steps``, then the
+      cosine decay over the remainder.
+    """
+    if schedule == "constant":
+        return lr
+    if schedule == "cosine":
+        return optax.cosine_decay_schedule(
+            lr, decay_steps=max(total_steps, 1), alpha=min_lr_ratio
+        )
+    if schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=max(total_steps, warmup_steps + 1),
+            end_value=lr * min_lr_ratio,
+        )
+    raise ValueError(f"unknown lr schedule {schedule!r}; pick from {SCHEDULES}")
+
+
+def build_optimizer(
+    lr: float,
+    *,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int = 1000,
+    min_lr_ratio: float = 0.1,
+) -> optax.GradientTransformation:
+    """Adam over :func:`build_schedule` — the one optimizer factory."""
+    return optax.adam(build_schedule(
+        lr, schedule=schedule, warmup_steps=warmup_steps,
+        total_steps=total_steps, min_lr_ratio=min_lr_ratio,
+    ))

@@ -49,7 +49,10 @@ class TrainerModule:
     def configure_optimizers(self):
         """Return one optax transformation, or a per-model dict — the
         ``configure_optimizers`` returning a list of Adams analog
-        (``demo_pytorch_lightning.py:35-40``)."""
+        (``demo_pytorch_lightning.py:35-40``).  For LR schedules use
+        :func:`tpudist.train.build_optimizer` (owning the optimizer is the
+        module's job, the Lightning contract, so the Trainer does not read
+        ``--lr_schedule`` itself)."""
         return optax.adam(1e-3)
 
     def loss(self, pred: jax.Array, target: jax.Array) -> jax.Array:
