@@ -51,13 +51,11 @@ def build_training(args, mesh, *, state_sharding_fn=None):
     ``state_sharding_fn(mesh, states) -> sharding pytree`` overrides the
     default replicated parameter layout (used by the model-split demo).
     """
-    from tpudist.train import build_optimizer
+    from tpudist.train import build_optimizer_from_args
 
     models = build_two_models(args.seed)
     # demo.py:80-81 (Adam), plus the shared schedule contract
-    tx = build_optimizer(args.lr, schedule=args.lr_schedule,
-                         warmup_steps=args.warmup_steps,
-                         total_steps=args.total_iterations)
+    tx = build_optimizer_from_args(args)
     states = init_model_states(models, tx)
     state_sharding = None
     if state_sharding_fn is not None:

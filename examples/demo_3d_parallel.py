@@ -79,11 +79,9 @@ def main() -> None:
         n_layers=args.n_layers,
         max_len=args.seq_len,
     )
-    from tpudist.train import build_optimizer
+    from tpudist.train import build_optimizer_from_args
 
-    tx = build_optimizer(args.lr, schedule=args.lr_schedule,
-                         warmup_steps=args.warmup_steps,
-                         total_steps=args.total_iterations)
+    tx = build_optimizer_from_args(args)
     state = init_lm_state(params, tx)
     sharding = transformer_tp_sharding(mesh, state)
     state = jax.device_put(state, sharding)

@@ -70,6 +70,9 @@ def get_args(argv=None):
     p.add_argument("--rope", action="store_true",
                    help="rotary position encoding instead of the learned "
                         "position table (length-extrapolating)")
+    p.add_argument("--n_kv_heads", default=None, type=int,
+                   help="grouped-query attention: K/V heads shared by "
+                        "query-head groups (default: = heads, plain MHA)")
     p.add_argument("--accum_steps", default=1, type=int,
                    help="gradient-accumulation microbatches per optimizer "
                         "step (peak activation memory / accum_steps)")
@@ -145,12 +148,11 @@ def main() -> None:
         moe_fn=moe_fn,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         rope=args.rope,
+        n_kv_heads=args.n_kv_heads,
     )
-    from tpudist.train import build_optimizer
+    from tpudist.train import build_optimizer_from_args
 
-    tx = build_optimizer(args.lr, schedule=args.lr_schedule,
-                         warmup_steps=args.warmup_steps,
-                         total_steps=args.total_iterations)
+    tx = build_optimizer_from_args(args)
     state = init_lm_state(params, tx)
     state_sharding = None
     if args.fsdp:
@@ -204,7 +206,15 @@ def main() -> None:
             return device_put_global(np.asarray(batch), tok_shard)
         return jax.device_put(batch, tok_shard)
 
+    if args.eval_fraction > 0 and corpus is None:
+        raise SystemExit("--eval_fraction needs --data_path (the synthetic "
+                         "task has no held-out set)")
     eval_step = None
+    if corpus is not None and 0 < len(eval_idx) < args.batch_size:
+        rank_print(
+            f"WARNING: eval disabled — the held-out tail has {len(eval_idx)}"
+            f" windows, fewer than one batch of {args.batch_size}"
+        )
     if corpus is not None and len(eval_idx) >= args.batch_size:
         from tpudist.train import make_lm_eval_step
 

@@ -268,6 +268,80 @@ class TestMoETransformer:
         assert dense_aux == {}
 
 
+class TestGQA:
+    def test_full_kv_heads_is_mha(self):
+        """n_kv_heads == n_heads produces the identical model (same param
+        shapes, same logits) as leaving it unset."""
+        tokens = _tokens(batch=2, seq=32)
+        mha, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                         **CFG)
+        gqa, params_g = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                           n_kv_heads=CFG["n_heads"], **CFG)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), params, params_g)
+        np.testing.assert_array_equal(np.asarray(mha.apply(params, tokens)),
+                                      np.asarray(gqa.apply(params_g, tokens)))
+
+    def test_kv_projection_smaller_and_causal(self):
+        module, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                            n_kv_heads=1, **CFG)
+        dh = CFG["d_model"] // CFG["n_heads"]
+        kern = params["params"]["block_0"]["qkv"]["kernel"]
+        assert kern.shape == (CFG["d_model"], CFG["d_model"] + 2 * dh)
+        tokens = _tokens(batch=2, seq=32)
+        out = module.apply(params, tokens)
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % CFG["vocab"])
+        out2 = module.apply(params, tokens2)
+        np.testing.assert_allclose(np.asarray(out[:, :-1]),
+                                   np.asarray(out2[:, :-1]),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_invalid_kv_heads_raises(self):
+        with pytest.raises(ValueError, match="divide"):
+            create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                               n_kv_heads=3, **CFG)
+
+    def test_gqa_decode_matches_forward_and_shrinks_cache(self):
+        from tpudist.models import decode_logits, make_decode_step
+
+        cfg = dict(CFG, n_heads=4)
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32, n_kv_heads=2, rope=True, **cfg)
+        tokens = _tokens(batch=2, seq=32)
+        np.testing.assert_allclose(
+            np.asarray(decode_logits(module, params, tokens)),
+            np.asarray(module.apply(params, tokens).astype(jnp.float32)),
+            atol=1e-4, rtol=1e-4)
+        init_cache, _ = make_decode_step(module, params)
+        cache = init_cache(2)
+        k = cache["block_0"]["k"]
+        assert k.shape[1] == 2  # n_kv_heads, not n_heads
+
+    def test_gqa_trains_with_ring(self, devices):
+        mesh = Mesh(np.asarray(devices).reshape(4, 2),
+                    axis_names=(AXIS_DATA, AXIS_SEQ))
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=32, n_kv_heads=2, rope=True,
+            attention_fn=make_ring_attention(mesh, causal=True,
+                                             batch_axis=AXIS_DATA),
+            **CFG)
+        tx = optax.adam(1e-3)
+        state = init_lm_state(params, tx)
+        step = make_lm_train_step(module.apply, tx, mesh)
+        rng = np.random.default_rng(0)
+        shard = token_sharding(mesh)
+        first = None
+        for _ in range(30):
+            start = rng.integers(0, CFG["vocab"], size=(8, 1))
+            toks = jax.device_put(
+                jnp.asarray((start + np.arange(32)[None]) % CFG["vocab"],
+                            jnp.int32), shard)
+            state, loss = step(state, toks)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first * 0.7, (first, float(loss))
+
+
 class TestGradAccumulation:
     def test_matches_full_batch(self, devices):
         """accum_steps=4 == full-batch step: identical reported loss and

@@ -153,6 +153,11 @@ class Block(nn.Module):
     moe_fn: Optional[Callable] = None
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
     rope: bool = False  # rotary q/k position encoding (no learned pos table)
+    # Grouped-query attention: project K/V at this many heads (must divide
+    # n_heads; None = n_heads = plain MHA).  K/V broadcast to full heads
+    # before the attention op — every implementation works unchanged — and
+    # the decode cache stores only n_kv_heads (the GQA memory win).
+    n_kv_heads: Optional[int] = None
     # Autoregressive decode mode: single-token inputs attend over a
     # ``max_len`` K/V cache carried in the flax "cache" collection.
     decode: bool = False
@@ -161,23 +166,37 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         dh = self.d_model // self.n_heads
+        n_kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        if not 1 <= n_kv <= self.n_heads or self.n_heads % n_kv:
+            raise ValueError(
+                f"n_kv_heads {n_kv} must be in [1, {self.n_heads}] and "
+                f"divide n_heads {self.n_heads}")
+        kv_dim = n_kv * dh
         # LayerNorm statistics in f32 for stability; projections compute in
         # ``dtype`` (flax casts inputs + the f32 master params at apply).
         h = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv",
-                       dtype=self.dtype)(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = nn.Dense(self.d_model + 2 * kv_dim, use_bias=False,
+                       name="qkv", dtype=self.dtype)(h)
+        q = qkv[..., : self.d_model]
+        k = qkv[..., self.d_model : self.d_model + kv_dim]
+        v = qkv[..., self.d_model + kv_dim :]
 
-        def heads(t):  # [b, s, d] -> [b, h, s, dh]
+        def heads(t, n):  # [b, s, n·dh] -> [b, n, s, dh]
             b, s, _ = t.shape
-            return t.reshape(b, s, self.n_heads, dh).transpose(0, 2, 1, 3)
+            return t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        q = heads(q, self.n_heads)
+        k = heads(k, n_kv)
+        v = heads(v, n_kv)
         if self.decode:
             attn = self._decode_attention(q, k, v)
         else:
             if self.rope:
                 q, k = rope_rotate(q), rope_rotate(k)
+            if n_kv != self.n_heads:
+                group = self.n_heads // n_kv
+                k = jnp.repeat(k, group, axis=1)
+                v = jnp.repeat(v, group, axis=1)
             attn = self.attention_fn(q, k, v)
         b, nh, s, _ = attn.shape
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
@@ -198,14 +217,16 @@ class Block(nn.Module):
         """Single-token cached attention: write this step's K/V at the
         cache cursor, attend causally over the filled prefix.  Static
         shapes ([max_len] cache, mask instead of slicing) keep the decode
-        step one compiled program."""
+        step one compiled program.  The cache is sized by the K/V head
+        count — GQA models pay n_kv_heads/n_heads of the MHA cache."""
         b, nh, s, dh = q.shape
+        n_kv = k.shape[1]
         if s != 1:
             raise ValueError(f"decode consumes one token at a time, got {s}")
         ck = self.variable("cache", "k", jnp.zeros,
-                           (b, nh, self.max_len, dh), self.dtype)
+                           (b, n_kv, self.max_len, dh), self.dtype)
         cv = self.variable("cache", "v", jnp.zeros,
-                           (b, nh, self.max_len, dh), self.dtype)
+                           (b, n_kv, self.max_len, dh), self.dtype)
         ci = self.variable("cache", "idx",
                            lambda: jnp.zeros((), jnp.int32))
         pos = ci.value
@@ -218,13 +239,18 @@ class Block(nn.Module):
             cv.value, v.astype(self.dtype), (0, 0, pos, 0))
         ci.value = pos + 1
         scale = dh ** -0.5
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck.value,
+        # grouped einsums read the un-repeated cache directly — per-step
+        # bandwidth scales with n_kv_heads, the actual GQA win
+        group = nh // n_kv
+        qg = q.reshape(b, n_kv, group, s, dh)
+        scores = jnp.einsum("bngqd,bnkd->bngqk", qg, ck.value,
                             preferred_element_type=jnp.float32) * scale
         live = jnp.arange(self.max_len) <= pos
-        scores = jnp.where(live[None, None, None, :], scores, -1e30)
+        scores = jnp.where(live[None, None, None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", w.astype(self.dtype), cv.value,
-                          preferred_element_type=jnp.float32).astype(q.dtype)
+        out = jnp.einsum("bngqk,bnkd->bngqd", w.astype(self.dtype), cv.value,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(b, nh, s, dh).astype(q.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -248,6 +274,9 @@ class TransformerLM(nn.Module):
     # Rotary position encoding on q/k instead of the learned position
     # table — length-extrapolating, the modern long-context default.
     rope: bool = False
+    # Grouped-query attention (Llama-2/Mistral style): K/V heads shared by
+    # groups of query heads; halves-or-better the decode KV cache.
+    n_kv_heads: Optional[int] = None  # None = n_heads (MHA)
     # KV-cache decode mode (see tpudist.models.generate): one token per
     # call, positions tracked in the flax "cache" collection.
     decode: bool = False
@@ -274,7 +303,8 @@ class TransformerLM(nn.Module):
             x = Block(
                 self.d_model, self.n_heads, self.d_ff, attn,
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
-                dtype=self.dtype, rope=self.rope, decode=self.decode,
+                dtype=self.dtype, rope=self.rope,
+                n_kv_heads=self.n_kv_heads, decode=self.decode,
                 max_len=self.max_len, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)

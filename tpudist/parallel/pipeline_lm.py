@@ -157,6 +157,7 @@ def make_pp_lm_apply(
         module.attention_fn or _default_attention,
         n_experts=module.n_experts, moe_fn=module.moe_fn,
         dtype=module.dtype, rope=module.rope,
+        n_kv_heads=module.n_kv_heads,
     )
     embed_mod = _LMEmbed(module.vocab, module.d_model, module.max_len,
                          rope=module.rope, dtype=module.dtype)

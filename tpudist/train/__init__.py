@@ -15,5 +15,6 @@ from tpudist.train.lm import (  # noqa: F401
 from tpudist.train.optim import (  # noqa: F401
     SCHEDULES,
     build_optimizer,
+    build_optimizer_from_args,
     build_schedule,
 )

@@ -61,3 +61,15 @@ def build_optimizer(
         lr, schedule=schedule, warmup_steps=warmup_steps,
         total_steps=total_steps, min_lr_ratio=min_lr_ratio,
     ))
+
+
+def build_optimizer_from_args(args) -> optax.GradientTransformation:
+    """The shared-CLI spelling (``--lr/--lr_schedule/--warmup_steps/
+    --total_iterations``) of :func:`build_optimizer` — entry points call
+    this so the args→kwargs mapping lives in exactly one place."""
+    return build_optimizer(
+        args.lr,
+        schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        total_steps=args.total_iterations,
+    )
