@@ -187,6 +187,7 @@ def main() -> None:
             num_shards=jax.process_count(), shard_id=jax.process_index(),
             seed=args.seed, mode=args.dataloader,
             eval_fraction=args.eval_fraction,
+            num_workers=args.num_workers,
         )
         max_tok = int(np.max(corpus_windows.tokens))
         if max_tok >= args.vocab:
@@ -265,6 +266,8 @@ def main() -> None:
                 logger.log(row)
     final = float(loss)
     logger.finish()
+    if hasattr(corpus, "close"):
+        corpus.close()  # joins the native gather pool's workers
     rank_print(f"final lm loss: {final:.4f}")
     if args.generate > 0:
         if jax.process_count() > 1:

@@ -104,6 +104,100 @@ def lm_batches(
     return gen()
 
 
+class PrefetchingTokenBatches:
+    """Endless ``[batch, seq_len]`` int32 stream, batch-for-batch identical
+    to :func:`lm_batches`, with window assembly running on the in-tree C++
+    gather pool (``tpudist/data/native``): the memmap page faults and the
+    batch memcpys happen on worker threads ``prefetch_depth`` batches ahead
+    of the training loop instead of on it.
+
+    Yielded arrays are fresh copies (the int32 conversion), so ring-slot
+    reuse can never alias a batch the consumer still holds — the same
+    contract as :class:`tpudist.data.native_loader.PrefetchingLoader`.
+    """
+
+    def __init__(
+        self,
+        windows: TokenWindows,
+        plan: ShardPlan,
+        batch_size: int,
+        *,
+        num_workers: int = 2,
+        prefetch_depth: int = 4,
+        start_epoch: int = 0,
+    ):
+        from tpudist.data.native_loader import GatherPool
+
+        if plan.samples_per_shard < batch_size:
+            raise ValueError(
+                f"shard holds {plan.samples_per_shard} windows — fewer than "
+                f"one batch of {batch_size}; the stream would never yield "
+                "(shrink batch_size/seq_len or grow the corpus)"
+            )
+        n, seq = len(windows), windows.seq_len
+        self._rows = windows.tokens[: n * seq].reshape(n, seq)
+        if not self._rows.flags.c_contiguous:  # memmap views are, but guard
+            self._rows = np.ascontiguousarray(self._rows)
+        self._plan = plan
+        self._batch = batch_size
+        self._slots = [
+            np.empty((batch_size, seq), windows.tokens.dtype)
+            for _ in range(prefetch_depth + 1)
+        ]
+        self._depth = prefetch_depth
+        self._pool = GatherPool(num_workers)
+        self._gen = self._run(start_epoch)
+
+    def _selections(self, start_epoch: int):
+        epoch = start_epoch
+        while True:
+            idx = epoch_indices(self._plan, epoch).astype(np.int64)
+            for i in range(0, len(idx) - self._batch + 1, self._batch):
+                yield idx[i : i + self._batch]
+            epoch += 1
+
+    def _run(self, start_epoch: int):
+        import collections
+
+        sels = self._selections(start_epoch)
+        inflight: collections.deque = collections.deque()
+        slot_i = 0
+
+        def submit():
+            nonlocal slot_i
+            sel = next(sels)
+            slot = self._slots[slot_i % len(self._slots)]
+            slot_i += 1
+            # sel and slot must outlive the job (C++ holds raw pointers);
+            # the inflight deque keeps both referenced until wait returns.
+            inflight.append((self._pool.submit(self._rows, sel, slot), sel,
+                             slot))
+
+        try:
+            for _ in range(self._depth):
+                submit()
+            while True:
+                job, _sel, slot = inflight.popleft()
+                self._pool.wait(job)
+                out = slot.astype(np.int32)  # fresh copy per yield
+                submit()
+                yield out
+        finally:
+            # abandoned stream: drain before the slot buffers can be freed
+            while inflight:
+                self._pool.wait(inflight.popleft()[0])
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        return next(self._gen)
+
+    def close(self) -> None:
+        self._gen.close()  # drains in-flight jobs via the finally block
+        self._pool.close()
+
+
 def make_lm_loader(
     path: str | Path,
     *,
@@ -115,12 +209,19 @@ def make_lm_loader(
     dtype: Optional[str] = None,
     mode: str = "distributed",
     eval_fraction: float = 0.0,
+    num_workers: int = 0,
 ):
     """One-call corpus loader: ``(windows, train_iterator, eval_indices)``.
 
     ``batch_size`` is per shard (per process); batches come back
     ``[batch, seq_len]`` int32, ready for
     :func:`tpudist.models.transformer.lm_loss` (which shifts internally).
+
+    ``num_workers`` > 0 assembles batches on the native C++ gather pool
+    (background memmap IO + memcpy, ``--num_workers`` semantics), falling
+    back silently to the synchronous iterator when the library can't build;
+    the batch stream is identical either way.  Call ``close()`` on the
+    returned iterator if it has one.
 
     ``eval_fraction`` > 0 holds out the corpus TAIL (the last fraction of
     windows — a contiguous held-out region, no shuffling leakage) from the
@@ -144,4 +245,11 @@ def make_lm_loader(
         mode=mode,
     )
     eval_idx = np.arange(n_train, n, dtype=np.int64)
+    if num_workers > 0:
+        from tpudist.data.native_loader import native_available
+
+        if native_available():
+            return windows, PrefetchingTokenBatches(
+                windows, plan, batch_size, num_workers=num_workers
+            ), eval_idx
     return windows, lm_batches(windows, plan, batch_size), eval_idx
