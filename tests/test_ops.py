@@ -64,6 +64,53 @@ class TestFlashAttention:
         with pytest.raises(ValueError, match="divide"):
             flash_attention(q, k, v, False, 64, 64, True)
 
+    @pytest.mark.parametrize("causal,bq,bk",
+                             [(False, 64, 64), (True, 64, 128), (True, 128, 64)])
+    def test_pallas_backward_block_shapes(self, causal, bq, bk):
+        """The Pallas dq (KV-innermost) and dk/dv (Q-innermost) kernels use
+        different dead-block remap arithmetic — cover non-causal plus both
+        unequal-block causal orientations."""
+        q, k, v = self._qkv(seq=256)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal, bq, bk, True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_backward_bf16(self):
+        """Mixed-precision discipline in the backward: bf16 MXU operands,
+        f32 accumulation, grads emitted in bf16 — matches the dense
+        reference run at the same input precision to bf16 tolerance."""
+        q, k, v = (a.astype(jnp.bfloat16) for a in self._qkv(seq=128))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, 64, 64, True).astype(jnp.float32)
+                ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                attention_reference(q, k, v, causal=True).astype(jnp.float32)
+                ** 2
+            )
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert a.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=0.15, rtol=0.15,
+            )
+
 
 class TestFusedMLP:
     def _toy_weights(self, seed=0):
@@ -102,7 +149,7 @@ class TestFusedMLP:
 
 
 class TestBlockwiseAttention:
-    """The XLA blockwise formulation backing flash_attention's backward."""
+    """The plain-XLA blockwise fallback (kernel-free platforms)."""
 
     def _qkv(self, seq=128, batch=2, heads=2, d=32, seed=3):
         import jax

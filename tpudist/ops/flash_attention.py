@@ -9,12 +9,16 @@ both matmuls per block land on the MXU.  Combined with
 :mod:`tpudist.parallel.ring_attention` (which rotates K/V between chips),
 this covers intra-chip blocking while the ring covers inter-chip sharding.
 
-Backward: ``jax.custom_vjp`` whose bwd differentiates a *blockwise*
-XLA formulation (``lax.scan`` over KV blocks with the same online-softmax
-update, each block under ``jax.checkpoint``) — so the backward also peaks
-at O(seq · block) memory instead of materializing the [seq, seq] score
-matrix, and long-context training fits on one chip.  Fwd and bwd match
-``attention_reference`` numerically (see tests).
+Backward: ``jax.custom_vjp`` with two Pallas kernels (the standard
+FlashAttention-2 split): the forward additionally emits the per-row
+logsumexp, the host computes ``delta = rowsum(dO · O)``, then a dq kernel
+(KV innermost, dq accumulated in VMEM across the KV sweep) and a dk/dv
+kernel (Q innermost, dk/dv accumulated across the Q sweep) reconstruct
+``p = exp(s − lse)`` per tile — no [seq, seq] matrix is ever materialized
+forward or backward, and both causal variants elide dead-block DMAs the
+same way the forward does.  Fwd and bwd match ``attention_reference``
+numerically (see tests).  ``blockwise_attention`` (plain-XLA scan with the
+same online-softmax math) remains as the kernel-free fallback path.
 
 No reference counterpart (the reference has no attention and ships no
 kernels of its own — SURVEY.md §0, §5.7); this is TPU-native capability.
@@ -39,8 +43,34 @@ from tpudist.parallel.ring_attention import (
 _MASK_VALUE = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  block_q: int, block_k: int, causal: bool, scale: float):
+def _tile_live(qi, kv, block_q: int, block_k: int, causal: bool):
+    """Whether tile (qi, kv) intersects the causal lower triangle.  The
+    non-causal form keeps a traced always-true predicate so both variants
+    flow through the same ``pl.when``."""
+    return (qi + 1) * block_q > kv * block_k if causal else kv >= 0
+
+
+def _tile_causal_mask(s, qi, kv, block_q: int, block_k: int):
+    """Apply the causal mask to score tile ``s`` at tile coords (qi, kv)."""
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = kv * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, s, _MASK_VALUE)
+
+
+def _last_live_kv(qi, nkv, block_q: int, block_k: int, causal: bool):
+    """Index of Q row ``qi``'s last live KV tile (the emission point of the
+    KV-innermost sweeps)."""
+    return jnp.minimum(
+        nkv - 1, ((qi + 1) * block_q - 1) // block_k
+    ) if causal else nkv - 1
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, block_q: int, block_k: int, causal: bool, scale: float):
     """One (bh, q_block, kv_block) grid step.
 
     The grid's KV dimension is innermost (TPU grids run sequentially), so
@@ -59,9 +89,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # Causal: blocks fully above the diagonal contribute nothing — skip.
-    live = (qi + 1) * block_q > kv * block_k if causal else kv >= 0
-
-    @pl.when(live)
+    @pl.when(_tile_live(qi, kv, block_q, block_k, causal))
     def _():
         # MXU operands stay in the input dtype (bf16 runs at bf16 MXU
         # throughput); accumulation is always f32 via preferred_element_type.
@@ -70,13 +98,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kv * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _MASK_VALUE)
+            s = _tile_causal_mask(s, qi, kv, block_q, block_k)
         m = m_ref[:, 0]
         l = l_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -89,13 +111,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         )
 
     # Last KV block of this Q row: normalize and emit.
-    last = jnp.minimum(
-        nkv - 1, ((qi + 1) * block_q - 1) // block_k
-    ) if causal else nkv - 1
-
-    @pl.when(kv == last)
+    @pl.when(kv == _last_live_kv(qi, nkv, block_q, block_k, causal))
     def _():
         o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+        # Per-row logsumexp (scaled-score domain) — the backward's residual:
+        # p = exp(s·scale − lse) reconstructs the softmax tile exactly.
+        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
@@ -138,9 +159,15 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
         bytes_accessed=int(qr.size + kr.size + vr.size + qr.size)
         * q.dtype.itemsize,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            # Trailing singleton lane dim: (1, bq, 1) blocks satisfy the TPU
+            # (8, 128)-or-full-dim tiling rule at 1/128th the HBM of the
+            # lane-padded layout the in-tree kernel uses.
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
         grid=(bh, seq_q // bq, seq_k // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
@@ -148,8 +175,12 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # m (running row max)
             pltpu.VMEM((bq, 1), jnp.float32),   # l (running normalizer)
@@ -162,7 +193,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
         cost_estimate=cost,
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(batch, heads, seq_q, d)
+    return out.reshape(batch, heads, seq_q, d), lse.reshape(batch, heads, seq_q)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -180,10 +211,11 @@ def flash_attention(
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
     testing); on TPU leave it False.
     """
-    return _flash_forward(
+    out, _ = _flash_forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
+    return out
 
 
 def blockwise_attention(
@@ -199,9 +231,9 @@ def blockwise_attention(
     in ``jax.checkpoint``.  Numerically identical to
     :func:`attention_reference`; peak memory O(seq·block) forward AND
     backward (XLA differentiates the scan and remat recomputes per-block
-    scores instead of saving them).  Used as the value function behind
-    :func:`flash_attention`'s custom VJP; also usable directly on platforms
-    without Pallas."""
+    scores instead of saving them).  The kernel-free fallback to
+    :func:`flash_attention` for platforms without Pallas (the flash
+    backward itself is Pallas — see `_flash_backward`)."""
     scale = q.shape[-1] ** -0.5
     seq_k = k.shape[2]
     bk = min(block_k, seq_k)
@@ -233,21 +265,201 @@ def blockwise_attention(
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc_ref, *, block_q: int, block_k: int,
+                         causal: bool, scale: float):
+    """dq: grid (bh, q_block, kv_block), KV innermost — dq for one Q tile
+    accumulates in VMEM scratch across its KV sweep, mirroring the forward's
+    schedule (and its causal dead-block elision)."""
+    qi = pl.program_id(1)
+    kv = pl.program_id(2)
+    nkv = pl.num_programs(2)
+
+    @pl.when(kv == 0)
+    def _():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(_tile_live(qi, kv, block_q, block_k, causal))
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _tile_causal_mask(s, qi, kv, block_q, block_k)
+        # Softmax tile from the saved row logsumexp — no m/l recurrence.
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        dq_acc_ref[:] += jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kv == _last_live_kv(qi, nkv, block_q, block_k, causal))
+    def _():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                          block_q: int, block_k: int, causal: bool,
+                          scale: float):
+    """dk/dv: grid (bh, kv_block, q_block), Q innermost — dk/dv for one KV
+    tile accumulate in VMEM scratch across the Q sweep.  Causal: Q tiles
+    fully above the diagonal are dead (elided); the final Q tile is always
+    live, so emission at the last grid step is safe."""
+    kv = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when(_tile_live(qi, kv, block_q, block_k, causal))
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _tile_causal_mask(s, qi, kv, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        pt = p.astype(do.dtype).T
+        dv_acc_ref[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        dk_acc_ref[:] += jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
+                    interpret):
+    batch, heads, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    scale = d ** -0.5
+    bh = batch * heads
+    qr = q.reshape(bh, seq_q, d)
+    kr = k.reshape(bh, seq_k, d)
+    vr = v.reshape(bh, seq_k, d)
+    dor = do.reshape(bh, seq_q, d).astype(q.dtype)
+    lser = lse.reshape(bh, seq_q, 1)
+    deltar = delta.reshape(bh, seq_q, 1)
+    nq = seq_q // bq
+    nkv = seq_k // bk
+
+    work = bh * seq_q * seq_k * (0.5 if causal else 1.0)
+    in_bytes = int(
+        (qr.size + kr.size + vr.size + dor.size) * q.dtype.itemsize
+        + (lser.size + deltar.size) * 4
+    )
+
+    def q_row_index(b, i, j):
+        return (b, i, 0)
+
+    q_spec = pl.BlockSpec((1, bq, d), q_row_index, memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, bq, 1), q_row_index, memory_space=pltpu.VMEM)
+    if causal:
+        def kv_index(b, i, j):
+            return (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+    else:
+        def kv_index(b, i, j):
+            return (b, j, 0)
+    kv_spec = pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
+                          causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        grid=(bh, nq, nkv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(6 * work * d), transcendentals=int(work),
+            bytes_accessed=in_bytes + int(qr.size * q.dtype.itemsize),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    # dk/dv sweep Q innermost; causal dead Q tiles (fully above the
+    # diagonal) re-map to the KV row's first live Q tile so their DMA is
+    # elided, mirroring the forward trick on the transposed schedule.
+    if causal:
+        def q_index(b, j, i):
+            return (b, jnp.maximum(i, (j * bk) // bq), 0)
+    else:
+        def q_index(b, j, i):
+            return (b, i, 0)
+
+    q_spec_t = pl.BlockSpec((1, bq, d), q_index, memory_space=pltpu.VMEM)
+    row_spec_t = pl.BlockSpec((1, bq, 1), q_index, memory_space=pltpu.VMEM)
+    kv_spec_t = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                             memory_space=pltpu.VMEM)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=bq, block_k=bk,
+                          causal=causal, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        grid=(bh, nkv, nq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(8 * work * d), transcendentals=int(work),
+            bytes_accessed=in_bytes + int(2 * kr.size * k.dtype.itemsize),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    shape_q = (batch, heads, seq_q, d)
+    shape_k = (batch, heads, seq_k, d)
+    return (dq.reshape(shape_q), dk.reshape(shape_k), dv.reshape(shape_k))
+
+
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        functools.partial(blockwise_attention, causal=causal, block_k=block_k),
-        q, k, v,
+    q, k, v, out, lse = residuals
+    # delta_i = rowsum(dO_i · O_i): the dp→ds correction term, cheap
+    # elementwise work XLA fuses on its own — no kernel needed.
+    delta = jnp.sum(
+        out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1
     )
-    return vjp(g)
+    return _flash_backward(
+        q, k, v, g, lse, delta, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)
