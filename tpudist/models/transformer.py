@@ -38,10 +38,11 @@ AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 def _default_attention(q, k, v):
     """Platform/length-aware single-device attention: dense XLA for short
     sequences (lowest dispatch overhead), the Pallas flash kernel on TPU /
-    the blockwise XLA formulation elsewhere once the [seq, seq] score
-    matrix would dominate memory (>2048 tokens)."""
+    the blockwise XLA formulation elsewhere.  Crossover measured on-chip
+    (benchmarks/flash_sweep.py): flash fwd+bwd wins 3× at 1024 and 3.1× at
+    2048; dense wins below 1024."""
     seq = q.shape[2]
-    if seq <= 2048 or seq % 512:
+    if seq < 1024 or seq % 512:
         return attention_reference(q, k, v, causal=True)
     if jax.devices()[0].platform == "tpu":
         from tpudist.ops import flash_attention

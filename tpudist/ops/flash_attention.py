@@ -69,6 +69,18 @@ def _last_live_kv(qi, nkv, block_q: int, block_k: int, causal: bool):
     ) if causal else nkv - 1
 
 
+def _causal_kv_index(block_q: int, block_k: int):
+    """Index map for the KV-innermost sweeps under causal masking: dead KV
+    tiles (fully above the diagonal) re-map to the Q row's last live tile —
+    Pallas elides the DMA when consecutive grid steps repeat a block index,
+    so each row's dead tail costs neither fetch bandwidth nor compute (the
+    kernels' ``_tile_live`` predicate is already false there)."""
+    def kv_index(b, i, j):
+        return (b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
+
+    return kv_index
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, block_q: int, block_k: int, causal: bool, scale: float):
     """One (bh, q_block, kv_block) grid step.
@@ -139,13 +151,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     )
 
     if causal:
-        # Dead KV blocks (fully above the diagonal) re-map to the row's last
-        # live block: Pallas elides the DMA when a block index repeats
-        # between consecutive grid steps, so the dead tail of each Q row
-        # costs neither fetch bandwidth nor a compute pass (the kernel's
-        # ``live`` predicate is already false there).
-        def kv_index(b, i, j):
-            return (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+        kv_index = _causal_kv_index(bq, bk)
     else:
         def kv_index(b, i, j):
             return (b, j, 0)
@@ -371,8 +377,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     q_spec = pl.BlockSpec((1, bq, d), q_row_index, memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, bq, 1), q_row_index, memory_space=pltpu.VMEM)
     if causal:
-        def kv_index(b, i, j):
-            return (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+        kv_index = _causal_kv_index(bq, bk)
     else:
         def kv_index(b, i, j):
             return (b, j, 0)
