@@ -11,6 +11,7 @@ as fallbacks and in correctness tests (interpret mode on CPU).
 from tpudist.ops.flash_attention import (  # noqa: F401
     blockwise_attention,
     flash_attention,
+    flash_attention_with_lse,
 )
 from tpudist.ops.fused_mlp import (  # noqa: F401
     fused_mlp,

@@ -131,7 +131,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
-def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
+                   out_f32=False):
     batch, heads, seq_q, d = q.shape
     seq_k = k.shape[2]
     bq = min(block_q, seq_q)
@@ -168,7 +169,8 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, d),
+                                 jnp.float32 if out_f32 else q.dtype),
             # Trailing singleton lane dim: (1, bq, 1) blocks satisfy the TPU
             # (8, 128)-or-full-dim tiling rule at 1/128th the HBM of the
             # lane-padded layout the in-tree kernel uses.
@@ -202,7 +204,37 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     return out.reshape(batch, heads, seq_q, d), lse.reshape(batch, heads, seq_q)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+    out_f32: bool = False,
+):
+    """Flash attention that also returns the per-row logsumexp
+    ``[batch, heads, seq_q]`` (f32, scaled-score domain).
+
+    The lse output is what makes partial attentions *mergeable*: two
+    results over disjoint KV sets combine exactly via
+    ``out = (out_a·e^{lse_a} + out_b·e^{lse_b}) / (e^{lse_a}+e^{lse_b})``
+    (stabilized) — the decomposition ring attention uses to run this
+    kernel per hop.  Differentiable in both outputs: the lse cotangent
+    folds into the backward's delta term (``ds = p·(dp − Δ + dL)``).
+
+    ``out_f32`` emits the attention output in f32 regardless of input
+    dtype — partial-merging callers keep full precision across merges
+    (the in-kernel accumulator is f32 either way, so this is free).
+    """
+    return _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, out_f32=out_f32,
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -217,9 +249,8 @@ def flash_attention(
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
     testing); on TPU leave it False.
     """
-    out, _ = _flash_forward(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+    out, _ = flash_attention_with_lse(
+        q, k, v, causal, block_q, block_k, interpret
     )
     return out
 
@@ -446,25 +477,28 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     return (dq.reshape(shape_q), dk.reshape(shape_k), dv.reshape(shape_k))
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, out_f32):
     out, lse = _flash_forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, out_f32=out_f32,
     )
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, residuals, g):
+def _bwd(causal, block_q, block_k, interpret, out_f32, residuals, g):
     q, k, v, out, lse = residuals
+    g_out, g_lse = g
     # delta_i = rowsum(dO_i · O_i): the dp→ds correction term, cheap
-    # elementwise work XLA fuses on its own — no kernel needed.
+    # elementwise work XLA fuses on its own — no kernel needed.  The lse
+    # cotangent enters through ds_ij = p_ij·(dp_ij − Δ_i + dL_i), i.e. it
+    # just shifts the delta the kernels already consume.
     delta = jnp.sum(
-        out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1
-    )
+        out.astype(jnp.float32) * g_out.astype(jnp.float32), axis=-1
+    ) - g_lse.astype(jnp.float32)
     return _flash_backward(
-        q, k, v, g, lse, delta, causal=causal, block_q=block_q,
+        q, k, v, g_out, lse, delta, causal=causal, block_q=block_q,
         block_k=block_k, interpret=interpret,
     )
 
 
-flash_attention.defvjp(_fwd, _bwd)
+flash_attention_with_lse.defvjp(_fwd, _bwd)

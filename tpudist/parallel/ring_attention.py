@@ -175,6 +175,98 @@ def ring_attention_shard(
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _merge_partials(out_c, lse_c, out_h, lse_h):
+    """Exact, stabilized merge of two attention partials over disjoint KV
+    sets, each given as (normalized out, row logsumexp).  Fully-masked
+    partials (lse == _MASK_VALUE, out == 0) merge to a no-op.  All f32."""
+    m = jnp.maximum(lse_c, lse_h)
+    w_c = jnp.exp(lse_c - m)
+    w_h = jnp.exp(lse_h - m)
+    denom = w_c + w_h
+    lse_new = m + jnp.log(denom)
+    out_new = (
+        out_c * w_c[..., None] + out_h * w_h[..., None]
+    ) / denom[..., None]
+    return out_new, lse_new
+
+
+def ring_attention_shard_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = AXIS_SEQ,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Shard-local ring attention whose per-hop math is the Pallas flash
+    kernel (call inside ``shard_map``).
+
+    Same ring schedule as :func:`ring_attention_shard`, different
+    decomposition: instead of threading the raw (m, l, o) online-softmax
+    carry through XLA block updates, each hop computes a *complete*
+    attention over its KV shard with :func:`tpudist.ops.flash_attention_with_lse`
+    and the partials are merged via their logsumexps (`_merge_partials`) —
+    O(shard) XLA work per hop, while every O(shard²·d) FLOP runs in the
+    flash kernels, forward AND backward (the kernel's custom VJP folds the
+    lse cotangent into its delta term).
+
+    With equal shards and the step-t block originating on rank
+    ``(i−t) mod n``, causal masking collapses to three static-per-hop
+    cases: hop 0 is the diagonal (causal kernel), later hops are either
+    fully live (unmasked kernel) or fully dead (skipped via ``lax.cond``
+    — half the ring's compute under causal attention, the same work the
+    XLA path spends masked).
+    """
+    from tpudist.ops import flash_attention_with_lse
+
+    # Trace-time fit check (shard shapes are static here): the kernel needs
+    # the clamped blocks to divide the shard.  Fall back to the XLA carry
+    # path otherwise — same semantics, no shape constraint.
+    shard = q.shape[-2]
+    if shard % min(block_q, shard) or shard % min(block_k, shard):
+        return ring_attention_shard(
+            q, k, v, axis_name=axis_name, causal=causal
+        )
+
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    # Hop 0 is this device's own (diagonal) KV shard: causal kernel.
+    # out_f32: partials stay f32 through every merge whatever the input
+    # dtype (parity with the XLA path's f32 (m, l, o) carry).
+    out, lse = flash_attention_with_lse(
+        q, k, v, causal, block_q, block_k, interpret, True
+    )
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    for step in range(1, axis_size):
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        kv_idx = (my_idx - step) % axis_size
+        if causal:
+            def live_hop(kt, vt):
+                return flash_attention_with_lse(
+                    q, kt, vt, False, block_q, block_k, interpret, True
+                )
+
+            def dead_hop(kt, vt):
+                return (
+                    jnp.zeros(q.shape, jnp.float32),
+                    jnp.full(q.shape[:-1], _MASK_VALUE, jnp.float32),
+                )
+
+            out_h, lse_h = lax.cond(kv_idx < my_idx, live_hop, dead_hop, k, v)
+        else:
+            out_h, lse_h = flash_attention_with_lse(
+                q, k, v, False, block_q, block_k, interpret, True
+            )
+        out, lse = _merge_partials(out, lse, out_h, lse_h)
+    return out.astype(q.dtype)
+
+
 def make_ring_attention(
     mesh: Mesh,
     *,
@@ -182,6 +274,10 @@ def make_ring_attention(
     causal: bool = False,
     batch_axis: Optional[str] = None,
     inner_block: Optional[int] = None,
+    kernel: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
 ):
     """Jitted global-view ring attention over ``mesh``.
 
@@ -190,16 +286,40 @@ def make_ring_attention(
     ``batch_axis``).  Sequence length must divide evenly by the ring size
     (the equal-block contract, like the reference's equal-batch assumption
     ``demo.py:113``).
+
+    ``kernel`` selects the shard-local math: ``'xla'`` = the
+    (m, l, o)-carry block updates (:func:`ring_attention_shard`),
+    ``'flash'`` = the Pallas per-hop kernels
+    (:func:`ring_attention_shard_flash`; shards whose shape doesn't fit
+    the block contract fall back to the xla body at trace time),
+    ``'auto'`` = flash on TPU — unless ``inner_block`` was explicitly
+    requested (a memory-blocking contract only the xla body honors).
     """
+    if kernel not in ("auto", "xla", "flash"):
+        raise ValueError(f"kernel must be auto|xla|flash, got {kernel!r}")
     spec = P(batch_axis, None, axis_name, None)
-    body = functools.partial(
-        ring_attention_shard, axis_name=axis_name, causal=causal,
-        inner_block=inner_block,
-    )
+    if kernel == "auto":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        kernel = "flash" if (on_tpu or interpret) and inner_block is None \
+            else "xla"
+    if kernel == "flash":
+        body = functools.partial(
+            ring_attention_shard_flash, axis_name=axis_name, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    else:
+        body = functools.partial(
+            ring_attention_shard, axis_name=axis_name, causal=causal,
+            inner_block=inner_block,
+        )
     sharded = jax.shard_map(
         lambda q, k, v: body(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call's out_shape carries no varying-manual-axes type, so
+        # the vma checker cannot type the flash path; the xla path keeps it
+        # (its carries are explicitly pcast).
+        check_vma=(kernel != "flash"),
     )
     return jax.jit(sharded)
