@@ -78,20 +78,25 @@ def bench_toy() -> dict:
         states, losses = chunk_step(states, x_all, y_all, idx)
     _sync(losses["model_X"])
 
-    # Adaptive duration: keep timing until >=1s has elapsed so the number
-    # is stable.
-    total_chunks = 0
-    t0 = time.perf_counter()
-    while True:
-        for _ in range(8):
-            states, losses = chunk_step(states, x_all, y_all, idx)
-        _sync(losses["model_X"])
-        total_chunks += 8
-        dt = time.perf_counter() - t0
-        if dt >= 1.0:
-            break
+    # Three independent >=0.5s segments, best taken: the axon tunnel is a
+    # shared, bursty transport, and a single timing window can eat another
+    # tenant's contention spike — max-of-segments rejects it (the classic
+    # min-of-repeats trick, inverted because this is a rate).
+    best = 0.0
+    for _ in range(3):
+        total_chunks = 0
+        t0 = time.perf_counter()
+        while True:
+            for _ in range(8):
+                states, losses = chunk_step(states, x_all, y_all, idx)
+            _sync(losses["model_X"])
+            total_chunks += 8
+            dt = time.perf_counter() - t0
+            if dt >= 0.5:
+                break
+        best = max(best, batch * window * total_chunks / dt)
 
-    per_chip = batch * window * total_chunks / dt / n_chips
+    per_chip = best / n_chips
     return {
         "metric": "toy_mlp_samples_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -159,6 +164,47 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
     }
 
 
+def bench_decode(*, batch: int = 8, prompt_len: int = 16, max_new: int = 240,
+                 d_model: int = 512, n_layers: int = 4, n_heads: int = 8,
+                 d_ff: int = 2048, vocab: int = 256) -> dict:
+    """Autoregressive decode throughput (KV-cache path, greedy): one
+    compiled scan over single-token cached forwards — measures the
+    framework's inference loop, which training MFU says nothing about."""
+    import jax.numpy as jnp
+
+    from tpudist.models import create_transformer, make_generator
+
+    max_len = prompt_len + max_new
+    module, params = create_transformer(
+        jax.random.PRNGKey(0), seq_len=max_len, vocab=vocab, d_model=d_model,
+        n_layers=n_layers, n_heads=n_heads, d_ff=d_ff, max_len=max_len,
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, vocab, size=(batch, prompt_len)),
+        jnp.int32,
+    )
+    # ONE reusable jitted program: the warmup call compiles it, the timed
+    # calls hit the jit cache (a fresh generate() per call would re-trace).
+    gen = make_generator(module, params, max_new)
+
+    _sync(gen(prompt))  # compile
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(gen(prompt))
+        dt = time.perf_counter() - t0
+        best = max(best, batch * max_new / dt)
+    return {
+        "metric": "lm_decode_tokens_per_sec",
+        "value": round(best, 1),
+        "unit": "tokens/sec (batch aggregate)",
+        "config": {"batch": batch, "prompt_len": prompt_len,
+                   "max_new": max_new, "d_model": d_model,
+                   "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
+                   "vocab": vocab},
+    }
+
+
 def main() -> None:
     results = {"device_kind": jax.devices()[0].device_kind,
                "n_chips": jax.local_device_count()}
@@ -192,6 +238,12 @@ def main() -> None:
             results[f"lm_long_context_{precision}"] = {"error": repr(e)}
             print(f"# lm_long_context_{precision} failed: {e!r}",
                   file=sys.stderr)
+
+    try:
+        results["lm_decode"] = bench_decode()
+    except Exception as e:
+        results["lm_decode"] = {"error": repr(e)}
+        print(f"# lm_decode failed: {e!r}", file=sys.stderr)
 
     (Path(__file__).parent / "BENCH_EXTENDED.json").write_text(
         json.dumps(results, indent=2) + "\n"

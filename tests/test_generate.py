@@ -89,3 +89,84 @@ class TestGeneration:
         # prompt preserved verbatim
         np.testing.assert_array_equal(np.asarray(out[:, :4]),
                                       np.asarray(prompt))
+
+
+class TestSampleLogits:
+    """Filter semantics of the sampling helper (pure function, no model)."""
+
+    def _logits(self):
+        # One batch row with a known ordering: token i has logit i.
+        return jnp.arange(8, dtype=jnp.float32)[None, :]
+
+    def test_greedy_ignores_filters(self):
+        from tpudist.models import sample_logits
+
+        tok = sample_logits(self._logits(), jax.random.PRNGKey(0),
+                            temperature=0.0, top_k=2, top_p=0.1)
+        assert int(tok[0]) == 7
+
+    def test_top_k_restricts_support(self):
+        from tpudist.models import sample_logits
+
+        counts = set()
+        for seed in range(40):
+            tok = sample_logits(self._logits(), jax.random.PRNGKey(seed),
+                                temperature=5.0, top_k=3)
+            counts.add(int(tok[0]))
+        # flat-ish temperature, but only the top 3 tokens {5, 6, 7} legal
+        assert counts <= {5, 6, 7} and len(counts) > 1
+
+    def test_top_p_keeps_threshold_crosser(self):
+        from tpudist.models import sample_logits
+
+        # One dominant token (mass ~0.99): any top_p below that must still
+        # keep it — and nothing else.
+        logits = jnp.array([[0.0, 0.0, 10.0]], jnp.float32)
+        for seed in range(10):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_p=0.5)
+            assert int(tok[0]) == 2
+
+    def test_top_p_nucleus_support(self):
+        from tpudist.models import sample_logits
+
+        # Two tokens at ~0.49 each, six sharing ~0.02: top_p=0.9 keeps the
+        # two big ones (0.49 + 0.49 = 0.98 ≥ 0.9 reached at token 2).
+        logits = jnp.log(jnp.array(
+            [[0.49, 0.49] + [0.02 / 6] * 6], jnp.float32))
+        seen = set()
+        for seed in range(40):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_p=0.9)
+            seen.add(int(tok[0]))
+        assert seen <= {0, 1} and len(seen) == 2
+
+    def test_top_p_distinct_logits_keeps_full_nucleus(self):
+        """Distinct logits (masses ~.665/.245/.090): top_p=0.95 must keep
+        all three tokens — the cutoff is the SMALLEST kept logit, not the
+        largest (the degenerate-distribution cases can't tell the two
+        apart)."""
+        from tpudist.models import sample_logits
+
+        logits = jnp.array([[3.0, 2.0, 1.0]], jnp.float32)
+        seen = set()
+        for seed in range(120):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_p=0.95)
+            seen.add(int(tok[0]))
+        assert seen == {0, 1, 2}
+        # tightening the threshold below the top token's mass drops the rest
+        for seed in range(20):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_p=0.6)
+            assert int(tok[0]) == 0
+
+    def test_generate_with_filters_runs(self, devices):
+        module, params = TestGeneration()._train_chain(devices, rope=False)
+        prompt = _tokens(batch=2, seq=4)
+        from tpudist.models import generate
+
+        out = generate(module, params, prompt, max_new=5, temperature=0.8,
+                       top_k=10, top_p=0.95, rng=jax.random.PRNGKey(3))
+        assert out.shape == (2, 9)
+        assert 0 <= np.asarray(out).min() and np.asarray(out).max() < CFG["vocab"]

@@ -8,4 +8,6 @@ from tpudist.models.generate import (  # noqa: F401
     decode_logits,
     generate,
     make_decode_step,
+    make_generator,
+    sample_logits,
 )

@@ -56,41 +56,74 @@ def make_decode_step(module, params):
     return init_cache, step
 
 
-def generate(
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Sample token ids from ``logits [batch, vocab]`` (f32).
+
+    ``temperature == 0`` is greedy argmax (``top_k``/``top_p`` ignored).
+    ``top_k``: keep only the k highest logits.  ``top_p``: nucleus
+    sampling — keep the smallest prefix of the probability-sorted vocab
+    whose mass reaches ``top_p`` (the first token crossing the threshold
+    is always kept, so the set is never empty).  Both filters compose
+    (k-filter first, then nucleus), everything is fixed-shape ``jnp`` —
+    the function jits and scans.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    neg = jnp.finfo(logits.dtype).min
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # Position i is kept while the mass BEFORE it is < top_p (shift by
+        # one so the first token crossing the threshold stays in).  The
+        # cutoff is the SMALLEST kept logit; everything below it is masked.
+        keep = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1
+        ) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def make_generator(
     module,
     params,
-    prompt: jax.Array,
     max_new: int,
     *,
     temperature: float = 0.0,
-    rng: Optional[jax.Array] = None,
-) -> jax.Array:
-    """Sample ``max_new`` tokens after ``prompt [batch, plen]``.
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
+    """Build a reusable compiled sampler: ``gen(prompt, rng=None) ->
+    [batch, plen + max_new]``.
 
-    ``temperature == 0`` is greedy argmax; otherwise categorical sampling
-    at that temperature.  Returns the full ``[batch, plen + max_new]``
-    sequence (prompt included).  The entire loop — prompt teacher-forcing
-    plus sampling — is one jitted ``lax.scan``.
+    The returned callable holds ONE jitted program (prompt teacher-forcing
+    + sampling in a single ``lax.scan``), so repeated calls with the same
+    prompt shape hit the jit cache — this is the entry for serving/bench
+    loops; :func:`generate` is the one-shot convenience wrapper.
     """
-    batch, plen = prompt.shape
-    total = plen + max_new
-    if total > module.max_len:
-        raise ValueError(
-            f"prompt {plen} + max_new {max_new} exceeds the model's "
-            f"max_len {module.max_len} (the KV-cache size)"
-        )
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
     init_cache, step = make_decode_step(module, params)
-    cache0 = init_cache(batch)
 
     def pick(logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(key, logits / temperature, axis=-1)
+        return sample_logits(logits, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     @jax.jit
-    def run(cache, prompt, key):
+    def run(prompt, key):
+        batch, plen = prompt.shape
+        cache = init_cache(batch)
+
         def body(carry, i):
             cache, tok, key = carry
             cache, logits = step(cache, tok)
@@ -104,11 +137,48 @@ def generate(
             return (cache, nxt[:, None], key), nxt
 
         (_, _, _), out = lax.scan(
-            body, (cache, prompt[:, :1], key), jnp.arange(total - 1)
+            body, (cache, prompt[:, :1], key), jnp.arange(plen + max_new - 1)
         )
         return jnp.concatenate([prompt[:, :1], out.T], axis=1)
 
-    return run(cache0, prompt, rng)
+    def gen(prompt: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
+        plen = prompt.shape[1]
+        if plen + max_new > module.max_len:
+            raise ValueError(
+                f"prompt {plen} + max_new {max_new} exceeds the model's "
+                f"max_len {module.max_len} (the KV-cache size)"
+            )
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return run(prompt, rng)
+
+    return gen
+
+
+def generate(
+    module,
+    params,
+    prompt: jax.Array,
+    max_new: int,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample ``max_new`` tokens after ``prompt [batch, plen]``.
+
+    ``temperature == 0`` is greedy argmax; otherwise categorical sampling
+    at that temperature, optionally filtered by ``top_k`` and/or nucleus
+    ``top_p`` (:func:`sample_logits`).  Returns the full
+    ``[batch, plen + max_new]`` sequence (prompt included).  One-shot
+    wrapper over :func:`make_generator` (use that directly to amortize
+    compilation across calls).
+    """
+    return make_generator(
+        module, params, max_new, temperature=temperature, top_k=top_k,
+        top_p=top_p,
+    )(prompt, rng)
 
 
 def decode_logits(module, params, tokens: jax.Array) -> jax.Array:

@@ -47,7 +47,10 @@ def _default_attention(q, k, v):
     if jax.devices()[0].platform == "tpu":
         from tpudist.ops import flash_attention
 
-        return flash_attention(q, k, v, True, 512, 512, False)
+        # Wider KV tiles amortize the per-tile grid overhead once the KV
+        # sweep is long (8192: 6.8 vs 8.7 ms fwd+bwd — flash_sweep.py).
+        bk = 1024 if seq >= 8192 and seq % 1024 == 0 else 512
+        return flash_attention(q, k, v, True, 512, bk, False)
     from tpudist.ops import blockwise_attention
 
     return blockwise_attention(q, k, v, causal=True, block_k=512)
