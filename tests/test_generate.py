@@ -161,6 +161,17 @@ class TestSampleLogits:
                                 temperature=1.0, top_p=0.6)
             assert int(tok[0]) == 0
 
+    def test_top_p_zero_is_greedy(self):
+        """Degenerate top_p <= 0 must keep the top token (never an empty
+        set un-masking the whole vocab)."""
+        from tpudist.models import sample_logits
+
+        logits = jnp.array([[1.0, 5.0, 2.0]], jnp.float32)
+        for seed in range(10):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_p=0.0)
+            assert int(tok[0]) == 1
+
     def test_generate_with_filters_runs(self, devices):
         module, params = TestGeneration()._train_chain(devices, rope=False)
         prompt = _tokens(batch=2, seq=4)

@@ -168,6 +168,35 @@ class TestOptimAndEvalStep:
         with pytest.raises(ValueError, match="unknown lr schedule"):
             build_schedule(1e-3, schedule="linear")
 
+    def test_grad_clip_bounds_update(self):
+        """With clipping, a huge gradient produces the same update a
+        rescaled-to-bound gradient would; without, it doesn't."""
+        from tpudist.train import build_optimizer
+
+        params = {"w": jnp.zeros((4,))}
+        big = {"w": jnp.full((4,), 1e6)}
+        scaled = {"w": big["w"] / (float(jnp.linalg.norm(big["w"])) / 1.0)}
+        clip = build_optimizer(1e-3, grad_clip=1.0)
+        u_big, _ = clip.update(big, clip.init(params), params)
+        u_scaled, _ = clip.update(scaled, clip.init(params), params)
+        np.testing.assert_allclose(np.asarray(u_big["w"]),
+                                   np.asarray(u_scaled["w"]), rtol=1e-5)
+
+    def test_weight_decay_shrinks_params(self):
+        """AdamW: zero gradient still decays nonzero MATRIX params; norm
+        scales/biases (ndim <= 1) and plain Adam stay untouched."""
+        from tpudist.train import build_optimizer
+
+        params = {"w": jnp.ones((4, 4)), "scale": jnp.ones((4,))}
+        zero = jax.tree.map(jnp.zeros_like, params)
+        adamw = build_optimizer(1e-3, weight_decay=0.1)
+        u, _ = adamw.update(zero, adamw.init(params), params)
+        assert float(jnp.max(u["w"])) < 0.0  # decay pulls toward zero
+        np.testing.assert_allclose(np.asarray(u["scale"]), 0.0, atol=1e-12)
+        adam = build_optimizer(1e-3)
+        u0, _ = adam.update(zero, adam.init(params), params)
+        np.testing.assert_allclose(np.asarray(u0["w"]), 0.0, atol=1e-12)
+
     def test_eval_step_matches_train_loss(self, tmp_path, devices):
         """Eval loss on the training batch equals the train step's
         reported loss before the update."""

@@ -90,6 +90,9 @@ def sample_logits(
         keep = jnp.concatenate(
             [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1
         ) < top_p
+        # Force-keep the top token so top_p <= 0 degenerates to greedy,
+        # never to an empty set (which would un-mask everything below).
+        keep = keep.at[..., 0].set(True)
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < cutoff, neg, logits)

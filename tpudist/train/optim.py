@@ -8,8 +8,11 @@ transformation so every entry point and the Trainer agree.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Union
 
+import jax
+import jax.numpy as jnp
 import optax
 
 SCHEDULES = ("constant", "cosine", "warmup_cosine")
@@ -55,21 +58,40 @@ def build_optimizer(
     warmup_steps: int = 0,
     total_steps: int = 1000,
     min_lr_ratio: float = 0.1,
+    grad_clip: float = 0.0,
+    weight_decay: float = 0.0,
 ) -> optax.GradientTransformation:
-    """Adam over :func:`build_schedule` — the one optimizer factory."""
-    return optax.adam(build_schedule(
+    """Adam/AdamW over :func:`build_schedule` — the one optimizer factory.
+
+    ``grad_clip > 0`` prepends global-norm clipping (the whole gradient
+    tree is rescaled when its L2 norm exceeds the bound — one ``psum``-free
+    pass, XLA fuses it into the step).  ``weight_decay > 0`` switches to
+    decoupled AdamW.
+    """
+    sched = build_schedule(
         lr, schedule=schedule, warmup_steps=warmup_steps,
         total_steps=total_steps, min_lr_ratio=min_lr_ratio,
-    ))
+    )
+    # Standard LM practice: decay only weight matrices — LayerNorm scales
+    # and biases (ndim <= 1) are excluded or convergence suffers.
+    decay_mask = functools.partial(jax.tree.map, lambda p: jnp.ndim(p) > 1)
+    opt = (optax.adamw(sched, weight_decay=weight_decay, mask=decay_mask)
+           if weight_decay > 0 else optax.adam(sched))
+    if grad_clip > 0:
+        return optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+    return opt
 
 
 def build_optimizer_from_args(args) -> optax.GradientTransformation:
     """The shared-CLI spelling (``--lr/--lr_schedule/--warmup_steps/
-    --total_iterations``) of :func:`build_optimizer` — entry points call
-    this so the args→kwargs mapping lives in exactly one place."""
+    --total_iterations/--grad_clip/--weight_decay``) of
+    :func:`build_optimizer` — entry points call this so the args→kwargs
+    mapping lives in exactly one place."""
     return build_optimizer(
         args.lr,
         schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
         total_steps=args.total_iterations,
+        grad_clip=getattr(args, "grad_clip", 0.0),
+        weight_decay=getattr(args, "weight_decay", 0.0),
     )
