@@ -84,6 +84,45 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("hq,hkv", [(4, 2), (4, 1)])
+    def test_gqa_native_kv(self, causal, hq, hkv):
+        """Grouped-query K/V consumed without repeat: fwd + all grads match
+        the repeated-KV dense reference; dk/dv come back at kv-head count
+        (the dkv kernel's group×q-tile accumulation sweep)."""
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (2, hq, 128, 32), jnp.float32)
+        k = jax.random.normal(ks[1], (2, hkv, 128, 32), jnp.float32)
+        v = jax.random.normal(ks[2], (2, hkv, 128, 32), jnp.float32)
+        g = hq // hkv
+
+        def rep(t):
+            return jnp.repeat(t, g, axis=1)
+
+        out = flash_attention(q, k, v, causal, 64, 64, True)
+        ref = attention_reference(q, rep(k), rep(v), causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        gf = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal, 64, 64, True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attention_reference(q, rep(k), rep(v), causal=causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        assert gf[1].shape == k.shape and gf[2].shape == v.shape
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_gqa_indivisible_heads_raises(self):
+        q = jnp.zeros((1, 4, 64, 16))
+        kv = jnp.zeros((1, 3, 64, 16))
+        with pytest.raises(ValueError, match="multiple of kv heads"):
+            flash_attention(q, kv, kv, False, 64, 64, True)
+
     def test_backward_bf16(self):
         """Mixed-precision discipline in the backward: bf16 MXU operands,
         f32 accumulation, grads emitted in bf16 — matches the dense

@@ -40,20 +40,36 @@ def _default_attention(q, k, v):
     sequences (lowest dispatch overhead), the Pallas flash kernel on TPU /
     the blockwise XLA formulation elsewhere.  Crossover measured on-chip
     (benchmarks/flash_sweep.py): flash fwd+bwd wins 3× at 1024 and 3.1× at
-    2048; dense wins below 1024."""
+    2048; dense wins below 1024.
+
+    Accepts grouped-query K/V (fewer heads than q): the flash kernels
+    consume it natively — KV tiles are fetched once per group, never
+    materialized at full head count; the non-kernel paths broadcast."""
     seq = q.shape[2]
-    if seq < 1024 or seq % 512:
-        return attention_reference(q, k, v, causal=True)
-    if jax.devices()[0].platform == "tpu":
+    use_flash = (seq >= 1024 and seq % 512 == 0
+                 and jax.devices()[0].platform == "tpu")
+    if not use_flash and k.shape[1] != q.shape[1]:
+        # only the flash kernels consume grouped K/V natively
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    if use_flash:
         from tpudist.ops import flash_attention
 
         # Wider KV tiles amortize the per-tile grid overhead once the KV
         # sweep is long (8192: 6.8 vs 8.7 ms fwd+bwd — flash_sweep.py).
         bk = 1024 if seq >= 8192 and seq % 1024 == 0 else 512
         return flash_attention(q, k, v, True, 512, bk, False)
+    if seq < 1024 or seq % 512:
+        return attention_reference(q, k, v, causal=True)
     from tpudist.ops import blockwise_attention
 
     return blockwise_attention(q, k, v, causal=True, block_k=512)
+
+
+# Block consults this tag before broadcasting K/V to full head count —
+# the default path handles grouped-query inputs itself (see above).
+_default_attention.supports_gqa = True
 
 
 def rope_rotate(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
@@ -158,9 +174,10 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
     rope: bool = False  # rotary q/k position encoding (no learned pos table)
     # Grouped-query attention: project K/V at this many heads (must divide
-    # n_heads; None = n_heads = plain MHA).  K/V broadcast to full heads
-    # before the attention op — every implementation works unchanged — and
-    # the decode cache stores only n_kv_heads (the GQA memory win).
+    # n_heads; None = n_heads = plain MHA).  Attention fns tagged
+    # ``supports_gqa`` (the default flash path) consume grouped K/V
+    # natively; others get K/V broadcast to full heads.  The decode cache
+    # stores only n_kv_heads either way (the GQA memory win).
     n_kv_heads: Optional[int] = None
     # Autoregressive decode mode: single-token inputs attend over a
     # ``max_len`` K/V cache carried in the flax "cache" collection.
@@ -197,7 +214,8 @@ class Block(nn.Module):
         else:
             if self.rope:
                 q, k = rope_rotate(q), rope_rotate(k)
-            if n_kv != self.n_heads:
+            if n_kv != self.n_heads and not getattr(
+                    self.attention_fn, "supports_gqa", False):
                 group = self.n_heads // n_kv
                 k = jnp.repeat(k, group, axis=1)
                 v = jnp.repeat(v, group, axis=1)

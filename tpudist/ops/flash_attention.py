@@ -131,9 +131,26 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
+def _gqa_shape_check(q, k, v) -> int:
+    """Validate [b, hq, sq, d] x [b, hkv, sk, d] inputs and return the KV
+    head count (hkv must divide hq — grouped-query attention runs
+    natively, no K/V repeat)."""
+    batch, heads, _, d = q.shape
+    kv_heads = k.shape[1]
+    if k.shape != v.shape or k.shape[0] != batch or k.shape[3] != d:
+        raise ValueError(f"k/v shape {k.shape} incompatible with q {q.shape}")
+    if heads % kv_heads:
+        raise ValueError(
+            f"q heads {heads} must be a multiple of kv heads {kv_heads}"
+        )
+    return kv_heads
+
+
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
                    out_f32=False):
     batch, heads, seq_q, d = q.shape
+    kv_heads = _gqa_shape_check(q, k, v)
+    group = heads // kv_heads
     seq_k = k.shape[2]
     bq = min(block_q, seq_q)
     bk = min(block_k, seq_k)
@@ -144,18 +161,25 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
     scale = d ** -0.5
     bh = batch * heads
     qr = q.reshape(bh, seq_q, d)
-    kr = k.reshape(bh, seq_k, d)
-    vr = v.reshape(bh, seq_k, d)
+    kr = k.reshape(batch * kv_heads, seq_k, d)
+    vr = v.reshape(batch * kv_heads, seq_k, d)
 
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale
     )
 
+    def kv_row(b):
+        # grid row (batch-major over q heads) -> its KV head's row
+        return (b // heads) * kv_heads + (b % heads) // group
+
     if causal:
-        kv_index = _causal_kv_index(bq, bk)
+        causal_j = _causal_kv_index(bq, bk)
+
+        def kv_index(b, i, j):
+            return (kv_row(b), causal_j(b, i, j)[1], 0)
     else:
         def kv_index(b, i, j):
-            return (b, j, 0)
+            return (kv_row(b), j, 0)
 
     # Whole-kernel cost for the XLA scheduler (matmul mult-add = 2 FLOPs;
     # exp per score entry; causal does half the score work).
@@ -255,6 +279,11 @@ def flash_attention(
     return out
 
 
+# Consumes grouped-query K/V natively (fewer KV heads than q heads);
+# wrappers that route to this kernel should propagate the tag.
+flash_attention.supports_gqa = True
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -341,16 +370,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
                           block_q: int, block_k: int, causal: bool,
-                          scale: float):
-    """dk/dv: grid (bh, kv_block, q_block), Q innermost — dk/dv for one KV
-    tile accumulate in VMEM scratch across the Q sweep.  Causal: Q tiles
-    fully above the diagonal are dead (elided); the final Q tile is always
-    live, so emission at the last grid step is safe."""
+                          scale: float, n_q_tiles: int):
+    """dk/dv: grid (bh_kv, kv_block, group·q_block) with the (group member,
+    Q tile) sweep innermost — dk/dv for one KV tile accumulate in VMEM
+    scratch across every Q tile of every q head in its GQA group (group=1
+    is plain MHA).  Causal: Q tiles fully above the diagonal are dead
+    (elided); each head's final Q tile is always live, so emission at the
+    last grid step is safe."""
     kv = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    gi = pl.program_id(2)
+    qi = gi % n_q_tiles
 
-    @pl.when(qi == 0)
+    @pl.when(gi == 0)
     def _():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -373,7 +404,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(gi == pl.num_programs(2) - 1)
     def _():
         dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -382,19 +413,25 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
                     interpret):
     batch, heads, seq_q, d = q.shape
+    kv_heads = _gqa_shape_check(q, k, v)
+    group = heads // kv_heads
     seq_k = k.shape[2]
     bq = min(block_q, seq_q)
     bk = min(block_k, seq_k)
     scale = d ** -0.5
     bh = batch * heads
+    bh_kv = batch * kv_heads
     qr = q.reshape(bh, seq_q, d)
-    kr = k.reshape(bh, seq_k, d)
-    vr = v.reshape(bh, seq_k, d)
+    kr = k.reshape(bh_kv, seq_k, d)
+    vr = v.reshape(bh_kv, seq_k, d)
     dor = do.reshape(bh, seq_q, d).astype(q.dtype)
     lser = lse.reshape(bh, seq_q, 1)
     deltar = delta.reshape(bh, seq_q, 1)
     nq = seq_q // bq
     nkv = seq_k // bk
+
+    def kv_row(b):
+        return (b // heads) * kv_heads + (b % heads) // group
 
     work = bh * seq_q * seq_k * (0.5 if causal else 1.0)
     in_bytes = int(
@@ -408,10 +445,13 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     q_spec = pl.BlockSpec((1, bq, d), q_row_index, memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, bq, 1), q_row_index, memory_space=pltpu.VMEM)
     if causal:
-        kv_index = _causal_kv_index(bq, bk)
+        causal_j = _causal_kv_index(bq, bk)
+
+        def kv_index(b, i, j):
+            return (kv_row(b), causal_j(b, i, j)[1], 0)
     else:
         def kv_index(b, i, j):
-            return (b, j, 0)
+            return (kv_row(b), j, 0)
     kv_spec = pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -432,29 +472,35 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
 
-    # dk/dv sweep Q innermost; causal dead Q tiles (fully above the
-    # diagonal) re-map to the KV row's first live Q tile so their DMA is
-    # elided, mirroring the forward trick on the transposed schedule.
+    # dk/dv sweep (group x Q tiles) innermost per KV head; causal dead Q
+    # tiles (fully above the diagonal) re-map to the KV row's first live
+    # tile of the same group head so their DMA is elided, mirroring the
+    # forward trick on the transposed schedule.
+    def q_row(b, g):
+        # KV grid row (batch-major over kv heads) + group member -> q row
+        return (b // kv_heads) * heads + (b % kv_heads) * group + g
+
     if causal:
-        def q_index(b, j, i):
-            return (b, jnp.maximum(i, (j * bk) // bq), 0)
+        def q_index(b, j, gi):
+            return (q_row(b, gi // nq),
+                    jnp.maximum(gi % nq, (j * bk) // bq), 0)
     else:
-        def q_index(b, j, i):
-            return (b, i, 0)
+        def q_index(b, j, gi):
+            return (q_row(b, gi // nq), gi % nq, 0)
 
     q_spec_t = pl.BlockSpec((1, bq, d), q_index, memory_space=pltpu.VMEM)
     row_spec_t = pl.BlockSpec((1, bq, 1), q_index, memory_space=pltpu.VMEM)
-    kv_spec_t = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+    kv_spec_t = pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0),
                              memory_space=pltpu.VMEM)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=bq, block_k=bk,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, n_q_tiles=nq),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, seq_k, d), v.dtype),
         ],
-        grid=(bh, nkv, nq),
+        grid=(bh_kv, nkv, nq * group),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
@@ -473,7 +519,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     )(qr, kr, vr, dor, lser, deltar)
 
     shape_q = (batch, heads, seq_q, d)
-    shape_k = (batch, heads, seq_k, d)
+    shape_k = (batch, kv_heads, seq_k, d)
     return (dq.reshape(shape_q), dk.reshape(shape_k), dv.reshape(shape_k))
 
 
