@@ -76,6 +76,12 @@ def get_args(argv=None):
     p.add_argument("--accum_steps", default=1, type=int,
                    help="gradient-accumulation microbatches per optimizer "
                         "step (peak activation memory / accum_steps)")
+    p.add_argument("--gen_temperature", default=0.0, type=float,
+                   help="sampling temperature for --generate (0 = greedy)")
+    p.add_argument("--gen_top_k", default=None, type=int,
+                   help="top-k filter for --generate sampling")
+    p.add_argument("--gen_top_p", default=None, type=float,
+                   help="nucleus top-p filter for --generate sampling")
     p.add_argument("--generate", default=0, type=int,
                    help="after training, greedy-decode this many tokens "
                         "from a prompt through the KV cache and print them")
@@ -284,8 +290,18 @@ def main() -> None:
             else:
                 prompt = make_batch(np.random.default_rng(args.seed + 1), 1,
                                     8, args.vocab)
+            temp = args.gen_temperature
+            if temp == 0.0 and (args.gen_top_k is not None
+                                or args.gen_top_p is not None):
+                # filters are meaningless under greedy argmax — sample
+                temp = 1.0
+                rank_print("--gen_top_k/--gen_top_p given with temperature "
+                           "0: sampling at temperature 1.0")
             out = lm_generate(module, state.params, jnp.asarray(prompt),
-                              max_new=args.generate)
+                              max_new=args.generate,
+                              temperature=temp,
+                              top_k=args.gen_top_k, top_p=args.gen_top_p,
+                              rng=jax.random.PRNGKey(args.seed or 0))
             rank_print(f"prompt {prompt[0].tolist()} -> "
                        f"{np.asarray(out)[0, 8:].tolist()}")
     if ctx.is_distributed:

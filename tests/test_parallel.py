@@ -151,6 +151,21 @@ class TestRingAttention:
                 atol=0.03, rtol=0.03,
             )
 
+    def test_flash_kernel_gqa_native(self, seq_mesh):
+        """The flash ring consumes grouped-query K/V without repeating
+        (advertised via supports_gqa): matches the repeated-KV dense
+        reference, and K/V rotate the ring at kv-head width."""
+        q, _, _ = self._qkv(seq=64, heads=4)
+        _, k, v = self._qkv(seq=64, heads=2, seed=9)
+        ring = make_ring_attention(seq_mesh, causal=True, kernel="flash",
+                                   interpret=True)
+        assert getattr(ring, "supports_gqa", False)
+        out = ring(q, k, v)
+        ref = attention_reference(q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1),
+                                  causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
     def test_flash_kernel_unfit_shard_falls_back(self, seq_mesh):
         """Shards that don't fit the kernel block contract (here 12 tokens
         per device with block 8) trace through the xla body instead of

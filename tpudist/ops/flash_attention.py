@@ -131,6 +131,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
+def _kv_row_map(heads: int, kv_heads: int):
+    """Map a batch-major q-head grid row to its KV head's row (GQA)."""
+    group = heads // kv_heads
+
+    def kv_row(b):
+        return (b // heads) * kv_heads + (b % heads) // group
+
+    return kv_row
+
+
 def _gqa_shape_check(q, k, v) -> int:
     """Validate [b, hq, sq, d] x [b, hkv, sk, d] inputs and return the KV
     head count (hkv must divide hq — grouped-query attention runs
@@ -168,10 +178,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale
     )
 
-    def kv_row(b):
-        # grid row (batch-major over q heads) -> its KV head's row
-        return (b // heads) * kv_heads + (b % heads) // group
-
+    kv_row = _kv_row_map(heads, kv_heads)
     if causal:
         causal_j = _causal_kv_index(bq, bk)
 
@@ -279,9 +286,10 @@ def flash_attention(
     return out
 
 
-# Consumes grouped-query K/V natively (fewer KV heads than q heads);
-# wrappers that route to this kernel should propagate the tag.
+# Consume grouped-query K/V natively (fewer KV heads than q heads);
+# wrappers that route to these kernels should propagate the tag.
 flash_attention.supports_gqa = True
+flash_attention_with_lse.supports_gqa = True
 
 
 def blockwise_attention(
@@ -430,8 +438,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     nq = seq_q // bq
     nkv = seq_k // bk
 
-    def kv_row(b):
-        return (b // heads) * kv_heads + (b % heads) // group
+    kv_row = _kv_row_map(heads, kv_heads)
 
     work = bh * seq_q * seq_k * (0.5 if causal else 1.0)
     in_bytes = int(

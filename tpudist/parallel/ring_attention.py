@@ -227,6 +227,10 @@ def ring_attention_shard_flash(
     # path otherwise — same semantics, no shape constraint.
     shard = q.shape[-2]
     if shard % min(block_q, shard) or shard % min(block_k, shard):
+        if k.shape[1] != q.shape[1]:  # xla body needs equal heads
+            group = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
         return ring_attention_shard(
             q, k, v, axis_name=axis_name, causal=causal
         )
@@ -322,4 +326,10 @@ def make_ring_attention(
         # (its carries are explicitly pcast).
         check_vma=(kernel != "flash"),
     )
-    return jax.jit(sharded)
+    ring = jax.jit(sharded)
+    if kernel == "flash":
+        # The per-hop flash kernels consume grouped-query K/V natively
+        # (Block then skips its repeat); the xla body needs equal heads,
+        # so only the flash path advertises it.
+        ring.supports_gqa = True
+    return ring
