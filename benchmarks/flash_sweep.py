@@ -73,6 +73,8 @@ def main(argv=None):
     p.add_argument("--batch", default=4, type=int)
     p.add_argument("--heads", default=4, type=int)
     p.add_argument("--head-dim", default=64, type=int)
+    p.add_argument("--kv-heads", default=None, type=int,
+                   help="grouped-query KV head count (default = --heads)")
     p.add_argument("--blocks", default="128x128,256x256,256x512,512x512,512x1024,1024x1024")
     p.add_argument("--steps", default=10, type=int)
     p.add_argument("--grad", action="store_true", help="time fwd+bwd too")
@@ -83,10 +85,12 @@ def main(argv=None):
     from tpudist.parallel.ring_attention import attention_reference
 
     rng = np.random.default_rng(0)
+    kv_heads = args.kv_heads or args.heads
     shape = (args.batch, args.heads, args.seq, args.head_dim)
+    kv_shape = (args.batch, kv_heads, args.seq, args.head_dim)
     q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
-    k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
-    v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    k = jnp.asarray(rng.standard_normal(kv_shape), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(kv_shape), jnp.float32)
 
     results = []
 
@@ -96,13 +100,18 @@ def main(argv=None):
         print(json.dumps(row))
 
     if not args.skip_dense:
+        # GQA baseline: dense on repeated K/V — the MHA-equivalent compute
+        # the grouped kernel's bandwidth win is measured against.
+        group = args.heads // kv_heads
+        kd = jnp.repeat(k, group, axis=1) if group > 1 else k
+        vd = jnp.repeat(v, group, axis=1) if group > 1 else v
         dense = jax.jit(lambda a, b, c: attention_reference(a, b, c, causal=True))
-        report("dense_xla_fwd", _time(dense, q, k, v, steps=args.steps))
+        report("dense_xla_fwd", _time(dense, q, kd, vd, steps=args.steps))
         if args.grad:
             dense_g = jax.jit(jax.grad(
                 lambda a, b, c: attention_reference(a, b, c, causal=True).sum()
             ))
-            report("dense_xla_fwdbwd", _time(dense_g, q, k, v, steps=args.steps))
+            report("dense_xla_fwdbwd", _time(dense_g, q, kd, vd, steps=args.steps))
 
     for spec in args.blocks.split(","):
         bq, bk = (int(x) for x in spec.split("x"))

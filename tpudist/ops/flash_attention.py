@@ -160,7 +160,6 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
                    out_f32=False):
     batch, heads, seq_q, d = q.shape
     kv_heads = _gqa_shape_check(q, k, v)
-    group = heads // kv_heads
     seq_k = k.shape[2]
     bq = min(block_q, seq_q)
     bk = min(block_k, seq_k)
