@@ -85,7 +85,10 @@ def main(argv=None):
     from tpudist.parallel.ring_attention import attention_reference
 
     rng = np.random.default_rng(0)
-    kv_heads = args.kv_heads or args.heads
+    kv_heads = args.heads if args.kv_heads is None else args.kv_heads
+    if kv_heads < 1 or args.heads % kv_heads:
+        raise SystemExit(
+            f"--kv-heads {kv_heads} must be >= 1 and divide --heads {args.heads}")
     shape = (args.batch, args.heads, args.seq, args.head_dim)
     kv_shape = (args.batch, kv_heads, args.seq, args.head_dim)
     q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
@@ -95,7 +98,9 @@ def main(argv=None):
     results = []
 
     def report(name, secs):
-        row = {"kernel": name, "seq": args.seq, "ms": round(secs * 1e3, 3)}
+        row = {"kernel": name, "seq": args.seq,
+               "heads": args.heads, "kv_heads": kv_heads,
+               "ms": round(secs * 1e3, 3)}
         results.append(row)
         print(json.dumps(row))
 

@@ -96,14 +96,22 @@ def barrier(name: str = "tpudist_barrier") -> None:
     multihost_utils.sync_global_devices(name)
 
 
-def device_put_global(x: np.ndarray, sharding) -> jax.Array:
+def device_put_global(x: np.ndarray, sharding, global_shape=None) -> jax.Array:
     """Assemble a global sharded array from per-process host data.
 
     Each process passes its *local* shard; the result is a global
     ``jax.Array`` laid out by ``sharding``.  Single-process: a plain
-    ``device_put``.
+    ``device_put``.  ``global_shape`` defaults to the data-parallel
+    convention (dim 0 scaled by process count); pass it explicitly when
+    sharding any other dimension (e.g. a seq-sharded ring input).
     """
     if jax.process_count() == 1:
+        if global_shape is not None and tuple(x.shape) != tuple(global_shape):
+            raise ValueError(
+                f"single-process data shape {x.shape} != requested "
+                f"global_shape {tuple(global_shape)} — pass the full array"
+            )
         return jax.device_put(x, sharding)
-    global_shape = (x.shape[0] * jax.process_count(), *x.shape[1:])
+    if global_shape is None:
+        global_shape = (x.shape[0] * jax.process_count(), *x.shape[1:])
     return jax.make_array_from_process_local_data(sharding, x, global_shape)
