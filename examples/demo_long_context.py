@@ -67,6 +67,9 @@ def get_args(argv=None):
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3-style fully-sharded params + optimizer "
                         "state over the data axis (1/n state memory/chip)")
+    p.add_argument("--sliding_window", default=None, type=int,
+                   help="local attention: attend the previous N positions "
+                        "only (single seq shard; flash band kernels on TPU)")
     p.add_argument("--rope", action="store_true",
                    help="rotary position encoding instead of the learned "
                         "position table (length-extrapolating)")
@@ -129,6 +132,9 @@ def main() -> None:
         f"seq_len={args.seq_len} (block {args.seq_len // args.seq_shards}/chip)"
     )
 
+    if args.sliding_window is not None and args.seq_shards > 1:
+        raise SystemExit("--sliding_window composes with the single-shard "
+                         "attention path; drop --seq_shards")
     attention = (
         make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA,
                             inner_block=args.inner_block)
@@ -155,6 +161,7 @@ def main() -> None:
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         rope=args.rope,
         n_kv_heads=args.n_kv_heads,
+        sliding_window=args.sliding_window,
     )
     from tpudist.train import build_optimizer_from_args
 

@@ -36,6 +36,25 @@ class TestDecodeConsistency:
                                    np.asarray(full.astype(jnp.float32)),
                                    atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("rope", [False, True])
+    def test_sliding_window_cache_matches_full_forward(self, rope):
+        """Windowed model: the decode cache's band mask must reproduce the
+        training-time sliding-window attention position for position."""
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, rope=rope, sliding_window=5,
+            **CFG)
+        tokens = _tokens(batch=2, seq=16)
+        full = module.apply(params, tokens)
+        cached = decode_logits(module, params, tokens)
+        np.testing.assert_allclose(np.asarray(cached),
+                                   np.asarray(full.astype(jnp.float32)),
+                                   atol=1e-4, rtol=1e-4)
+        # sanity: the window actually bites (differs from the unwindowed
+        # model with the same params)
+        dense_mod = module.clone(sliding_window=None)
+        dense = dense_mod.apply(params, tokens)
+        assert float(jnp.max(jnp.abs(full - dense))) > 1e-4
+
     def test_bf16_decode_runs(self):
         module, params = create_transformer(jax.random.PRNGKey(0),
                                             seq_len=16, dtype=jnp.bfloat16,

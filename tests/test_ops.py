@@ -117,6 +117,47 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("window", [16, 100, 128])
+    def test_sliding_window(self, window):
+        """Sliding-window band (q − k < window): fwd + grads match the
+        dense banded reference, including windows that don't align with
+        tile edges — both band edges elide dead tiles."""
+        q, k, v = self._qkv(seq=256, d=32)
+        out = flash_attention(q, k, v, True, 64, 64, True, window)
+        ref = attention_reference(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        gf = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, True, 64, 64, True, window) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attention_reference(q, k, v, causal=True,
+                                    window=window) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_sliding_window_gqa(self):
+        """Window composes with grouped-query K/V."""
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = jax.random.normal(ks[0], (1, 4, 128, 32), jnp.float32)
+        k = jax.random.normal(ks[1], (1, 2, 128, 32), jnp.float32)
+        v = jax.random.normal(ks[2], (1, 2, 128, 32), jnp.float32)
+        out = flash_attention(q, k, v, True, 64, 64, True, 32)
+        ref = attention_reference(q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1),
+                                  causal=True, window=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_requires_causal(self):
+        q, k, v = self._qkv(seq=64)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, False, 64, 64, True, 16)
+
     def test_gqa_indivisible_heads_raises(self):
         q = jnp.zeros((1, 4, 64, 16))
         kv = jnp.zeros((1, 3, 64, 16))

@@ -35,41 +35,51 @@ from tpudist.parallel.ring_attention import attention_reference
 AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
 
-def _default_attention(q, k, v):
-    """Platform/length-aware single-device attention: dense XLA for short
-    sequences (lowest dispatch overhead), the Pallas flash kernel on TPU /
-    the blockwise XLA formulation elsewhere.  Crossover measured on-chip
-    (benchmarks/flash_sweep.py): flash fwd+bwd wins 3× at 1024 and 3.1× at
-    2048; dense wins below 1024.
+def make_length_aware_attention(window: Optional[int] = None):
+    """Build the platform/length-aware single-device causal attention:
+    dense XLA for short sequences (lowest dispatch overhead), the Pallas
+    flash kernel on TPU / the blockwise XLA formulation elsewhere.
+    Crossover measured on-chip (benchmarks/flash_sweep.py): flash fwd+bwd
+    wins 3× at 1024 and 3.1× at 2048; dense wins below 1024.
 
-    Accepts grouped-query K/V (fewer heads than q): the flash kernels
-    consume it natively — KV tiles are fetched once per group, never
-    materialized at full head count; the non-kernel paths broadcast."""
-    seq = q.shape[2]
-    use_flash = (seq >= 1024 and seq % 512 == 0
-                 and jax.devices()[0].platform == "tpu")
-    if not use_flash and k.shape[1] != q.shape[1]:
-        # only the flash kernels consume grouped K/V natively
-        group = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
-    if use_flash:
-        from tpudist.ops import flash_attention
+    ``window``: sliding-window (local) attention — the flash kernels mask
+    to the band and elide tiles outside it on both sides (compute scales
+    with window, not seq); the non-kernel paths mask the dense scores.
 
-        # Wider KV tiles amortize the per-tile grid overhead once the KV
-        # sweep is long (8192: 6.8 vs 8.7 ms fwd+bwd — flash_sweep.py).
-        bk = 1024 if seq >= 8192 and seq % 1024 == 0 else 512
-        return flash_attention(q, k, v, True, 512, bk, False)
-    if seq < 1024 or seq % 512:
-        return attention_reference(q, k, v, causal=True)
-    from tpudist.ops import blockwise_attention
+    The result accepts grouped-query K/V (fewer heads than q): the flash
+    kernels consume it natively — KV tiles are fetched once per group,
+    never materialized at full head count; the non-kernel paths broadcast.
+    """
+    def attend(q, k, v):
+        seq = q.shape[2]
+        use_flash = (seq >= 1024 and seq % 512 == 0
+                     and jax.devices()[0].platform == "tpu")
+        if not use_flash and k.shape[1] != q.shape[1]:
+            # only the flash kernels consume grouped K/V natively
+            group = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
+        if use_flash:
+            from tpudist.ops import flash_attention
 
-    return blockwise_attention(q, k, v, causal=True, block_k=512)
+            # Wider KV tiles amortize the per-tile grid overhead once the
+            # KV sweep is long (8192: 6.8 vs 8.7 ms fwd+bwd — flash_sweep).
+            bk = 1024 if seq >= 8192 and seq % 1024 == 0 else 512
+            return flash_attention(q, k, v, True, 512, bk, False, window)
+        if seq < 1024 or seq % 512:
+            return attention_reference(q, k, v, causal=True, window=window)
+        from tpudist.ops import blockwise_attention
+
+        return blockwise_attention(q, k, v, causal=True, block_k=512,
+                                   window=window)
+
+    # Block consults this tag before broadcasting K/V to full head count —
+    # this path handles grouped-query inputs itself (see above).
+    attend.supports_gqa = True
+    return attend
 
 
-# Block consults this tag before broadcasting K/V to full head count —
-# the default path handles grouped-query inputs itself (see above).
-_default_attention.supports_gqa = True
+_default_attention = make_length_aware_attention()
 
 
 def rope_rotate(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
@@ -179,6 +189,9 @@ class Block(nn.Module):
     # natively; others get K/V broadcast to full heads.  The decode cache
     # stores only n_kv_heads either way (the GQA memory win).
     n_kv_heads: Optional[int] = None
+    # Sliding-window size for the DECODE cache mask (training-time
+    # windowing lives in attention_fn — TransformerLM threads both).
+    sliding_window: Optional[int] = None
     # Autoregressive decode mode: single-token inputs attend over a
     # ``max_len`` K/V cache carried in the flax "cache" collection.
     decode: bool = False
@@ -268,6 +281,8 @@ class Block(nn.Module):
         scores = jnp.einsum("bngqd,bnkd->bngqk", qg, ck.value,
                             preferred_element_type=jnp.float32) * scale
         live = jnp.arange(self.max_len) <= pos
+        if self.sliding_window is not None:
+            live &= jnp.arange(self.max_len) > pos - self.sliding_window
         scores = jnp.where(live[None, None, None, None, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bngqk,bnkd->bngqd", w.astype(self.dtype), cv.value,
@@ -299,6 +314,10 @@ class TransformerLM(nn.Module):
     # Grouped-query attention (Llama-2/Mistral style): K/V heads shared by
     # groups of query heads; halves-or-better the decode KV cache.
     n_kv_heads: Optional[int] = None  # None = n_heads (MHA)
+    # Sliding-window (local) attention: each token attends to the previous
+    # ``sliding_window`` positions only (Mistral-style).  Ignored when a
+    # custom attention_fn is injected (compose the window there).
+    sliding_window: Optional[int] = None
     # KV-cache decode mode (see tpudist.models.generate): one token per
     # call, positions tracked in the flax "cache" collection.
     decode: bool = False
@@ -306,7 +325,18 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
         """``tokens: [batch, seq] int32`` → logits ``[batch, seq, vocab]``."""
-        attn = self.attention_fn or _default_attention
+        if self.sliding_window is not None:
+            if self.attention_fn is not None:
+                raise ValueError(
+                    "sliding_window with a custom attention_fn would window "
+                    "decode but not training — compose the window inside "
+                    "the injected attention_fn instead")
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got {self.sliding_window}")
+        attn = self.attention_fn or (
+            make_length_aware_attention(self.sliding_window)
+            if self.sliding_window is not None else _default_attention)
         seq = tokens.shape[1]
         x = nn.Embed(self.vocab, self.d_model, name="tok_embed",
                      dtype=self.dtype)(tokens)
@@ -327,7 +357,8 @@ class TransformerLM(nn.Module):
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
                 dtype=self.dtype, rope=self.rope,
                 n_kv_heads=self.n_kv_heads, decode=self.decode,
-                max_len=self.max_len, name=f"block_{i}",
+                max_len=self.max_len, sliding_window=self.sliding_window,
+                name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
         return nn.Dense(self.vocab, use_bias=False, name="head",

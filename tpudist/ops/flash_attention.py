@@ -43,22 +43,32 @@ from tpudist.parallel.ring_attention import (
 _MASK_VALUE = -1e30
 
 
-def _tile_live(qi, kv, block_q: int, block_k: int, causal: bool):
-    """Whether tile (qi, kv) intersects the causal lower triangle.  The
-    non-causal form keeps a traced always-true predicate so both variants
-    flow through the same ``pl.when``."""
-    return (qi + 1) * block_q > kv * block_k if causal else kv >= 0
+def _tile_live(qi, kv, block_q: int, block_k: int, causal: bool,
+               window=None):
+    """Whether tile (qi, kv) intersects the attended band.  Causal: the
+    lower triangle; with a sliding ``window`` additionally q − k < window
+    (tiles entirely left of the band are dead too).  The non-causal form
+    keeps a traced always-true predicate so every variant flows through
+    the same ``pl.when``."""
+    live = (qi + 1) * block_q > kv * block_k if causal else kv >= 0
+    if window is not None:
+        live &= qi * block_q - ((kv + 1) * block_k - 1) < window
+    return live
 
 
-def _tile_causal_mask(s, qi, kv, block_q: int, block_k: int):
-    """Apply the causal mask to score tile ``s`` at tile coords (qi, kv)."""
+def _tile_causal_mask(s, qi, kv, block_q: int, block_k: int, window=None):
+    """Apply the causal (and optional sliding-window) mask to score tile
+    ``s`` at tile coords (qi, kv)."""
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = kv * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return jnp.where(q_pos >= k_pos, s, _MASK_VALUE)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= q_pos - k_pos < window
+    return jnp.where(keep, s, _MASK_VALUE)
 
 
 def _last_live_kv(qi, nkv, block_q: int, block_k: int, causal: bool):
@@ -69,20 +79,27 @@ def _last_live_kv(qi, nkv, block_q: int, block_k: int, causal: bool):
     ) if causal else nkv - 1
 
 
-def _causal_kv_index(block_q: int, block_k: int):
+def _causal_kv_index(block_q: int, block_k: int, window=None):
     """Index map for the KV-innermost sweeps under causal masking: dead KV
-    tiles (fully above the diagonal) re-map to the Q row's last live tile —
-    Pallas elides the DMA when consecutive grid steps repeat a block index,
-    so each row's dead tail costs neither fetch bandwidth nor compute (the
-    kernels' ``_tile_live`` predicate is already false there)."""
+    tiles (fully above the diagonal — and, with a sliding ``window``, fully
+    left of the band) re-map to the Q row's nearest live tile — Pallas
+    elides the DMA when consecutive grid steps repeat a block index, so
+    dead tiles cost neither fetch bandwidth nor compute (the kernels'
+    ``_tile_live`` predicate is already false there)."""
     def kv_index(b, i, j):
-        return (b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
+        j = jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
+        if window is not None:
+            j = jnp.maximum(
+                j, jnp.maximum(i * block_q - window + 1, 0) // block_k
+            )
+        return (b, j, 0)
 
     return kv_index
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                  *, block_q: int, block_k: int, causal: bool, scale: float):
+                  *, block_q: int, block_k: int, causal: bool, scale: float,
+                  window=None):
     """One (bh, q_block, kv_block) grid step.
 
     The grid's KV dimension is innermost (TPU grids run sequentially), so
@@ -101,7 +118,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # Causal: blocks fully above the diagonal contribute nothing — skip.
-    @pl.when(_tile_live(qi, kv, block_q, block_k, causal))
+    @pl.when(_tile_live(qi, kv, block_q, block_k, causal, window))
     def _():
         # MXU operands stay in the input dtype (bf16 runs at bf16 MXU
         # throughput); accumulation is always f32 via preferred_element_type.
@@ -110,7 +127,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _tile_causal_mask(s, qi, kv, block_q, block_k)
+            s = _tile_causal_mask(s, qi, kv, block_q, block_k, window)
         m = m_ref[:, 0]
         l = l_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -157,7 +174,12 @@ def _gqa_shape_check(q, k, v) -> int:
 
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
-                   out_f32=False):
+                   out_f32=False, window=None):
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     batch, heads, seq_q, d = q.shape
     kv_heads = _gqa_shape_check(q, k, v)
     seq_k = k.shape[2]
@@ -174,12 +196,13 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
     vr = v.reshape(batch * kv_heads, seq_k, d)
 
     kernel = functools.partial(
-        _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale
+        _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale,
+        window=window,
     )
 
     kv_row = _kv_row_map(heads, kv_heads)
     if causal:
-        causal_j = _causal_kv_index(bq, bk)
+        causal_j = _causal_kv_index(bq, bk, window)
 
         def kv_index(b, i, j):
             return (kv_row(b), causal_j(b, i, j)[1], 0)
@@ -234,7 +257,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
     return out.reshape(batch, heads, seq_q, d), lse.reshape(batch, heads, seq_q)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(
     q: jax.Array,
     k: jax.Array,
@@ -244,6 +267,7 @@ def flash_attention_with_lse(
     block_k: int = 128,
     interpret: bool = False,
     out_f32: bool = False,
+    window: int | None = None,
 ):
     """Flash attention that also returns the per-row logsumexp
     ``[batch, heads, seq_q]`` (f32, scaled-score domain).
@@ -261,7 +285,7 @@ def flash_attention_with_lse(
     """
     return _flash_forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, out_f32=out_f32,
+        interpret=interpret, out_f32=out_f32, window=window,
     )
 
 
@@ -273,14 +297,18 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Flash attention over ``[batch, heads, seq, head_dim]`` inputs.
 
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
-    testing); on TPU leave it False.
+    testing); on TPU leave it False.  ``window`` (requires ``causal``)
+    restricts each token to the previous ``window`` positions (sliding-
+    window attention, Mistral-style): tiles outside the band are dead on
+    both sides — compute AND fetch cost scale with ``window``, not seq.
     """
     out, _ = flash_attention_with_lse(
-        q, k, v, causal, block_q, block_k, interpret
+        q, k, v, causal, block_q, block_k, interpret, False, window
     )
     return out
 
@@ -298,6 +326,7 @@ def blockwise_attention(
     *,
     causal: bool = False,
     block_k: int = 128,
+    window: int | None = None,
 ) -> jax.Array:
     """Memory-efficient attention in plain XLA: ``lax.scan`` over KV blocks
     carrying the (m, l, o) online-softmax triple, each block's work wrapped
@@ -307,6 +336,11 @@ def blockwise_attention(
     scores instead of saving them).  The kernel-free fallback to
     :func:`flash_attention` for platforms without Pallas (the flash
     backward itself is Pallas — see `_flash_backward`)."""
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     scale = q.shape[-1] ** -0.5
     seq_k = k.shape[2]
     bk = min(block_k, seq_k)
@@ -326,7 +360,14 @@ def blockwise_attention(
     def body(carry, blk):
         m, l, o = carry
         kv_i, kt, vt = blk
-        mask = _causal_mask(0, kv_i * bk, q_len, bk) if causal else None
+        mask = None
+        if causal:
+            mask = _causal_mask(0, kv_i * bk, q_len, bk)
+            if window is not None:
+                q_pos = lax.broadcasted_iota(jnp.int32, (q_len, bk), 0)
+                k_pos = kv_i * bk + lax.broadcasted_iota(
+                    jnp.int32, (q_len, bk), 1)
+                mask &= q_pos - k_pos < window
         return _block_update(q, kt, vt, m, l, o, scale=scale, mask=mask), None
 
     m0 = jnp.full(q.shape[:-1], _MASK_VALUE, jnp.float32)
@@ -340,7 +381,7 @@ def blockwise_attention(
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc_ref, *, block_q: int, block_k: int,
-                         causal: bool, scale: float):
+                         causal: bool, scale: float, window=None):
     """dq: grid (bh, q_block, kv_block), KV innermost — dq for one Q tile
     accumulates in VMEM scratch across its KV sweep, mirroring the forward's
     schedule (and its causal dead-block elision)."""
@@ -352,7 +393,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(_tile_live(qi, kv, block_q, block_k, causal))
+    @pl.when(_tile_live(qi, kv, block_q, block_k, causal, window))
     def _():
         q = q_ref[0]
         k = k_ref[0]
@@ -360,7 +401,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _tile_causal_mask(s, qi, kv, block_q, block_k)
+            s = _tile_causal_mask(s, qi, kv, block_q, block_k, window)
         # Softmax tile from the saved row logsumexp — no m/l recurrence.
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -377,7 +418,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
                           block_q: int, block_k: int, causal: bool,
-                          scale: float, n_q_tiles: int):
+                          scale: float, n_q_tiles: int, window=None):
     """dk/dv: grid (bh_kv, kv_block, group·q_block) with the (group member,
     Q tile) sweep innermost — dk/dv for one KV tile accumulate in VMEM
     scratch across every Q tile of every q head in its GQA group (group=1
@@ -393,7 +434,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    @pl.when(_tile_live(qi, kv, block_q, block_k, causal))
+    @pl.when(_tile_live(qi, kv, block_q, block_k, causal, window))
     def _():
         q = q_ref[0]
         k = k_ref[0]
@@ -401,7 +442,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _tile_causal_mask(s, qi, kv, block_q, block_k)
+            s = _tile_causal_mask(s, qi, kv, block_q, block_k, window)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         pt = p.astype(do.dtype).T
         dv_acc_ref[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
@@ -418,7 +459,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
-                    interpret):
+                    interpret, window=None):
     batch, heads, seq_q, d = q.shape
     kv_heads = _gqa_shape_check(q, k, v)
     group = heads // kv_heads
@@ -451,7 +492,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     q_spec = pl.BlockSpec((1, bq, d), q_row_index, memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, bq, 1), q_row_index, memory_space=pltpu.VMEM)
     if causal:
-        causal_j = _causal_kv_index(bq, bk)
+        causal_j = _causal_kv_index(bq, bk, window)
 
         def kv_index(b, i, j):
             return (kv_row(b), causal_j(b, i, j)[1], 0)
@@ -462,7 +503,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         grid=(bh, nq, nkv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -488,8 +529,11 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     if causal:
         def q_index(b, j, gi):
-            return (q_row(b, gi // nq),
-                    jnp.maximum(gi % nq, (j * bk) // bq), 0)
+            qi = jnp.maximum(gi % nq, (j * bk) // bq)
+            if window is not None:
+                # band's right edge: q tiles past k + window are dead too
+                qi = jnp.minimum(qi, ((j + 1) * bk - 1 + window - 1) // bq)
+            return (q_row(b, gi // nq), qi, 0)
     else:
         def q_index(b, j, gi):
             return (q_row(b, gi // nq), gi % nq, 0)
@@ -501,7 +545,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=bq, block_k=bk,
-                          causal=causal, scale=scale, n_q_tiles=nq),
+                          causal=causal, scale=scale, n_q_tiles=nq,
+                          window=window),
         out_shape=[
             jax.ShapeDtypeStruct((bh_kv, seq_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh_kv, seq_k, d), v.dtype),
@@ -529,15 +574,15 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     return (dq.reshape(shape_q), dk.reshape(shape_k), dv.reshape(shape_k))
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, out_f32):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, out_f32, window):
     out, lse = _flash_forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, out_f32=out_f32,
+        interpret=interpret, out_f32=out_f32, window=window,
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, out_f32, residuals, g):
+def _bwd(causal, block_q, block_k, interpret, out_f32, window, residuals, g):
     q, k, v, out, lse = residuals
     g_out, g_lse = g
     # delta_i = rowsum(dO_i · O_i): the dp→ds correction term, cheap
@@ -549,7 +594,7 @@ def _bwd(causal, block_q, block_k, interpret, out_f32, residuals, g):
     ) - g_lse.astype(jnp.float32)
     return _flash_backward(
         q, k, v, g_out, lse, delta, causal=causal, block_q=block_q,
-        block_k=block_k, interpret=interpret,
+        block_k=block_k, interpret=interpret, window=window,
     )
 
 

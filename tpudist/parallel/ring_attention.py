@@ -44,21 +44,31 @@ def attention_reference(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Plain softmax attention — the single-device ground truth.
 
-    Shapes: ``q, k, v: [batch, heads, seq, head_dim]``.
+    Shapes: ``q, k, v: [batch, heads, seq, head_dim]``.  ``window``
+    (requires ``causal``) masks to the sliding band ``q − k < window``.
     """
     scale = q.shape[-1] ** -0.5
     # Mixed-precision discipline (a no-op for f32 inputs): MXU operands in
     # the input dtype, score accumulation + softmax in f32, output cast back.
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if causal:
         q_len, k_len = scores.shape[-2], scores.shape[-1]
         qi = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
         kj = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
-        scores = jnp.where(qi >= kj, scores, _MASK_VALUE)
+        keep = qi >= kj
+        if window is not None:
+            keep &= qi - kj < window
+        scores = jnp.where(keep, scores, _MASK_VALUE)
     w = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
