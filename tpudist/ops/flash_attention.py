@@ -43,63 +43,97 @@ from tpudist.parallel.ring_attention import (
 _MASK_VALUE = -1e30
 
 
-def _tile_live(qi, kv, block_q: int, block_k: int, causal: bool,
-               window=None):
-    """Whether tile (qi, kv) intersects the attended band.  Causal: the
-    lower triangle; with a sliding ``window`` additionally q − k < window
-    (tiles entirely left of the band are dead too).  The non-causal form
-    keeps a traced always-true predicate so every variant flows through
-    the same ``pl.when``."""
-    live = (qi + 1) * block_q > kv * block_k if causal else kv >= 0
-    if window is not None:
-        live &= qi * block_q - ((kv + 1) * block_k - 1) < window
+def _normalize_band(causal, window):
+    """Reduce (causal, window) to the internal band ``lo <= q − k < hi``
+    (either side ``None`` = unbounded).
+
+    ``window`` forms: ``None`` (plain causal / full), an ``int`` W
+    (causal sliding window: band [0, W)), or an explicit ``(lo, hi)``
+    tuple (a shifted band in LOCAL coordinates — how ring attention
+    expresses an off-diagonal hop, where the global offset q − k = t·S
+    is static; requires ``causal=False`` since the band subsumes it).
+    """
+    if window is None:
+        return (0, None) if causal else (None, None)
+    if isinstance(window, tuple):
+        if causal:
+            raise ValueError("band-tuple window subsumes causal; pass "
+                             "causal=False")
+        lo, hi = window
+        if lo is not None and hi is not None and lo >= hi:
+            raise ValueError(f"empty band: lo {lo} >= hi {hi}")
+        return lo, hi
+    if not causal:
+        raise ValueError("sliding window requires causal=True")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return 0, window
+
+
+def _tile_live(qi, kv, block_q: int, block_k: int, lo, hi):
+    """Whether tile (qi, kv) intersects the band ``lo <= q − k < hi``.
+    The unbounded form keeps a traced always-true predicate so every
+    variant flows through the same ``pl.when``."""
+    live = kv >= 0
+    if lo is not None:
+        # max(q − k) over the tile = (qi+1)·bq − 1 − kv·bk
+        live &= (qi + 1) * block_q - 1 - kv * block_k >= lo
+    if hi is not None:
+        # min(q − k) over the tile = qi·bq − ((kv+1)·bk − 1)
+        live &= qi * block_q - ((kv + 1) * block_k - 1) < hi
     return live
 
 
-def _tile_causal_mask(s, qi, kv, block_q: int, block_k: int, window=None):
-    """Apply the causal (and optional sliding-window) mask to score tile
-    ``s`` at tile coords (qi, kv)."""
+def _tile_band_mask(s, qi, kv, block_q: int, block_k: int, lo, hi):
+    """Mask score tile ``s`` at tile coords (qi, kv) to the band."""
+    if lo is None and hi is None:
+        return s
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = kv * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    keep = q_pos >= k_pos
-    if window is not None:
-        keep &= q_pos - k_pos < window
+    keep = None
+    if lo is not None:
+        keep = q_pos - k_pos >= lo
+    if hi is not None:
+        upper = q_pos - k_pos < hi
+        keep = upper if keep is None else keep & upper
     return jnp.where(keep, s, _MASK_VALUE)
 
 
-def _last_live_kv(qi, nkv, block_q: int, block_k: int, causal: bool):
+def _last_live_kv(qi, nkv, block_q: int, block_k: int, lo):
     """Index of Q row ``qi``'s last live KV tile (the emission point of the
-    KV-innermost sweeps)."""
-    return jnp.minimum(
-        nkv - 1, ((qi + 1) * block_q - 1) // block_k
-    ) if causal else nkv - 1
+    KV-innermost sweeps).  Only the band's lower edge bounds it: k ranges
+    up to q − lo."""
+    if lo is None:
+        return nkv - 1
+    return jnp.clip(
+        ((qi + 1) * block_q - 1 - lo) // block_k, 0, nkv - 1
+    )
 
 
-def _causal_kv_index(block_q: int, block_k: int, window=None):
-    """Index map for the KV-innermost sweeps under causal masking: dead KV
-    tiles (fully above the diagonal — and, with a sliding ``window``, fully
-    left of the band) re-map to the Q row's nearest live tile — Pallas
+def _band_kv_index(block_q: int, block_k: int, lo, hi, nkv: int):
+    """Index map for the KV-innermost sweeps: dead KV tiles (outside the
+    band on either side) re-map to the Q row's nearest live tile — Pallas
     elides the DMA when consecutive grid steps repeat a block index, so
     dead tiles cost neither fetch bandwidth nor compute (the kernels'
     ``_tile_live`` predicate is already false there)."""
     def kv_index(b, i, j):
-        j = jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
-        if window is not None:
+        if lo is not None:
+            j = jnp.minimum(j, ((i + 1) * block_q - 1 - lo) // block_k)
+        if hi is not None:
             j = jnp.maximum(
-                j, jnp.maximum(i * block_q - window + 1, 0) // block_k
+                j, jnp.maximum(i * block_q - hi + 1, 0) // block_k
             )
-        return (b, j, 0)
+        return (b, jnp.clip(j, 0, nkv - 1), 0)
 
     return kv_index
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                  *, block_q: int, block_k: int, causal: bool, scale: float,
-                  window=None):
+                  *, block_q: int, block_k: int, lo, hi, scale: float):
     """One (bh, q_block, kv_block) grid step.
 
     The grid's KV dimension is innermost (TPU grids run sequentially), so
@@ -117,8 +151,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: blocks fully above the diagonal contribute nothing — skip.
-    @pl.when(_tile_live(qi, kv, block_q, block_k, causal, window))
+    # Tiles outside the band contribute nothing — skip.
+    @pl.when(_tile_live(qi, kv, block_q, block_k, lo, hi))
     def _():
         # MXU operands stay in the input dtype (bf16 runs at bf16 MXU
         # throughput); accumulation is always f32 via preferred_element_type.
@@ -126,8 +160,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         k = k_ref[0]
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _tile_causal_mask(s, qi, kv, block_q, block_k, window)
+        s = _tile_band_mask(s, qi, kv, block_q, block_k, lo, hi)
         m = m_ref[:, 0]
         l = l_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -139,13 +172,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
-    # Last KV block of this Q row: normalize and emit.
-    @pl.when(kv == _last_live_kv(qi, nkv, block_q, block_k, causal))
+    # Last KV block of this Q row: normalize and emit.  A row with no
+    # live tile at all (possible under a shifted band — e.g. a ring hop
+    # whose window edge crosses mid-shard) emits out=0, lse=_MASK_VALUE:
+    # exactly the "no contribution" partial for logsumexp merging, and a
+    # 0/0 NaN otherwise.
+    @pl.when(kv == _last_live_kv(qi, nkv, block_q, block_k, lo))
     def _():
-        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+        l = l_ref[:, 0]
+        # A row is dead when m never left its init — catches both "no live
+        # tile" (l == 0) and "live tile but every entry masked" (l counts
+        # exp(_MASK − _MASK) = 1 per masked entry, so l alone misses it).
+        dead = m_ref[:, 0] <= _MASK_VALUE * 0.5
+        safe_l = jnp.where(dead, 1.0, l)
+        o_ref[0] = jnp.where(
+            dead[:, None], 0.0, acc_ref[:] / safe_l[:, None]
+        ).astype(o_ref.dtype)
         # Per-row logsumexp (scaled-score domain) — the backward's residual:
         # p = exp(s·scale − lse) reconstructs the softmax tile exactly.
-        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
+        lse_ref[0, :, 0] = jnp.where(
+            dead, _MASK_VALUE, m_ref[:, 0] + jnp.log(safe_l)
+        )
 
 
 def _kv_row_map(heads: int, kv_heads: int):
@@ -175,11 +222,7 @@ def _gqa_shape_check(q, k, v) -> int:
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
                    out_f32=False, window=None):
-    if window is not None:
-        if not causal:
-            raise ValueError("sliding window requires causal=True")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    lo, hi = _normalize_band(causal, window)
     batch, heads, seq_q, d = q.shape
     kv_heads = _gqa_shape_check(q, k, v)
     seq_k = k.shape[2]
@@ -196,23 +239,18 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
     vr = v.reshape(batch * kv_heads, seq_k, d)
 
     kernel = functools.partial(
-        _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale,
-        window=window,
+        _flash_kernel, block_q=bq, block_k=bk, lo=lo, hi=hi, scale=scale,
     )
 
     kv_row = _kv_row_map(heads, kv_heads)
-    if causal:
-        causal_j = _causal_kv_index(bq, bk, window)
+    band_j = _band_kv_index(bq, bk, lo, hi, seq_k // bk)
 
-        def kv_index(b, i, j):
-            return (kv_row(b), causal_j(b, i, j)[1], 0)
-    else:
-        def kv_index(b, i, j):
-            return (kv_row(b), j, 0)
+    def kv_index(b, i, j):
+        return (kv_row(b), band_j(b, i, j)[1], 0)
 
     # Whole-kernel cost for the XLA scheduler (matmul mult-add = 2 FLOPs;
     # exp per score entry; causal does half the score work).
-    work = bh * seq_q * seq_k * (0.5 if causal else 1.0)
+    work = bh * seq_q * seq_k * (0.5 if lo is not None else 1.0)
     cost = pl.CostEstimate(
         flops=int(4 * work * d),
         transcendentals=int(work),
@@ -360,14 +398,8 @@ def blockwise_attention(
     def body(carry, blk):
         m, l, o = carry
         kv_i, kt, vt = blk
-        mask = None
-        if causal:
-            mask = _causal_mask(0, kv_i * bk, q_len, bk)
-            if window is not None:
-                q_pos = lax.broadcasted_iota(jnp.int32, (q_len, bk), 0)
-                k_pos = kv_i * bk + lax.broadcasted_iota(
-                    jnp.int32, (q_len, bk), 1)
-                mask &= q_pos - k_pos < window
+        mask = _causal_mask(0, kv_i * bk, q_len, bk, window) \
+            if causal else None
         return _block_update(q, kt, vt, m, l, o, scale=scale, mask=mask), None
 
     m0 = jnp.full(q.shape[:-1], _MASK_VALUE, jnp.float32)
@@ -381,7 +413,7 @@ def blockwise_attention(
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc_ref, *, block_q: int, block_k: int,
-                         causal: bool, scale: float, window=None):
+                         lo, hi, scale: float):
     """dq: grid (bh, q_block, kv_block), KV innermost — dq for one Q tile
     accumulates in VMEM scratch across its KV sweep, mirroring the forward's
     schedule (and its causal dead-block elision)."""
@@ -393,32 +425,35 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(_tile_live(qi, kv, block_q, block_k, causal, window))
+    @pl.when(_tile_live(qi, kv, block_q, block_k, lo, hi))
     def _():
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _tile_causal_mask(s, qi, kv, block_q, block_k, window)
+        s = _tile_band_mask(s, qi, kv, block_q, block_k, lo, hi)
         # Softmax tile from the saved row logsumexp — no m/l recurrence.
-        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        # Dead rows carry the _MASK_VALUE lse sentinel: exp(s − lse) would
+        # be exp(0)=1 on their masked entries, so zero them explicitly.
+        row_lse = lse_ref[0, :, 0]
+        p = jnp.exp(s - row_lse[:, None]) * (
+            row_lse > _MASK_VALUE * 0.5)[:, None]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
         dq_acc_ref[:] += jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
-    @pl.when(kv == _last_live_kv(qi, nkv, block_q, block_k, causal))
+    @pl.when(kv == _last_live_kv(qi, nkv, block_q, block_k, lo))
     def _():
         dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
-                          block_q: int, block_k: int, causal: bool,
-                          scale: float, n_q_tiles: int, window=None):
+                          block_q: int, block_k: int, lo, hi,
+                          scale: float, n_q_tiles: int):
     """dk/dv: grid (bh_kv, kv_block, group·q_block) with the (group member,
     Q tile) sweep innermost — dk/dv for one KV tile accumulate in VMEM
     scratch across every Q tile of every q head in its GQA group (group=1
@@ -434,16 +469,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    @pl.when(_tile_live(qi, kv, block_q, block_k, causal, window))
+    @pl.when(_tile_live(qi, kv, block_q, block_k, lo, hi))
     def _():
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _tile_causal_mask(s, qi, kv, block_q, block_k, window)
-        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        s = _tile_band_mask(s, qi, kv, block_q, block_k, lo, hi)
+        row_lse = lse_ref[0, :, 0]
+        p = jnp.exp(s - row_lse[:, None]) * (
+            row_lse > _MASK_VALUE * 0.5)[:, None]
         pt = p.astype(do.dtype).T
         dv_acc_ref[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -460,6 +496,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
                     interpret, window=None):
+    lo, hi = _normalize_band(causal, window)
     batch, heads, seq_q, d = q.shape
     kv_heads = _gqa_shape_check(q, k, v)
     group = heads // kv_heads
@@ -480,7 +517,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     kv_row = _kv_row_map(heads, kv_heads)
 
-    work = bh * seq_q * seq_k * (0.5 if causal else 1.0)
+    work = bh * seq_q * seq_k * (0.5 if lo is not None else 1.0)
     in_bytes = int(
         (qr.size + kr.size + vr.size + dor.size) * q.dtype.itemsize
         + (lser.size + deltar.size) * 4
@@ -491,19 +528,15 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     q_spec = pl.BlockSpec((1, bq, d), q_row_index, memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, bq, 1), q_row_index, memory_space=pltpu.VMEM)
-    if causal:
-        causal_j = _causal_kv_index(bq, bk, window)
+    band_j = _band_kv_index(bq, bk, lo, hi, nkv)
 
-        def kv_index(b, i, j):
-            return (kv_row(b), causal_j(b, i, j)[1], 0)
-    else:
-        def kv_index(b, i, j):
-            return (kv_row(b), j, 0)
+    def kv_index(b, i, j):
+        return (kv_row(b), band_j(b, i, j)[1], 0)
     kv_spec = pl.BlockSpec((1, bk, d), kv_index, memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
-                          causal=causal, scale=scale, window=window),
+                          lo=lo, hi=hi, scale=scale),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         grid=(bh, nq, nkv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -527,16 +560,15 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         # KV grid row (batch-major over kv heads) + group member -> q row
         return (b // kv_heads) * heads + (b % kv_heads) * group + g
 
-    if causal:
-        def q_index(b, j, gi):
-            qi = jnp.maximum(gi % nq, (j * bk) // bq)
-            if window is not None:
-                # band's right edge: q tiles past k + window are dead too
-                qi = jnp.minimum(qi, ((j + 1) * bk - 1 + window - 1) // bq)
-            return (q_row(b, gi // nq), qi, 0)
-    else:
-        def q_index(b, j, gi):
-            return (q_row(b, gi // nq), gi % nq, 0)
+    def q_index(b, j, gi):
+        qi = gi % nq
+        if lo is not None:
+            # band's lower edge: q < k + lo tiles are dead
+            qi = jnp.maximum(qi, (j * bk + lo) // bq)
+        if hi is not None:
+            # band's upper edge: q tiles past k + hi are dead too
+            qi = jnp.minimum(qi, ((j + 1) * bk - 1 + hi - 1) // bq)
+        return (q_row(b, gi // nq), jnp.clip(qi, 0, nq - 1), 0)
 
     q_spec_t = pl.BlockSpec((1, bq, d), q_index, memory_space=pltpu.VMEM)
     row_spec_t = pl.BlockSpec((1, bq, 1), q_index, memory_space=pltpu.VMEM)
@@ -545,8 +577,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=bq, block_k=bk,
-                          causal=causal, scale=scale, n_q_tiles=nq,
-                          window=window),
+                          lo=lo, hi=hi, scale=scale, n_q_tiles=nq),
         out_shape=[
             jax.ShapeDtypeStruct((bh_kv, seq_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh_kv, seq_k, d), v.dtype),

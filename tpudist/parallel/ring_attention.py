@@ -96,10 +96,13 @@ def _block_update(q, k, v, m, l, o, *, scale, mask=None):
     return m_new, l_new, o_new
 
 
-def _causal_mask(q_off, k_off, bq: int, bk: int):
+def _causal_mask(q_off, k_off, bq: int, bk: int, window=None):
     q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return q_pos >= k_pos
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= q_pos - k_pos < window
+    return keep
 
 
 def ring_attention_shard(
@@ -110,6 +113,7 @@ def ring_attention_shard(
     axis_name: str = AXIS_SEQ,
     causal: bool = False,
     inner_block: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Shard-local ring attention body (call inside ``shard_map``).
 
@@ -140,11 +144,14 @@ def ring_attention_shard(
     o = lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
     q_off = my_idx * block
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+
     def consume_shard(kv_idx, k, v, m, l, o):
         """Fold one ring step's KV shard into the (m, l, o) carry."""
         if inner_block is None:
-            mask = _causal_mask(q_off, kv_idx * block, block, block) \
-                if causal else None
+            mask = _causal_mask(q_off, kv_idx * block, block, block,
+                                window) if causal else None
             return _block_update(q, k, v, m, l, o, scale=scale, mask=mask)
         nb = block // inner_block
         if block % inner_block:
@@ -166,7 +173,7 @@ def ring_attention_shard(
             if causal:
                 mask = _causal_mask(
                     q_off, kv_idx * block + sub_i * inner_block,
-                    block, inner_block,
+                    block, inner_block, window,
                 )
             return _block_update(q, kt, vt, m, l, o, scale=scale, mask=mask), None
 
@@ -177,6 +184,12 @@ def ring_attention_shard(
     for step in range(axis_size):
         kv_idx = (my_idx - step) % axis_size
         m, l, o = consume_shard(kv_idx, k, v, m, l, o)
+        if window is not None and window - (step + 1) * block <= -(block - 1):
+            # Sliding window: every later hop is fully masked for every
+            # device (un-wrapped hops sit left of the band at the static
+            # offset (step+1)·block; wrapped hops are causally dead) —
+            # stop the ring, same static break as the flash body.
+            break
         if step + 1 < axis_size:
             # One ICI hop: K/V move to the right neighbor while the next
             # step's compute is still queued — XLA overlaps the two.
@@ -210,6 +223,7 @@ def ring_attention_shard_flash(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Shard-local ring attention whose per-hop math is the Pallas flash
     kernel (call inside ``shard_map``).
@@ -235,6 +249,8 @@ def ring_attention_shard_flash(
     # Trace-time fit check (shard shapes are static here): the kernel needs
     # the clamped blocks to divide the shard.  Fall back to the XLA carry
     # path otherwise — same semantics, no shape constraint.
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     shard = q.shape[-2]
     if shard % min(block_q, shard) or shard % min(block_k, shard):
         if k.shape[1] != q.shape[1]:  # xla body needs equal heads
@@ -242,28 +258,42 @@ def ring_attention_shard_flash(
             k = jnp.repeat(k, group, axis=1)
             v = jnp.repeat(v, group, axis=1)
         return ring_attention_shard(
-            q, k, v, axis_name=axis_name, causal=causal
+            q, k, v, axis_name=axis_name, causal=causal, window=window
         )
 
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
 
-    # Hop 0 is this device's own (diagonal) KV shard: causal kernel.
-    # out_f32: partials stay f32 through every merge whatever the input
-    # dtype (parity with the XLA path's f32 (m, l, o) carry).
+    # Hop 0 is this device's own (diagonal) KV shard: causal kernel
+    # (windowed if requested).  out_f32: partials stay f32 through every
+    # merge whatever the input dtype (parity with the XLA path's f32
+    # (m, l, o) carry).
     out, lse = flash_attention_with_lse(
-        q, k, v, causal, block_q, block_k, interpret, True
+        q, k, v, causal, block_q, block_k, interpret, True, window
     )
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     for step in range(1, axis_size):
+        if window is not None and window - step * shard <= -(shard - 1):
+            # The band ends before this hop's shard for EVERY device (the
+            # un-wrapped local offset q − k = step·shard is static), and
+            # later hops are further left still: with a sliding window the
+            # ring stops here — compute scales with window, not seq.
+            break
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         kv_idx = (my_idx - step) % axis_size
         if causal:
+            # Un-wrapped hops (kv_idx < my_idx) sit wholly in the causal
+            # past: the per-hop kernel needs no causal mask, only the
+            # window band shifted by the static hop offset step·shard.
+            band = (None, window - step * shard) if window is not None \
+                else None
+
             def live_hop(kt, vt):
                 return flash_attention_with_lse(
-                    q, kt, vt, False, block_q, block_k, interpret, True
+                    q, kt, vt, False, block_q, block_k, interpret, True,
+                    band,
                 )
 
             def dead_hop(kt, vt):
@@ -292,6 +322,7 @@ def make_ring_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    window: Optional[int] = None,
 ):
     """Jitted global-view ring attention over ``mesh``.
 
@@ -316,15 +347,18 @@ def make_ring_attention(
         on_tpu = jax.devices()[0].platform == "tpu"
         kernel = "flash" if (on_tpu or interpret) and inner_block is None \
             else "xla"
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if kernel == "flash":
         body = functools.partial(
             ring_attention_shard_flash, axis_name=axis_name, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            window=window,
         )
     else:
         body = functools.partial(
             ring_attention_shard, axis_name=axis_name, causal=causal,
-            inner_block=inner_block,
+            inner_block=inner_block, window=window,
         )
     sharded = jax.shard_map(
         lambda q, k, v: body(q, k, v),
