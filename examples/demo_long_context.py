@@ -69,7 +69,8 @@ def get_args(argv=None):
                         "state over the data axis (1/n state memory/chip)")
     p.add_argument("--sliding_window", default=None, type=int,
                    help="local attention: attend the previous N positions "
-                        "only (single seq shard; flash band kernels on TPU)")
+                        "only (flash band kernels on TPU; with --seq_shards"
+                        " the ring stops at the window)")
     p.add_argument("--rope", action="store_true",
                    help="rotary position encoding instead of the learned "
                         "position table (length-extrapolating)")
@@ -132,12 +133,10 @@ def main() -> None:
         f"seq_len={args.seq_len} (block {args.seq_len // args.seq_shards}/chip)"
     )
 
-    if args.sliding_window is not None and args.seq_shards > 1:
-        raise SystemExit("--sliding_window composes with the single-shard "
-                         "attention path; drop --seq_shards")
     attention = (
         make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA,
-                            inner_block=args.inner_block)
+                            inner_block=args.inner_block,
+                            window=args.sliding_window)
         if args.seq_shards > 1
         else None  # single seq shard: length-aware default (dense/flash)
     )
@@ -161,7 +160,10 @@ def main() -> None:
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         rope=args.rope,
         n_kv_heads=args.n_kv_heads,
-        sliding_window=args.sliding_window,
+        # ring path: the window lives inside the injected ring attention
+        # (TransformerLM rejects composing both); single-shard: the model
+        # owns it end-to-end (training band + decode cache mask).
+        sliding_window=None if args.seq_shards > 1 else args.sliding_window,
     )
     from tpudist.train import build_optimizer_from_args
 
@@ -290,6 +292,14 @@ def main() -> None:
         else:
             from tpudist.models import generate as lm_generate
 
+            gen_module = module
+            if args.sliding_window is not None and args.seq_shards > 1:
+                # decode from a ring-trained windowed model: swap the ring
+                # attention_fn for the model-owned window so the KV cache
+                # masks to the same band training used
+                gen_module = module.clone(
+                    attention_fn=None, sliding_window=args.sliding_window)
+
             if corpus_windows is not None:
                 # prompt from the training distribution: the first 8
                 # tokens of the corpus's first window
@@ -304,7 +314,7 @@ def main() -> None:
                 temp = 1.0
                 rank_print("--gen_top_k/--gen_top_p given with temperature "
                            "0: sampling at temperature 1.0")
-            out = lm_generate(module, state.params, jnp.asarray(prompt),
+            out = lm_generate(gen_module, state.params, jnp.asarray(prompt),
                               max_new=args.generate,
                               temperature=temp,
                               top_k=args.gen_top_k, top_p=args.gen_top_p,

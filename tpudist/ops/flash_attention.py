@@ -70,6 +70,19 @@ def _normalize_band(causal, window):
     return 0, window
 
 
+def _band_live_pairs(seq_q: int, seq_k: int, lo, hi) -> int:
+    """Exact number of (q, k) pairs inside the band — the FLOP-proportional
+    work the cost estimates feed the XLA scheduler (a hi-only ring-hop band
+    can be a thin corner; calling it dense would overstate work by the
+    seq/window ratio)."""
+    import numpy as np
+
+    q = np.arange(seq_q)
+    k_hi = np.minimum(q - (lo if lo is not None else -seq_k), seq_k - 1)
+    k_lo = np.maximum(q - ((hi if hi is not None else seq_q + seq_k) - 1), 0)
+    return int(np.clip(k_hi - k_lo + 1, 0, None).sum())
+
+
 def _tile_live(qi, kv, block_q: int, block_k: int, lo, hi):
     """Whether tile (qi, kv) intersects the band ``lo <= q − k < hi``.
     The unbounded form keeps a traced always-true predicate so every
@@ -250,7 +263,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
 
     # Whole-kernel cost for the XLA scheduler (matmul mult-add = 2 FLOPs;
     # exp per score entry; causal does half the score work).
-    work = bh * seq_q * seq_k * (0.5 if lo is not None else 1.0)
+    work = bh * _band_live_pairs(seq_q, seq_k, lo, hi)
     cost = pl.CostEstimate(
         flops=int(4 * work * d),
         transcendentals=int(work),
@@ -517,7 +530,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
     kv_row = _kv_row_map(heads, kv_heads)
 
-    work = bh * seq_q * seq_k * (0.5 if lo is not None else 1.0)
+    work = bh * _band_live_pairs(seq_q, seq_k, lo, hi)
     in_bytes = int(
         (qr.size + kr.size + vr.size + dor.size) * q.dtype.itemsize
         + (lser.size + deltar.size) * 4

@@ -144,8 +144,11 @@ def ring_attention_shard(
     o = lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
     q_off = my_idx * block
 
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
 
     def consume_shard(kv_idx, k, v, m, l, o):
         """Fold one ring step's KV shard into the (m, l, o) carry."""
@@ -347,8 +350,11 @@ def make_ring_attention(
         on_tpu = jax.devices()[0].platform == "tpu"
         kernel = "flash" if (on_tpu or interpret) and inner_block is None \
             else "xla"
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if kernel == "flash":
         body = functools.partial(
             ring_attention_shard_flash, axis_name=axis_name, causal=causal,

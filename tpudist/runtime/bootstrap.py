@@ -243,6 +243,22 @@ def initialize(
     global _INITIALIZED_CTX
     if _INITIALIZED_CTX is not None:
         return _INITIALIZED_CTX
+    # An explicitly-set JAX_PLATFORMS env var must win even on hosts whose
+    # sitecustomize force-selects a platform via jax.config at interpreter
+    # start (which silently defeats the env var).  Re-assert it before the
+    # backend comes up; no-op once backends are initialized.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        import jax
+
+        try:
+            from jax._src import xla_bridge as _xb
+
+            backend_up = _xb.backends_are_initialized()
+        except Exception:  # internal API moved — don't second-guess
+            backend_up = True
+        if not backend_up:
+            jax.config.update("jax_platforms", env_platforms)
     if ctx is None:
         ctx = resolve_process_context(use_node_rank=use_node_rank)
     if ctx.is_distributed:
