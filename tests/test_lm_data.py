@@ -197,6 +197,46 @@ class TestOptimAndEvalStep:
         u0, _ = adam.update(zero, adam.init(params), params)
         np.testing.assert_allclose(np.asarray(u0["w"]), 0.0, atol=1e-12)
 
+    @pytest.mark.parametrize("name", ["adam", "adamw", "adafactor", "lion"])
+    def test_optimizer_families_step(self, name):
+        """Every family must produce a finite descent step on a quadratic."""
+        from tpudist.train import build_optimizer
+
+        opt = build_optimizer(1e-2, optimizer=name, grad_clip=1.0)
+        params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        state = opt.init(params)
+
+        def loss(p):
+            return jnp.sum(p["w"] ** 2) + jnp.sum(p["b"] ** 2)
+
+        l0 = float(loss(params))
+        for _ in range(20):
+            g = jax.grad(loss)(params)
+            u, state = opt.update(g, state, params)
+            params = optax.apply_updates(params, u)
+        l1 = float(loss(params))
+        assert np.isfinite(l1) and l1 < l0, (name, l0, l1)
+
+    def test_adafactor_state_is_factored(self):
+        """The point of adafactor: second-moment state for a [d, d] matrix
+        is O(d) (row + column accumulators), not O(d^2)."""
+        from tpudist.train import build_optimizer
+
+        d = 256  # adafactor only factors dims >= its 128 threshold
+        params = {"w": jnp.ones((d, d))}
+        opt = build_optimizer(1e-2, optimizer="adafactor")
+        state = opt.init(params)
+        leaves = jax.tree.leaves(state)
+        assert all(leaf.size < d * d for leaf in leaves
+                   if hasattr(leaf, "size")), \
+            [getattr(leaf, "shape", None) for leaf in leaves]
+
+    def test_unknown_optimizer_rejected(self):
+        from tpudist.train import build_optimizer
+
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            build_optimizer(1e-3, optimizer="sgd")
+
     def test_eval_step_matches_train_loss(self, tmp_path, devices):
         """Eval loss on the training batch equals the train step's
         reported loss before the update."""

@@ -63,10 +63,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="learning-rate schedule over --total_iterations")
     p.add_argument("--warmup_steps", default=0, type=int,
                    help="linear warmup steps (warmup_cosine)")
+    p.add_argument("--optimizer",
+                   choices=["adam", "adamw", "adafactor", "lion"],
+                   default="adam",
+                   help="optimizer family (adam = the reference's choice)")
     p.add_argument("--grad_clip", default=0.0, type=float,
                    help="global-norm gradient clipping (0 = off)")
     p.add_argument("--weight_decay", default=0.0, type=float,
-                   help="decoupled AdamW weight decay (0 = plain Adam)")
+                   help="decoupled weight decay, masked to weight matrices "
+                        "(applies to adamw/adafactor/lion; with --optimizer "
+                        "adam, >0 upgrades to adamw)")
     p.add_argument("--log_every", default=1, type=int)
     p.add_argument("--project", default="tpudist", type=str)
     p.add_argument("--group", default=None, type=str)

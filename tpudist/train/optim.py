@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import optax
 
 SCHEDULES = ("constant", "cosine", "warmup_cosine")
+OPTIMIZERS = ("adam", "adamw", "adafactor", "lion")
 
 
 def build_schedule(
@@ -54,6 +55,7 @@ def build_schedule(
 def build_optimizer(
     lr: float,
     *,
+    optimizer: str = "adam",
     schedule: str = "constant",
     warmup_steps: int = 0,
     total_steps: int = 1000,
@@ -61,22 +63,44 @@ def build_optimizer(
     grad_clip: float = 0.0,
     weight_decay: float = 0.0,
 ) -> optax.GradientTransformation:
-    """Adam/AdamW over :func:`build_schedule` — the one optimizer factory.
+    """The one optimizer factory, over :func:`build_schedule`.
+
+    ``optimizer``: ``adam`` (the reference's choice, ``demo.py:80-81``),
+    ``adamw`` (decoupled decay), ``adafactor`` (factored second moments —
+    the classic memory-lean TPU LM optimizer: O(d) state for a d×d
+    matrix), or ``lion`` (sign-momentum; typically wants ~3-10× smaller lr
+    and larger decay).  ``weight_decay > 0`` with ``adam`` upgrades it to
+    ``adamw`` (back-compat with the pre-``optimizer``-flag CLI).
 
     ``grad_clip > 0`` prepends global-norm clipping (the whole gradient
     tree is rescaled when its L2 norm exceeds the bound — one ``psum``-free
-    pass, XLA fuses it into the step).  ``weight_decay > 0`` switches to
-    decoupled AdamW.
+    pass, XLA fuses it into the step).  Weight decay, where supported, is
+    masked to weight matrices (ndim > 1): decaying LayerNorm scales and
+    biases measurably hurts convergence.
     """
+    if optimizer not in OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {optimizer!r}; pick from "
+                         f"{OPTIMIZERS}")
     sched = build_schedule(
         lr, schedule=schedule, warmup_steps=warmup_steps,
         total_steps=total_steps, min_lr_ratio=min_lr_ratio,
     )
-    # Standard LM practice: decay only weight matrices — LayerNorm scales
-    # and biases (ndim <= 1) are excluded or convergence suffers.
     decay_mask = functools.partial(jax.tree.map, lambda p: jnp.ndim(p) > 1)
-    opt = (optax.adamw(sched, weight_decay=weight_decay, mask=decay_mask)
-           if weight_decay > 0 else optax.adam(sched))
+    if optimizer == "adam" and weight_decay > 0:
+        optimizer = "adamw"
+    if optimizer == "adam":
+        opt = optax.adam(sched)
+    elif optimizer == "adamw":
+        opt = optax.adamw(sched, weight_decay=weight_decay,
+                          mask=decay_mask)
+    elif optimizer == "adafactor":
+        # adafactor owns its own clipping/scaling pipeline; weight decay
+        # rides through its decay_rate-independent hook.
+        opt = optax.adafactor(sched, weight_decay_rate=weight_decay or None,
+                              weight_decay_mask=decay_mask)
+    else:  # lion
+        opt = optax.lion(sched, weight_decay=weight_decay,
+                         mask=decay_mask)
     if grad_clip > 0:
         return optax.chain(optax.clip_by_global_norm(grad_clip), opt)
     return opt
@@ -89,6 +113,7 @@ def build_optimizer_from_args(args) -> optax.GradientTransformation:
     mapping lives in exactly one place."""
     return build_optimizer(
         args.lr,
+        optimizer=getattr(args, "optimizer", "adam"),
         schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
         total_steps=args.total_iterations,
