@@ -205,7 +205,61 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 16, max_new: int = 240,
     }
 
 
+def _device_reachable(timeout_s: float = 180.0) -> bool:
+    """Probe the accelerator with a wall-clock bound.
+
+    The axon remote-execution tunnel can wedge for hours (a hung program
+    upstream blocks every later one); a plain first op would then hang the
+    whole bench with no artifact for the round.  Run a tiny matmul in a
+    daemon thread and give up after ``timeout_s``."""
+    import threading
+
+    done: list = []
+    errors: list = []
+
+    def probe():
+        try:
+            import jax.numpy as jnp
+
+            _sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+            done.append(True)
+        except Exception as e:  # a raising probe is NOT a wedged tunnel
+            errors.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if errors:
+        raise errors[0]  # real config/backend error: crash loudly
+    return bool(done)
+
+
 def main() -> None:
+    if not _device_reachable():
+        # Emit a parseable failure record rather than hanging the driver:
+        # value 0 / vs_baseline 0 cannot be mistaken for a measurement.
+        line = {"metric": "toy_mlp_samples_per_sec_per_chip", "value": 0,
+                "unit": "samples/sec/chip", "vs_baseline": 0.0,
+                "error": "device unreachable (remote tunnel down?)"}
+        # Print the record FIRST — the annotation write below is
+        # best-effort and must not be able to cost the driver its line.
+        print(json.dumps(line), flush=True)
+        try:
+            # Annotate BENCH_EXTENDED without clobbering the last good
+            # run's measurements.
+            ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
+            try:
+                ext = json.loads(ext_path.read_text())
+            except Exception:
+                ext = {}
+            ext["last_run_error"] = line["error"]
+            ext_path.write_text(json.dumps(ext, indent=2) + "\n")
+        except Exception:
+            pass
+        import os
+
+        os._exit(0)  # the stuck backend would hang normal interpreter exit
+
     results = {"device_kind": jax.devices()[0].device_kind,
                "n_chips": jax.local_device_count()}
 
