@@ -524,6 +524,20 @@ class TestLongContextExample:
         assert final < 2.0
 
 
+class TestWindowedRingExample:
+    def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
+        """--sliding_window composed with --seq_shards: the windowed ring
+        trains the increment-chain task (fully learnable inside any
+        window >= 2) end to end through the entry point."""
+        final = _run_example("demo_long_context", [
+            "--dry_run", "--seq_shards", "4", "--seq_len", "64",
+            "--sliding_window", "24", "--d_model", "64",
+            "--total_iterations", "60", "--batch_size", "8",
+            "--seed", "0", "--log_every", "20",
+        ], tmp_path, monkeypatch, capsys)
+        assert final < 2.0
+
+
 class Test3DParallelExample:
     def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
         final = _run_example("demo_3d_parallel", [
