@@ -75,6 +75,9 @@ def main(argv=None):
     p.add_argument("--head-dim", default=64, type=int)
     p.add_argument("--kv-heads", default=None, type=int,
                    help="grouped-query KV head count (default = --heads)")
+    p.add_argument("--window", default=None, type=int,
+                   help="sliding-window band (band-tile DMA elision: cost "
+                        "should scale with window, not seq)")
     p.add_argument("--blocks", default="128x128,256x256,256x512,512x512,512x1024,1024x1024")
     p.add_argument("--steps", default=10, type=int)
     p.add_argument("--grad", action="store_true", help="time fwd+bwd too")
@@ -89,6 +92,8 @@ def main(argv=None):
     if kv_heads < 1 or args.heads % kv_heads:
         raise SystemExit(
             f"--kv-heads {kv_heads} must be >= 1 and divide --heads {args.heads}")
+    if args.window is not None and args.window < 1:
+        raise SystemExit(f"--window must be >= 1, got {args.window}")
     shape = (args.batch, args.heads, args.seq, args.head_dim)
     kv_shape = (args.batch, kv_heads, args.seq, args.head_dim)
     q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
@@ -100,7 +105,7 @@ def main(argv=None):
     def report(name, secs):
         row = {"kernel": name, "seq": args.seq,
                "heads": args.heads, "kv_heads": kv_heads,
-               "ms": round(secs * 1e3, 3)}
+               "window": args.window, "ms": round(secs * 1e3, 3)}
         results.append(row)
         print(json.dumps(row))
 
@@ -110,11 +115,13 @@ def main(argv=None):
         group = args.heads // kv_heads
         kd = jnp.repeat(k, group, axis=1) if group > 1 else k
         vd = jnp.repeat(v, group, axis=1) if group > 1 else v
-        dense = jax.jit(lambda a, b, c: attention_reference(a, b, c, causal=True))
+        dense = jax.jit(lambda a, b, c: attention_reference(
+            a, b, c, causal=True, window=args.window))
         report("dense_xla_fwd", _time(dense, q, kd, vd, steps=args.steps))
         if args.grad:
             dense_g = jax.jit(jax.grad(
-                lambda a, b, c: attention_reference(a, b, c, causal=True).sum()
+                lambda a, b, c: attention_reference(
+                    a, b, c, causal=True, window=args.window).sum()
             ))
             report("dense_xla_fwdbwd", _time(dense_g, q, kd, vd, steps=args.steps))
 
@@ -123,12 +130,14 @@ def main(argv=None):
         if args.seq % bq or args.seq % bk:
             continue
         fl = jax.jit(lambda a, b, c, bq=bq, bk=bk:
-                     flash_attention(a, b, c, True, bq, bk, False))
+                     flash_attention(a, b, c, True, bq, bk, False,
+                                     args.window))
         report(f"flash_{bq}x{bk}_fwd", _time(fl, q, k, v, steps=args.steps))
         if args.grad:
             fl_g = jax.jit(jax.grad(
                 lambda a, b, c, bq=bq, bk=bk:
-                flash_attention(a, b, c, True, bq, bk, False).sum()
+                flash_attention(a, b, c, True, bq, bk, False,
+                                args.window).sum()
             ))
             report(f"flash_{bq}x{bk}_fwdbwd", _time(fl_g, q, k, v, steps=args.steps))
     return results

@@ -28,7 +28,7 @@ from jax.sharding import Mesh
 
 
 def measure(seq_len: int, seq_shards: int, *, batch: int, steps: int,
-            d_model: int, n_layers: int) -> dict:
+            d_model: int, n_layers: int, window=None) -> dict:
     from tpudist.models import create_transformer
     from tpudist.parallel import make_ring_attention
     from tpudist.runtime.mesh import AXIS_DATA, AXIS_SEQ
@@ -49,12 +49,14 @@ def measure(seq_len: int, seq_shards: int, *, batch: int, steps: int,
         axis_names=(AXIS_DATA, AXIS_SEQ),
     )
     attention = (
-        make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA)
+        make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA,
+                            window=window)
         if seq_shards > 1 else None
     )
     module, params = create_transformer(
         jax.random.PRNGKey(0), seq_len=seq_len, attention_fn=attention,
         vocab=256, d_model=d_model, n_layers=n_layers, max_len=seq_len,
+        sliding_window=window if seq_shards == 1 else None,
     )
     tx = optax.adam(3e-4)
     state = init_lm_state(params, tx)
@@ -81,7 +83,7 @@ def measure(seq_len: int, seq_shards: int, *, batch: int, steps: int,
 
     flops = transformer_train_flops(
         batch=batch, seq_len=seq_len, d_model=d_model, n_layers=n_layers,
-        d_ff=module.d_ff, vocab=module.vocab,
+        d_ff=module.d_ff, vocab=module.vocab, window=window,
     )
     util = mfu(flops, dt / steps, data_size * seq_shards, chip_peak_flops())
     return {
@@ -90,6 +92,7 @@ def measure(seq_len: int, seq_shards: int, *, batch: int, steps: int,
         "tokens_per_sec": round(batch * seq_len * steps / dt, 1),
         "model_flops_per_step": flops,
         "mfu_pct": round(util * 100, 2) if util is not None else None,
+        "window": window,
         "block_per_chip": seq_len // seq_shards,
         "regime": "virtual-cpu" if devices[0].platform == "cpu" else "hardware",
     }
@@ -103,14 +106,20 @@ def main(argv=None) -> list:
     p.add_argument("--steps", default=8, type=int)
     p.add_argument("--d-model", default=128, type=int)
     p.add_argument("--n-layers", default=2, type=int)
+    p.add_argument("--sliding-window", default=None, type=int,
+                   help="sliding-window attention: the ring stops at the "
+                        "window, so tokens/sec should hold as seq grows")
     args = p.parse_args(argv)
+    if args.sliding_window is not None and args.sliding_window < 1:
+        p.error(f"--sliding-window must be >= 1, got {args.sliding_window}")
 
     results = []
     for s in (int(x) for x in args.seq_lens.split(",")):
         for r in (int(x) for x in args.seq_shards.split(",")):
             try:
                 res = measure(s, r, batch=args.batch, steps=args.steps,
-                              d_model=args.d_model, n_layers=args.n_layers)
+                              d_model=args.d_model, n_layers=args.n_layers,
+                              window=args.sliding_window)
             except ValueError as e:
                 print(f"# skip seq={s} shards={r}: {e}", file=sys.stderr)
                 continue

@@ -46,18 +46,28 @@ class TestFlopsAccounting:
         from tpudist.utils import transformer_train_flops
 
         # One layer, no attention-vs-ffn surprises: check against the
-        # hand-expanded formula for small numbers.
+        # hand-expanded formula for small numbers.  Causal attention counts
+        # the exact live pairs s(s+1)/2 (each token attends itself + past).
         b, s, d, f, v, L = 2, 8, 4, 16, 10, 1
-        fwd = L * (8 * b * s * d * d + 2 * b * s * s * d + 4 * b * s * d * f) \
-            + 2 * b * s * d * v
+        causal_pairs = s * (s + 1) / 2
+        fwd = L * (8 * b * s * d * d + 4 * b * causal_pairs * d
+                   + 4 * b * s * d * f) + 2 * b * s * d * v
         got = transformer_train_flops(batch=b, seq_len=s, d_model=d,
                                       n_layers=L, d_ff=f, vocab=v)
         assert got == 3.0 * fwd
-        # Full attention doubles only the s^2 term.
+        # Full attention raises the pair count to s^2.
         full = transformer_train_flops(batch=b, seq_len=s, d_model=d,
                                        n_layers=L, d_ff=f, vocab=v,
                                        causal=False)
-        assert full - got == 3.0 * 2 * b * s * s * d
+        assert full - got == 3.0 * 4 * b * (s * s - causal_pairs) * d
+        # Sliding window clamps it to the band: first w tokens ramp up,
+        # the rest attend exactly w keys.
+        w = 3
+        band_pairs = w * (w + 1) / 2 + (s - w) * w
+        windowed = transformer_train_flops(batch=b, seq_len=s, d_model=d,
+                                           n_layers=L, d_ff=f, vocab=v,
+                                           window=w)
+        assert got - windowed == 3.0 * 4 * b * (causal_pairs - band_pairs) * d
         # fwd_only is exactly a third of the train count.
         assert transformer_train_flops(batch=b, seq_len=s, d_model=d,
                                        n_layers=L, d_ff=f, vocab=v,

@@ -43,6 +43,21 @@ def chip_peak_flops(device=None) -> Optional[float]:
     return PEAK_BF16_FLOPS.get(getattr(device, "device_kind", ""))
 
 
+def attention_live_pairs(seq_len: int, *, causal: bool = True,
+                         window=None) -> float:
+    """Number of attended (q, k) pairs — the score-matmul work unit.
+    Causal: s(s+1)/2; sliding window W: each token attends min(q+1, W)
+    keys; dense: s²."""
+    s = seq_len
+    if not causal:
+        return float(s * s)
+    if window is None or window >= s:
+        return s * (s + 1) / 2.0
+    w = max(int(window), 1)
+    # first w tokens attend q+1 keys; the rest attend exactly w
+    return w * (w + 1) / 2.0 + (s - w) * float(w)
+
+
 def transformer_train_flops(
     *,
     batch: int,
@@ -52,6 +67,7 @@ def transformer_train_flops(
     d_ff: int,
     vocab: int,
     causal: bool = True,
+    window=None,
     fwd_only: bool = False,
 ) -> float:
     """Analytic matmul FLOPs for one TransformerLM train step
@@ -59,15 +75,17 @@ def transformer_train_flops(
     proj, wi/wo FFN, untied head).
 
     Per block forward: qkv ``6*b*s*d^2`` + proj ``2*b*s*d^2`` + attention
-    ``4*b*s^2*d`` (scores + values; halved when causal) + FFN ``4*b*s*d*f``.
+    ``4 * live_pairs * d`` (scores + values over the attended band —
+    causal halves the dense count, a sliding ``window`` clamps it to the
+    band; see :func:`attention_live_pairs`) + FFN ``4*b*s*d*f``.
     Head: ``2*b*s*d*V``.  Train = 3x forward.  A top-1 capacity MoE FFN has
     the same per-token FLOPs as the dense FFN (each token visits one
     expert), so this formula covers the MoE variant too (router matmul is
     O(b*s*d*E), negligible).
     """
     b, s, d, f, v = batch, seq_len, d_model, d_ff, vocab
-    attn_factor = 2.0 if causal else 4.0
-    per_block = 8 * b * s * d * d + attn_factor * b * s * s * d + 4 * b * s * d * f
+    attn = 4.0 * b * attention_live_pairs(s, causal=causal, window=window) * d
+    per_block = 8 * b * s * d * d + attn + 4 * b * s * d * f
     fwd = n_layers * per_block + 2 * b * s * d * v
     return fwd if fwd_only else 3.0 * fwd
 
