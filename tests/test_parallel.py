@@ -198,6 +198,33 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, rtol=5e-5)
 
+    def test_sliding_window_gqa_ring_composed(self, seq_mesh):
+        """All three kernel capabilities at once — grouped K/V, sliding
+        window, ring decomposition — against the dense banded repeated-KV
+        reference, forward and backward."""
+        q, _, _ = self._qkv(seq=64, heads=4)
+        _, k, v = self._qkv(seq=64, heads=2, seed=11)
+        ring = make_ring_attention(seq_mesh, causal=True, kernel="flash",
+                                   interpret=True, window=20)
+
+        def rep(t):
+            return jnp.repeat(t, 2, axis=1)
+
+        ref_fn = lambda q, k, v: attention_reference(  # noqa: E731
+            q, rep(k), rep(v), causal=True, window=20)
+        np.testing.assert_allclose(
+            np.asarray(ring(q, k, v)), np.asarray(ref_fn(q, k, v)),
+            atol=2e-5, rtol=2e-5)
+        g_ring = jax.grad(
+            lambda q, k, v: jnp.sum(ring(q, k, v) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(ref_fn(q, k, v) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
     def test_flash_kernel_unfit_shard_falls_back(self, seq_mesh):
         """Shards that don't fit the kernel block contract (here 12 tokens
         per device with block 8) trace through the xla body instead of

@@ -590,6 +590,28 @@ class TestPipelineParallelTransformer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
+    def test_pp_apply_honors_sliding_window(self, devices):
+        """A windowed model pipelined over stages must reproduce the
+        unpipelined windowed forward (the stage blocks rebuild the
+        windowed default attention), and must differ from the unwindowed
+        forward (the window actually bites)."""
+        from tpudist.parallel import make_pp_lm_apply, stack_block_params
+
+        mesh = self._mesh(devices)
+        cfg = dict(vocab=32, d_model=32, n_layers=4, n_heads=2, d_ff=64,
+                   max_len=32)
+        module, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                            sliding_window=7, **cfg)
+        tokens = _tokens(batch=8, seq=32)
+        ref = module.apply(params, tokens)
+        pp_apply = make_pp_lm_apply(mesh, module, n_stages=4,
+                                    num_microbatches=2)
+        out = pp_apply(stack_block_params(params, n_stages=4), tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        dense = module.clone(sliding_window=None).apply(params, tokens)
+        assert float(jnp.max(jnp.abs(ref - dense))) > 1e-4
+
     def test_pp_apply_rope_remat(self, devices):
         """RoPE (no pos table) + stage remat through the pipeline path."""
         from tpudist.parallel import make_pp_lm_apply, stack_block_params

@@ -74,6 +74,8 @@ def sample_logits(
     (k-filter first, then nucleus), everything is fixed-shape ``jnp`` —
     the function jits and scans.
     """
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature

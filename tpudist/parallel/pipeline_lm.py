@@ -150,14 +150,25 @@ def make_pp_lm_apply(
     :func:`pp_state_sharding` — the loss/grad/optimizer path needs no
     pipeline awareness.
     """
-    from tpudist.models.transformer import Block, _default_attention
+    from tpudist.models.transformer import (
+        Block,
+        _default_attention,
+        make_length_aware_attention,
+    )
 
+    # Honor the model's sliding window: TransformerLM guarantees
+    # attention_fn is None when sliding_window is set, so rebuild the
+    # windowed default here exactly as the unpipelined model would.
+    if module.sliding_window is not None:
+        attn = make_length_aware_attention(module.sliding_window)
+    else:
+        attn = module.attention_fn or _default_attention
     block_mod = Block(
-        module.d_model, module.n_heads, module.d_ff,
-        module.attention_fn or _default_attention,
+        module.d_model, module.n_heads, module.d_ff, attn,
         n_experts=module.n_experts, moe_fn=module.moe_fn,
         dtype=module.dtype, rope=module.rope,
         n_kv_heads=module.n_kv_heads,
+        sliding_window=module.sliding_window,
     )
     embed_mod = _LMEmbed(module.vocab, module.d_model, module.max_len,
                          rope=module.rope, dtype=module.dtype)
