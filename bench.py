@@ -205,6 +205,88 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 16, max_new: int = 240,
     }
 
 
+def numerics_gate(interpret: bool = False, quick: bool = False) -> dict:
+    """Kernel-correctness gate — runs ON THE REAL CHIP before any timing.
+
+    The test suite forces CPU (``tests/conftest.py``), so every Pallas test
+    exercises interpret mode only; a silent Mosaic miscompilation on a new
+    libtpu would otherwise ship a plausible-looking number.  Assert the
+    flash kernels (fwd + bwd; dense / sliding-window / GQA / both) against
+    the XLA reference — at small shapes for mask/GQA semantics AND at the
+    PRODUCTION tile sizes the timed paths use (512-wide blocks at seq 1024,
+    1024-wide KV blocks at seq 8192 — ``make_length_aware_attention``'s
+    routing), since a miscompile can be specific to one tile layout.  A
+    mismatch raises — main() turns that into a value-0 record and a NONZERO
+    exit, so a bad kernel can never produce a recorded measurement.
+
+    ``quick=True`` runs only the small-block semantic cases (used by the
+    CPU interpret-mode test, where an 8192-seq interpreted kernel is
+    prohibitively slow).
+
+    Returns per-case max relative error (snapshotted to BENCH_EXTENDED so
+    every artifact carries the evidence the gate ran).
+    """
+    import jax.numpy as jnp
+
+    from tpudist.ops import flash_attention
+    from tpudist.parallel import attention_reference
+
+    h = 4
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+
+    def rel_err(got, want) -> float:
+        got, want = np.asarray(got), np.asarray(want)
+        return float(np.abs(got - want).max() / max(np.abs(want).max(), 1e-6))
+
+    # Loose enough for MXU-vs-MXU f32 accumulation-order differences,
+    # tight enough that a miscompiled tile (garbage, zeros, wrong mask)
+    # cannot slip through.
+    tol = 1e-2
+    #         tag           heads hkv  seq  blocks    window
+    cases = [("dense",        h,  h,   512, (128, 128), None),
+             ("window",       h,  h,   512, (128, 128), 192),
+             ("gqa",          h,  2,   512, (128, 128), None),
+             ("gqa_window",   h,  2,   512, (128, 128), 192)]
+    if not quick:
+        # The tiles the timed paths actually run (transformer.py routing:
+        # 512/512 from seq 1024, 512/1024 from seq 8192).
+        cases += [("tile512_gqa_window", h, 2, 1024, (512, 512), 768),
+                  ("tile1024_dense",     1, 1, 8192, (512, 1024), None)]
+    report = {}
+    for tag, nh, hkv, s, (bq, bk), window in cases:
+        q = jax.random.normal(kq, (1, nh, s, 64), jnp.float32)
+        k = jax.random.normal(kk, (1, hkv, s, 64), jnp.float32)
+        v = jax.random.normal(kv, (1, hkv, s, 64), jnp.float32)
+
+        def loss_flash(q, k, v, bq=bq, bk=bk, window=window):
+            return (flash_attention(q, k, v, True, bq, bk, interpret,
+                                    window) ** 2).sum()
+
+        def loss_ref(q, k, v, nh=nh, hkv=hkv, window=window):
+            kf, vf = (k, v) if hkv == nh else (
+                jnp.repeat(k, nh // hkv, axis=1),
+                jnp.repeat(v, nh // hkv, axis=1))
+            return (attention_reference(q, kf, vf, causal=True,
+                                        window=window) ** 2).sum()
+
+        # One value+grad evaluation covers the forward kernel and all
+        # three backward kernels (dq, dk/dv) in this configuration.
+        fg, got = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        rg, want = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        errs = {"loss": rel_err(fg, rg),
+                "dq": rel_err(got[0], want[0]),
+                "dk": rel_err(got[1], want[1]),
+                "dv": rel_err(got[2], want[2])}
+        worst = max(errs.values())
+        report[tag] = {"max_rel_err": round(worst, 6), **{
+            kk_: round(v_, 6) for kk_, v_ in errs.items()}}
+        if not np.isfinite(worst) or worst > tol:
+            raise AssertionError(
+                f"flash kernel numerics gate FAILED [{tag}]: {errs} "
+                f"(tolerance {tol}) — refusing to record a benchmark")
+    return report
+
+
 def _device_reachable(timeout_s: float = 180.0) -> bool:
     """Probe the accelerator with a wall-clock bound.
 
@@ -234,34 +316,47 @@ def _device_reachable(timeout_s: float = 180.0) -> bool:
     return bool(done)
 
 
+def _fail_record(error: str, exit_code: int) -> None:
+    """Abort the run with a parseable value-0 record + NONZERO exit —
+    a failure must never be mistakable for a measurement, by JSON line
+    (value 0) or by exit status."""
+    line = {"metric": "toy_mlp_samples_per_sec_per_chip", "value": 0,
+            "unit": "samples/sec/chip", "vs_baseline": 0.0, "error": error}
+    # Print the record FIRST — the annotation write below is best-effort
+    # and must not be able to cost the driver its line.
+    print(json.dumps(line), flush=True)
+    try:
+        # Annotate BENCH_EXTENDED without clobbering the last good run's
+        # measurements.
+        ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
+        try:
+            ext = json.loads(ext_path.read_text())
+        except Exception:
+            ext = {}
+        ext["last_run_error"] = error
+        ext_path.write_text(json.dumps(ext, indent=2) + "\n")
+    except Exception:
+        pass
+    import os
+
+    # os._exit because a stuck backend would hang normal interpreter exit.
+    os._exit(exit_code)
+
+
 def main() -> None:
     if not _device_reachable():
-        # Emit a parseable failure record rather than hanging the driver:
-        # value 0 / vs_baseline 0 cannot be mistaken for a measurement.
-        line = {"metric": "toy_mlp_samples_per_sec_per_chip", "value": 0,
-                "unit": "samples/sec/chip", "vs_baseline": 0.0,
-                "error": "device unreachable (remote tunnel down?)"}
-        # Print the record FIRST — the annotation write below is
-        # best-effort and must not be able to cost the driver its line.
-        print(json.dumps(line), flush=True)
-        try:
-            # Annotate BENCH_EXTENDED without clobbering the last good
-            # run's measurements.
-            ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
-            try:
-                ext = json.loads(ext_path.read_text())
-            except Exception:
-                ext = {}
-            ext["last_run_error"] = line["error"]
-            ext_path.write_text(json.dumps(ext, indent=2) + "\n")
-        except Exception:
-            pass
-        import os
-
-        os._exit(0)  # the stuck backend would hang normal interpreter exit
+        _fail_record("device unreachable (remote tunnel down?)", 2)
 
     results = {"device_kind": jax.devices()[0].device_kind,
                "n_chips": jax.local_device_count()}
+
+    if jax.devices()[0].platform == "tpu":
+        # Correctness gate BEFORE any timing: a kernel mismatch must kill
+        # the run (nonzero exit), never record a number.
+        try:
+            results["numerics_gate"] = numerics_gate()
+        except Exception as e:
+            _fail_record(f"numerics gate failed: {e!r}", 3)
 
     toy = bench_toy()
     results["toy"] = toy

@@ -90,3 +90,39 @@ class TestFlopsAccounting:
                      "--steps", "1", "--d-model", "32", "--n-layers", "1"])
         assert rows[0]["model_flops_per_step"] > 0
         assert rows[0]["mfu_pct"] is None  # virtual CPU: no peak known
+
+
+class TestNumericsGate:
+    """bench.py's on-chip kernel gate, exercised here in interpret mode
+    (the real run asserts the same cases on the TPU before any timing)."""
+
+    def test_gate_passes_and_reports_all_cases(self):
+        import bench
+
+        report = bench.numerics_gate(interpret=True, quick=True)
+        assert set(report) == {"dense", "window", "gqa", "gqa_window"}
+        for case in report.values():
+            assert case["max_rel_err"] < 1e-2
+            assert {"loss", "dq", "dk", "dv"} <= set(case)
+
+    def test_gate_raises_on_mismatch(self, monkeypatch):
+        import bench
+        from tpudist import ops
+
+        real = ops.flash_attention
+
+        def corrupted(q, k, v, *a, **kw):
+            return real(q, k, v, *a, **kw) * 1.5  # a "miscompiled" kernel
+
+        corrupted.supports_gqa = True
+        monkeypatch.setattr(ops, "flash_attention", corrupted)
+        with pytest.raises(AssertionError, match="numerics gate FAILED"):
+            bench.numerics_gate(interpret=True, quick=True)
+
+
+class TestFlopsWindowContract:
+    def test_window_without_causal_raises(self):
+        from tpudist.utils.flops import attention_live_pairs
+
+        with pytest.raises(ValueError, match="window requires causal"):
+            attention_live_pairs(16, causal=False, window=4)

@@ -698,3 +698,40 @@ class TestPipelineParallelTransformer:
         # [4 stages, 1 layer, 32, 96] -> one stage's [1, 1, 32, 96] per shard.
         assert qkv.addressable_shards[0].data.shape == (1, 1, 32, 96)
         assert sharded["rest"]["head"]["kernel"].sharding.spec == P()
+
+
+class TestBlockWindowGuard:
+    """Block.sliding_window only masks the decode cache; the training path
+    must be given an attention_fn carrying a MATCHING window tag —
+    otherwise the model would silently train full-causal and decode
+    windowed (advisor finding, round 2)."""
+
+    def test_untagged_attention_fn_raises(self):
+        from tpudist.models.transformer import Block
+        from tpudist.parallel import attention_reference
+
+        def untagged(q, k, v):
+            return attention_reference(q, k, v, causal=True)
+
+        block = Block(d_model=32, n_heads=4, d_ff=64, attention_fn=untagged,
+                      sliding_window=8)
+        x = jnp.zeros((2, 16, 32), jnp.float32)
+        with pytest.raises(ValueError, match="sliding_window"):
+            block.init(jax.random.PRNGKey(0), x)
+
+    def test_matching_tag_passes(self):
+        from tpudist.models.transformer import (
+            Block, make_length_aware_attention)
+
+        block = Block(d_model=32, n_heads=4, d_ff=64,
+                      attention_fn=make_length_aware_attention(8),
+                      sliding_window=8)
+        x = jnp.zeros((2, 16, 32), jnp.float32)
+        params = block.init(jax.random.PRNGKey(0), x)
+        assert block.apply(params, x).shape == x.shape
+
+    def test_ring_attention_carries_window_tag(self, devices):
+        mesh = Mesh(np.asarray(devices[:4]), axis_names=(AXIS_SEQ,))
+        ring = make_ring_attention(mesh, causal=True, window=8)
+        assert ring.window == 8
+        assert make_ring_attention(mesh, causal=True).window is None

@@ -97,6 +97,11 @@ def sample_logits(
         keep = keep.at[..., 0].set(True)
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
                          keepdims=True)
+        # Value-space masking: tokens exactly TIED with the cutoff logit
+        # survive even when outside the nucleus prefix (same for top_k's
+        # kth-value compare above).  Slightly more mass than requested on
+        # tied logits — the standard HF/T5X behavior; exactness would need
+        # masking in sorted-index space and a scatter back.
         logits = jnp.where(logits < cutoff, neg, logits)
     return jax.random.categorical(key, logits, axis=-1)
 

@@ -76,6 +76,10 @@ def make_length_aware_attention(window: Optional[int] = None):
     # Block consults this tag before broadcasting K/V to full head count —
     # this path handles grouped-query inputs itself (see above).
     attend.supports_gqa = True
+    # Block's training-path guard checks this tag against its
+    # sliding_window field (decode-cache masking alone is not windowed
+    # training — the mismatch must be loud, not silent).
+    attend.window = window
     return attend
 
 
@@ -225,6 +229,20 @@ class Block(nn.Module):
         if self.decode:
             attn = self._decode_attention(q, k, v)
         else:
+            if self.sliding_window is not None and getattr(
+                    self.attention_fn, "window", None) != self.sliding_window:
+                # sliding_window alone only masks the decode cache; a
+                # non-windowed attention_fn would train full-causal and
+                # decode windowed.  TransformerLM/pipeline_lm thread a
+                # matching windowed fn — raw Block users must too (fns
+                # built by make_length_aware_attention / make_ring_attention
+                # carry a ``window`` tag).
+                raise ValueError(
+                    "Block.sliding_window is set but attention_fn is not "
+                    "tagged with a matching window — inject an attention_fn "
+                    "built with the same window (e.g. "
+                    "make_length_aware_attention(window)), or tag a custom "
+                    "fn with .window")
             if self.rope:
                 q, k = rope_rotate(q), rope_rotate(k)
             if n_kv != self.n_heads and not getattr(

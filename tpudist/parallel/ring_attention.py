@@ -377,6 +377,8 @@ def make_ring_attention(
         check_vma=(kernel != "flash"),
     )
     ring = jax.jit(sharded)
+    # Window tag consumed by Block's sliding_window training-path guard.
+    ring.window = window
     if kernel == "flash":
         # The per-hop flash kernels consume grouped-query K/V natively
         # (Block then skips its repeat); the xla body needs equal heads,

@@ -50,6 +50,10 @@ def attention_live_pairs(seq_len: int, *, causal: bool = True,
     keys; dense: s²."""
     s = seq_len
     if not causal:
+        if window is not None:
+            # Match the kernel contract (flash_attention.py rejects this
+            # combination) rather than silently overstating FLOPs/MFU.
+            raise ValueError("window requires causal=True")
         return float(s * s)
     if window is None or window >= s:
         return s * (s + 1) / 2.0
