@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Pipeline-schedule comparison: GPipe vs 1F1B memory and bubble math.
+
+The point of 1F1B (``tpudist.parallel.pipeline.pipeline_1f1b_shard``) is
+that peak residual memory is O(n_stages) — CONSTANT in the microbatch
+count — while GPipe's autodiff backward keeps every microbatch's residuals
+live at the forward/backward phase boundary, so its memory grows with M.
+Both schedules idle (S−1) fill + (S−1) drain slots; raising M amortizes
+that bubble — which only 1F1B can afford memory-wise.
+
+This harness makes that concrete: for S stages and a ladder of M values it
+compiles BOTH train steps on the (data × stage) mesh and reports
+
+- XLA's compiled peak temp-buffer bytes per device
+  (``compiled.memory_analysis()`` — temp allocations hold the live
+  activations/residuals, the thing 1F1B bounds), and
+- the analytic bubble fraction of each schedule's tick loop:
+  GPipe runs M+S−1 forward ticks then M+S−1 backward ticks → idle
+  fraction (S−1)/(M+S−1); the SPMD-uniform "eager" 1F1B here runs
+  M+2(S−1) combined fwd+bwd ticks → idle fraction 2(S−1)/(M+2S−2).
+
+Works on the virtual CPU mesh (schedule math and compiled memory are
+platform-meaningful there; wall-clock is not measured).
+
+Usage:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/pp_schedules.py [--stages 4] [--micro 4,8,16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+
+def _peak_temp_bytes(jitted, *args):
+    """Per-device temp-allocation peak from XLA's memory analysis."""
+    compiled = jitted.lower(*args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:  # backend without the analysis API
+        return None
+    return int(getattr(ma, "temp_size_in_bytes", 0))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--stages", type=int, default=4)
+    p.add_argument("--micro", default="4,8,16",
+                   help="comma list of microbatch counts")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--batch-per-micro", type=int, default=2,
+                   help="global batch = this * num_micro (so per-micro "
+                        "work stays fixed while M grows)")
+    args = p.parse_args(argv)
+
+    if jax.default_backend() != "cpu" and jax.device_count() < 2:
+        print(json.dumps({"error": "need a multi-device mesh"}))
+        return []
+
+    from tpudist.models import create_transformer
+    from tpudist.parallel import (
+        make_pp_lm_apply,
+        make_pp_lm_train_step,
+        pp_state_sharding,
+        stack_block_params,
+    )
+    from tpudist.runtime.mesh import AXIS_DATA, AXIS_STAGE
+    from tpudist.train import init_lm_state, make_lm_train_step, token_sharding
+
+    S = args.stages
+    n_dev = jax.device_count()
+    data = n_dev // S
+    if data < 1 or n_dev % S:
+        raise SystemExit(f"{n_dev} devices do not fit {S} stages")
+    mesh = Mesh(np.asarray(jax.devices()).reshape(data, S),
+                axis_names=(AXIS_DATA, AXIS_STAGE))
+
+    module, params = create_transformer(
+        jax.random.PRNGKey(0), seq_len=args.seq_len, vocab=64,
+        d_model=args.d_model, n_layers=S, n_heads=4,
+        d_ff=4 * args.d_model, max_len=args.seq_len)
+    tx = optax.adam(1e-3)
+    pp = stack_block_params(params, S)
+    state = init_lm_state(pp, tx)
+    shard = pp_state_sharding(mesh, state)
+    state = jax.device_put(state, shard)
+
+    rows = []
+    for m in (int(x) for x in args.micro.split(",")):
+        batch = args.batch_per_micro * m * data
+        tokens = jax.device_put(
+            np.random.default_rng(0).integers(
+                0, 64, size=(batch, args.seq_len)).astype(np.int32),
+            token_sharding(mesh))
+
+        apply_g = make_pp_lm_apply(mesh, module, n_stages=S,
+                                   num_microbatches=m)
+        step_g = make_lm_train_step(apply_g, tx, mesh, donate_state=False,
+                                    state_sharding=shard)
+        step_f = make_pp_lm_train_step(
+            mesh, module, tx, n_stages=S, num_microbatches=m,
+            schedule="1f1b", donate_state=False, state_sharding=shard)
+
+        row = {
+            "stages": S, "num_micro": m, "global_batch": batch,
+            "bubble_gpipe": round((S - 1) / (m + S - 1), 4),
+            "bubble_1f1b": round(2 * (S - 1) / (m + 2 * S - 2), 4),
+            "temp_bytes_gpipe": _peak_temp_bytes(step_g, state, tokens),
+            "temp_bytes_1f1b": _peak_temp_bytes(step_f, state, tokens),
+        }
+        if row["temp_bytes_gpipe"] and row["temp_bytes_1f1b"]:
+            row["mem_ratio_1f1b_vs_gpipe"] = round(
+                row["temp_bytes_1f1b"] / row["temp_bytes_gpipe"], 3)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

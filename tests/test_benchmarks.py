@@ -126,3 +126,22 @@ class TestFlopsWindowContract:
 
         with pytest.raises(ValueError, match="window requires causal"):
             attention_live_pairs(16, causal=False, window=4)
+
+
+class TestPPSchedules:
+    def test_1f1b_memory_constant_in_m(self):
+        """The 1F1B schedule's compiled temp memory must grow far slower
+        with the microbatch count than GPipe's (the schedule's reason to
+        exist); bubble fields carry the analytic schedule math."""
+        sys.path.insert(0, "benchmarks")
+        from benchmarks.pp_schedules import main
+
+        rows = main(["--micro", "2,8", "--seq-len", "32", "--d-model", "32"])
+        assert [r["num_micro"] for r in rows] == [2, 8]
+        assert rows[0]["bubble_gpipe"] == pytest.approx(3 / 5, abs=1e-3)
+        assert rows[1]["bubble_1f1b"] == pytest.approx(6 / 14, abs=1e-3)
+        g_growth = rows[1]["temp_bytes_gpipe"] / rows[0]["temp_bytes_gpipe"]
+        f_growth = rows[1]["temp_bytes_1f1b"] / rows[0]["temp_bytes_1f1b"]
+        # GPipe residuals scale ~linearly with M; 1F1B's are O(S).
+        assert f_growth < g_growth
+        assert rows[1]["temp_bytes_1f1b"] < rows[1]["temp_bytes_gpipe"]

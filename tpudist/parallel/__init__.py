@@ -31,9 +31,14 @@ from tpudist.parallel.tensor_parallel import (  # noqa: F401
     row_spec,
     tp_mlp_shard,
 )
-from tpudist.parallel.pipeline import make_pipeline, pipeline_shard  # noqa: F401
+from tpudist.parallel.pipeline import (  # noqa: F401
+    make_pipeline,
+    pipeline_1f1b_shard,
+    pipeline_shard,
+)
 from tpudist.parallel.pipeline_lm import (  # noqa: F401
     make_pp_lm_apply,
+    make_pp_lm_train_step,
     pp_state_sharding,
     stack_block_params,
     unstack_block_params,

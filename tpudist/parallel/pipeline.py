@@ -111,6 +111,154 @@ def pipeline_shard(
     return outputs
 
 
+def pipeline_1f1b_shard(
+    stage_params,
+    out_params,
+    x_microbatches: jax.Array,
+    aux_microbatches: jax.Array,
+    *,
+    stage_fn: StageFn,
+    loss_fn,
+    axis_name: str = AXIS_STAGE,
+    data_axis=None,
+):
+    """Shard-local 1F1B schedule: forward AND backward in ONE scan, with
+    per-stage activation recompute and an O(num_stages) residual buffer.
+
+    GPipe (:func:`pipeline_shard` + autodiff) runs all ``M`` forwards, then
+    lets autodiff replay all ``M`` backwards — every microbatch's residuals
+    are live at the phase boundary, so peak memory grows with ``M``.  This
+    schedule hand-interleaves them instead, which autodiff cannot be asked
+    to do: backward of microbatch ``m`` starts as soon as the loss for
+    ``m`` exists, so at most ``2·(S−1)+1`` stage-input activations are ever
+    held per device — **constant in M**.  That unlocks the 1F1B trade:
+    raise ``M`` to amortize the pipeline bubble without activation memory
+    growing with it (the schedule of Narayanan et al.'s PipeDream-Flush /
+    Megatron's non-interleaved 1F1B, formulated SPMD-uniformly).
+
+    Timeline (0-indexed tick ``t``, stage ``s``, ``S`` stages, ``M``
+    microbatches; each tick every device runs one fwd unit and one
+    recompute+bwd unit, ``jnp.where``-gated like the GPipe loop):
+
+    - forward of micro ``m`` on stage ``s`` at tick ``t = s + m``;
+    - the LAST stage computes the microbatch loss and its cotangent the
+      same tick its forward lands (``loss_fn`` grad) and immediately
+      backwards it — 1F1B's defining move;
+    - backward of micro ``m`` on stage ``s`` at tick ``2(S−1) − s + m``
+      (cotangents hop right→left on the reverse ring each tick);
+    - total ticks ``M + 2(S−1)``; stage-input residuals live in a ring
+      buffer of depth ``2S − 1``, indexed ``m mod (2S−1)`` (lifetime of a
+      residual is ``2(S−1−s)`` ticks < depth, so live slots never collide).
+
+    ``stage_params``: this device's ``[1, ...]`` block of the stage stack.
+    ``out_params``: replicated params consumed by ``loss_fn`` (e.g. the LM
+    final-norm + head); their gradient is accumulated on the last stage
+    and ``psum``-replicated.  ``loss_fn(out_params, act, aux) -> scalar``
+    maps the last stage's activation + per-micro aux (e.g. target tokens)
+    to the microbatch loss.  Backward recomputes each stage forward from
+    its saved INPUT (stage-granular rematerialization), so no
+    ``jax.checkpoint`` is needed — 1F1B implies it.
+
+    Returns ``(loss_sum, stage_grads, out_grads, dx_microbatches)`` —
+    all UNNORMALIZED sums over this shard's microbatches (caller divides
+    by ``M`` and mean-reduces over ``data_axis``): ``loss_sum`` and
+    ``out_grads`` psum-replicated over the stage axis, ``stage_grads``
+    carrying the ``[1, ...]`` leading axis for a ``P(stage)`` out_spec,
+    ``dx_microbatches`` the cotangent w.r.t. ``x_microbatches`` (stage 0's
+    contribution, psum-replicated).
+    """
+    p = jax.tree.map(lambda a: a[0], stage_params)
+    n_stages = lax.axis_size(axis_name)
+    my_stage = lax.axis_index(axis_name)
+    last = n_stages - 1
+    num_micro = x_microbatches.shape[0]
+    micro_shape = x_microbatches.shape[1:]
+    depth = 2 * n_stages - 1
+    total_ticks = num_micro + 2 * (n_stages - 1)
+
+    perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+    perm_bwd = [(i + 1, i) for i in range(n_stages - 1)]
+
+    def fwd_bwd(carry, t):
+        (act_state, cot_state, ring, dx_bank,
+         loss_acc, sg_acc, og_acc) = carry
+
+        # ---- forward unit: micro m_f = t - s ----
+        m_f = t - my_stage
+        fwd_valid = jnp.logical_and(m_f >= 0, m_f < num_micro)
+        m_f_c = jnp.clip(m_f, 0, num_micro - 1)
+        fresh = lax.dynamic_index_in_dim(x_microbatches, m_f_c, 0,
+                                         keepdims=False)
+        a_in = jnp.where(my_stage == 0, fresh, act_state)
+        a_out = stage_fn(p, a_in)
+
+        # save the stage INPUT (backward recomputes from it); a dead slot
+        # keeps its old value so live residuals are never clobbered
+        slot = jnp.mod(m_f_c, depth)
+        old = lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(fwd_valid, a_in, old), slot, 0)
+
+        # last stage: loss + its cotangent for THIS micro, this tick
+        aux_m = lax.dynamic_index_in_dim(aux_microbatches, m_f_c, 0,
+                                         keepdims=False)
+        (l_m, lgrads) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            out_params, a_out, aux_m)
+        d_og, d_act = lgrads
+        on_last = my_stage == last
+        take_loss = jnp.logical_and(on_last, fwd_valid)
+        loss_acc = loss_acc + jnp.where(take_loss, l_m, 0.0)
+        og_acc = jax.tree.map(
+            lambda acc, g: acc + jnp.where(take_loss, g, 0.0), og_acc, d_og)
+
+        # ---- backward unit: micro m_b = t - 2(S-1) + s ----
+        m_b = t - 2 * (n_stages - 1) + my_stage
+        bwd_valid = jnp.logical_and(m_b >= 0, m_b < num_micro)
+        m_b_c = jnp.clip(m_b, 0, num_micro - 1)
+        a_saved = lax.dynamic_index_in_dim(ring, jnp.mod(m_b_c, depth), 0,
+                                           keepdims=False)
+        cot_in = jnp.where(on_last, d_act, cot_state)
+        _, stage_vjp = jax.vjp(stage_fn, p, a_saved)
+        dp, da = stage_vjp(cot_in)
+        sg_acc = jax.tree.map(
+            lambda acc, g: acc + jnp.where(bwd_valid, g, 0.0), sg_acc, dp)
+        take_dx = jnp.logical_and(my_stage == 0, bwd_valid)
+        old_dx = lax.dynamic_index_in_dim(dx_bank, m_b_c, 0, keepdims=False)
+        dx_bank = lax.dynamic_update_index_in_dim(
+            dx_bank, jnp.where(take_dx, da, old_dx), m_b_c, 0)
+
+        act_state = lax.ppermute(a_out, axis_name, perm_fwd)
+        cot_state = lax.ppermute(da, axis_name, perm_bwd)
+        return (act_state, cot_state, ring, dx_bank,
+                loss_acc, sg_acc, og_acc), None
+
+    dtype = x_microbatches.dtype
+    zeros_g = functools.partial(jax.tree.map, jnp.zeros_like)
+    init = (
+        jnp.zeros(micro_shape, dtype),                  # act_state
+        jnp.zeros(micro_shape, dtype),                  # cot_state
+        jnp.zeros((depth,) + micro_shape, dtype),       # residual ring
+        jnp.zeros((num_micro,) + micro_shape, dtype),   # dx bank
+        jnp.zeros((), jnp.float32),                     # loss sum
+        zeros_g(p),                                     # stage grads
+        zeros_g(out_params),                            # out grads
+    )
+    (_, _, _, dx_bank, loss_acc, sg_acc, og_acc), _ = lax.scan(
+        fwd_bwd, init, jnp.arange(total_ticks))
+
+    loss_sum = lax.psum(loss_acc, axis_name)
+    og_sum = jax.tree.map(lambda g: lax.psum(g, axis_name), og_acc)
+    dx_sum = lax.psum(dx_bank, axis_name)
+    if data_axis is not None:
+        # Batch is also sharded: grads/loss average over the data axis
+        # (equal shard sizes — the reference's equal-batch contract).
+        loss_sum = lax.pmean(loss_sum, data_axis)
+        og_sum = jax.tree.map(lambda g: lax.pmean(g, data_axis), og_sum)
+        sg_acc = jax.tree.map(lambda g: lax.pmean(g, data_axis), sg_acc)
+    stage_grads = jax.tree.map(lambda g: g[None], sg_acc)
+    return loss_sum, stage_grads, og_sum, dx_sum
+
+
 def make_pipeline(
     mesh: Mesh,
     stage_fn: StageFn,

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudist.parallel.pipeline import pipeline_shard
+from tpudist.parallel.pipeline import pipeline_1f1b_shard, pipeline_shard
 from tpudist.runtime.mesh import AXIS_DATA, AXIS_STAGE
 
 # NOTE: tpudist.models.transformer is imported lazily inside the builders —
@@ -132,24 +132,10 @@ def pp_state_sharding(mesh: Mesh, tree, *, axis_name: str = AXIS_STAGE):
     return jax.tree_util.tree_map_with_path(shard_for, tree)
 
 
-def make_pp_lm_apply(
-    mesh: Mesh,
-    module,  # a tpudist.models.transformer.TransformerLM
-    *,
-    n_stages: int,
-    num_microbatches: int = 4,
-    axis_name: str = AXIS_STAGE,
-    data_axis: Optional[str] = AXIS_DATA,
-    remat: bool = False,
-):
-    """Build ``apply(pp_params, tokens) -> logits`` with the block stack
-    pipelined over ``axis_name`` and the batch sharded over ``data_axis``.
-
-    ``pp_params`` comes from :func:`stack_block_params`.  Feed the result
-    to :func:`tpudist.train.make_lm_train_step` together with
-    :func:`pp_state_sharding` — the loss/grad/optimizer path needs no
-    pipeline awareness.
-    """
+def _lm_pipeline_parts(module):
+    """Shared sub-modules + stage fn for the pipelined TransformerLM:
+    ``(embed_mod, head_mod, stage_fn)`` — one construction point so the
+    GPipe apply and the 1F1B train step cannot drift."""
     from tpudist.models.transformer import (
         Block,
         _default_attention,
@@ -181,6 +167,32 @@ def make_pp_lm_apply(
             layer = jax.tree.map(lambda a, j=j: a[j], stage_params)
             x = block_mod.apply({"params": layer}, x)
         return x
+
+    return embed_mod, head_mod, stage_fn
+
+
+def make_pp_lm_apply(
+    mesh: Mesh,
+    module,  # a tpudist.models.transformer.TransformerLM
+    *,
+    n_stages: int,
+    num_microbatches: int = 4,
+    axis_name: str = AXIS_STAGE,
+    data_axis: Optional[str] = AXIS_DATA,
+    remat: bool = False,
+):
+    """Build ``apply(pp_params, tokens) -> logits`` with the block stack
+    pipelined over ``axis_name`` and the batch sharded over ``data_axis``.
+
+    ``pp_params`` comes from :func:`stack_block_params`.  Feed the result
+    to :func:`tpudist.train.make_lm_train_step` together with
+    :func:`pp_state_sharding` — the loss/grad/optimizer path needs no
+    pipeline awareness.  (Training through this apply is the GPipe
+    schedule: autodiff replays every microbatch's backward after all
+    forwards.  For the memory-bounded 1F1B alternative, use
+    :func:`make_pp_lm_train_step` with ``schedule='1f1b'``.)
+    """
+    embed_mod, head_mod, stage_fn = _lm_pipeline_parts(module)
 
     data_in_spec = P(None, data_axis) if data_axis else P()
     out_spec = (
@@ -220,3 +232,129 @@ def make_pp_lm_apply(
         )
 
     return apply
+
+
+def make_pp_lm_train_step(
+    mesh: Mesh,
+    module,  # a tpudist.models.transformer.TransformerLM
+    tx,      # optax.GradientTransformation
+    *,
+    n_stages: int,
+    num_microbatches: int = 4,
+    schedule: str = "1f1b",
+    axis_name: str = AXIS_STAGE,
+    data_axis: Optional[str] = AXIS_DATA,
+    donate_state: bool = True,
+    state_sharding=None,
+):
+    """Build the jitted pipeline-parallel LM train step
+    ``step(state, tokens) -> (state, loss)`` with a selectable schedule.
+
+    ``schedule='gpipe'``: training through :func:`make_pp_lm_apply` +
+    ``make_lm_train_step`` — all microbatch forwards, then autodiff's
+    backward replay; peak activation memory grows with ``num_microbatches``.
+
+    ``schedule='1f1b'``: the hand-interleaved one-forward-one-backward
+    schedule (:func:`tpudist.parallel.pipeline.pipeline_1f1b_shard`) —
+    backward of each microbatch starts the tick its loss exists, so peak
+    residual memory is O(n_stages), CONSTANT in ``num_microbatches``.
+    Raise ``num_microbatches`` to amortize the pipeline bubble for free.
+    Loss/grad numerics match GPipe up to summation order (tests assert
+    parity).  MoE blocks are not supported under 1F1B (their expert
+    all_to_all would nest inside this shard_map); use GPipe there.
+
+    ``state``: ``ModelState`` over the :func:`stack_block_params` layout,
+    sharded per :func:`pp_state_sharding`.
+    """
+    import optax
+
+    from tpudist.models.transformer import lm_loss
+    from tpudist.train.step import ModelState
+
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+    if schedule == "gpipe":
+        from tpudist.train.lm import make_lm_train_step
+
+        apply_fn = make_pp_lm_apply(
+            mesh, module, n_stages=n_stages,
+            num_microbatches=num_microbatches, axis_name=axis_name,
+            data_axis=data_axis,
+        )
+        return make_lm_train_step(
+            apply_fn, tx, mesh, donate_state=donate_state,
+            state_sharding=state_sharding,
+        )
+    if module.n_experts > 0:
+        raise ValueError("schedule='1f1b' does not support MoE blocks")
+
+    embed_mod, head_mod, stage_fn = _lm_pipeline_parts(module)
+    data_in_spec = P(None, data_axis) if data_axis else P()
+
+    def micro_loss(head_params, act, toks):
+        logits = head_mod.apply({"params": head_params}, act)
+        return lm_loss(logits, toks)
+
+    def body(blocks, head_params, xm, tm):
+        return pipeline_1f1b_shard(
+            blocks, head_params, xm, tm, stage_fn=stage_fn,
+            loss_fn=micro_loss, axis_name=axis_name, data_axis=data_axis,
+        )
+
+    sharded_body = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), data_in_spec, data_in_spec),
+        out_specs=(P(), P(axis_name), P(), data_in_spec),
+        check_vma=False,  # replicated inputs; ppermute varies them
+    )
+
+    def step(state: ModelState, tokens):
+        pp_params = state.params
+        rest = pp_params["rest"]
+        embed_params = {k: rest[k] for k in _EMBED_KEYS if k in rest}
+        head_params = {k: rest[k] for k in _HEAD_KEYS}
+        b = tokens.shape[0]
+        m = num_microbatches
+        if b % m:
+            raise ValueError(
+                f"batch {b} must divide into {m} microbatches")
+
+        x, embed_vjp = jax.vjp(
+            lambda ep: embed_mod.apply({"params": ep}, tokens), embed_params)
+        _, s, d = x.shape
+        xm = x.reshape(m, b // m, s, d)
+        tm = tokens.reshape(m, b // m, s)
+
+        loss_sum, stage_g, head_g, dxm = sharded_body(
+            pp_params["blocks"], head_params, xm, tm)
+
+        # The shard body returns per-microbatch SUMS (data-axis already
+        # mean-reduced inside); the step's loss is the mean over the m
+        # equal microbatches, so every gradient scales by 1/m too.
+        loss = loss_sum / m
+        head_g = jax.tree.map(lambda g: g / m, head_g)
+        stage_g = jax.tree.map(lambda g: g / m, stage_g)
+        # dx was NOT data-mean-reduced inside (each shard's activations
+        # are its own): the global cotangent is d(global mean)/dx =
+        # local_sum / (m · data_axis_size); the embed vjp under jit's
+        # global view then inserts the cross-shard embedding-grad psum.
+        d_size = mesh.shape[data_axis] if data_axis else 1
+        dx = dxm.reshape(b, s, d) / (m * d_size)
+        (embed_g,) = embed_vjp(dx)
+
+        grads = {"blocks": stage_g,
+                 "rest": {**embed_g, **head_g}}
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = ModelState(params=new_params, opt_state=new_opt)
+        return new_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(state_sharding, None) if state_sharding is not None
+        else None,
+        out_shardings=(state_sharding, None) if state_sharding is not None
+        else None,
+        donate_argnums=(0,) if donate_state else (),
+    )
