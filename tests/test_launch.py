@@ -253,3 +253,97 @@ class TestSweep:
         assert rc == 0
         assert len(calls) == 1
         assert "--dry_run" in calls[0]
+
+
+class TestBayesSweep:
+    """Local bayes (TPE-style categorical sampler) — reference parity for
+    sweeper.yml's `method` field without the W&B server round-trip."""
+
+    SPEC = {
+        "program": "obj.py",
+        "method": "bayes",
+        "metric": {"name": "loss", "goal": "minimize"},
+        "parameters": {"lr": {"values": [0.001, 0.01, 0.1, 1.0]},
+                       "wd": {"values": [0.0, 0.1]}},
+    }
+
+    def test_seed_phase_is_random_then_concentrates(self):
+        spec = SweepSpec.from_dict(self.SPEC)
+        # Before 4 observations: seeded random draws from the grid.
+        c0 = spec.propose(0, [])
+        assert c0["lr"] in self.SPEC["parameters"]["lr"]["values"]
+        assert spec.propose(0, []) == c0  # deterministic per index
+
+        # Feed observations where lr=0.01 is always in the best quartile.
+        results = []
+        for i, lr in enumerate([0.001, 0.01, 0.1, 1.0] * 4):
+            results.append({"config": {"lr": lr, "wd": 0.0},
+                            "metric": 0.1 if lr == 0.01 else 1.0 + i})
+        picks = [spec.propose(i, results)["lr"] for i in range(40)]
+        # The winning value must dominate proposals (smoothed sampling
+        # keeps the others alive, so ~60% of draws, not 100%).
+        counts = {v: picks.count(v) for v in (0.001, 0.01, 0.1, 1.0)}
+        assert counts[0.01] >= 18, counts
+        assert counts[0.01] > 2 * max(c for v, c in counts.items()
+                                      if v != 0.01), counts
+
+    def test_maximize_goal_flips_ranking(self):
+        spec = SweepSpec.from_dict(dict(
+            self.SPEC, metric={"name": "acc", "goal": "maximize"}))
+        results = []
+        for i, lr in enumerate([0.001, 0.01, 0.1, 1.0] * 4):
+            results.append({"config": {"lr": lr, "wd": 0.0},
+                            "metric": 0.9 if lr == 0.1 else 0.1})
+        picks = [spec.propose(i, results)["lr"] for i in range(40)]
+        counts = {v: picks.count(v) for v in (0.001, 0.01, 0.1, 1.0)}
+        assert counts[0.1] >= 18, counts
+        assert counts[0.1] > 2 * max(c for v, c in counts.items()
+                                     if v != 0.1), counts
+
+    def test_run_bayes_end_to_end_minimizes(self, tmp_path):
+        """Full loop against a real subprocess objective: (log10(lr)+2)^2
+        — optimum lr=0.01.  After 16 agent steps the results file must
+        show proposals concentrating on the optimum."""
+        import json
+
+        obj = tmp_path / "obj.py"
+        obj.write_text(
+            "import math, sys\n"
+            "from tpudist.launch.sweep import report_metric\n"
+            "lr = float(next(a.split('=')[1] for a in sys.argv\n"
+            "                if a.startswith('--lr=')))\n"
+            "report_metric((math.log10(lr) + 2) ** 2)\n")
+        spec = SweepSpec.from_dict(dict(
+            self.SPEC,
+            program=str(obj),
+            command=["python", "${program}", "${args}"],
+        ))
+        results_path = tmp_path / "results.jsonl"
+        env = {"PYTHONPATH": str(REPO)}  # the obj subprocess imports tpudist
+        for i in range(16):
+            rc = spec.run_bayes(i, results_path, extra_env=env)
+            assert rc == 0
+        rows = [json.loads(l) for l in results_path.read_text().splitlines()]
+        assert len(rows) == 16
+        assert all(r["metric"] is not None for r in rows)
+        # The optimum keeps being revisited after the seed phase (strong
+        # concentration at this sample size is asserted by the propose()
+        # unit tests above; here we prove the full agent loop works).
+        late_picks = [r["config"]["lr"] for r in rows[8:]]
+        assert late_picks.count(0.01) >= 2, late_picks
+        best = min(rows, key=lambda r: r["metric"])
+        assert best["config"]["lr"] == 0.01
+
+    def test_crashed_run_recorded_as_none(self, tmp_path):
+        import json
+
+        obj = tmp_path / "crash.py"
+        obj.write_text("raise SystemExit(3)\n")
+        spec = SweepSpec.from_dict(dict(
+            self.SPEC, program=str(obj),
+            command=["python", "${program}", "${args}"]))
+        results_path = tmp_path / "r.jsonl"
+        rc = spec.run_bayes(0, results_path)
+        assert rc == 3
+        row = json.loads(results_path.read_text())
+        assert row["metric"] is None and row["rc"] == 3

@@ -220,3 +220,210 @@ def test_flash_ring_crosses_process_boundary(tmp_path, monkeypatch):
     assert rc == 0
     recs = [json.load(open(f)) for f in sorted(out_dir.glob("ring*.json"))]
     assert len(recs) == 2
+
+
+ELASTIC_WORKER = """
+    import json, os, threading, time
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # 1 device per process
+    os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudist.runtime import bootstrap
+    from tpudist.comm import collectives
+
+    ctx = bootstrap.initialize()
+    attempt = int(os.environ["TPUDIST_RESTART_COUNT"])
+    rank = ctx.process_id
+
+    # Every rank proves the (re-)rendezvous actually formed the full world
+    # before anything else.
+    total = collectives.host_allreduce_sum(np.float64(rank))
+    assert float(total) == sum(range(ctx.num_processes))
+
+    if attempt == 0:
+        # Mid-run failure in group A: rank 0 dies hard (no cleanup — a
+        # real crash).  The other group's workers discover it through
+        # their next collective erroring (gloo peer gone) — the
+        # NCCL_ASYNC_ERROR_HANDLING analog — with a watchdog bail as the
+        # backstop, then exit nonzero so THEIR agent restarts them too.
+        marker = os.path.join(os.environ["OUT_DIR"],
+                              f"attempt0_rank{rank}.json")
+        json.dump({"rank": rank, "world": ctx.num_processes}, open(marker, "w"))
+        if rank == 0:
+            os._exit(17)
+        threading.Timer(60.0, lambda: os._exit(1)).start()
+        try:
+            for _ in range(100):
+                collectives.host_allreduce_sum(np.float64(1.0))
+                time.sleep(0.2)
+            os._exit(1)  # rank 0's death must have been noticed by now
+        except BaseException:
+            os._exit(1)
+
+    # Attempt 1: the restarted world trains to convergence.
+    from tpudist.data import make_toy_data
+    from tpudist.models import create_toy_model
+    from tpudist.runtime.mesh import data_parallel_mesh
+    from tpudist.train import init_model_states, make_scanned_train_step
+
+    mesh = data_parallel_mesh()
+    kx, = jax.random.split(jax.random.PRNGKey(0), 1)
+    mx, px = create_toy_model(kx)
+    models = {"m": (mx.apply, px)}
+    tx = optax.adam(1e-2)
+    states = init_model_states(models, tx)
+    step = make_scanned_train_step({"m": mx.apply}, tx, mesh)
+    data = make_toy_data(seed=0)
+    rng = np.random.default_rng(rank)
+    x_all, y_all = jnp.asarray(data.x), jnp.asarray(data.y)
+    first = last = None
+    for _ in range(6):
+        idx = jnp.asarray(rng.integers(0, len(data), size=(32, 64)), jnp.int32)
+        states, losses = step(states, x_all, y_all, idx)
+        val = float(np.asarray(losses["m"]).ravel()[-1])
+        if first is None:
+            first = val
+        last = val
+    assert last < first, (first, last)
+
+    collectives.barrier()
+    out = os.path.join(os.environ["OUT_DIR"], f"elastic{rank}.json")
+    json.dump({"rank": rank, "attempt": attempt, "run_id":
+               os.environ["TPUDIST_RUN_ID"], "first": first, "last": last},
+              open(out, "w"))
+    bootstrap.shutdown()
+"""
+
+
+def test_multi_agent_elastic_restart(tmp_path, monkeypatch):
+    """torchrun c10d semantics (torchrun_launcher.sh:16-19): two tpurun
+    agents share one rendezvous (--coordinator + --run-id); a worker in
+    agent A's group dies mid-run; BOTH agents must restart their groups,
+    re-rendezvous into the same world, and train to convergence."""
+    import concurrent.futures
+    import textwrap as tw
+
+    from tpudist.runtime.bootstrap import find_free_port
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(tw.dedent(ELASTIC_WORKER))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    for var in list(os.environ):
+        if var.startswith(("TPUDIST_", "SLURM_", "OMPI_")) or var in (
+                "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK"):
+            monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OUT_DIR", str(out_dir))
+    monkeypatch.setenv("PYTHONPATH", str(REPO))
+    coordinator = f"127.0.0.1:{find_free_port()}"
+
+    def agent(node_rank):
+        return tpurun_main([
+            "--nprocs", "1", "--nnodes", "2", "--node-rank", str(node_rank),
+            "--coordinator", coordinator, "--run-id", "elastic-test",
+            "--max-restarts", "2", "--restart-backoff", "1.0",
+            "--tmpdir", str(tmp_path / f"scratch{node_rank}"),
+            "--", sys.executable, str(worker)])
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        rcs = list(pool.map(agent, [0, 1]))
+    assert rcs == [0, 0], rcs
+
+    # Attempt 0 formed the full world before the induced crash...
+    assert len(list(out_dir.glob("attempt0_rank*.json"))) == 2
+    # ...and the restarted world (same run id) completed + converged.
+    recs = [json.load(open(f)) for f in sorted(out_dir.glob("elastic*.json"))]
+    assert {r["rank"] for r in recs} == {0, 1}
+    assert all(r["attempt"] == 1 for r in recs), recs
+    assert all(r["run_id"] == "elastic-test" for r in recs)
+    assert all(r["last"] < r["first"] for r in recs)
+
+
+MPI_WORKER = """
+    import json, os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+
+    import numpy as np
+
+    from tpudist.comm import collectives
+    from tpudist.runtime import bootstrap
+    from tpudist.runtime.mpi_bootstrap import initialize_from_mpi
+
+    # The real thing: MPI_COMM_WORLD rank/size, rank 0 picks the port,
+    # bcast, then jax.distributed.initialize on the agreed coordinator
+    # (demo_assume_started_with_mpiexec.py:35-50 semantics end to end).
+    ctx = initialize_from_mpi()
+    total = collectives.host_allreduce_sum(np.float64(ctx.process_id))
+    assert float(total) == sum(range(ctx.num_processes))
+    collectives.barrier()
+    out = os.path.join(os.environ["OUT_DIR"], f"mpi{ctx.process_id}.json")
+    json.dump({"rank": ctx.process_id, "world": ctx.num_processes,
+               "source": ctx.launch_source}, open(out, "w"))
+    bootstrap.shutdown()
+"""
+
+
+def _mpi_launcher():
+    import shutil
+
+    for exe in ("mpiexec", "mpirun"):
+        path = shutil.which(exe)
+        if path:
+            return path
+    return None
+
+
+def _has_mpi4py():
+    try:
+        import mpi4py  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(
+    _mpi_launcher() is None or not _has_mpi4py(),
+    reason="needs an MPI launcher (mpiexec/mpirun) and mpi4py",
+)
+def test_mpiexec_bootstrap_end_to_end(tmp_path, monkeypatch):
+    """Launch 2 ranks under the REAL mpiexec: exchange_coordinator picks
+    and broadcasts the rendezvous over MPI, jax.distributed forms the
+    world, a cross-process collective proves it (SURVEY.md §3.3 — 'use one
+    fabric (MPI) to bootstrap another')."""
+    import subprocess
+    import textwrap as tw
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(tw.dedent(MPI_WORKER))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("TPUDIST_", "SLURM_")) and k not in (
+               "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK")}
+    env.update({"OUT_DIR": str(out_dir), "PYTHONPATH": str(REPO)})
+    launcher = _mpi_launcher()
+    cmd = [launcher, "-np", "2", sys.executable, str(worker)]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=300)
+    if proc.returncode != 0 and "oversubscribe" in (
+            proc.stdout + proc.stderr).lower():
+        # OpenMPI refuses slots > cores by default on small hosts.
+        cmd = [launcher, "-np", "2", "--oversubscribe",
+               sys.executable, str(worker)]
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    recs = [json.load(open(f)) for f in sorted(out_dir.glob("mpi*.json"))]
+    assert {r["rank"] for r in recs} == {0, 1}
+    assert all(r["world"] == 2 for r in recs)
+    assert all(r["source"] == "mpi" for r in recs)

@@ -15,6 +15,18 @@ SLURM array task or a loop over TPU pod workers replaces the W&B server
 round-trip.  When wandb *is* installed and a sweep id is given, ``agent``
 delegates to the real ``wandb agent --count 1`` for full parity.
 
+Methods: ``grid`` and ``random`` enumerate independently per index (array
+tasks need no shared state).  ``method: bayes`` runs a LOCAL
+sequential-model-based search (a TPE-style smoothed good/bad frequency
+sampler over the declared value grids — see :meth:`SweepSpec.propose`):
+completed runs append ``{config, metric}`` to a shared results file
+(``<spec>.results.jsonl`` by default) and later proposals concentrate on
+values over-represented in the best quartile.  The trained program reports
+its objective by calling :func:`report_metric` (or writing a float to
+``$TPUDIST_SWEEP_METRIC_FILE``).  Full GP-based bayes remains available by
+delegating to the W&B server exactly like the reference
+(``--wandb-sweep-id``).
+
 CLI::
 
     python -m tpudist.launch.sweep count  sweeper.yml
@@ -37,10 +49,24 @@ from typing import Any, Dict, List, Optional
 import yaml
 
 
+def report_metric(value: float, path: Optional[str] = None) -> None:
+    """Report the run's objective to the sweep agent (bayes method).
+
+    Programs under a bayes sweep call this once with their final metric
+    (or write the float themselves to ``$TPUDIST_SWEEP_METRIC_FILE``);
+    the agent appends ``{config, metric}`` to the shared results file
+    after the run exits.  A no-op outside a sweep."""
+    path = path or os.environ.get("TPUDIST_SWEEP_METRIC_FILE")
+    if not path:
+        return
+    with open(path, "w") as f:
+        f.write(repr(float(value)))
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepSpec:
     program: str
-    method: str  # grid | random
+    method: str  # grid | random | bayes
     parameters: Dict[str, List[Any]]  # name -> candidate values (ordered)
     command: List[str]
     metric: Optional[Dict[str, Any]] = None
@@ -103,6 +129,41 @@ class SweepSpec:
             config[name] = values[i]
         return {k: config[k] for k in self.parameters}
 
+    def propose(self, index: int, results: List[Dict[str, Any]],
+                seed: int = 0) -> Dict[str, Any]:
+        """Bayes proposal from observed ``[{config, metric}, ...]``.
+
+        A TPE-flavored categorical sampler over the declared value grids:
+        runs in the best quartile (by ``metric.goal``, default minimize)
+        are "good"; each parameter value gets the smoothed score
+        ``(good(v) + 1) / (all(v) + n_values)`` (≈ P(good | v) with a
+        uniform prior) and the next value is drawn proportionally — so
+        values that keep landing in the best quartile are sampled more,
+        while the +1 smoothing keeps every value alive (exploration).
+        Fewer than 4 observations (or all-failed runs) fall back to the
+        seeded random draw, like ``method: random``.
+        """
+        rng = random.Random((seed << 20) ^ (0xB1A5 + index))
+        scored = [(r["config"], float(r["metric"])) for r in results
+                  if r.get("metric") is not None]
+        if len(scored) < 4:
+            return {k: rng.choice(v) for k, v in self.parameters.items()}
+        goal = (self.metric or {}).get("goal", "minimize")
+        sign = -1.0 if goal == "maximize" else 1.0
+        scored.sort(key=lambda cv: sign * cv[1])
+        n_good = max(1, len(scored) // 4)
+        good = [c for c, _ in scored[:n_good]]
+        allc = [c for c, _ in scored]
+        config: Dict[str, Any] = {}
+        for name, values in self.parameters.items():
+            weights = []
+            for v in values:
+                g = sum(1 for c in good if c.get(name) == v)
+                a = sum(1 for c in allc if c.get(name) == v)
+                weights.append((g + 1.0) / (a + len(values)))
+            config[name] = rng.choices(values, weights=weights, k=1)[0]
+        return config
+
     def command_for(self, config: Dict[str, Any],
                     env: Optional[Dict[str, str]] = None) -> List[str]:
         """Render the command template (``sweeper.yml:21-41`` interpolation:
@@ -132,6 +193,48 @@ class SweepSpec:
         print(f"[sweep] index {index}/{self.count()}: {config}")
         return subprocess.call(cmd, env=env)
 
+    def run_bayes(self, index: int, results_path: str | Path,
+                  extra_env: Optional[Dict[str, str]] = None,
+                  seed: int = 0) -> int:
+        """One bayes step: propose from the shared results file, run the
+        command, harvest the reported metric, append the observation
+        (appends are line-atomic, so array tasks may share the file)."""
+        import json
+        import tempfile
+
+        results_path = Path(results_path)
+        results: List[Dict[str, Any]] = []
+        if results_path.exists():
+            for line in results_path.read_text().splitlines():
+                try:
+                    results.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        config = self.propose(index, results, seed=seed)
+        cmd = self.command_for(config)
+        fd, metric_file = tempfile.mkstemp(prefix="sweep_metric_")
+        os.close(fd)
+        os.unlink(metric_file)  # existence == the program reported
+        env = {**os.environ, **(extra_env or {}),
+               "TPUDIST_SWEEP_INDEX": str(index),
+               "TPUDIST_SWEEP_CONFIG": repr(config),
+               "TPUDIST_SWEEP_METRIC_FILE": metric_file}
+        print(f"[sweep] bayes index {index} "
+              f"({len(results)} observed): {config}")
+        rc = subprocess.call(cmd, env=env)
+        metric: Optional[float] = None
+        try:
+            with open(metric_file) as f:
+                metric = float(f.read().strip())
+            os.unlink(metric_file)
+        except (OSError, ValueError):
+            pass  # no report / crashed run -> recorded as metric None
+        results_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(results_path, "a") as f:
+            f.write(json.dumps({"index": index, "config": config,
+                                "metric": metric, "rc": rc}) + "\n")
+        return rc
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="tpudist-sweep")
@@ -146,6 +249,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "sweep -I <id>` ships the server sweep to every "
                         "array task — unless an explicit --index pins this "
                         "run to the local grid")
+    p.add_argument("--results", default=None,
+                   help="bayes observations file (default <spec>."
+                        "results.jsonl, or $TPUDIST_SWEEP_RESULTS)")
     args = p.parse_args(argv)
     spec = SweepSpec.from_yaml(args.spec)
     if args.action == "count":
@@ -167,6 +273,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # sweep_cmd.txt:1 — `wandb agent --count 1 USER/PROJECT/SWEEPID`.
         return subprocess.call([sys.executable, "-m", "wandb", "agent",
                                 "--count", "1", sweep_id])
+    if spec.method == "bayes":
+        results = (args.results
+                   or os.environ.get("TPUDIST_SWEEP_RESULTS")
+                   or f"{args.spec}.results.jsonl")
+        return spec.run_bayes(index, results)
     return spec.run_index(index)
 
 

@@ -51,9 +51,23 @@ def make_length_aware_attention(window: Optional[int] = None):
     never materialized at full head count; the non-kernel paths broadcast.
     """
     def attend(q, k, v):
+        from tpudist.utils.tuning import tuned
+
+        # Measured-on-v5e defaults, re-tunable per platform generation
+        # via TPUDIST_FLASH_* env vars (tpudist.utils.tuning).
+        min_seq = tuned("flash_min_seq")
+        bq = tuned("flash_block_q")
+        # Wider KV tiles amortize the per-tile grid overhead once the KV
+        # sweep is long (8192: 6.8 vs 8.7 ms fwd+bwd — flash_sweep).
+        bk_long = tuned("flash_block_k_long")
         seq = q.shape[2]
-        use_flash = (seq >= 1024 and seq % 512 == 0
-                     and jax.devices()[0].platform == "tpu")
+        bk = (bk_long if seq >= tuned("flash_long_seq")
+              and seq % bk_long == 0 else tuned("flash_block_k"))
+        # BOTH tile sizes must divide seq (the kernel's contract) — with
+        # independently overridable knobs a bad combination routes to the
+        # fallbacks instead of crashing at trace time.
+        blocks_fit = seq >= min_seq and seq % bq == 0 and seq % bk == 0
+        use_flash = blocks_fit and jax.devices()[0].platform == "tpu"
         if not use_flash and k.shape[1] != q.shape[1]:
             # only the flash kernels consume grouped K/V natively
             group = q.shape[1] // k.shape[1]
@@ -62,15 +76,12 @@ def make_length_aware_attention(window: Optional[int] = None):
         if use_flash:
             from tpudist.ops import flash_attention
 
-            # Wider KV tiles amortize the per-tile grid overhead once the
-            # KV sweep is long (8192: 6.8 vs 8.7 ms fwd+bwd — flash_sweep).
-            bk = 1024 if seq >= 8192 and seq % 1024 == 0 else 512
-            return flash_attention(q, k, v, True, 512, bk, False, window)
-        if seq < 1024 or seq % 512:
+            return flash_attention(q, k, v, True, bq, bk, False, window)
+        if not blocks_fit:
             return attention_reference(q, k, v, causal=True, window=window)
         from tpudist.ops import blockwise_attention
 
-        return blockwise_attention(q, k, v, causal=True, block_k=512,
+        return blockwise_attention(q, k, v, causal=True, block_k=bk,
                                    window=window)
 
     # Block consults this tag before broadcasting K/V to full head count —

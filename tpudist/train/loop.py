@@ -42,11 +42,19 @@ class TrainLoopConfig:
     # 256 (vs the earlier 32): on a real v5e chip the toy step costs
     # ~41 µs inside a 512-long scan vs ~60 µs at window 32 (value-fetch-
     # synced timing) — longer windows amortize per-step overhead ~1.5x.
-    sync_every: int = 256
+    # None = resolve via tpudist.utils.tuning (TPUDIST_SYNC_EVERY env /
+    # per-device-kind table / the measured 256) at loop start.
+    sync_every: Optional[int] = None
     # Device-cached scan path: opt-out plus an HBM budget — the dataset is
     # replicated per device, so only datasets under this cap take the path.
     device_cache: bool = True
     device_cache_max_bytes: int = 256 * 1024 * 1024
+
+    def __post_init__(self):
+        if self.sync_every is None:
+            from tpudist.utils.tuning import tuned
+
+            self.sync_every = tuned("sync_every")
 
 
 def _make_pbar(config: TrainLoopConfig, initial: int = 0):
