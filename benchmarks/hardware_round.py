@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""One-shot on-chip measurement round — run the moment the TPU returns.
+
+The axon tunnel can be down for most of a session (BASELINE.md round 2:
+an 11+ hour outage stranded a whole round's kernel work unmeasured).
+This orchestrator makes a brief hardware window sufficient: it probes the
+device, then runs every pending measurement as a SEPARATE subprocess with
+its own wall-clock bound (a wedged step is killed and recorded, and the
+later steps still get their chance), appending incrementally to
+``HW_ROUND.json`` so a mid-round wedge keeps everything measured so far.
+
+Steps (the BASELINE.md "pending on-chip measurements" list + VERDICT r3
+items):
+  1. bench.py                          — numerics gate + headline + MFU rows
+  2. flash_sweep --kv-heads 2 --grad   — GQA-native kernels vs repeated-KV
+  3. flash_sweep --seq 32768 --window 1024 --grad  — sliding-window band
+  4. long_context --sliding-window 1024            — end-to-end windowed
+  5. long_context (dense ring, seq ladder)
+  6. profile summary of the MFU row's trace (if captured)
+
+Usage:
+  python benchmarks/hardware_round.py            # everything
+  python benchmarks/hardware_round.py --only 1,2 # subset
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+OUT = REPO / "HW_ROUND.json"
+
+
+def _probe(timeout_s: float = 180.0) -> bool:
+    """Tiny-matmul reachability probe in a subprocess (a wedged tunnel
+    hangs the op; the subprocess is killable, the parent is not)."""
+    code = ("import jax, jax.numpy as jnp, numpy as np;"
+            "x = jnp.ones((64, 64));"
+            "print(float(np.asarray((x @ x).sum())))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True, cwd=REPO)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+STEPS = {
+    "1_bench": {
+        "cmd": [sys.executable, "bench.py"],
+        "timeout": 2400,
+        "env": {"TPUDIST_BENCH_PROFILE": "runs/profile_mfu"},
+    },
+    "2_flash_gqa": {
+        "cmd": [sys.executable, "benchmarks/flash_sweep.py",
+                "--kv-heads", "2", "--grad", "--seq", "2048",
+                "--blocks", "512x512,512x1024"],
+        "timeout": 1200,
+    },
+    "3_flash_window": {
+        "cmd": [sys.executable, "benchmarks/flash_sweep.py",
+                "--seq", "32768", "--window", "1024", "--grad",
+                "--skip-dense", "--blocks", "512x512,512x1024"],
+        "timeout": 1800,
+    },
+    "4_long_context_window": {
+        "cmd": [sys.executable, "benchmarks/long_context.py",
+                "--seq-lens", "8192", "--seq-shards", "1",
+                "--sliding-window", "1024", "--batch", "4"],
+        "timeout": 1200,
+    },
+    "5_long_context_dense": {
+        "cmd": [sys.executable, "benchmarks/long_context.py",
+                "--seq-lens", "2048,8192", "--seq-shards", "1",
+                "--batch", "4"],
+        "timeout": 1200,
+    },
+    "6_profile_summary": {
+        "cmd": [sys.executable, "benchmarks/profile_summary.py",
+                "runs/profile_mfu", "--json"],
+        "timeout": 300,
+    },
+}
+
+
+def _run_step(name: str, spec: dict) -> dict:
+    env = {**os.environ, **spec.get("env", {})}
+    t0 = time.time()
+    try:
+        r = subprocess.run(spec["cmd"], timeout=spec["timeout"], cwd=REPO,
+                           capture_output=True, text=True, env=env)
+        return {"rc": r.returncode, "seconds": round(time.time() - t0, 1),
+                "stdout": r.stdout[-20000:], "stderr": r.stderr[-4000:]}
+    except subprocess.TimeoutExpired as e:
+        def _tail(stream):
+            if isinstance(stream, bytes):
+                return stream[-4000:].decode("utf-8", "replace")
+            return (stream or "")[-4000:]
+
+        return {"rc": None, "seconds": round(time.time() - t0, 1),
+                "error": f"timeout after {spec['timeout']}s (tunnel wedged?)",
+                "stdout": _tail(e.stdout), "stderr": _tail(e.stderr)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default=None,
+                   help="comma list of step number prefixes (e.g. 1,3)")
+    p.add_argument("--skip-probe", action="store_true")
+    args = p.parse_args(argv)
+
+    results: dict = {}
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = {}
+
+    if not args.skip_probe and not _probe():
+        results["probe"] = {"ok": False, "error": "device unreachable"}
+        OUT.write_text(json.dumps(results, indent=2) + "\n")
+        print(json.dumps({"probe": "unreachable"}))
+        return 2
+    results["probe"] = {"ok": True}
+
+    wanted = None
+    if args.only:
+        wanted = tuple(x.strip() for x in args.only.split(","))
+    for name, spec in STEPS.items():
+        if wanted and not name.split("_")[0] in wanted:
+            continue
+        print(f"[hw-round] {name}: {' '.join(spec['cmd'])}", flush=True)
+        results[name] = _run_step(name, spec)
+        results[name]["cmd"] = " ".join(spec["cmd"])
+        # Persist after EVERY step: a later wedge keeps earlier evidence.
+        OUT.write_text(json.dumps(results, indent=2) + "\n")
+        ok = results[name].get("rc") == 0
+        print(f"[hw-round] {name}: "
+              f"{'ok' if ok else results[name].get('error', 'failed')} "
+              f"({results[name]['seconds']}s)", flush=True)
+    bad = [n for n in STEPS if n in results and results[n].get("rc") != 0]
+    print(json.dumps({"done": True, "failed_steps": bad}))
+    return 0 if not bad else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

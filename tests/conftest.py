@@ -145,9 +145,14 @@ def pytest_collection_modifyitems(config, items):
             if p in item.nodeid:
                 item.add_marker(pytest.mark.slow)
                 matched.add(p)
-    # Self-audit on FULL collections (no file/dir args): a renamed test
-    # must not silently drop its pattern and rejoin the <5-min default.
-    if not config.getoption("file_or_dir", default=None):
+    # Self-audit on FULL collections: a renamed test must not silently
+    # drop its pattern and rejoin the <5-min default.  "Full" = bare
+    # `pytest` OR args that only restate the configured testpaths (the
+    # README's `pytest tests/ -q` is a full collection too).
+    args = {a.rstrip("/") for a in (config.getoption(
+        "file_or_dir", default=None) or [])}
+    testpaths = {t.rstrip("/") for t in config.getini("testpaths")}
+    if not args or args <= testpaths:
         stale = [p for p in _SLOW_PATTERNS if p not in matched]
         if stale:
             raise pytest.UsageError(

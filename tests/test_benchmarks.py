@@ -145,3 +145,65 @@ class TestPPSchedules:
         # GPipe residuals scale ~linearly with M; 1F1B's are O(S).
         assert f_growth < g_growth
         assert rows[1]["temp_bytes_1f1b"] < rows[1]["temp_bytes_gpipe"]
+
+
+class TestProfileSummary:
+    def test_synthetic_trace_groups_and_filters(self, tmp_path):
+        """Chrome-trace events bucket into op groups; host python frames
+        and metadata events are excluded from device self-time."""
+        import gzip
+        import json as _json
+
+        sys.path.insert(0, "benchmarks")
+        from benchmarks.profile_summary import summarize
+
+        events = [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0 TensorCore"}},
+            {"ph": "X", "pid": 7, "name": "fusion.3", "dur": 300.0},
+            {"ph": "X", "pid": 7, "name": "dot_general.1", "dur": 600.0},
+            {"ph": "X", "pid": 7, "name": "all-reduce.2", "dur": 100.0},
+            {"ph": "X", "pid": 7, "name": "$loop.py:10 run", "dur": 999.0},
+            {"ph": "X", "pid": 9, "name": "host_thread_junk", "dur": 999.0},
+        ]
+        f = tmp_path / "x.trace.json.gz"
+        with gzip.open(f, "wt") as fh:
+            _json.dump({"traceEvents": events}, fh)
+        s = summarize(tmp_path)
+        assert s["total_us"] == 1000.0
+        assert s["groups"]["matmul (MXU)"]["pct"] == 60.0
+        assert s["groups"]["collectives"]["pct"] == 10.0
+        names = [r["name"] for r in s["top_ops"]]
+        assert "$loop.py:10 run" not in names
+        assert "host_thread_junk" not in names
+
+    def test_empty_dir_reports_error(self, tmp_path):
+        from benchmarks.profile_summary import summarize
+
+        assert "error" in summarize(tmp_path)
+
+
+class TestHardwareRound:
+    def test_step_runner_records_rc_and_timeout(self, tmp_path):
+        from benchmarks.hardware_round import _run_step
+
+        ok = _run_step("echo", {"cmd": [sys.executable, "-c", "print('hi')"],
+                                "timeout": 30})
+        assert ok["rc"] == 0 and "hi" in ok["stdout"]
+        bad = _run_step("sleep", {"cmd": [sys.executable, "-c",
+                                          "import time; time.sleep(30)"],
+                                  "timeout": 1})
+        assert bad["rc"] is None and "timeout" in bad["error"]
+
+    def test_steps_cover_the_pending_list(self):
+        """The orchestrator must include every BASELINE.md 'pending
+        on-chip measurement': bench (gate+MFU+decode), GQA sweep,
+        windowed sweep, windowed long-context."""
+        from benchmarks.hardware_round import STEPS
+
+        joined = " ".join(" ".join(s["cmd"]) for s in STEPS.values())
+        assert "bench.py" in joined
+        assert "--kv-heads 2" in joined
+        assert "--window 1024" in joined
+        assert "--sliding-window 1024" in joined
+        assert "profile_summary" in joined

@@ -212,9 +212,11 @@ class SweepSpec:
                     continue
         config = self.propose(index, results, seed=seed)
         cmd = self.command_for(config)
-        fd, metric_file = tempfile.mkstemp(prefix="sweep_metric_")
-        os.close(fd)
-        os.unlink(metric_file)  # existence == the program reported
+        # Private per-run directory + fixed name: the path stays reserved
+        # (no unlink-then-reuse race in a shared tmpdir); "reported" ==
+        # the file has content.
+        metric_file = os.path.join(
+            tempfile.mkdtemp(prefix="sweep_metric_"), "metric")
         env = {**os.environ, **(extra_env or {}),
                "TPUDIST_SWEEP_INDEX": str(index),
                "TPUDIST_SWEEP_CONFIG": repr(config),
@@ -226,9 +228,12 @@ class SweepSpec:
         try:
             with open(metric_file) as f:
                 metric = float(f.read().strip())
-            os.unlink(metric_file)
         except (OSError, ValueError):
             pass  # no report / crashed run -> recorded as metric None
+        finally:
+            import shutil
+
+            shutil.rmtree(os.path.dirname(metric_file), ignore_errors=True)
         results_path.parent.mkdir(parents=True, exist_ok=True)
         with open(results_path, "a") as f:
             f.write(json.dumps({"index": index, "config": config,

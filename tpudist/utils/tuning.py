@@ -55,8 +55,10 @@ def _device_kind() -> str:
 
         if not _xb.backends_are_initialized():
             return ""
-    except Exception:  # internal API moved — fall through to the safe path
-        pass
+    except Exception:
+        # Internal API moved: we can no longer PROVE the backend is up, so
+        # we must not risk initializing it — take the v5e defaults.
+        return ""
     try:
         import jax
 
