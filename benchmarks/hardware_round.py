@@ -132,20 +132,24 @@ def main(argv=None) -> int:
     wanted = None
     if args.only:
         wanted = tuple(x.strip() for x in args.only.split(","))
+    ran = []
     for name, spec in STEPS.items():
         if wanted and not name.split("_")[0] in wanted:
             continue
         print(f"[hw-round] {name}: {' '.join(spec['cmd'])}", flush=True)
         results[name] = _run_step(name, spec)
         results[name]["cmd"] = " ".join(spec["cmd"])
+        ran.append(name)
         # Persist after EVERY step: a later wedge keeps earlier evidence.
         OUT.write_text(json.dumps(results, indent=2) + "\n")
         ok = results[name].get("rc") == 0
         print(f"[hw-round] {name}: "
               f"{'ok' if ok else results[name].get('error', 'failed')} "
               f"({results[name]['seconds']}s)", flush=True)
-    bad = [n for n in STEPS if n in results and results[n].get("rc") != 0]
-    print(json.dumps({"done": True, "failed_steps": bad}))
+    # Exit status reflects THIS invocation only (HW_ROUND.json may carry
+    # stale rows from a previous partial round).
+    bad = [n for n in ran if results[n].get("rc") != 0]
+    print(json.dumps({"done": True, "ran": ran, "failed_steps": bad}))
     return 0 if not bad else 1
 
 

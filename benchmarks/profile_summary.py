@@ -61,7 +61,9 @@ def _load_events(path: Path) -> List[dict]:
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rt") as f:
         data = json.load(f)
-    return data.get("traceEvents", data if isinstance(data, list) else [])
+    if isinstance(data, list):  # Chrome "JSON Array Format" root
+        return data
+    return data.get("traceEvents", [])
 
 
 def _device_pids(events: List[dict]) -> set:

@@ -189,3 +189,166 @@ def test_tp_sharded_lm_checkpoint_restores_replicated(devices, tmp_path):
     state, loss_tp = step_tp(state, jax.device_put(tokens, token_sharding(mesh_tp)))
     np.testing.assert_allclose(float(loss_dp), float(loss_tp), atol=1e-5)
     mgr.close()
+
+
+class TestPreemption:
+    def test_sigterm_flag_and_reset(self):
+        """The handler catches a real SIGTERM to this process and sets the
+        flag without killing anything; reset() restores the old handler."""
+        import os
+        import signal
+        import time
+
+        from tpudist.runtime import preemption
+
+        preemption.reset()
+        preemption.install()
+        try:
+            assert not preemption.requested()
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(100):
+                if preemption.requested():
+                    break
+                time.sleep(0.01)
+            assert preemption.requested()
+            assert preemption.check_all()  # single process: local flag
+        finally:
+            preemption.reset()
+        assert not preemption.requested()
+
+    def test_loop_saves_and_exits_on_preemption_then_resumes(
+            self, dp_mesh, tmp_path):
+        """Flag set mid-run => the loop checkpoints at the next sync
+        boundary with meta.preempted, returns early, and a resumed run
+        matches the unbroken run bit-for-bit."""
+        from tpudist.runtime import preemption
+
+        # Unbroken 12-iteration reference run.
+        states_a, step, loader = _build(dp_mesh)
+        cfg12 = TrainLoopConfig(total_iterations=12, progress_bar=False,
+                                sync_every=4, device_cache=False)
+        states_a, _ = run_training(states_a, step, loader, dp_mesh,
+                                   config=cfg12)
+
+        # Preempted run: the flag is already set, so the first sync
+        # boundary (iteration 4) saves and exits.
+        preemption.reset()
+        preemption._flag.set()
+        try:
+            states_b, step_b, loader_b = _build(dp_mesh)
+            mgr = CheckpointManager(CheckpointConfig(
+                directory=str(tmp_path / "pre"), async_save=False))
+            states_b, _ = run_training(states_b, step_b, loader_b, dp_mesh,
+                                       config=cfg12, ckpt=mgr)
+            assert mgr.latest_step == 4  # stopped at the boundary, not 12
+            states_c, step_c, loader_c = _build(dp_mesh)
+            restored, meta = mgr.restore(abstract_like(states_c))
+            assert meta["preempted"] is True
+            assert meta["iteration"] == 4
+            mgr.close()
+        finally:
+            preemption.reset()
+
+        # Resume to 12 and match the unbroken run.
+        states_c, _ = run_training(
+            restored, step_c, loader_c, dp_mesh, config=cfg12,
+            start_iteration=meta["iteration"])
+        for a, b in zip(_leaves(states_a), _leaves(states_c)):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    def test_scanned_path_preempts_at_window_edge(self, dp_mesh, tmp_path):
+        from tpudist.runtime import preemption
+        from tpudist.train import make_scanned_train_step
+
+        states, step, loader = _build(dp_mesh)
+        import optax as _optax
+
+        from tpudist.models import create_toy_model as _ctm
+
+        kx, ky = jax.random.split(jax.random.PRNGKey(0))
+        mx, _ = _ctm(kx)
+        my, _ = _ctm(ky)
+        chunk = make_scanned_train_step(
+            {"model_X": mx.apply, "model_Y": my.apply},
+            _optax.adam(1e-3), dp_mesh)
+        cfg = TrainLoopConfig(total_iterations=64, progress_bar=False,
+                              sync_every=8)
+        preemption.reset()
+        preemption._flag.set()
+        try:
+            mgr = CheckpointManager(CheckpointConfig(
+                directory=str(tmp_path / "scan"), async_save=False))
+            states, _ = run_training(states, step, loader, dp_mesh,
+                                     config=cfg, ckpt=mgr,
+                                     chunk_step_fn=chunk)
+            # first window = 8 iterations, then the agreed exit
+            assert mgr.latest_step == 8
+            _, meta = mgr.restore(abstract_like(states))
+            assert meta["preempted"] is True
+            mgr.close()
+        finally:
+            preemption.reset()
+
+
+def test_real_sigterm_preempts_training_subprocess(tmp_path):
+    """End to end through the entry point: a REAL SIGTERM to a running
+    `examples/demo.py` makes it checkpoint, exit cleanly (rc 0), and a
+    `--resume` run finishes the budget from the saved iteration."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    ckdir = tmp_path / "ck"
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("TPUDIST_", "SLURM_", "OMPI_"))
+           and k not in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK")}
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(repo),
+                # short windows -> prompt preemption boundaries
+                "TPUDIST_SYNC_EVERY": "16",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    cmd = [sys.executable, str(repo / "examples" / "demo.py"), "--dry_run",
+           "--total_iterations", "2000000", "--checkpoint_dir", str(ckdir),
+           "--checkpoint_every", "100000", "--seed", "0"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    time.sleep(20)  # well past compile; training is mid-flight
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == 0, out[-2000:]
+    metas = sorted(ckdir.rglob("meta/metadata"))
+    assert metas, f"no checkpoint written under {ckdir}: {out[-2000:]}"
+    meta = json.loads(metas[-1].read_text())
+    assert meta.get("preempted") is True, meta
+    saved_at = meta["iteration"]
+    assert 0 < saved_at < 2000000
+
+    # Resume from the preemption point and complete a small budget.
+    cmd2 = [sys.executable, str(repo / "examples" / "demo.py"), "--dry_run",
+            "--total_iterations", str(saved_at + 64), "--checkpoint_dir",
+            str(ckdir), "--checkpoint_every", "100000", "--resume",
+            "--seed", "0"]
+    r = subprocess.run(cmd2, env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_force_save_overwrites_colliding_step(dp_mesh, tmp_path):
+    """A preemption save landing on a cadence boundary must still stamp
+    its meta (manager.save(force=True) replaces the existing step)."""
+    states, _, _ = _build(dp_mesh)
+    mgr = CheckpointManager(CheckpointConfig(
+        directory=str(tmp_path / "fc"), async_save=False))
+    assert mgr.save(4, states, {"iteration": 4, "epoch": 0})
+    assert not mgr.save(4, states, {"iteration": 4, "preempted": True})
+    _, meta = mgr.restore(abstract_like(states))
+    assert "preempted" not in meta
+    assert mgr.save(4, states, {"iteration": 4, "preempted": True},
+                    force=True)
+    _, meta = mgr.restore(abstract_like(states))
+    assert meta["preempted"] is True
+    mgr.close()

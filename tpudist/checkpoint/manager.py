@@ -72,10 +72,17 @@ class CheckpointManager:
             return False
         return self.save(step, states, meta)
 
-    def save(self, step: int, states: Any, meta: dict) -> bool:
+    def save(self, step: int, states: Any, meta: dict,
+             force: bool = False) -> bool:
+        """``force=True`` re-saves an existing step (e.g. the preemption
+        save landing on a cadence boundary must still stamp its meta);
+        default is idempotent — cadence save + final save may collide."""
         ocp = self._ocp
         if step in self._mgr.all_steps():
-            return False  # idempotent: cadence save + final save may collide
+            if not force:
+                return False
+            self._mgr.wait_until_finished()  # the colliding save may be async
+            self._mgr.delete(step)
         return self._mgr.save(
             step,
             args=ocp.args.Composite(

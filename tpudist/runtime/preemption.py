@@ -1,0 +1,84 @@
+"""Preemption-safe shutdown: SIGTERM → checkpoint at a step boundary.
+
+SLURM (and every cloud TPU scheduler) delivers SIGTERM ahead of a
+preemption/requeue; the reference's jobs simply died and its launcher
+provisioned checkpoint directories it never wrote (SURVEY.md §5.4).
+tpudist closes the loop: install the handler once per process, and
+``run_training`` (``tpudist/train/loop.py``) checks the flag at its sync
+boundaries — when every process agrees it was signaled, the loop saves a
+final checkpoint (meta carries ``preempted: true``), tears down in the
+reference's ordering, and returns.  A later run with ``--resume`` picks
+up at the exact iteration (the loop's deterministic fast-forward).
+
+Cross-process agreement matters: ranks receive the signal at slightly
+different times, and an Orbax save is collective — everyone must save at
+the SAME step.  ``check_all()`` reduces the local flags over the host
+fabric (Gloo-group analog), so the decision lands on a common boundary.
+
+Usage (the demos and Trainer do this automatically)::
+
+    from tpudist.runtime import preemption
+    preemption.install()
+    run_training(..., ckpt=manager)   # loop handles the rest
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable
+
+import numpy as np
+
+_flag = threading.Event()
+_installed: list = []  # (signum, previous handler) for uninstall/tests
+
+
+def install(signals: Iterable[int] = (signal.SIGTERM,)) -> bool:
+    """Install the preemption handler (idempotent; main thread only —
+    CPython restricts ``signal.signal`` to it).  Returns whether anything
+    NEW was installed — the caller that got True owns the matching
+    :func:`reset` (``run_training`` restores handlers on exit so SIGTERM
+    terminates the process again once training is done)."""
+    new = False
+    for signum in signals:
+        if any(s == signum for s, _ in _installed):
+            continue
+        prev = signal.signal(signum, _handle)
+        _installed.append((signum, prev))
+        new = True
+    return new
+
+
+def _handle(signum, frame):  # noqa: ARG001
+    _flag.set()
+
+
+def requested() -> bool:
+    """This process received a preemption signal."""
+    return _flag.is_set()
+
+
+def check_all() -> bool:
+    """True when ANY process was signaled — reduced over the host fabric
+    so every rank takes the same save-and-exit decision at the same
+    boundary (single-process: just the local flag)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return _flag.is_set()
+    from tpudist.comm.collectives import host_allreduce_sum
+
+    total = host_allreduce_sum(np.float64(1.0 if _flag.is_set() else 0.0))
+    return float(total) > 0.0
+
+
+def reset() -> None:
+    """Clear the flag and restore previous handlers (tests)."""
+    _flag.clear()
+    while _installed:
+        signum, prev = _installed.pop()
+        try:
+            signal.signal(signum, prev)
+        except (ValueError, OSError):  # non-main thread / closed interp
+            pass

@@ -50,11 +50,27 @@ class TrainLoopConfig:
     device_cache: bool = True
     device_cache_max_bytes: int = 256 * 1024 * 1024
 
+    # Preemption-safe shutdown: when a SIGTERM arrived (see
+    # tpudist.runtime.preemption — the demos/Trainer install the handler)
+    # and a checkpoint manager is active, save at the next sync boundary
+    # (all processes agree on it via the host fabric) and return early.
+    preempt_save: bool = True
+
     def __post_init__(self):
         if self.sync_every is None:
             from tpudist.utils.tuning import tuned
 
             self.sync_every = tuned("sync_every")
+
+
+def _preempt_agreed() -> bool:
+    """All-process preemption consensus (see tpudist.runtime.preemption).
+    Cheap fast path: no local signal and single process → no collective."""
+    from tpudist.runtime import preemption
+
+    if jax.process_count() == 1:
+        return preemption.requested()
+    return preemption.check_all()
 
 
 def _make_pbar(config: TrainLoopConfig, initial: int = 0):
@@ -137,6 +153,29 @@ def run_training(
     Numerics and log rows are identical to the per-step path.
     """
     config = config or TrainLoopConfig()
+    installed_here = False
+    if config.preempt_save and ckpt is not None:
+        from tpudist.runtime import preemption
+
+        try:
+            installed_here = preemption.install()
+        except ValueError:
+            pass  # not the main thread — caller owns signal handling
+    try:
+        return _dispatch_training(
+            states, step_fn, loader, mesh, logger, config,
+            ckpt, start_iteration, chunk_step_fn)
+    finally:
+        if installed_here:
+            # SIGTERM must terminate the process again after training —
+            # a library must not leave a process-wide handler behind.
+            from tpudist.runtime import preemption
+
+            preemption.reset()
+
+
+def _dispatch_training(states, step_fn, loader, mesh, logger, config,
+                       ckpt, start_iteration, chunk_step_fn):
     if (
         chunk_step_fn is not None
         and config.device_cache
@@ -160,7 +199,8 @@ def run_training(
 
     deferred = _DeferredMetrics(logger, config) if logger is not None else None
     last_losses = None
-    while iteration < config.total_iterations:
+    preempted = False
+    while iteration < config.total_iterations and not preempted:
         loader.set_epoch(epoch)
         iteration += skip_in_epoch
         skip, skip_in_epoch = skip_in_epoch, 0
@@ -178,6 +218,11 @@ def run_training(
                 ckpt.maybe_save(
                     iteration, states, {"iteration": iteration, "epoch": epoch}
                 )
+            if (config.preempt_save and ckpt is not None
+                    and iteration % max(1, config.sync_every) == 0
+                    and _preempt_agreed()):
+                preempted = True
+                break
             if pbar is not None:
                 pbar.update(1)
         epoch += 1
@@ -185,7 +230,12 @@ def run_training(
     if pbar is not None:
         pbar.close()
     if ckpt is not None:
-        ckpt.save(iteration, states, {"iteration": iteration, "epoch": epoch})
+        # force on preemption: the boundary may coincide with a cadence
+        # save whose meta lacks the preempted stamp.
+        ckpt.save(iteration, states,
+                  {"iteration": iteration, "epoch": epoch,
+                   **({"preempted": True} if preempted else {})},
+                  force=preempted)
         ckpt.wait_until_finished()
     # Teardown ordering parity (demo.py:130-136): metrics first, then barrier.
     if deferred is not None:
@@ -246,6 +296,7 @@ def _run_scanned(
     pending_losses = []  # (first_iteration, device dict of (K,) losses)
     last_losses = None
 
+    preempted = False
     while iteration < total:
         # window length: sync cadence, save cadence, and budget boundaries
         k = min(max(1, config.sync_every), total - iteration)
@@ -279,11 +330,21 @@ def _run_scanned(
             ckpt.maybe_save(iteration, states, {"iteration": iteration, "epoch": epoch})
         if pbar is not None:
             pbar.update(len(idx_rows))
+        # Window edges are the natural (all-process-agreed) preemption
+        # boundaries of the scanned path.
+        if config.preempt_save and ckpt is not None and _preempt_agreed():
+            preempted = True
+            break
 
     if pbar is not None:
         pbar.close()
     if ckpt is not None:
-        ckpt.save(iteration, states, {"iteration": iteration, "epoch": epoch})
+        # force on preemption: the boundary may coincide with a cadence
+        # save whose meta lacks the preempted stamp.
+        ckpt.save(iteration, states,
+                  {"iteration": iteration, "epoch": epoch,
+                   **({"preempted": True} if preempted else {})},
+                  force=preempted)
         ckpt.wait_until_finished()
     if logger is not None:
         _flush_scanned(pending_losses, logger, config)
