@@ -80,6 +80,10 @@ def get_args(argv=None):
     p.add_argument("--accum_steps", default=1, type=int,
                    help="gradient-accumulation microbatches per optimizer "
                         "step (peak activation memory / accum_steps)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize each transformer block in the "
+                        "backward (jax.checkpoint): activation memory down "
+                        "to block boundaries for ~1 extra forward of FLOPs")
     p.add_argument("--gen_temperature", default=0.0, type=float,
                    help="sampling temperature for --generate (0 = greedy)")
     p.add_argument("--gen_top_k", default=None, type=int,
@@ -164,6 +168,7 @@ def main() -> None:
         # (TransformerLM rejects composing both); single-shard: the model
         # owns it end-to-end (training band + decode cache mask).
         sliding_window=None if args.seq_shards > 1 else args.sliding_window,
+        remat=args.remat,
     )
     from tpudist.train import build_optimizer_from_args
 

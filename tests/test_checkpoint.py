@@ -241,6 +241,10 @@ class TestPreemption:
             states_b, _ = run_training(states_b, step_b, loader_b, dp_mesh,
                                        config=cfg12, ckpt=mgr)
             assert mgr.latest_step == 4  # stopped at the boundary, not 12
+            # sticky per-run record: the caller can tell this run was cut
+            # short even though the live flag/handlers were reset
+            assert preemption.last_run_preempted()
+            assert not preemption.requested()  # loop reset the live flag
             states_c, step_c, loader_c = _build(dp_mesh)
             restored, meta = mgr.restore(abstract_like(states_c))
             assert meta["preempted"] is True
@@ -315,8 +319,23 @@ def test_real_sigterm_preempts_training_subprocess(tmp_path):
            "--total_iterations", "2000000", "--checkpoint_dir", str(ckdir),
            "--checkpoint_every", "100000", "--seed", "0"]
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
-    time.sleep(20)  # well past compile; training is mid-flight
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=str(tmp_path))
+    # Readiness, not a fixed sleep (racy on loaded machines): metrics
+    # rows only appear once training iterates, which is strictly after
+    # run_training installed the SIGTERM handler.
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        rows = [p for p in tmp_path.glob("runs/**/metrics.jsonl")
+                if p.stat().st_size > 0]
+        if rows:
+            break
+        assert proc.poll() is None, "demo exited before training started"
+        time.sleep(0.5)
+    else:
+        proc.kill()
+        raise AssertionError("training never produced a metrics row")
+    time.sleep(2)  # let a few more sync windows land
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=120)
     assert proc.returncode == 0, out[-2000:]

@@ -350,6 +350,11 @@ class TransformerLM(nn.Module):
     # KV-cache decode mode (see tpudist.models.generate): one token per
     # call, positions tracked in the flax "cache" collection.
     decode: bool = False
+    # Rematerialize each block in the backward pass (jax.checkpoint):
+    # activation memory drops from O(layers × per-block internals) to the
+    # block boundaries, at ~1 extra forward of FLOPs — the lever that fits
+    # d_model≥1024 configs in HBM.  Identical numerics (tests assert it).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -380,8 +385,13 @@ class TransformerLM(nn.Module):
             pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
                            dtype=self.dtype)(positions)
             x = x + pos[None]
+        block_cls = Block
+        if self.remat and not self.decode:
+            # static_argnums: nothing — Block takes only the activation;
+            # policy: save nothing inside the block (boundaries only).
+            block_cls = nn.remat(Block)
         for i in range(self.n_layers):
-            x = Block(
+            x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, attn,
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
                 dtype=self.dtype, rope=self.rope,

@@ -32,6 +32,7 @@ import numpy as np
 
 _flag = threading.Event()
 _installed: list = []  # (signum, previous handler) for uninstall/tests
+_last_run_preempted = False  # sticky: survives reset() (callers consult it)
 
 
 def install(signals: Iterable[int] = (signal.SIGTERM,)) -> bool:
@@ -73,8 +74,29 @@ def check_all() -> bool:
     return float(total) > 0.0
 
 
+def note_run_preempted() -> None:
+    """Called by the train loop when it exits early on preemption — the
+    sticky record callers consult AFTER the loop returns (reset() clears
+    the live flag but not this)."""
+    global _last_run_preempted
+    _last_run_preempted = True
+
+
+def last_run_preempted() -> bool:
+    """Did the most recent training loop exit early on preemption?  A
+    partially-trained run must be distinguishable from a completed one
+    (the loop's return signature carries no status)."""
+    return _last_run_preempted
+
+
+def clear_last_run_preempted() -> None:
+    global _last_run_preempted
+    _last_run_preempted = False
+
+
 def reset() -> None:
-    """Clear the flag and restore previous handlers (tests)."""
+    """Clear the live flag and restore previous handlers (loop exit,
+    tests).  The sticky :func:`last_run_preempted` record is NOT cleared."""
     _flag.clear()
     while _installed:
         signum, prev = _installed.pop()

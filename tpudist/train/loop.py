@@ -63,13 +63,9 @@ class TrainLoopConfig:
             self.sync_every = tuned("sync_every")
 
 
-def _preempt_agreed() -> bool:
-    """All-process preemption consensus (see tpudist.runtime.preemption).
-    Cheap fast path: no local signal and single process → no collective."""
+def _preemption_check() -> bool:
     from tpudist.runtime import preemption
 
-    if jax.process_count() == 1:
-        return preemption.requested()
     return preemption.check_all()
 
 
@@ -157,6 +153,7 @@ def run_training(
     if config.preempt_save and ckpt is not None:
         from tpudist.runtime import preemption
 
+        preemption.clear_last_run_preempted()  # record is per-run
         try:
             installed_here = preemption.install()
         except ValueError:
@@ -220,12 +217,13 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
                 )
             if (config.preempt_save and ckpt is not None
                     and iteration % max(1, config.sync_every) == 0
-                    and _preempt_agreed()):
+                    and _preemption_check()):
                 preempted = True
                 break
             if pbar is not None:
                 pbar.update(1)
-        epoch += 1
+        if not preempted:  # the preempted break leaves epoch mid-flight
+            epoch += 1
 
     if pbar is not None:
         pbar.close()
@@ -237,6 +235,12 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
                    **({"preempted": True} if preempted else {})},
                   force=preempted)
         ckpt.wait_until_finished()
+    if preempted:
+        # Sticky, surviving the handler reset below: callers must be able
+        # to tell a partially-trained early exit from a completed run.
+        from tpudist.runtime import preemption
+
+        preemption.note_run_preempted()
     # Teardown ordering parity (demo.py:130-136): metrics first, then barrier.
     if deferred is not None:
         deferred.flush()
@@ -332,7 +336,7 @@ def _run_scanned(
             pbar.update(len(idx_rows))
         # Window edges are the natural (all-process-agreed) preemption
         # boundaries of the scanned path.
-        if config.preempt_save and ckpt is not None and _preempt_agreed():
+        if config.preempt_save and ckpt is not None and _preemption_check():
             preempted = True
             break
 
@@ -346,6 +350,12 @@ def _run_scanned(
                    **({"preempted": True} if preempted else {})},
                   force=preempted)
         ckpt.wait_until_finished()
+    if preempted:
+        # Sticky, surviving the handler reset below: callers must be able
+        # to tell a partially-trained early exit from a completed run.
+        from tpudist.runtime import preemption
+
+        preemption.note_run_preempted()
     if logger is not None:
         _flush_scanned(pending_losses, logger, config)
         logger.finish()

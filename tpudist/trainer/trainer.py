@@ -173,6 +173,16 @@ class Trainer:
             if ckpt is not None:
                 ckpt.close()
         self.final_states = states
+        # A SIGTERM-preempted run checkpointed and exited EARLY — the
+        # caller must not mistake it for a completed fit (resume with
+        # the same checkpoint_dir + resume=True to continue).
+        from tpudist.runtime import preemption
+        from tpudist.runtime.rank_logging import rank_print
+
+        self.preempted = preemption.last_run_preempted()
+        if self.preempted:
+            rank_print("[trainer] preempted: checkpoint saved, fit "
+                       "incomplete — rerun with resume=True to continue")
         return losses
 
     @staticmethod
