@@ -160,6 +160,7 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
     )
     peak = chip_peak_flops()
     util = mfu(flops, step_s, n_chips, peak)
+    mem = _hbm_in_use()
     return {
         "metric": f"lm_{name}_tokens_per_sec_per_chip",
         "value": round(batch * seq_len / step_s / n_chips, 1),
@@ -175,6 +176,10 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
         # roofline.
         "mfu_pct_vs_bf16_peak": round(util * 100, 2) if util is not None else None,
         "peak_bf16_flops_per_chip": peak,
+        # HBM in use after the timed steps (params + opt state + live
+        # buffers) — the memory side of the MFU story, and the evidence
+        # for how much headroom --remat/--accum_steps would buy.
+        "hbm_bytes_in_use": mem,
     }
 
 
@@ -217,6 +222,16 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 16, max_new: int = 240,
                    "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
                    "vocab": vocab},
     }
+
+
+def _hbm_in_use() -> int | None:
+    """Device memory in use (bytes) per ``Device.memory_stats`` — None on
+    backends without the API (CPU virtual mesh)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return int(stats.get("bytes_in_use")) if stats else None
+    except Exception:
+        return None
 
 
 def numerics_gate(interpret: bool = False, quick: bool = False) -> dict:

@@ -371,3 +371,51 @@ def test_force_save_overwrites_colliding_step(dp_mesh, tmp_path):
     _, meta = mgr.restore(abstract_like(states))
     assert meta["preempted"] is True
     mgr.close()
+
+
+def test_completed_run_not_mislabeled_preempted(dp_mesh, tmp_path):
+    """SIGTERM during the final window, and a later no-ckpt run, must not
+    read as preemptions (review findings: boundary off-by-one + stale
+    sticky record)."""
+    from tpudist.runtime import preemption
+
+    states, step, loader = _build(dp_mesh)
+    cfg = TrainLoopConfig(total_iterations=8, progress_bar=False,
+                          sync_every=4, device_cache=False)
+    preemption.reset()
+    preemption._flag.set()  # signal "arrives" before the final boundary
+    try:
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(tmp_path / "fin"), async_save=False))
+        # total=8, sync_every=4: checks at 4 (preempt -> save at 4)...
+        states, _ = run_training(states, step, loader, dp_mesh,
+                                 config=cfg, ckpt=mgr)
+        assert mgr.latest_step == 4 and preemption.last_run_preempted()
+        mgr.close()
+
+        # ...but at total == boundary (start at 4, one window to 8) the
+        # run COMPLETES: meta must not carry preempted.
+        preemption.reset()
+        preemption._flag.set()
+        states2, step2, loader2 = _build(dp_mesh)
+        mgr2 = CheckpointManager(CheckpointConfig(
+            directory=str(tmp_path / "fin2"), async_save=False))
+        cfg4 = TrainLoopConfig(total_iterations=4, progress_bar=False,
+                               sync_every=4, device_cache=False)
+        states2, _ = run_training(states2, step2, loader2, dp_mesh,
+                                  config=cfg4, ckpt=mgr2)
+        _, meta = mgr2.restore(abstract_like(states2))
+        assert meta["iteration"] == 4
+        assert "preempted" not in meta, meta
+        assert not preemption.last_run_preempted()
+        mgr2.close()
+
+        # A later run WITHOUT checkpointing clears the stale record too.
+        preemption.reset()
+        preemption._flag.set()
+        preemption.note_run_preempted()  # simulate stale state
+        states3, step3, loader3 = _build(dp_mesh)
+        run_training(states3, step3, loader3, dp_mesh, config=cfg4)
+        assert not preemption.last_run_preempted()
+    finally:
+        preemption.reset()

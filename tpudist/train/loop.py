@@ -149,11 +149,13 @@ def run_training(
     Numerics and log rows are identical to the per-step path.
     """
     config = config or TrainLoopConfig()
+    from tpudist.runtime import preemption
+
+    # Per-run record, cleared UNCONDITIONALLY: a later run without
+    # checkpointing must not inherit an earlier run's preempted status.
+    preemption.clear_last_run_preempted()
     installed_here = False
     if config.preempt_save and ckpt is not None:
-        from tpudist.runtime import preemption
-
-        preemption.clear_last_run_preempted()  # record is per-run
         try:
             installed_here = preemption.install()
         except ValueError:
@@ -216,6 +218,7 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
                     iteration, states, {"iteration": iteration, "epoch": epoch}
                 )
             if (config.preempt_save and ckpt is not None
+                    and iteration < config.total_iterations
                     and iteration % max(1, config.sync_every) == 0
                     and _preemption_check()):
                 preempted = True
@@ -335,8 +338,10 @@ def _run_scanned(
         if pbar is not None:
             pbar.update(len(idx_rows))
         # Window edges are the natural (all-process-agreed) preemption
-        # boundaries of the scanned path.
-        if config.preempt_save and ckpt is not None and _preemption_check():
+        # boundaries of the scanned path.  A signal during the FINAL
+        # window is not a preemption — the run completed.
+        if (config.preempt_save and ckpt is not None
+                and iteration < total and _preemption_check()):
             preempted = True
             break
 
