@@ -136,6 +136,9 @@ _SLOW_PATTERNS = (
     "TestFlashAttention::test_backward_bf16",
     "test_flash_kernel_bf16_partials_stay_f32",
     "test_real_sigterm_preempts_training_subprocess",
+    "test_loop_saves_and_exits_on_preemption_then_resumes",
+    "test_completed_run_not_mislabeled_preempted",
+    "test_run_bayes_end_to_end_minimizes",
 )
 
 
