@@ -1,0 +1,251 @@
+"""Compile-time collective audit: parse a compiled step's optimized HLO
+and account for every cross-device collective — kind, bytes moved, replica
+grouping, and whether it sits inside a loop body.
+
+Why this exists (SURVEY.md §2.4, VERDICT r3 next-round #3): multi-chip
+hardware is not available in the build environment, so runtime scaling
+numbers cannot be measured here.  What CAN be established without a pod is
+the *communication structure* the compiler actually emitted: a training
+step whose HLO contains exactly the predicted collectives with the
+predicted byte volumes has a falsifiable perf shape — DP costs one
+gradient all-reduce of 2(n−1)/n × param bytes on the wire, ring attention
+costs (ring−1) neighbor hops of the KV shard, MoE costs two all_to_alls of
+the capacity buffer each way, and so on.  The audit turns "the sharding is
+correct" into "the collectives are exactly these, moving exactly these
+bytes" — the strongest scaling statement available at compile time.
+
+The reference repo has no analog (its NCCL traffic is implicit in torch's
+DDP/autograd internals); this is TPU-native observability of the same
+layer the reference trusts blindly.
+
+Usage::
+
+    ops = collect_collectives(jitted_step, state, tokens)
+    prof = profile(ops)        # {kind: {count, bytes_total, ...}}
+
+The parser works on the *optimized* (post-SPMD-partitioner, post-fusion)
+HLO so what it sees is what executes, not what was requested.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence
+
+# Cross-device collective opcodes (HLO names).  ``*-start`` forms are the
+# async halves — counted as the op; their ``*-done`` twin is skipped so a
+# (start, done) pair is one collective.
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([\d,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total bytes of every array literal in an HLO shape string.
+
+    Handles plain shapes (``f32[4,16]{1,0}``), tuples
+    (``(f32[4]{0}, bf16[2,2]{1,0})``), and skips non-array types
+    (``token[]``, ``u32[]`` scalars count their element size).
+    """
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token[] etc.
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective instruction in the optimized HLO."""
+
+    kind: str            # e.g. "all-reduce" (start forms normalized)
+    name: str            # instruction name
+    bytes: int           # payload bytes (result for sync, operands for start)
+    computation: str     # enclosing HLO computation
+    in_loop: bool        # executes inside a while loop (lax.scan body etc.)
+    groups: str          # replica_groups= / source_target_pairs= text, if any
+    shape: str           # the payload shape text
+    op_name: str = ""    # jax op_name metadata (trace provenance)
+
+
+# instruction line:   %name = SHAPE opcode(OPERANDS), attr=..., ...
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(\([^)]*\)|\S+)\s+([\w-]+)\("
+)
+# computation header: [ENTRY] %name (params) -> type {
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.-]+)\s*(?:\([^)]*\))?\s*->.*\{\s*$")
+_COMP_SIMPLE_RE = re.compile(r"^%?([\w.-]+)\s*\{\s*$")
+# while-instruction body reference: body=%name
+_WHILE_BODY_RE = re.compile(r"body=%?([\w.-]+)")
+# callee references that can nest a collective under a while body
+_CALLED_RE = re.compile(
+    r"(?:calls|to_apply|body|condition)=%?([\w.-]+)"
+)
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_GROUPS_RE = re.compile(
+    r"((?:replica_groups|source_target_pairs)=(?:\{[^=]*?\}\}|\{[^{}]*\}|"
+    r"\[[^\]]*\]<=\[[^\]]*\][^,]*))"
+)
+
+
+def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
+    """Extract every collective instruction from HLO text, tagging each
+    with whether it executes inside a ``while`` loop (a ``lax.scan`` /
+    ``while_loop`` body).
+
+    Loop residence is decided two ways, OR-ed: the jax ``op_name``
+    provenance metadata contains a ``/while/`` frame (robust across XLA's
+    computation outlining), or the instruction's computation is reachable
+    from a ``while`` instruction's body in the call graph.
+    """
+    ops: List[CollectiveOp] = []
+    current_comp = "<module>"
+    while_bodies: List[str] = []
+    calls: Dict[str, List[str]] = {}
+
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        m = _COMP_RE.match(stripped) or _COMP_SIMPLE_RE.match(stripped)
+        if m and not stripped.startswith(("//", "#")) and "=" not in \
+                stripped.split("(")[0]:
+            current_comp = m.group(1)
+            continue
+        im = _INSTR_RE.match(line)
+        if not im:
+            continue
+        name, shape, opcode = im.groups()
+        # Call-graph edges for loop-reachability.
+        for cm in _CALLED_RE.finditer(line):
+            calls.setdefault(current_comp, []).append(cm.group(1))
+        bm = _BRANCHES_RE.search(line)
+        if bm:
+            calls.setdefault(current_comp, []).extend(
+                t.strip().lstrip("%") for t in bm.group(1).split(",") if t.strip()
+            )
+        if opcode == "while":
+            wb = _WHILE_BODY_RE.search(line)
+            if wb:
+                while_bodies.append(wb.group(1))
+        base = opcode
+        if base.endswith("-done"):
+            continue  # counted at the -start
+        if base.endswith("-start"):
+            base = base[: -len("-start")]
+        if base not in COLLECTIVE_KINDS:
+            continue
+        if opcode.endswith("-start"):
+            # start-form result shapes carry bookkeeping tuples; measure the
+            # operand payload instead.
+            operands = line[im.end():].split("),")[0]
+            nbytes = shape_bytes(operands)
+        else:
+            nbytes = shape_bytes(shape)
+        gm = _GROUPS_RE.search(line)
+        om = _OPNAME_RE.search(line)
+        ops.append(
+            CollectiveOp(
+                kind=base,
+                name=name,
+                bytes=nbytes,
+                computation=current_comp,
+                in_loop=False,  # resolved below
+                groups=gm.group(0) if gm else "",
+                shape=shape,
+                op_name=om.group(1) if om else "",
+            )
+        )
+
+    # Transitive closure: computations reachable from any while body are
+    # loop-resident (a scan body may call fusions/conditionals that hold
+    # the collective).
+    looped = set()
+    frontier = list(while_bodies)
+    while frontier:
+        c = frontier.pop()
+        if c in looped:
+            continue
+        looped.add(c)
+        frontier.extend(calls.get(c, []))
+    for op in ops:
+        op.in_loop = (op.computation in looped) or ("/while/" in op.op_name)
+    return ops
+
+
+def lower_optimized_hlo(jitted, *args, **kwargs) -> str:
+    """Compile a jitted function for its example args and return the
+    post-optimization HLO text (what actually executes)."""
+    compiled = jitted.lower(*args, **kwargs).compile()
+    return compiled.as_text()
+
+
+def collect_collectives(jitted, *args, **kwargs) -> List[CollectiveOp]:
+    return parse_collectives(lower_optimized_hlo(jitted, *args, **kwargs))
+
+
+def profile(ops: Sequence[CollectiveOp]) -> Dict[str, dict]:
+    """Group a collective list into ``{kind: {count, bytes_total,
+    count_in_loop, bytes_in_loop, instructions}}`` (bytes are
+    per-execution payload; loop-resident ops execute once per trip)."""
+    out: Dict[str, dict] = {}
+    for op in ops:
+        row = out.setdefault(
+            op.kind,
+            {"count": 0, "bytes_total": 0, "count_in_loop": 0,
+             "bytes_in_loop": 0, "instructions": []},
+        )
+        row["count"] += 1
+        row["bytes_total"] += op.bytes
+        if op.in_loop:
+            row["count_in_loop"] += 1
+            row["bytes_in_loop"] += op.bytes
+        row["instructions"].append(
+            {"name": op.name, "bytes": op.bytes, "in_loop": op.in_loop,
+             "op_name": op.op_name}
+        )
+    return out
+
+
+def ring_allreduce_wire_bytes(payload_bytes: int, n: int) -> int:
+    """Per-device wire traffic of a ring all-reduce: 2(n−1)/n × payload
+    (reduce-scatter pass + all-gather pass) — the number to compare against
+    ICI/DCN bandwidth when predicting DP scaling."""
+    return int(2 * (n - 1) * payload_bytes / n)
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of every array leaf of a pytree (analytic side of the
+    audit: grad bytes == param bytes for a float tree)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape or (1,))) * dtype.itemsize
+    return total
