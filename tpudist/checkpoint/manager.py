@@ -62,6 +62,7 @@ class CheckpointManager:
             enable_async_checkpointing=config.async_save,
         )
         self._ocp = ocp
+        self._dir = path
         self._mgr = ocp.CheckpointManager(path, options=options)
 
     # -- save ---------------------------------------------------------------
@@ -74,22 +75,74 @@ class CheckpointManager:
 
     def save(self, step: int, states: Any, meta: dict,
              force: bool = False) -> bool:
-        """``force=True`` re-saves an existing step (e.g. the preemption
-        save landing on a cadence boundary must still stamp its meta);
-        default is idempotent — cadence save + final save may collide."""
+        """``force=True`` re-stamps an existing step's meta (e.g. the
+        preemption save landing on a cadence boundary must still stamp
+        ``preempted``); default is idempotent — cadence save + final save
+        may collide.
+
+        The force path is NON-destructive: at a colliding step the arrays
+        are identical (same iteration, same states) and only the meta
+        differs, so the stamp is written as an atomic sidecar overlay that
+        :meth:`restore` merges in.  The existing step is never deleted —
+        this runs inside a SIGTERM grace window, and a SIGKILL landing
+        between a delete and a completed re-save would destroy the only
+        valid checkpoint of that step (r3 advisor finding)."""
         ocp = self._ocp
         if step in self._mgr.all_steps():
             if not force:
                 return False
-            self._mgr.wait_until_finished()  # the colliding save may be async
-            self._mgr.delete(step)
-        return self._mgr.save(
+            # Durability first: the colliding save may still be async
+            # in-flight — stamp only a finished checkpoint (a SIGKILL
+            # mid-overlay then loses the stamp, never the checkpoint).
+            self._mgr.wait_until_finished()
+            if jax.process_index() == 0:
+                self._write_meta_overlay(step, meta)
+            return True
+        ok = self._mgr.save(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(states),
                 meta=ocp.args.JsonSave(meta),
             ),
         )
+        self._gc_meta_overlays()
+        return ok
+
+    # -- meta overlays ------------------------------------------------------
+
+    def _overlay_path(self, step: int) -> Path:
+        return self._dir / f"meta_overlay_{step}.json"
+
+    def _write_meta_overlay(self, step: int, meta: dict) -> None:
+        """Atomic (tmp + rename on the same filesystem) sidecar write."""
+        import json
+
+        tmp = self._overlay_path(step).with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, self._overlay_path(step))
+
+    def _read_meta_overlay(self, step: int) -> dict:
+        import json
+
+        p = self._overlay_path(step)
+        if not p.exists():
+            return {}
+        try:
+            return dict(json.loads(p.read_text()))
+        except (ValueError, OSError):
+            return {}  # torn write of the stamp: fall back to base meta
+
+    def _gc_meta_overlays(self) -> None:
+        """Drop overlays whose step was retired by Orbax retention."""
+        if jax.process_index() != 0:
+            return
+        live = set(self._mgr.all_steps())
+        for p in self._dir.glob("meta_overlay_*.json"):
+            try:
+                if int(p.stem.rsplit("_", 1)[1]) not in live:
+                    p.unlink(missing_ok=True)
+            except (ValueError, OSError):
+                pass
 
     # -- restore ------------------------------------------------------------
 
@@ -119,7 +172,9 @@ class CheckpointManager:
                 meta=ocp.args.JsonRestore(),
             ),
         )
-        return restored["state"], dict(restored["meta"])
+        meta = dict(restored["meta"])
+        meta.update(self._read_meta_overlay(step))  # force-save stamps win
+        return restored["state"], meta
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

@@ -159,6 +159,18 @@ def pipeline_1f1b_shard(
     its saved INPUT (stage-granular rematerialization), so no
     ``jax.checkpoint`` is needed — 1F1B implies it.
 
+    SPMD-uniformity cost: the ``jnp.where``-gated formulation evaluates
+    ``loss_fn`` — the full vocab-projection head, forward and backward via
+    ``value_and_grad`` — on EVERY stage at EVERY tick, masking all but the
+    last stage's result.  That is ``n_stages×`` redundant head FLOPs per
+    step, inherent to running one uniform program on all stages (the
+    alternative — ``lax.cond`` per stage — still executes both branches
+    under vmap-style SPMD).  For the block-dominated models this schedule
+    targets the head is a sliver of stage FLOPs; for large-vocab models
+    (head ≳ a block) prefer GPipe, or shrink the masked work by evaluating
+    the head on a reduced/zeroed activation before scaling this schedule
+    up (r3 advisor finding).
+
     Returns ``(loss_sum, stage_grads, out_grads, dx_microbatches)`` —
     all UNNORMALIZED sums over this shard's microbatches (caller divides
     by ``M`` and mean-reduces over ``data_axis``): ``loss_sum`` and

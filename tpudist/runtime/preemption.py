@@ -5,15 +5,17 @@ preemption/requeue; the reference's jobs simply died and its launcher
 provisioned checkpoint directories it never wrote (SURVEY.md §5.4).
 tpudist closes the loop: install the handler once per process, and
 ``run_training`` (``tpudist/train/loop.py``) checks the flag at its sync
-boundaries — when every process agrees it was signaled, the loop saves a
-final checkpoint (meta carries ``preempted: true``), tears down in the
-reference's ordering, and returns.  A later run with ``--resume`` picks
-up at the exact iteration (the loop's deterministic fast-forward).
+boundaries — when ANY process was signaled, all processes save a final
+checkpoint at the same boundary (meta carries ``preempted: true``), tear
+down in the reference's ordering, and return.  A later run with
+``--resume`` picks up at the exact iteration (the loop's deterministic
+fast-forward).
 
-Cross-process agreement matters: ranks receive the signal at slightly
-different times, and an Orbax save is collective — everyone must save at
-the SAME step.  ``check_all()`` reduces the local flags over the host
-fabric (Gloo-group analog), so the decision lands on a common boundary.
+Any-semantics is deliberate, and skew-tolerant: SLURM delivers SIGTERM to
+ranks at slightly different times, and an Orbax save is collective —
+everyone must save at the SAME step.  ``check_all()`` OR-reduces the
+local flags over the host fabric (Gloo-group analog), so the first
+boundary after the first signal lands the whole job on one common save.
 
 Usage (the demos and Trainer do this automatically)::
 
