@@ -7,6 +7,7 @@ CPU.  True multi-process rendezvous (jax.distributed over localhost) is in
 ``test_multiprocess.py``.
 """
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -347,3 +348,159 @@ class TestBayesSweep:
         assert rc == 3
         row = json.loads(results_path.read_text())
         assert row["metric"] is None and row["rc"] == 3
+
+
+class TestContinuousParameters:
+    """min/max distribution parameters (W&B schema parity — r3 verdict:
+    the local bayes covered only declared value grids)."""
+
+    def _spec(self, method="random", **params):
+        return SweepSpec.from_dict({
+            "program": "obj.py", "method": method,
+            "metric": {"name": "loss", "goal": "minimize"},
+            "parameters": params,
+        })
+
+    def test_parse_distributions(self):
+        spec = self._spec(
+            lr={"min": 1e-4, "max": 1e-1, "distribution": "log_uniform"},
+            layers={"min": 2, "max": 8},
+            frac={"min": 0.0, "max": 1.0},
+            step={"min": 0.0, "max": 2.0, "distribution": "q_uniform",
+                  "q": 0.25},
+        )
+        draws = [spec.config_at(i) for i in range(64)]
+        for c in draws:
+            assert 1e-4 <= c["lr"] <= 1e-1
+            assert isinstance(c["layers"], int) and 2 <= c["layers"] <= 8
+            assert 0.0 <= c["frac"] <= 1.0
+            assert abs(c["step"] / 0.25 - round(c["step"] / 0.25)) < 1e-9
+        # int default for int bounds, uniform for float bounds
+        assert any(c["layers"] != draws[0]["layers"] for c in draws)
+        # log_uniform actually spreads over decades (a uniform draw over
+        # [1e-4, 1e-1] would put ~99% of mass above 1e-3)
+        frac_small = sum(c["lr"] < 1e-3 for c in draws) / len(draws)
+        assert frac_small > 0.15, frac_small
+        # deterministic per index
+        assert spec.config_at(7) == spec.config_at(7)
+
+    def test_invalid_specs_raise(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="distribution"):
+            self._spec(x={"min": 0, "max": 1, "distribution": "normal"})
+        with pytest.raises(ValueError, match="min > 0"):
+            self._spec(x={"min": 0.0, "max": 1.0,
+                          "distribution": "log_uniform"})
+        with pytest.raises(ValueError, match="needs q"):
+            self._spec(x={"min": 0.0, "max": 1.0,
+                          "distribution": "q_uniform"})
+
+    def test_grid_and_count_reject_continuous(self):
+        import pytest
+
+        spec = self._spec(method="grid", lr={"min": 0.0, "max": 1.0})
+        with pytest.raises(ValueError, match="continuous"):
+            spec.count()
+        with pytest.raises(ValueError, match="continuous"):
+            spec.config_at(0)
+
+    def test_bayes_concentrates_on_continuous_optimum(self):
+        """TPE over a log_uniform lr: feed observations with the optimum
+        at 1e-2; late proposals must sit closer to it (in log space) than
+        prior draws."""
+        import math
+
+        spec = self._spec(
+            method="bayes",
+            lr={"min": 1e-4, "max": 1e-1, "distribution": "log_uniform"})
+        rng_lrs = [spec.propose(i, [])["lr"] for i in range(48)]
+        results = [
+            {"config": {"lr": lr}, "metric": (math.log10(lr) + 2) ** 2}
+            for lr in rng_lrs
+        ]
+        props = [spec.propose(100 + i, results)["lr"] for i in range(48)]
+
+        def mean_dist(vals):
+            return sum(abs(math.log10(v) + 2) for v in vals) / len(vals)
+
+        assert mean_dist(props) < 0.6 * mean_dist(rng_lrs), (
+            mean_dist(props), mean_dist(rng_lrs))
+
+    def test_q_uniform_respects_offgrid_bounds(self):
+        """q-rounding of a clamped draw must never step outside [min,max]
+        when the bounds aren't multiples of q (review finding)."""
+        from tpudist.launch.sweep import Continuous
+
+        p = Continuous(lo=0.2, hi=1.0, distribution="q_uniform", q=0.5)
+        import random as _r
+
+        vals = {p.sample(_r.Random(i)) for i in range(200)}
+        assert vals <= {0.5, 1.0}, vals  # in-range multiples only
+        assert p.from_t(0.2) == 0.5  # 0.2 rounds down to 0.0 -> re-clamped
+
+    def test_int_uniform_endpoints_get_full_mass(self):
+        """Uniform over the integers, not uniform-then-round (which halves
+        endpoint probability — review finding)."""
+        from tpudist.launch.sweep import Continuous
+
+        p = Continuous(lo=2, hi=4, distribution="int_uniform")
+        import random as _r
+
+        draws = [p.sample(_r.Random(i)) for i in range(900)]
+        counts = {v: draws.count(v) for v in (2, 3, 4)}
+        assert all(c > 230 for c in counts.values()), counts
+
+    def test_run_index_with_continuous_random(self, tmp_path):
+        """The agent CLI path must not call count() on continuous specs
+        (review finding: the progress print crashed method random)."""
+        import sys as _sys
+
+        obj = tmp_path / "ok.py"
+        obj.write_text("print('ran')\n")
+        spec = self._spec(lr={"min": 1e-4, "max": 1e-1,
+                              "distribution": "log_uniform"})
+        spec = dataclasses.replace(
+            spec, program=str(obj),
+            command=[_sys.executable, "${program}", "${args}"])
+        assert spec.run_index(0) == 0
+
+    def test_continuous_composes_with_grid_dims(self):
+        """Mixed spec: categorical TPE + continuous TPE in one proposal."""
+        spec = self._spec(
+            method="bayes",
+            lr={"min": 1e-4, "max": 1e-1, "distribution": "log_uniform"},
+            wd={"values": [0.0, 0.1]},
+        )
+        results = [{"config": {"lr": 10 ** -(2 + 0.01 * i), "wd": 0.1},
+                    "metric": float(i)} for i in range(12)]
+        c = spec.propose(5, results)
+        assert 1e-4 <= c["lr"] <= 1e-1 and c["wd"] in (0.0, 0.1)
+
+
+def test_locked_append_under_concurrency(tmp_path):
+    """Concurrent agents share the bayes results file: every appended
+    line must land whole (O_APPEND + flock)."""
+    import json
+    import threading
+
+    from tpudist.launch.sweep import _locked_append
+
+    path = tmp_path / "results.jsonl"
+    n_threads, n_each = 8, 50
+
+    def writer(t):
+        for i in range(n_each):
+            _locked_append(path, json.dumps(
+                {"t": t, "i": i, "pad": "x" * 200}) + "\n")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = path.read_text().splitlines()
+    assert len(lines) == n_threads * n_each
+    seen = {(json.loads(l)["t"], json.loads(l)["i"]) for l in lines}
+    assert len(seen) == n_threads * n_each

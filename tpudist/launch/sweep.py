@@ -17,15 +17,30 @@ delegates to the real ``wandb agent --count 1`` for full parity.
 
 Methods: ``grid`` and ``random`` enumerate independently per index (array
 tasks need no shared state).  ``method: bayes`` runs a LOCAL
-sequential-model-based search (a TPE-style smoothed good/bad frequency
-sampler over the declared value grids — see :meth:`SweepSpec.propose`):
+sequential-model-based search (TPE-style — see :meth:`SweepSpec.propose`):
 completed runs append ``{config, metric}`` to a shared results file
-(``<spec>.results.jsonl`` by default) and later proposals concentrate on
-values over-represented in the best quartile.  The trained program reports
-its objective by calling :func:`report_metric` (or writing a float to
-``$TPUDIST_SWEEP_METRIC_FILE``).  Full GP-based bayes remains available by
-delegating to the W&B server exactly like the reference
+(``<spec>.results.jsonl`` by default; appends are O_APPEND +
+``flock``-serialized, so concurrent array tasks may share it) and later
+proposals concentrate where the best quartile lives.  The trained program
+reports its objective by calling :func:`report_metric` (or writing a
+float to ``$TPUDIST_SWEEP_METRIC_FILE``).  Full GP-based bayes remains
+available by delegating to the W&B server exactly like the reference
 (``--wandb-sweep-id``).
+
+Parameters take either form of the W&B schema: value grids
+(``values: [...]`` / ``value: x``) or continuous distributions
+(``min``/``max`` with ``distribution: uniform | log_uniform |
+int_uniform | q_uniform`` — ``log_uniform`` here is over the VALUES,
+i.e. W&B's ``log_uniform_values``; ``q_uniform`` takes a ``q`` step).
+Continuous parameters work under ``random`` and ``bayes``; ``grid``
+(and ``count``) rejects them — a distribution has no grid to enumerate.
+
+Honest labeling of the approximation (README "Sweeps"): the local bayes
+is a Parzen/TPE flavor — categorical dimensions use smoothed good/bad
+frequencies, continuous dimensions a best-quartile kernel-density ratio
+over prior + locally-perturbed candidates — not a GP with expected
+improvement, and there is no cross-parameter covariance model.  For the
+real thing, delegate to the W&B server (``-I``), same as the reference.
 
 CLI::
 
@@ -49,6 +64,29 @@ from typing import Any, Dict, List, Optional
 import yaml
 
 
+def _locked_append(path: Path, line: str) -> None:
+    """Append one record to the shared results file safely under
+    concurrent agents: O_APPEND (each write lands at the current end) +
+    an advisory ``flock`` held across the write (serializes appends so a
+    line can never interleave even if a platform splits large writes).
+    Lock-less platforms (no fcntl) degrade to bare O_APPEND, which POSIX
+    already keeps line-atomic at these sizes."""
+    fd = os.open(str(path), os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        try:
+            import fcntl
+
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            # non-POSIX (no fcntl) or a filesystem without lock support
+            # (ENOLCK on NFS/Lustre): degrade to bare O_APPEND as
+            # advertised — losing the lock must never lose the record.
+            pass
+        os.write(fd, line.encode())
+    finally:
+        os.close(fd)
+
+
 def report_metric(value: float, path: Optional[str] = None) -> None:
     """Report the run's objective to the sweep agent (bayes method).
 
@@ -64,10 +102,76 @@ def report_metric(value: float, path: Optional[str] = None) -> None:
 
 
 @dataclasses.dataclass(frozen=True)
+class Continuous:
+    """A ``min``/``max`` distribution parameter (W&B schema).
+
+    ``log_uniform`` is over the VALUES (W&B's ``log_uniform_values``
+    spelling is accepted too): draws are ``exp(U(ln lo, ln hi))``.
+    ``int_uniform`` draws integers inclusive of both ends; ``q_uniform``
+    rounds uniform draws to multiples of ``q``.
+    """
+
+    lo: float
+    hi: float
+    distribution: str = "uniform"
+    q: Optional[float] = None
+
+    def __post_init__(self):
+        if self.distribution not in (
+            "uniform", "log_uniform", "log_uniform_values", "int_uniform",
+            "q_uniform",
+        ):
+            raise ValueError(
+                f"unsupported distribution {self.distribution!r}")
+        if not self.hi > self.lo:
+            raise ValueError(f"min {self.lo} must be < max {self.hi}")
+        if self._log and self.lo <= 0:
+            raise ValueError("log_uniform needs min > 0")
+        if self.distribution == "q_uniform" and not self.q:
+            raise ValueError("q_uniform needs q")
+
+    @property
+    def _log(self) -> bool:
+        return self.distribution in ("log_uniform", "log_uniform_values")
+
+    # TPE works in the transformed space where the prior is uniform.
+    def to_t(self, x: float) -> float:
+        import math
+
+        return math.log(x) if self._log else float(x)
+
+    def from_t(self, t: float) -> Any:
+        import math
+
+        x = math.exp(t) if self._log else t
+        x = min(max(x, self.lo), self.hi)
+        if self.distribution == "int_uniform":
+            return int(round(x))
+        if self.distribution == "q_uniform":
+            # Nearest IN-RANGE multiple of q: plain rounding of a clamped
+            # draw can step outside [lo, hi] when the bounds are not
+            # themselves multiples of q.
+            lo_q = math.ceil(self.lo / self.q - 1e-9) * self.q
+            hi_q = math.floor(self.hi / self.q + 1e-9) * self.q
+            v = round(x / self.q) * self.q
+            return round(min(max(v, lo_q), hi_q), 10)
+        return x
+
+    def sample(self, rng: random.Random) -> Any:
+        if self.distribution == "int_uniform":
+            # Uniform over the integers themselves: uniform-then-round
+            # would give both endpoints half the interior mass.
+            return rng.randint(int(self.lo), int(self.hi))
+        t_lo, t_hi = self.to_t(self.lo), self.to_t(self.hi)
+        return self.from_t(rng.uniform(t_lo, t_hi))
+
+
+@dataclasses.dataclass(frozen=True)
 class SweepSpec:
     program: str
     method: str  # grid | random | bayes
-    parameters: Dict[str, List[Any]]  # name -> candidate values (ordered)
+    # name -> ordered candidate values (list) or a Continuous distribution
+    parameters: Dict[str, Any]
     command: List[str]
     metric: Optional[Dict[str, Any]] = None
 
@@ -79,17 +183,27 @@ class SweepSpec:
 
     @classmethod
     def from_dict(cls, raw: Dict[str, Any]) -> "SweepSpec":
-        params: Dict[str, List[Any]] = {}
+        params: Dict[str, Any] = {}
         for name, spec in (raw.get("parameters") or {}).items():
             if isinstance(spec, dict):
                 if "values" in spec:
                     params[name] = list(spec["values"])
                 elif "value" in spec:
                     params[name] = [spec["value"]]
+                elif "min" in spec and "max" in spec:
+                    dist = spec.get("distribution")
+                    if dist is None:
+                        # W&B default: ints -> int_uniform, else uniform
+                        both_int = (isinstance(spec["min"], int)
+                                    and isinstance(spec["max"], int))
+                        dist = "int_uniform" if both_int else "uniform"
+                    params[name] = Continuous(
+                        lo=float(spec["min"]), hi=float(spec["max"]),
+                        distribution=dist, q=spec.get("q"))
                 else:
                     raise ValueError(
-                        f"parameter {name!r}: only values/value grids are "
-                        f"supported (got keys {sorted(spec)})")
+                        f"parameter {name!r}: need values/value or min+max "
+                        f"(got keys {sorted(spec)})")
             else:
                 params[name] = [spec]
         command = raw.get("command") or ["python", "${program}", "${args}"]
@@ -101,9 +215,24 @@ class SweepSpec:
             metric=raw.get("metric"),
         )
 
+    def _continuous(self) -> List[str]:
+        return [k for k, v in self.parameters.items()
+                if isinstance(v, Continuous)]
+
+    def _draw(self, rng: random.Random) -> Dict[str, Any]:
+        return {k: (v.sample(rng) if isinstance(v, Continuous)
+                    else rng.choice(v))
+                for k, v in self.parameters.items()}
+
     def count(self) -> int:
         """Grid size — ``count_sweeps.bash:4-16`` parity (product of value
-        counts)."""
+        counts).  Continuous parameters have no grid: rejected here so an
+        array sized from ``count`` can never silently under-cover them."""
+        cont = self._continuous()
+        if cont:
+            raise ValueError(
+                f"count() undefined over continuous parameters {cont} — "
+                f"size the array explicitly for random/bayes sweeps")
         n = 1
         for values in self.parameters.values():
             n *= len(values)
@@ -114,8 +243,12 @@ class SweepSpec:
         order over parameters in YAML order, last varying fastest); ``random``
         draws with a seeded RNG so array tasks are reproducible."""
         if self.method == "random":
-            rng = random.Random((seed << 20) ^ index)
-            return {k: rng.choice(v) for k, v in self.parameters.items()}
+            return self._draw(random.Random((seed << 20) ^ index))
+        cont = self._continuous()
+        if cont:
+            raise ValueError(
+                f"method {self.method!r} cannot enumerate continuous "
+                f"parameters {cont}: use method random or bayes")
         n = self.count()
         if not 0 <= index < n:
             raise IndexError(f"sweep index {index} out of range [0,{n})")
@@ -133,13 +266,21 @@ class SweepSpec:
                 seed: int = 0) -> Dict[str, Any]:
         """Bayes proposal from observed ``[{config, metric}, ...]``.
 
-        A TPE-flavored categorical sampler over the declared value grids:
+        TPE-flavored, per-parameter (no cross-parameter covariance):
         runs in the best quartile (by ``metric.goal``, default minimize)
-        are "good"; each parameter value gets the smoothed score
-        ``(good(v) + 1) / (all(v) + n_values)`` (≈ P(good | v) with a
-        uniform prior) and the next value is drawn proportionally — so
-        values that keep landing in the best quartile are sampled more,
-        while the +1 smoothing keeps every value alive (exploration).
+        are "good".
+
+        - **value grids**: each value gets the smoothed score
+          ``(good(v) + 1) / (all(v) + n_values)`` (≈ P(good | v) with a
+          uniform prior) and the next value is drawn proportionally —
+          values that keep landing in the best quartile are sampled more,
+          while the +1 smoothing keeps every value alive (exploration).
+        - **continuous**: candidates are drawn half from the prior, half
+          as Gaussian perturbations around good observations (in log
+          space for ``log_uniform``), and the candidate maximizing the
+          Parzen density ratio ``l_good(x)/l_all(x)`` wins — the TPE
+          acquisition with kernel-density estimators.
+
         Fewer than 4 observations (or all-failed runs) fall back to the
         seeded random draw, like ``method: random``.
         """
@@ -147,7 +288,7 @@ class SweepSpec:
         scored = [(r["config"], float(r["metric"])) for r in results
                   if r.get("metric") is not None]
         if len(scored) < 4:
-            return {k: rng.choice(v) for k, v in self.parameters.items()}
+            return self._draw(rng)
         goal = (self.metric or {}).get("goal", "minimize")
         sign = -1.0 if goal == "maximize" else 1.0
         scored.sort(key=lambda cv: sign * cv[1])
@@ -156,6 +297,10 @@ class SweepSpec:
         allc = [c for c, _ in scored]
         config: Dict[str, Any] = {}
         for name, values in self.parameters.items():
+            if isinstance(values, Continuous):
+                config[name] = self._propose_continuous(
+                    values, name, good, allc, rng)
+                continue
             weights = []
             for v in values:
                 g = sum(1 for c in good if c.get(name) == v)
@@ -163,6 +308,33 @@ class SweepSpec:
                 weights.append((g + 1.0) / (a + len(values)))
             config[name] = rng.choices(values, weights=weights, k=1)[0]
         return config
+
+    @staticmethod
+    def _propose_continuous(p: Continuous, name: str,
+                            good: List[dict], allc: List[dict],
+                            rng: random.Random) -> Any:
+        import math
+
+        t_lo, t_hi = p.to_t(p.lo), p.to_t(p.hi)
+        span = t_hi - t_lo
+        bw = span / 8.0  # Parzen bandwidth in transformed space
+        good_t = [p.to_t(c[name]) for c in good if name in c]
+        all_t = [p.to_t(c[name]) for c in allc if name in c]
+        if not good_t:
+            return p.sample(rng)
+
+        # Candidates: prior draws (exploration) + local perturbations of
+        # good points (exploitation).
+        cands = [rng.uniform(t_lo, t_hi) for _ in range(12)]
+        cands += [min(max(rng.gauss(rng.choice(good_t), bw), t_lo), t_hi)
+                  for _ in range(12)]
+
+        def kde(ts: List[float], x: float) -> float:
+            return sum(math.exp(-0.5 * ((x - t) / bw) ** 2) for t in ts) \
+                / (len(ts) * bw) + 1e-12
+
+        best = max(cands, key=lambda x: kde(good_t, x) / kde(all_t, x))
+        return p.from_t(best)
 
     def command_for(self, config: Dict[str, Any],
                     env: Optional[Dict[str, str]] = None) -> List[str]:
@@ -190,7 +362,10 @@ class SweepSpec:
         env = {**os.environ, **(extra_env or {}),
                "TPUDIST_SWEEP_INDEX": str(index),
                "TPUDIST_SWEEP_CONFIG": repr(config)}
-        print(f"[sweep] index {index}/{self.count()}: {config}")
+        # count() is undefined over continuous parameters (method random
+        # draws from a distribution — there is no grid size to show).
+        total = "?" if self._continuous() else str(self.count())
+        print(f"[sweep] index {index}/{total}: {config}")
         return subprocess.call(cmd, env=env)
 
     def run_bayes(self, index: int, results_path: str | Path,
@@ -235,9 +410,10 @@ class SweepSpec:
 
             shutil.rmtree(os.path.dirname(metric_file), ignore_errors=True)
         results_path.parent.mkdir(parents=True, exist_ok=True)
-        with open(results_path, "a") as f:
-            f.write(json.dumps({"index": index, "config": config,
-                                "metric": metric, "rc": rc}) + "\n")
+        _locked_append(
+            results_path,
+            json.dumps({"index": index, "config": config,
+                        "metric": metric, "rc": rc}) + "\n")
         return rc
 
 
