@@ -1,0 +1,264 @@
+#!/bin/bash
+# Cloud TPU front door — the gcloud analog of launch/job_submitter.sh
+# (reference hpc_files/job_submitter.sh owns allocation→launch end to end:
+# workspace provisioning :157-163, data tarballing :166-174, W&B key
+# plumbing :154-155,305-308, submit confirmation :330-344 — this script
+# gives the TPU-pod path the same treatment, replacing sbatch with the
+# gcloud TPU-VM / queued-resources API).
+#
+# Usage:
+#   bash launch/gcloud_submitter.sh -T NAME -z ZONE [options] [-- CMD...]
+# Options:
+#   -T NAME      TPU name (required)
+#   -z ZONE      zone (required)
+#   -A TYPE      accelerator type for provisioning (e.g. v5litepod-8);
+#                with -A the TPU is created if absent, else it must exist
+#   -V VERSION   runtime version                  (default tpu-ubuntu2204-base)
+#   -q           provision through a queued resource (spot-friendly
+#                allocation; polls until ACTIVE) instead of direct create
+#   -d PATHS     comma-separated data dirs -> staged as tarballs once,
+#                pushed + extracted on every worker
+#   -s DIR       scratch root                     (default ${SCRATCH:-$HOME/scratch})
+#   -e NAME      experiment name                  (default timestamped)
+#   -x FILE      experiment config file (one-line command; default
+#                launch/experiment_configurations.txt; a trailing -- CMD
+#                overrides the file)
+#   -r N         max whole-pod restarts on worker failure (default 0;
+#                the tpurun --max-restarts contract at pod scope)
+#   -b SEC       restart backoff seconds          (default 5)
+#   -w N         worker count override (default: parsed from describe)
+#   -D           delete the TPU / queued resource on exit (always runs via
+#                trap, even when the job fails)
+#   -n           no-confirm
+#   -h           help
+#
+# Per-worker stdout/stderr land in
+#   ${scratch}/${project}/${exp}/cloud_outputs/attempt${A}-worker${W}.out
+# mirroring the reference's hpc_outputs/%x-%j-%N.out per-node capture.
+set -euo pipefail
+
+# shellcheck disable=SC1091
+source "$(dirname "$0")/lib.sh"
+
+source_dir="$(pwd)"
+project_name="$(basename "${source_dir}")"
+
+tpu_name=""; zone=""; accel_type=""; runtime_version="tpu-ubuntu2204-base"
+queued=0; data_paths=""; scratch_dir="${SCRATCH:-$HOME/scratch}"
+exp_name="exp_$(date +%Y%m%d_%H%M%S)"
+exp_configs_path="launch/experiment_configurations.txt"
+max_restarts=0; backoff=5; n_workers=""; delete_on_exit=0; confirm=1
+
+while getopts "T:z:A:V:qd:s:e:x:r:b:w:Dnh" opt; do
+  case "${opt}" in
+    T) tpu_name="${OPTARG}" ;;
+    z) zone="${OPTARG}" ;;
+    A) accel_type="${OPTARG}" ;;
+    V) runtime_version="${OPTARG}" ;;
+    q) queued=1 ;;
+    d) data_paths="${OPTARG}" ;;
+    s) scratch_dir="${OPTARG}" ;;
+    e) exp_name="${OPTARG}" ;;
+    x) exp_configs_path="${OPTARG}" ;;
+    r) max_restarts="${OPTARG}" ;;
+    b) backoff="${OPTARG}" ;;
+    w) n_workers="${OPTARG}" ;;
+    D) delete_on_exit=1 ;;
+    n) confirm=0 ;;
+    h) sed -n '2,37p' "$0" | sed 's/^# \{0,1\}//'; exit 0 ;;
+    *) echo "unknown flag; -h for help" >&2; exit 2 ;;
+  esac
+done
+shift $((OPTIND - 1))
+[[ "${1:-}" == "--" ]] && shift
+
+[[ -n "${tpu_name}" && -n "${zone}" ]] || {
+  echo "gcloud_submitter: -T NAME and -z ZONE are required" >&2; exit 2; }
+
+tpu() { gcloud compute tpus tpu-vm "$@"; }
+qres() { gcloud compute tpus queued-resources "$@"; }
+
+# ---- provision or reuse -------------------------------------------------
+# Reuse when the TPU already answers describe; create (directly or through
+# a queued resource) only when -A declares what to create.
+cleanup_provisioned() {
+  if [[ "${delete_on_exit}" -eq 1 ]]; then
+    echo "cleanup: deleting ${tpu_name}"
+    tpu delete "${tpu_name}" --zone "${zone}" --quiet || true
+    if [[ "${queued}" -eq 1 ]]; then
+      qres delete "${tpu_name}-qr" --zone "${zone}" --quiet --force || true
+    fi
+  fi
+}
+trap cleanup_provisioned EXIT
+
+if tpu describe "${tpu_name}" --zone "${zone}" >/dev/null 2>&1; then
+  echo "reusing TPU ${tpu_name} (${zone})"
+elif [[ -n "${accel_type}" ]]; then
+  if [[ "${queued}" -eq 1 ]]; then
+    echo "queueing ${accel_type} as ${tpu_name}-qr…"
+    qres create "${tpu_name}-qr" --zone "${zone}" \
+      --node-id "${tpu_name}" --accelerator-type "${accel_type}" \
+      --runtime-version "${runtime_version}"
+    # Poll until the allocation lands (queued capacity can take a while;
+    # the reference's install-job poll, job_submitter.sh:184-245, is the
+    # same submit-and-wait shape).
+    poll_fails=0
+    while true; do
+      state="$(qres describe "${tpu_name}-qr" --zone "${zone}" \
+        --format='value(state.state)' 2>/dev/null)" || state=""
+      case "${state}" in
+        ACTIVE) break ;;
+        FAILED|SUSPENDED)
+          echo "queued resource ${tpu_name}-qr entered ${state}" >&2; exit 1 ;;
+        "")
+          poll_fails=$((poll_fails + 1))
+          [[ "${poll_fails}" -ge 30 ]] && {
+            echo "queued-resource describe unreachable" >&2; exit 1; } ;;
+        *) poll_fails=0 ;;
+      esac
+      sleep 10
+    done
+    echo "queued resource ACTIVE"
+  else
+    echo "creating TPU ${tpu_name} (${accel_type})…"
+    tpu create "${tpu_name}" --zone "${zone}" \
+      --accelerator-type "${accel_type}" \
+      --version "${runtime_version}"
+  fi
+else
+  echo "gcloud_submitter: TPU ${tpu_name} not found and no -A type to create" >&2
+  exit 1
+fi
+
+# ---- worker topology ----------------------------------------------------
+if [[ -z "${n_workers}" ]]; then
+  n_workers="$(tpu describe "${tpu_name}" --zone "${zone}" \
+    --format='value(networkEndpoints[].ipAddress)' | tr ';' '\n' | grep -c . \
+    || true)"
+  [[ "${n_workers}" -ge 1 ]] || n_workers=1
+fi
+echo "workers: ${n_workers}"
+
+# ---- experiment workspace (job_submitter.sh:157-163 parity) -------------
+exp_dir="${scratch_dir}/${project_name}/${exp_name}"
+mkdir -p "${exp_dir}/checkpoints" "${exp_dir}/cloud_outputs" "${exp_dir}/data"
+
+# ---- stage code + data --------------------------------------------------
+# Code: one tarball of the working tree, pushed and unpacked on every
+# worker.  In a git checkout, ship tracked + untracked-unignored files
+# with their WORKING-TREE content (git archive would ship only committed
+# state; plain ls-files would abort on locally-deleted tracked files and
+# drop new files) — skipping paths that no longer exist.
+code_tar="${exp_dir}/data/${project_name}-code.tar"
+if git -C "${source_dir}" rev-parse --git-dir >/dev/null 2>&1; then
+  (
+    cd "${source_dir}"
+    while IFS= read -r -d '' f; do
+      [[ -e "${f}" ]] && printf '%s\0' "${f}"
+    done < <(git ls-files -z --cached --others --exclude-standard)
+  ) | tar -cf "${code_tar}" --null -C "${source_dir}" -T - \
+        --transform "s,^,${project_name}/,"
+else
+  tar -cf "${code_tar}" -C "$(dirname "${source_dir}")" \
+    --exclude="${project_name}/.git" --exclude="${project_name}/runs" \
+    "${project_name}"
+fi
+
+# Data: the reference's tar-once contract (:166-174; launch/lib.sh).
+tpudist_stage_data "${exp_dir}" "${data_paths}"
+staged="${code_tar}${staged_out:+,${staged_out}}"
+
+# ---- the experiment command --------------------------------------------
+if [[ "$#" -gt 0 ]]; then
+  cmd="$*"
+else
+  tpudist_experiment_cmd "${exp_configs_path}"
+fi
+[[ "${cmd}" == python* ]] || {
+  echo "gcloud_submitter: command must start with python (got: ${cmd})" >&2
+  exit 2; }
+
+# ---- W&B credentials (job_submitter.sh:154-155,306; launch/lib.sh) ------
+tpudist_wandb_key
+
+echo "launch: ${cmd}"
+echo "  tpu=${tpu_name} zone=${zone} workers=${n_workers} restarts=${max_restarts}"
+echo "  outputs=${exp_dir}/cloud_outputs/"
+if [[ "${confirm}" -eq 1 ]]; then
+  read -r -p "launch? [y/N] " yn
+  [[ "${yn}" == "y" || "${yn}" == "Y" ]] || { echo "aborted"; exit 0; }
+fi
+
+# ---- ship the experiment environment as a 0600 file ---------------------
+# Secrets must never ride the ssh --command argv (visible in `ps` on every
+# worker for the job's lifetime); the SLURM path ships them through
+# sbatch's exported environment, the pod path ships a sourced env file.
+# $HOME/$(whoami) references stay literal here — they expand on the
+# WORKER when the file is sourced (multi-user paths differ per VM).
+remote_env="/tmp/tpudist_env_${exp_name}"
+remote_data="\$HOME/tpudist_data/${exp_name}"
+env_file="${exp_dir}/data/remote_env.sh"
+cat > "${env_file}" <<EOF
+export WANDB_API_KEY='${wandb_key}'
+export scratch_dir="\$HOME/scratch"
+export exp_name='${exp_name}'
+export project_name='${project_name}'
+export TPUDIST_TMPDIR="${remote_data}"
+EOF
+chmod 600 "${env_file}"
+
+# Push + unpack the tarballs on every worker in one fan-out: code into
+# \$HOME, data into TPUDIST_TMPDIR (the landing spot the framework's
+# staging discovery and the SLURM job scripts share —
+# launch/standard_job.sh extracts into the same contract).
+IFS=',' read -ra tars <<< "${staged}"
+for tb in "${tars[@]}"; do
+  tpu scp "${tb}" "${tpu_name}:/tmp/" --zone "${zone}" --worker=all
+done
+tpu scp "${env_file}" "${tpu_name}:${remote_env}" --zone "${zone}" \
+  --worker=all
+unpack="chmod 600 ${remote_env} && mkdir -p ${remote_data} && cd \$HOME"
+unpack+=" && tar -xf /tmp/$(basename "${code_tar}")"
+for tb in "${tars[@]}"; do
+  [[ "${tb}" == "${code_tar}" ]] && continue
+  unpack+=" && tar -xf /tmp/$(basename "${tb}") -C ${remote_data}"
+done
+tpu ssh "${tpu_name}" --zone "${zone}" --worker=all --command "${unpack}"
+
+# ---- run with the restart-with-backoff contract -------------------------
+# One ssh per worker, backgrounded, per-worker output files, wait on all —
+# the dispatcher shape (distributed_dispatcher.sh node loop) at pod scope.
+# On TPU VMs jax.distributed.initialize() discovers coordinator/topology
+# from the metadata server, so the sourced env only carries the experiment
+# contract (scratch/exp/project for checkpoint_dir_for, TPUDIST_TMPDIR for
+# staged data, the W&B key) plus per-attempt TPUDIST_RESTART_COUNT for
+# crash records.
+attempt=0
+while :; do
+  pids=()
+  for ((w = 0; w < n_workers; w++)); do
+    out="${exp_dir}/cloud_outputs/attempt${attempt}-worker${w}.out"
+    remote="source ${remote_env} && cd \$HOME/${project_name} && \
+TPUDIST_RESTART_COUNT='${attempt}' ${cmd}"
+    tpu ssh "${tpu_name}" --zone "${zone}" --worker="${w}" \
+      --command "${remote}" > "${out}" 2>&1 &
+    pids+=("$!")
+  done
+  rc=0
+  for pid in "${pids[@]}"; do
+    wait "${pid}" || rc=$?
+  done
+  if [[ "${rc}" -eq 0 ]]; then
+    echo "job finished (attempt ${attempt})"
+    break
+  fi
+  echo "attempt ${attempt} failed (rc=${rc}); outputs in ${exp_dir}/cloud_outputs/"
+  if [[ "${attempt}" -ge "${max_restarts}" ]]; then
+    echo "restarts exhausted (${max_restarts})" >&2
+    exit 1
+  fi
+  attempt=$((attempt + 1))
+  echo "restarting in ${backoff}s (attempt ${attempt}/${max_restarts})…"
+  sleep "${backoff}"
+done

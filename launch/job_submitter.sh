@@ -41,6 +41,9 @@
 #   -h           help
 set -euo pipefail
 
+# shellcheck disable=SC1091
+source "$(dirname "$0")/lib.sh"
+
 source_dir="$(pwd)"
 project_name="$(basename "${source_dir}")"
 
@@ -151,19 +154,9 @@ fi
 exp_dir="${scratch_dir}/${project_name}/${exp_name}"
 mkdir -p "${exp_dir}/checkpoints" "${exp_dir}/hpc_outputs" "${exp_dir}/data"
 
-# Stage data as tarballs once (job_submitter.sh:166-174).
-staged=""
-if [[ -n "${data_paths}" ]]; then
-  IFS=',' read -ra paths <<< "${data_paths}"
-  for p in "${paths[@]}"; do
-    tb="${exp_dir}/data/$(basename "${p}").tar"
-    if [[ ! -f "${tb}" ]]; then
-      echo "staging ${p} -> ${tb}"
-      time tar -cf "${tb}" -C "$(dirname "${p}")" "$(basename "${p}")"
-    fi
-    staged="${staged:+${staged},}${tb}"
-  done
-fi
+# Stage data as tarballs once (job_submitter.sh:166-174; launch/lib.sh).
+tpudist_stage_data "${exp_dir}" "${data_paths}"
+staged="${staged_out}"
 
 # Optional virtualenv bootstrap: submit the install job and poll squeue until
 # it leaves the queue (reference job_submitter.sh:184-245 + B8).
@@ -191,12 +184,11 @@ if [[ "${install_env}" -eq 1 ]]; then
   echo "install job ${install_id} finished"
 fi
 
-# The one-line experiment command (job_submitter.sh:300).
-cmd="$(tr -d '\n\r\\' < "${exp_configs_path}")"
+# The one-line experiment command (job_submitter.sh:300; launch/lib.sh).
+tpudist_experiment_cmd "${exp_configs_path}"
 
-# W&B credentials plumbing (job_submitter.sh:154-155,306): optional file.
-wandb_key=""
-[[ -f "${HOME}/wandb_credentials.txt" ]] && wandb_key="$(head -n1 "${HOME}/wandb_credentials.txt")"
+# W&B credentials plumbing (job_submitter.sh:154-155,306; launch/lib.sh).
+tpudist_wandb_key
 
 sbatch_cmd=(
   --job-name="${project_name}-${exp_name}"

@@ -32,3 +32,43 @@ tpudist_tmpdir() {
   fi
   mkdir -p "${TPUDIST_TMPDIR}"
 }
+
+# tpudist_stage_data <exp_dir> <comma-separated-dirs>
+#
+# The reference's tar-once data staging (job_submitter.sh:166-174): each
+# dir becomes <exp_dir>/data/<name>.tar, created only when absent.  Sets
+# `staged_out` to the comma-joined tarball list (empty when no dirs).
+# Shared by the SLURM and gcloud front doors.
+tpudist_stage_data() {
+  local exp_dir="$1" data_paths="$2" p tb
+  staged_out=""
+  [[ -z "${data_paths}" ]] && return 0
+  local -a paths
+  IFS=',' read -ra paths <<< "${data_paths}"
+  for p in "${paths[@]}"; do
+    tb="${exp_dir}/data/$(basename "${p}").tar"
+    if [[ ! -f "${tb}" ]]; then
+      echo "staging ${p} -> ${tb}"
+      time tar -cf "${tb}" -C "$(dirname "${p}")" "$(basename "${p}")"
+    fi
+    staged_out="${staged_out:+${staged_out},}${tb}"
+  done
+}
+
+# tpudist_wandb_key — sets `wandb_key` from ~/wandb_credentials.txt
+# (reference job_submitter.sh:154-155: optional credentials file).
+# if-form, not `[[ ]] &&`: a falsy final list would make the FUNCTION
+# return nonzero and kill `set -e` callers.
+tpudist_wandb_key() {
+  wandb_key=""
+  if [[ -f "${HOME}/wandb_credentials.txt" ]]; then
+    wandb_key="$(head -n1 "${HOME}/wandb_credentials.txt")"
+  fi
+}
+
+# tpudist_experiment_cmd <file> — sets `cmd` to the one-line experiment
+# command (reference job_submitter.sh:300: the config file carries one
+# command, possibly wrapped with backslashes).
+tpudist_experiment_cmd() {
+  cmd="$(tr -d '\n\r\\' < "$1")"
+}

@@ -1,9 +1,10 @@
 #!/bin/bash
-# TPU-pod launcher — the non-SLURM path. Where the reference drives multi-node
-# jobs with per-node srun (distributed_dispatcher.sh:25-34), Cloud TPU pods use
-# one gcloud command fanned out to every worker VM (--worker=all); each worker
-# runs a tpurun agent that starts one process per host (the standard JAX
-# multi-controller shape: 1 process/host, all local chips visible to it).
+# TPU-pod one-liner — the interactive quick path: one command fanned out to
+# every worker VM of an EXISTING, already-staged TPU (the salloc-analog of
+# the interactive/ scripts).  For the full submission contract —
+# provisioning/queued resources, code+data staging, W&B key plumbing,
+# per-worker output capture, restart-with-backoff, cleanup — use
+# launch/gcloud_submitter.sh (the job_submitter.sh analog for clouds).
 #
 # Usage:
 #   bash launch/tpu_pod_run.sh TPU_NAME ZONE "python examples/demo.py --dry_run"
