@@ -342,6 +342,10 @@ def numerics_gate(interpret: bool = False, quick: bool = False) -> dict:
                   ("tile1024_dense",     1, 1, 8192, (512, 1024), None)]
     report = {}
     for tag, nh, hkv, s, (bq, bk), window in cases:
+        # Progress to stderr: when the gate wedges (a tunnel can hang a
+        # single compile for >30 min — observed r4), the watchdog's
+        # postmortem must show WHICH case died, not an empty log.
+        print(f"# numerics_gate: {tag} ...", file=sys.stderr, flush=True)
         q = jax.random.normal(kq, (1, nh, s, 64), jnp.float32)
         k = jax.random.normal(kk, (1, hkv, s, 64), jnp.float32)
         v = jax.random.normal(kv, (1, hkv, s, 64), jnp.float32)
@@ -375,33 +379,54 @@ def numerics_gate(interpret: bool = False, quick: bool = False) -> dict:
     return report
 
 
+def _with_watchdog(fn, timeout_s: float, label: str):
+    """Run ``fn()`` in a daemon thread with a wall-clock bound.
+
+    The axon tunnel can wedge a single XLA/Mosaic compile for longer than
+    the whole round budget (r4: the numerics gate's first kernel compile
+    hung 37+ min after a PASSING reachability probe) — every on-chip
+    section must be individually bounded or one wedge hangs the artifact.
+    Returns ``fn()``'s result; raises ``TimeoutError`` on expiry (the
+    wedged thread is left behind as a daemon; callers exit via os._exit).
+    """
+    import threading
+
+    box: dict = {}
+
+    def run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — report, don't swallow
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "error" in box:
+        raise box["error"]
+    if "result" not in box:
+        raise TimeoutError(f"{label} timed out after {timeout_s:.0f}s "
+                           f"(tunnel wedged?)")
+    return box["result"]
+
+
 def _device_reachable(timeout_s: float = 180.0) -> bool:
     """Probe the accelerator with a wall-clock bound.
 
     The axon remote-execution tunnel can wedge for hours (a hung program
     upstream blocks every later one); a plain first op would then hang the
-    whole bench with no artifact for the round.  Run a tiny matmul in a
-    daemon thread and give up after ``timeout_s``."""
-    import threading
-
-    done: list = []
-    errors: list = []
-
+    whole bench with no artifact for the round.  A raising probe is NOT a
+    wedged tunnel — real config/backend errors crash loudly."""
     def probe():
-        try:
-            import jax.numpy as jnp
+        import jax.numpy as jnp
 
-            _sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
-            done.append(True)
-        except Exception as e:  # a raising probe is NOT a wedged tunnel
-            errors.append(e)
+        _sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        return True
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if errors:
-        raise errors[0]  # real config/backend error: crash loudly
-    return bool(done)
+    try:
+        return _with_watchdog(probe, timeout_s, "device probe")
+    except TimeoutError:
+        return False
 
 
 def _fail_record(error: str, exit_code: int) -> None:
@@ -438,22 +463,32 @@ def main() -> None:
     results = {"device_kind": jax.devices()[0].device_kind,
                "n_chips": jax.local_device_count()}
 
+    import os as _os
+
+    gate_timeout = float(_os.environ.get("TPUDIST_GATE_TIMEOUT", "900"))
     if jax.devices()[0].platform == "tpu":
         # Correctness gate BEFORE any timing: a kernel mismatch must kill
-        # the run (nonzero exit), never record a number.
+        # the run (nonzero exit), never record a number.  Watchdogged: a
+        # wedged gate compile must fail the run loudly, not hang the
+        # driver's whole round-end bench invocation.
         try:
-            results["numerics_gate"] = numerics_gate()
+            results["numerics_gate"] = _with_watchdog(
+                numerics_gate, gate_timeout, "numerics gate")
         except Exception as e:
             _fail_record(f"numerics gate failed: {e!r}", 3)
 
-    toy = bench_toy()
+    try:
+        toy = _with_watchdog(bench_toy, 600.0, "toy bench")
+    except Exception as e:
+        _fail_record(f"toy bench failed: {e!r}", 4)
     results["toy"] = toy
 
     if jax.devices()[0].platform == "tpu":
         # Kernel-vs-XLA A/B on the toy forward (the answer is interesting
         # either way; a failure must not cost the headline).
         try:
-            results["toy_fused_mlp"] = bench_fused_mlp()
+            results["toy_fused_mlp"] = _with_watchdog(
+                bench_fused_mlp, 600.0, "fused mlp bench")
         except Exception as e:
             results["toy_fused_mlp"] = {"error": repr(e)}
             print(f"# toy_fused_mlp failed: {e!r}", file=sys.stderr)
@@ -461,40 +496,50 @@ def main() -> None:
     # MXU-dense LM config: matmul-dominated, the MFU yardstick — timed at
     # both precisions (bf16 = the MXU's native throughput, the number that
     # matters; fp32 tracks numerics-reference cost round over round).
-    for precision in ("fp32", "bf16"):
+    # Persist after EVERY section (a later wedge keeps earlier evidence),
+    # and bail out of further on-chip sections after two consecutive
+    # watchdog timeouts — a wedged tunnel makes every later compile wedge
+    # too, and 600s apiece of confirmation adds nothing.
+    ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
+    wedged = 0
+
+    def run_section(key: str, fn, timeout: float = 600.0) -> None:
+        nonlocal wedged
+        if wedged >= 2:
+            results[key] = {"error": "skipped: tunnel wedged "
+                            "(2+ consecutive section timeouts)"}
+            return
         try:
-            results[f"lm_dense_{precision}"] = bench_lm(
-                name=f"dense_{precision}", batch=8, seq_len=2048, d_model=512,
-                n_layers=4, n_heads=8, d_ff=2048, precision=precision,
-            )
+            results[key] = _with_watchdog(fn, timeout, key)
+            wedged = 0
+        except TimeoutError as e:
+            wedged += 1
+            results[key] = {"error": repr(e)}
+            print(f"# {key} failed: {e!r}", file=sys.stderr)
         except Exception as e:  # keep the headline alive on small hosts
-            results[f"lm_dense_{precision}"] = {"error": repr(e)}
-            print(f"# lm_dense_{precision} failed: {e!r}", file=sys.stderr)
+            results[key] = {"error": repr(e)}
+            print(f"# {key} failed: {e!r}", file=sys.stderr)
+        ext_path.write_text(json.dumps(results, indent=2) + "\n")
+
+    for precision in ("fp32", "bf16"):
+        run_section(
+            f"lm_dense_{precision}",
+            lambda p=precision: bench_lm(
+                name=f"dense_{p}", batch=8, seq_len=2048, d_model=512,
+                n_layers=4, n_heads=8, d_ff=2048, precision=p))
 
     # Long-context LM config (BASELINE.md's measured row): flash-attention
     # regime, attention-dominated — tracks the kernel round over round.
     for precision in ("fp32", "bf16"):
-        try:
-            results[f"lm_long_context_{precision}"] = bench_lm(
-                name=f"long_context_{precision}", batch=4, seq_len=8192,
+        run_section(
+            f"lm_long_context_{precision}",
+            lambda p=precision: bench_lm(
+                name=f"long_context_{p}", batch=4, seq_len=8192,
                 d_model=256, n_layers=4, n_heads=4, d_ff=1024,
-                precision=precision,
-            )
-        except Exception as e:
-            results[f"lm_long_context_{precision}"] = {"error": repr(e)}
-            print(f"# lm_long_context_{precision} failed: {e!r}",
-                  file=sys.stderr)
+                precision=p))
 
-    try:
-        results["lm_decode"] = bench_decode()
-    except Exception as e:
-        results["lm_decode"] = {"error": repr(e)}
-        print(f"# lm_decode failed: {e!r}", file=sys.stderr)
+    run_section("lm_decode", bench_decode)
 
-    # Persist everything measured so far BEFORE the big-model row: a
-    # d1024/L8 compile once wedged the remote tunnel for a whole session,
-    # and it must not be able to take the round's other numbers with it.
-    ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
     ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
     # MXU-saturating MFU row (VERDICT r2: demonstrate >=35% or profile
@@ -505,26 +550,16 @@ def main() -> None:
     # jax.profiler trace of the timed steps.
     if jax.devices()[0].platform == "tpu":
         import os
-        import threading
 
-        box: dict = {}
-
-        def _mfu_row():
-            try:
-                box["row"] = bench_lm(
-                    name="mfu_d1024_bf16", batch=8, seq_len=2048,
-                    d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
-                    precision="bf16", steps=3,
-                    profile_dir=os.environ.get("TPUDIST_BENCH_PROFILE"),
-                )
-            except Exception as e:  # noqa: BLE001
-                box["row"] = {"error": repr(e)}
-
-        t = threading.Thread(target=_mfu_row, daemon=True)
-        t.start()
-        t.join(900.0)
-        results["lm_mfu_d1024"] = box.get(
-            "row", {"error": "timeout after 900s (tunnel wedged?)"})
+        run_section(
+            "lm_mfu_d1024",
+            lambda: bench_lm(
+                name="mfu_d1024_bf16", batch=8, seq_len=2048,
+                d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
+                precision="bf16", steps=3,
+                profile_dir=os.environ.get("TPUDIST_BENCH_PROFILE"),
+            ),
+            timeout=900.0)
 
     ext_path.write_text(json.dumps(results, indent=2) + "\n")
 

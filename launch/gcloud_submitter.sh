@@ -155,7 +155,10 @@ if git -C "${source_dir}" rev-parse --git-dir >/dev/null 2>&1; then
   (
     cd "${source_dir}"
     while IFS= read -r -d '' f; do
-      [[ -e "${f}" ]] && printf '%s\0' "${f}"
+      # if-form: a `[[ ]] &&` list as the loop's last command would end
+      # the subshell with status 1 when the FINAL listed file is deleted,
+      # and pipefail would kill the submitter.
+      if [[ -e "${f}" ]]; then printf '%s\0' "${f}"; fi
     done < <(git ls-files -z --cached --others --exclude-standard)
   ) | tar -cf "${code_tar}" --null -C "${source_dir}" -T - \
         --transform "s,^,${project_name}/,"
@@ -175,7 +178,9 @@ if [[ "$#" -gt 0 ]]; then
 else
   tpudist_experiment_cmd "${exp_configs_path}"
 fi
-[[ "${cmd}" == python* ]] || {
+# basename check like tpurun's _validate_cmd: absolute-path interpreters
+# (/opt/venv/bin/python train.py) are the common real shape.
+[[ "$(basename "${cmd%% *}")" == python* ]] || {
   echo "gcloud_submitter: command must start with python (got: ${cmd})" >&2
   exit 2; }
 

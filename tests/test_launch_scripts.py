@@ -496,10 +496,12 @@ class TestGcloudSubmitter:
              "-c", "user.name=t"]
         subprocess.run([*g[:3], "init", "-q"], check=True)
         (src / "kept.py").write_text("print('kept')\n")
-        (src / "gone.py").write_text("doomed\n")
+        # zzz_: sorts LAST in ls-files — a deleted final entry once ended
+        # the staging subshell with status 1 and pipefail killed the run.
+        (src / "zzz_gone.py").write_text("doomed\n")
         subprocess.run([*g, "add", "."], check=True)
         subprocess.run([*g, "commit", "-qm", "init"], check=True)
-        (src / "gone.py").unlink()          # tracked, locally deleted
+        (src / "zzz_gone.py").unlink()      # tracked, locally deleted
         (src / "brand_new.py").write_text("new\n")  # untracked
         r = subprocess.run(
             ["bash", str(REPO / "launch" / "gcloud_submitter.sh"), "-n",
@@ -511,4 +513,4 @@ class TestGcloudSubmitter:
         names = set(tarfile.open(tb).getnames())
         assert "proj/kept.py" in names
         assert "proj/brand_new.py" in names
-        assert "proj/gone.py" not in names
+        assert "proj/zzz_gone.py" not in names

@@ -427,3 +427,88 @@ def test_mpiexec_bootstrap_end_to_end(tmp_path, monkeypatch):
     assert {r["rank"] for r in recs} == {0, 1}
     assert all(r["world"] == 2 for r in recs)
     assert all(r["source"] == "mpi" for r in recs)
+
+
+def test_agent_preemption_end_to_end(tmp_path):
+    """The SLURM preemption shape, end to end (VERDICT r3 #5): SIGTERM the
+    tpurun AGENT'S PROCESS GROUP (what `scancel`/requeue actually signals)
+    while two gloo-rendezvous'd workers train `examples/demo.py` with
+    checkpointing.  The agent must survive the signal, the workers must
+    save one agreed `preempted`-stamped checkpoint (Orbax collective
+    save), the agent must surface the outcome and exit 0 without
+    restarting, and a `--resume` relaunch under the agent must complete
+    the original budget."""
+    import signal
+    import subprocess
+    import time
+
+    ckdir = tmp_path / "ck"
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("TPUDIST_", "SLURM_", "OMPI_"))
+           and k not in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK")}
+    env.pop("XLA_FLAGS", None)  # one CPU device per worker process
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+        "PYTHONPATH": str(REPO),
+        "TPUDIST_SYNC_EVERY": "16",  # prompt preemption boundaries
+    })
+    worker_cmd = [sys.executable, str(REPO / "examples" / "demo.py"),
+                  "--dry_run", "--total_iterations", "2000000",
+                  "--checkpoint_dir", str(ckdir),
+                  "--checkpoint_every", "100000", "--seed", "0"]
+    agent_cmd = [sys.executable, "-m", "tpudist.launch.run",
+                 "--nprocs", "2", "--max-restarts", "2",
+                 "--restart-backoff", "0.1",
+                 "--tmpdir", str(tmp_path / "scratch"),
+                 "--", *worker_cmd]
+    # New session => the agent leads its own process group, and killpg
+    # reaches agent + workers together — exactly what SLURM delivers.
+    proc = subprocess.Popen(agent_cmd, env=env, cwd=str(tmp_path),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
+    try:
+        # Readiness: metrics rows appear only once rank 0 iterates, which
+        # is strictly after the workers installed their SIGTERM handlers.
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            rows = [p for p in tmp_path.glob("runs/**/metrics.jsonl")
+                    if p.stat().st_size > 0]
+            if rows:
+                break
+            assert proc.poll() is None, proc.communicate()[0][-3000:]
+            time.sleep(0.5)
+        else:
+            raise AssertionError("training never produced a metrics row")
+        time.sleep(2)  # let a few sync windows land
+        os.killpg(proc.pid, signal.SIGTERM)
+        out, _ = proc.communicate(timeout=300)
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+    assert proc.returncode == 0, out[-3000:]
+    # The agent surfaced the preemption and did NOT treat it as a crash.
+    assert "preemption: worker group saved and exited cleanly" in out, \
+        out[-3000:]
+    assert "restarting worker group" not in out, out[-3000:]
+    # One agreed checkpoint with the preempted stamp.
+    metas = sorted(ckdir.rglob("meta/metadata"))
+    assert metas, f"no checkpoint written: {out[-3000:]}"
+    meta = json.loads(metas[-1].read_text())
+    assert meta.get("preempted") is True, meta
+    saved_at = meta["iteration"]
+    assert 0 < saved_at < 2000000
+
+    # Resume under the agent to the original-budget shape.
+    resume_cmd = [sys.executable, "-m", "tpudist.launch.run",
+                  "--nprocs", "2", "--max-restarts", "0",
+                  "--tmpdir", str(tmp_path / "scratch2"),
+                  "--", sys.executable, str(REPO / "examples" / "demo.py"),
+                  "--dry_run", "--total_iterations", str(saved_at + 32),
+                  "--checkpoint_dir", str(ckdir),
+                  "--checkpoint_every", "100000", "--resume",
+                  "--seed", "0"]
+    r = subprocess.run(resume_cmd, env=env, cwd=str(tmp_path),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]

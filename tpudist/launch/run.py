@@ -22,6 +22,13 @@ itself (SURVEY.md §2.2 B2, §3.1):
   write structured tracebacks to ``TPUDIST_ERROR_FILE``; the agent
   collects and surfaces the *first* failure (the ``@record`` +
   elastic-error-file pattern, ``demo.py:14,156``).
+- preemption: SLURM delivers SIGTERM to the agent's PROCESS GROUP ahead
+  of a requeue.  The agent must not die under the workers mid-save: its
+  handler forwards SIGTERM to any worker that did not share the group
+  signal, then the agent WAITS for the group to finish its collective
+  preemption checkpoint (``tpudist.runtime.preemption`` in the workers),
+  skips the restart loop (the machine is going away), surfaces the
+  outcome, and exits with the group's status.
 - data staging: ``--stage-data a.tar.gz,b.tar.gz`` extracts into the
   job-local tmpdir before workers start (``torchrun_launcher.sh:35-40``).
 - command validation: like ``torchrun_launcher.sh:23-25`` the worker
@@ -124,6 +131,27 @@ def _read_crash_records(error_template: str, world: int) -> List[dict]:
     return records
 
 
+# Signal-handler state: the live worker group and whether a preemption
+# signal arrived.  Module-level (not closure) so the handler, the attempt
+# loop, and tests all see one source of truth.
+_preempt_state: dict = {"flag": False, "procs": []}
+
+
+def _handle_agent_sigterm(signum, frame):  # noqa: ARG001
+    """Agent-side preemption: mark, forward to workers, keep running.
+
+    Returning (instead of dying, the default SIGTERM action) is the whole
+    point — the agent must stay alive to reap the workers' collective
+    checkpoint save and report it."""
+    _preempt_state["flag"] = True
+    for p in list(_preempt_state["procs"]):
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+
 def _terminate(procs: List[subprocess.Popen], grace_s: float = 10.0) -> None:
     for p in procs:
         if p.poll() is None:
@@ -143,6 +171,7 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
                  tmpdir: str) -> int:
     """Launch the local worker group once; return 0 iff all workers exit 0."""
     procs: List[subprocess.Popen] = []
+    _preempt_state["procs"] = procs
     base_env = dict(os.environ)
     if args.nprocs > 1 and (
         os.path.exists("/dev/accel0") or base_env.get("TPU_NAME")
@@ -176,6 +205,10 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
                 live.remove(p)
                 if rc != 0:
                     failed_rc = rc
+                    if _preempt_state["flag"]:
+                        # Preempting: a straggler may still be finishing
+                        # the collective save — keep waiting, don't kill.
+                        continue
                     # One worker down ⇒ the group is done (the coordination
                     # service cannot re-admit a lone restarted process).
                     _terminate(live)
@@ -185,6 +218,8 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
     except KeyboardInterrupt:
         _terminate(procs)
         raise
+    finally:
+        _preempt_state["procs"] = []
     return failed_rc
 
 
@@ -227,36 +262,74 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpudist.launch.staging import extract_tarballs
         extract_tarballs(args.stage_data.split(","), tmpdir)
 
-    max_attempts = args.max_restarts + 1
-    for attempt in range(max_attempts):
-        error_template = os.path.join(error_dir, f"error_attempt{attempt}_rank%r.json")
-        if attempt > 0:
-            backoff = args.restart_backoff * (2 ** (attempt - 1))
-            print(f"[tpurun] restarting worker group "
-                  f"(attempt {attempt + 1}/{max_attempts}) in {backoff:.1f}s",
-                  file=sys.stderr)
-            time.sleep(backoff)
-            if standalone and world > 1:
-                # Fresh rendezvous port: the dead service may linger in TIME_WAIT.
-                coordinator = f"127.0.0.1:{find_free_port()}"
-        rc = _run_attempt(cmd, args, coordinator, world, run_id, attempt,
-                          error_template, tmpdir)
-        if rc == 0:
-            return 0
-        records = _read_crash_records(error_template, world)
-        if records:
-            first = records[0]
-            print(f"[tpurun] first failure: rank {first.get('process_id')} "
-                  f"{first.get('exc_type')}: {first.get('message')}",
-                  file=sys.stderr)
-            tb = first.get("traceback")
-            if tb:
-                print(tb, file=sys.stderr)
-        else:
-            print(f"[tpurun] worker group failed (exit {rc}); no crash record "
-                  f"written (segfault or unhandled signal?)", file=sys.stderr)
-    print(f"[tpurun] giving up after {max_attempts} attempts", file=sys.stderr)
-    return 1
+    # Preemption protocol: SLURM SIGTERMs the agent's process group; the
+    # agent must survive it (forwarding to workers that missed the group
+    # signal), wait out the workers' collective checkpoint save, and NOT
+    # restart — the allocation is going away.  Handler installed only in
+    # the main thread (CPython restriction); restored on exit so embedding
+    # callers (tests) keep their own handlers.
+    _preempt_state["flag"] = False
+    prev_handler = None
+    import threading
+
+    in_main_thread = threading.current_thread() is threading.main_thread()
+    if in_main_thread:
+        prev_handler = signal.signal(signal.SIGTERM, _handle_agent_sigterm)
+    try:
+        max_attempts = args.max_restarts + 1
+        for attempt in range(max_attempts):
+            error_template = os.path.join(
+                error_dir, f"error_attempt{attempt}_rank%r.json")
+            if attempt > 0:
+                backoff = args.restart_backoff * (2 ** (attempt - 1))
+                print(f"[tpurun] restarting worker group "
+                      f"(attempt {attempt + 1}/{max_attempts}) in {backoff:.1f}s",
+                      file=sys.stderr)
+                time.sleep(backoff)
+                if standalone and world > 1:
+                    # Fresh rendezvous port: the dead service may linger in
+                    # TIME_WAIT.
+                    coordinator = f"127.0.0.1:{find_free_port()}"
+            if _preempt_state["flag"]:
+                # SIGTERM landed between attempts (e.g. during backoff):
+                # a fresh group would never have received the group
+                # signal and would train until SLURM's SIGKILL — don't
+                # launch onto a node being reclaimed.
+                print("[tpurun] preemption signal during restart window; "
+                      "not launching a new worker group", file=sys.stderr)
+                return 1
+            rc = _run_attempt(cmd, args, coordinator, world, run_id, attempt,
+                              error_template, tmpdir)
+            if _preempt_state["flag"]:
+                ok = rc == 0
+                print("[tpurun] preemption: worker group "
+                      f"{'saved and exited cleanly' if ok else f'exited rc={rc}'} "
+                      "after SIGTERM; not restarting", file=sys.stderr)
+                return 0 if ok else 1
+            if rc == 0:
+                return 0
+            records = _read_crash_records(error_template, world)
+            if records:
+                first = records[0]
+                print(f"[tpurun] first failure: rank {first.get('process_id')} "
+                      f"{first.get('exc_type')}: {first.get('message')}",
+                      file=sys.stderr)
+                tb = first.get("traceback")
+                if tb:
+                    print(tb, file=sys.stderr)
+            else:
+                print(f"[tpurun] worker group failed (exit {rc}); no crash "
+                      f"record written (segfault or unhandled signal?)",
+                      file=sys.stderr)
+        print(f"[tpurun] giving up after {max_attempts} attempts",
+              file=sys.stderr)
+        return 1
+    finally:
+        if in_main_thread and prev_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_handler)
+            except (ValueError, OSError):
+                pass
 
 
 if __name__ == "__main__":
