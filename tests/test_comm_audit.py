@@ -187,3 +187,53 @@ class TestCommAudit:
         assert ring_allreduce_wire_bytes(payload, 8) == \
             ring_allreduce_wire_bytes(
                 info["param_bytes"] + 4 * info["n_loss_scalars"], 8)
+
+
+class TestScalingModel:
+    """Analytic scaling model consistency (benchmarks/scaling_model.py):
+    its formulas must agree with the audit's measured HLO payloads and
+    obey the ring-collective algebra."""
+
+    def test_dp_wire_algebra(self):
+        import scaling_model as sm
+
+        d = sm.dp_rows("t", grad_bytes=1000, step_s=0.010,
+                       link_bw=4.5e10, ns=(2, 4, 8, 256))
+        rows = {r["n_chips"]: r for r in d["rows"]}
+        # n=2: each chip wires exactly G bytes; n→∞ approaches 2G.
+        assert rows[2]["wire_bytes_per_chip"] == 1000
+        assert rows[256]["wire_bytes_per_chip"] == int(2 * 255 / 256 * 1000)
+        # Efficiency decreases with n; overlap efficiency >= no-overlap.
+        effs = [rows[n]["efficiency_no_overlap"] for n in (2, 4, 8, 256)]
+        assert effs == sorted(effs, reverse=True)
+        for r in rows.values():
+            assert r["efficiency_overlap"] >= r["efficiency_no_overlap"]
+
+    def test_bw_needed_is_spec_independent(self):
+        import scaling_model as sm
+
+        a = sm.dp_rows("t", grad_bytes=1000, step_s=0.010, link_bw=1e9)
+        b = sm.dp_rows("t", grad_bytes=1000, step_s=0.010, link_bw=9e10)
+        for ra, rb in zip(a["rows"], b["rows"]):
+            assert ra["bw_needed_for_target_GBps"] == \
+                rb["bw_needed_for_target_GBps"]
+
+    def test_toy_grad_bytes_match_audit(self):
+        """The constant the model feeds dp_rows for the toy regime is
+        exactly what the audit measured in the optimized HLO."""
+        import scaling_model as sm
+
+        prof, info = _audit("dp")
+        assert prof["all-reduce"]["bytes_total"] == sm.TOY_GRAD_BYTES
+
+    def test_ring_hop_bytes_match_audit_shards(self):
+        """ring_sp_row's per-hop K+V bytes = 2x one audited KV-shard
+        permute payload at the audit geometry."""
+        import scaling_model as sm
+
+        row = sm.ring_sp_row(
+            name="audit_geom", batch=2, heads=2, seq=64, head_dim=16,
+            ring=4, link_bw=4.5e10, peak_flops=197e12,
+            mfu_measured=0.2, dtype_bytes=4)
+        # audit dp_sp_ring: kv_shard_bytes (ONE tensor) == 4096.
+        assert row["kv_hop_bytes"] == 2 * 4096

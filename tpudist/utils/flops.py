@@ -32,6 +32,27 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# One-way ICI bandwidth PER LINK, bytes/s — the number a ring collective
+# rides (each chip forwards on one link per direction per ring axis).
+# APPROXIMATE public figures (scaling-book-style accounting; exact specs
+# vary by generation/topology doc) — the scaling model treats these as
+# stated assumptions and also reports the inverse question ("bandwidth
+# needed for the target"), which is spec-independent.
+ICI_LINK_BYTES_PER_S = {
+    "TPU v4": 4.5e10,
+    "TPU v5 lite": 4.5e10,
+    "TPU v5e": 4.5e10,
+    "TPU v5": 9.0e10,
+    "TPU v5p": 9.0e10,
+    "TPU v6 lite": 9.0e10,
+    "TPU v6e": 9.0e10,
+}
+
+# Per-HOST data-center-network bandwidth, bytes/s (the fabric the `data`
+# axis rides in the hybrid mesh when it spans hosts) — assumption,
+# ~200 Gbps NICs.
+DCN_HOST_BYTES_PER_S = 2.5e10
+
 
 def chip_peak_flops(device=None) -> Optional[float]:
     """bf16 peak FLOP/s for ``device`` (default: first visible device);
