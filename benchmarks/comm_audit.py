@@ -58,6 +58,22 @@ def _force_cpu_mesh(n: int = 8) -> None:
         )
 
 
+def collect_ops(step, ex_args, info):
+    """One collection point for regime HLO (used by main() AND the test
+    suite so the shipped artifact and the asserted audit can never
+    measure different programs): optimized HLO normally, pre-opt HLO for
+    regimes whose checked property a backend pass rewrites."""
+    from tpudist.utils.hlo_audit import (
+        collect_collectives,
+        lower_preopt_hlo,
+        parse_collectives,
+    )
+
+    if info.get("pre_opt"):
+        return parse_collectives(lower_preopt_hlo(step, *ex_args))
+    return collect_collectives(step, *ex_args)
+
+
 # ---------------------------------------------------------------------------
 # Regime builders: each returns (jitted_step, example_args, info) where
 # info carries the analytic quantities the checks consume.
@@ -104,6 +120,43 @@ def regime_dp(devices):
         "n_loss_scalars": 2,
     }
     return step, (states, x, y), info
+
+
+def regime_dp_bf16_reduce(devices):
+    """(8,) pure DP with grad_reduce_dtype=bf16: the gradient all-reduce
+    must ride the wire at HALF the f32 payload (tpudist/train/lm.py
+    compressed path; the DCN-scaling lever of scaling_model.py)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from tpudist.models import create_transformer
+    from tpudist.runtime.mesh import AXIS_DATA
+    from tpudist.train import init_lm_state, make_lm_train_step, token_sharding
+    from tpudist.utils.hlo_audit import tree_bytes
+
+    mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+    module, params = create_transformer(
+        jax.random.PRNGKey(0), seq_len=16, vocab=32, d_model=32,
+        n_layers=1, n_heads=2, d_ff=64, max_len=16)
+    tx = optax.adam(1e-3)
+    state = init_lm_state(params, tx)
+    step = make_lm_train_step(module.apply, tx, mesh,
+                              grad_reduce_dtype=jnp.bfloat16)
+    toks = np.random.default_rng(0).integers(0, 32, size=(8, 16)) \
+        .astype(np.int32)
+    args = (state, jax.device_put(toks, token_sharding(mesh)))
+    return step, args, {
+        "mesh": {"data": 8},
+        "param_bytes": tree_bytes(state.params),
+        # Audit the PRE-optimization HLO: the CPU backend's all-reduce
+        # promotion pass re-widens bf16 reduces to f32 (no native bf16
+        # reduction on CPU); TPU executes the bf16 width as requested.
+        "pre_opt": True,
+        "note": "cpu backend promotes bf16 all-reduce to f32; "
+                "pre-opt HLO carries the requested wire dtype",
+    }
 
 
 def regime_dp_model_split(devices):
@@ -353,6 +406,7 @@ def regime_dp_pp_1f1b(devices):
 
 REGIMES = {
     "dp": regime_dp,
+    "dp_bf16_reduce": regime_dp_bf16_reduce,
     "dp_model_split": regime_dp_model_split,
     "dp_sp_ring": regime_dp_sp_ring,
     "dp_sp_ring_window": lambda d: regime_dp_sp_ring(d, window=12),
@@ -391,6 +445,25 @@ def check_dp(prof, info):
         _c("one combined gradient all-reduce", 1, ar["count"]),
         _c("all-reduce payload = grad + loss bytes", payload,
            ar["bytes_total"]),
+        _c("no loop-resident collectives", 0, ar["count_in_loop"]),
+    ]
+
+
+def check_dp_bf16_reduce(prof, info):
+    ar = prof.get("all-reduce",
+                  {"count": 0, "bytes_total": 0, "instructions": []})
+    # Wire payload: every f32 param-grad rides at 2 bytes (half) + the
+    # f32 loss scalar's 4.  Checked on the pre-opt HLO (info["pre_opt"])
+    # — exactly one f32 instruction (the loss) and the rest bf16.
+    payload = info["param_bytes"] // 2 + 4
+    f32_instrs = [i for i in ar["instructions"] if "f32[" in i["shape"]]
+    return [
+        _c("only collective kind is all-reduce", ["all-reduce"],
+           sorted(prof)),
+        _c("all-reduce payload = bf16 grads + f32 loss", payload,
+           ar["bytes_total"]),
+        _c("single f32 scalar reduce (the loss); grads all narrow", 1,
+           len(f32_instrs)),
         _c("no loop-resident collectives", 0, ar["count_in_loop"]),
     ]
 
@@ -534,7 +607,7 @@ def main(argv=None) -> int:
     _force_cpu_mesh(8)
     import jax
 
-    from tpudist.utils.hlo_audit import collect_collectives, profile
+    from tpudist.utils.hlo_audit import profile
 
     devices = jax.devices()[:8]
     wanted = set(args.only.split(",")) if args.only else None
@@ -546,7 +619,7 @@ def main(argv=None) -> int:
             continue
         print(f"[comm-audit] lowering {name} ...", flush=True)
         step, ex_args, info = builder(devices)
-        ops = collect_collectives(step, *ex_args)
+        ops = collect_ops(step, ex_args, info)
         prof = profile(ops)
         profiles[name] = prof
         row = {"mesh": info.get("mesh"), "info": {
@@ -554,6 +627,8 @@ def main(argv=None) -> int:
         if not args.measure_only:
             if name == "dp":
                 checks = check_dp(prof, info)
+            elif name == "dp_bf16_reduce":
+                checks = check_dp_bf16_reduce(prof, info)
             elif name == "dp_model_split":
                 checks = check_dp_model_split(prof, info)
             elif name == "dp_sp_ring":

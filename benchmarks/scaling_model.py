@@ -213,6 +213,11 @@ def main() -> int:
         out["dp"].append(dp_rows(
             f"{key}_dp_dcn_floor", grad_bytes=pb + 4, step_s=t,
             link_bw=DCN_HOST_BYTES_PER_S))
+        # grad_reduce_dtype=bf16 (tpudist/train/lm.py compressed path,
+        # audited in COMM_AUDIT dp_bf16_reduce): grads ride at 2 bytes.
+        out["dp"].append(dp_rows(
+            f"{key}_dp_dcn_bf16_reduce", grad_bytes=pb // 2 + 4,
+            step_s=t, link_bw=DCN_HOST_BYTES_PER_S))
 
     # --- sp ring ---------------------------------------------------------
     lc = ext.get("lm_long_context_bf16", {})

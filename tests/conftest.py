@@ -139,6 +139,9 @@ _SLOW_PATTERNS = (
     "test_loop_saves_and_exits_on_preemption_then_resumes",
     "test_completed_run_not_mislabeled_preempted",
     "test_run_bayes_end_to_end_minimizes",
+    # compressed-grad-reduce convergence smoke (the fast
+    # rejects-incompatible twin stays default)
+    "TestCompressedGradReduce::test_tracks_f32_training",
     # comm-audit transformer lowers (compile-heavy; the dp/model-split
     # regimes + parser units stay in the default lane)
     "test_regime[dp_sp",

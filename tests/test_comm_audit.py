@@ -126,11 +126,9 @@ def _audit(name):
     ca._force_cpu_mesh(8)
     import jax
 
-    from tpudist.utils.hlo_audit import collect_collectives
-
     devices = jax.devices()[:8]
     step, args, info = ca.REGIMES[name](devices)
-    prof = profile(collect_collectives(step, *args))
+    prof = profile(ca.collect_ops(step, args, info))
     _PROFILES[name] = prof
     _INFOS[name] = info
     return prof, info
@@ -141,6 +139,8 @@ def _checks_for(name, prof, info):
 
     if name == "dp":
         return ca.check_dp(prof, info)
+    if name == "dp_bf16_reduce":
+        return ca.check_dp_bf16_reduce(prof, info)
     if name == "dp_model_split":
         return ca.check_dp_model_split(prof, info)
     if name == "dp_sp_ring":
@@ -160,6 +160,7 @@ def _checks_for(name, prof, info):
 
 REGIME_NAMES = (
     "dp",
+    "dp_bf16_reduce",
     "dp_model_split",
     "dp_sp_ring",
     "dp_sp_ring_window",

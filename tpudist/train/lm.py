@@ -36,6 +36,59 @@ def init_lm_state(params, tx: optax.GradientTransformation) -> ModelState:
     return ModelState(params=params, opt_state=tx.init(params))
 
 
+def _make_lm_train_step_compressed(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    donate_state: bool,
+    reduce_dtype,
+):
+    """The ``grad_reduce_dtype`` body of :func:`make_lm_train_step`:
+    per-shard grads inside ``shard_map``, explicit narrow-dtype ``pmean``
+    on the wire, f32 update outside."""
+    repl = NamedSharding(mesh, P())
+    tok_shard = token_sharding(mesh)
+
+    def shard_body(params, toks):
+        # pcast-to-varying FIRST: differentiating w.r.t. replicated
+        # (unvarying) inputs makes shard_map's transpose insert its own
+        # full-width f32 psum for the cotangents — the very reduce this
+        # path exists to narrow.  Varying params keep the grads local,
+        # so the explicit narrow pmean below is the ONLY wire traffic
+        # (the audit asserts exactly this).
+        params = jax.tree.map(
+            lambda p: lax.pcast(p, (AXIS_DATA,), to="varying"), params)
+        # Local mean over this shard's rows; equal shards (the sharded
+        # batch contract) make pmean-of-means the exact global mean.
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(apply_fn(p, toks), toks))(params)
+        narrow = jax.tree.map(
+            lambda g: lax.pmean(g.astype(reduce_dtype), AXIS_DATA), grads)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), narrow)
+        return lax.pmean(loss, AXIS_DATA), grads
+
+    sharded_grad = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_DATA)),
+        out_specs=(P(), P()),
+    )
+
+    def step(state: ModelState, tokens):
+        loss, grads = sharded_grad(state.params, tokens)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return ModelState(params=new_params, opt_state=new_opt), loss
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, tok_shard),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
 def make_lm_eval_step(
     apply_fn: Callable,
     mesh: Mesh,
@@ -70,6 +123,7 @@ def make_lm_train_step(
     aux: bool = False,
     moe_balance_weight: float = 0.0,
     accum_steps: int = 1,
+    grad_reduce_dtype=None,
 ):
     """Build ``step(state, tokens) -> (state, loss)``, compiled once.
 
@@ -100,7 +154,40 @@ def make_lm_train_step(
     optimizer update — big effective batches at 1/``accum_steps`` peak
     activation memory, numerics equal to the full-batch step up to
     summation order.  Batch size must divide evenly.
+
+    ``grad_reduce_dtype`` (e.g. ``jnp.bfloat16``) compresses the DP
+    gradient all-reduce: each shard's local gradients are cast down, the
+    cross-device mean rides the wire at that dtype, and the result is
+    cast back to f32 for the optimizer update — halving the per-step DP
+    wire bytes (the first thing that binds when the data axis crosses
+    DCN; see ``benchmarks/scaling_model.py``).  Master weights, loss and
+    optimizer state stay f32; only the reduce payload narrows (the
+    gradient stochasticity the mean averages over is far larger than
+    bf16's rounding at trained scales — tests bound the drift).
+    Implementation: the default path lets XLA insert the f32 psum from
+    the global batch mean; this path instead computes per-shard grads in
+    a ``shard_map`` and reduces them explicitly at the narrow dtype, so
+    it requires the pure-DP layout (replicated state, no
+    ``state_sharding``, no ``aux``/``accum_steps`` composition yet) and a
+    mesh whose only batch axis is ``data``.
     """
+    if grad_reduce_dtype is not None:
+        if state_sharding is not None or aux or moe_balance_weight > 0.0 \
+                or accum_steps != 1:
+            raise ValueError(
+                "grad_reduce_dtype requires the pure-DP step (replicated "
+                "state; no aux/moe_balance_weight/accum_steps)")
+        if AXIS_DATA not in mesh.axis_names:
+            raise ValueError("grad_reduce_dtype needs a 'data' mesh axis")
+        extra = [a for a in mesh.axis_names
+                 if a != AXIS_DATA and mesh.shape[a] > 1]
+        if extra:
+            raise ValueError(
+                f"grad_reduce_dtype supports data-only meshes; axes "
+                f"{extra} have size > 1")
+        return _make_lm_train_step_compressed(
+            apply_fn, tx, mesh, donate_state=donate_state,
+            reduce_dtype=grad_reduce_dtype)
     repl = NamedSharding(mesh, P())
     tok_shard = token_sharding(mesh)
     state_out = repl if state_sharding is None else state_sharding

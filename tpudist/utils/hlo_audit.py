@@ -201,6 +201,16 @@ def lower_optimized_hlo(jitted, *args, **kwargs) -> str:
     return compiled.as_text()
 
 
+def lower_preopt_hlo(jitted, *args, **kwargs) -> str:
+    """Pre-optimization HLO (post-lowering, before backend passes) — the
+    program as REQUESTED.  Needed when a backend pass rewrites what the
+    audit checks: e.g. the CPU backend's all-reduce promotion re-widens a
+    requested bf16 gradient all-reduce to f32 (CPU has no native bf16
+    reduction), while TPU executes it at bf16 as written."""
+    return jitted.lower(*args, **kwargs).compiler_ir(
+        dialect="hlo").as_hlo_text()
+
+
 def collect_collectives(jitted, *args, **kwargs) -> List[CollectiveOp]:
     return parse_collectives(lower_optimized_hlo(jitted, *args, **kwargs))
 
@@ -223,7 +233,7 @@ def profile(ops: Sequence[CollectiveOp]) -> Dict[str, dict]:
             row["bytes_in_loop"] += op.bytes
         row["instructions"].append(
             {"name": op.name, "bytes": op.bytes, "in_loop": op.in_loop,
-             "op_name": op.op_name}
+             "shape": op.shape, "op_name": op.op_name}
         )
     return out
 
