@@ -112,12 +112,15 @@ def bench_fused_mlp(batch: int = 4096) -> dict:
     on a 371-parameter MLP the expectation is that XLA's fusion already
     saturates — the kernel exists to show the explicit-VMEM formulation
     and to measure what hand-fusing buys (or costs) at this scale.
-    Forward-only (the kernel defines no VJP); numerics are asserted
-    against the XLA reference before timing."""
+    Forward-only (the kernel defines no VJP); BOTH paths are asserted
+    against a float64 numpy forward before timing — the kernel at its
+    Precision.HIGHEST budget (1e-4), the XLA path at the TPU
+    default-precision bf16-pass budget (5e-2)."""
     import jax.numpy as jnp
 
     from tpudist.models import create_toy_model
-    from tpudist.ops.fused_mlp import fused_mlp, mlp_reference, pad_params
+    from tpudist.ops.fused_mlp import (NEGATIVE_SLOPE, fused_mlp,
+                                       mlp_reference, pad_params)
 
     _, params = create_toy_model(jax.random.PRNGKey(0))
     p = params["params"]
@@ -130,10 +133,22 @@ def bench_fused_mlp(batch: int = 4096) -> dict:
     f_fused = jax.jit(lambda x: fused_mlp(x, padded, d_out))
     f_xla = jax.jit(lambda x: mlp_reference(x, weights))
 
-    got, want = np.asarray(f_fused(x)), np.asarray(f_xla(x))
-    rel = float(np.abs(got - want).max() / max(np.abs(want).max(), 1e-6))
+    # Ground truth is float64 numpy, NOT the XLA path: on TPU the default
+    # matmul precision is a single bf16 pass (~1e-2 rel), while the kernel
+    # runs Precision.HIGHEST — comparing them directly flags the XLA side's
+    # own rounding as a "kernel mismatch" (observed on-chip r4: rel=0.013).
+    h = np.asarray(x, np.float64)
+    for i, (w, b) in enumerate(weights):
+        h = h @ np.asarray(w, np.float64) + np.asarray(b, np.float64)
+        if i + 1 < len(weights):
+            h = np.where(h >= 0, h, NEGATIVE_SLOPE * h)
+    scale = max(np.abs(h).max(), 1e-6)
+    rel = float(np.abs(np.asarray(f_fused(x)) - h).max() / scale)
+    rel_xla = float(np.abs(np.asarray(f_xla(x)) - h).max() / scale)
     if not np.isfinite(rel) or rel > 1e-4:
         raise AssertionError(f"fused_mlp numerics mismatch: rel={rel}")
+    if not np.isfinite(rel_xla) or rel_xla > 5e-2:  # bf16-pass budget
+        raise AssertionError(f"xla reference numerics mismatch: rel={rel_xla}")
 
     rates = {}
     for tag, fn in (("pallas_fused", f_fused), ("xla_fused", f_xla)):
@@ -156,7 +171,8 @@ def bench_fused_mlp(batch: int = 4096) -> dict:
         "metric": "toy_mlp_fused_forward_samples_per_sec",
         "unit": "samples/sec (forward only)",
         "config": {"batch": batch},
-        "max_rel_err_vs_xla": round(rel, 8),
+        "max_rel_err_vs_f64": round(rel, 8),
+        "xla_rel_err_vs_f64": round(rel_xla, 8),
         **rates,
         "pallas_over_xla": round(rates["pallas_fused"] / rates["xla_fused"],
                                  3),
@@ -466,14 +482,36 @@ def main() -> None:
     import os as _os
 
     gate_timeout = float(_os.environ.get("TPUDIST_GATE_TIMEOUT", "900"))
+    gate_ok = True
     if jax.devices()[0].platform == "tpu":
-        # Correctness gate BEFORE any timing: a kernel mismatch must kill
-        # the run (nonzero exit), never record a number.  Watchdogged: a
-        # wedged gate compile must fail the run loudly, not hang the
-        # driver's whole round-end bench invocation.
+        # Correctness gate BEFORE any timing: a kernel MISMATCH must kill
+        # the run (nonzero exit), never record a number.  A gate TIMEOUT is
+        # a different animal — a Pallas compile wedging the tunnel (twice
+        # observed r4) says nothing about kernel correctness, and killing
+        # the whole artifact forfeits the XLA-only rows (dense, MFU, decode)
+        # that compile fine.  So: timeout → skip every Pallas-certified row
+        # and keep going; mismatch → hard fail as before.
         try:
             results["numerics_gate"] = _with_watchdog(
                 numerics_gate, gate_timeout, "numerics gate")
+        except TimeoutError as e:
+            gate_ok = False
+            # Not only the long-context rows go through the flash kernel:
+            # at seq 2048 >= FLASH_MIN_SEQ the dense/MFU rows route to it
+            # too (transformer.py attend()).  Uncertified kernels must not
+            # time ANY row — force the routing crossover out of reach so
+            # every surviving row runs XLA reference attention, and label
+            # the artifact so the rows aren't compared against flash-path
+            # rounds.
+            _os.environ["TPUDIST_FLASH_MIN_SEQ"] = str(1 << 30)
+            results["numerics_gate"] = {
+                "error": repr(e),
+                "consequence": "flash rows skipped; remaining rows forced "
+                               "to XLA reference attention (uncertified "
+                               "kernels must not be timed)"}
+            results["attention_path"] = "xla_reference (gate wedged)"
+            print(f"# numerics gate wedged — Pallas rows skipped: {e!r}",
+                  file=sys.stderr)
         except Exception as e:
             _fail_record(f"numerics gate failed: {e!r}", 3)
 
@@ -483,7 +521,7 @@ def main() -> None:
         _fail_record(f"toy bench failed: {e!r}", 4)
     results["toy"] = toy
 
-    if jax.devices()[0].platform == "tpu":
+    if jax.devices()[0].platform == "tpu" and gate_ok:
         # Kernel-vs-XLA A/B on the toy forward (the answer is interesting
         # either way; a failure must not cost the headline).
         try:
@@ -521,26 +559,20 @@ def main() -> None:
             print(f"# {key} failed: {e!r}", file=sys.stderr)
         ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
+    # Section order is failure-mode-aware: the short-sequence rows (dense,
+    # MFU, decode) run BEFORE the long-context Pallas rows.  Twice this
+    # round a Pallas kernel compile wedged the axon tunnel machine-wide;
+    # when that happens the two-timeout bailout must not have skipped the
+    # dense MFU yardstick that would have run fine (observed r4:
+    # long_context fp32 wedged at 600s and the d1024 row never executed).
+    # (Dense/MFU still route seq 2048 through the flash kernel when the
+    # gate certified it — the gate-timeout branch above reroutes them.)
     for precision in ("fp32", "bf16"):
         run_section(
             f"lm_dense_{precision}",
             lambda p=precision: bench_lm(
                 name=f"dense_{p}", batch=8, seq_len=2048, d_model=512,
                 n_layers=4, n_heads=8, d_ff=2048, precision=p))
-
-    # Long-context LM config (BASELINE.md's measured row): flash-attention
-    # regime, attention-dominated — tracks the kernel round over round.
-    for precision in ("fp32", "bf16"):
-        run_section(
-            f"lm_long_context_{precision}",
-            lambda p=precision: bench_lm(
-                name=f"long_context_{p}", batch=4, seq_len=8192,
-                d_model=256, n_layers=4, n_heads=4, d_ff=1024,
-                precision=p))
-
-    run_section("lm_decode", bench_decode)
-
-    ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
     # MXU-saturating MFU row (VERDICT r2: demonstrate >=35% or profile
     # why not): d1024/L8/ff4096/seq2048 bf16 — wide enough matmuls that
@@ -560,6 +592,24 @@ def main() -> None:
                 profile_dir=os.environ.get("TPUDIST_BENCH_PROFILE"),
             ),
             timeout=900.0)
+
+    run_section("lm_decode", bench_decode)
+
+    # Long-context LM config (BASELINE.md's measured row): flash-attention
+    # regime, attention-dominated — tracks the kernel round over round.
+    # Pallas compiles are the tunnel-wedge trigger, so these come last,
+    # and only run when the gate actually certified the kernels.
+    for precision in ("fp32", "bf16"):
+        if not gate_ok:
+            results[f"lm_long_context_{precision}"] = {
+                "error": "skipped: numerics gate wedged, kernels uncertified"}
+            continue
+        run_section(
+            f"lm_long_context_{precision}",
+            lambda p=precision: bench_lm(
+                name=f"long_context_{p}", batch=4, seq_len=8192,
+                d_model=256, n_layers=4, n_heads=4, d_ff=1024,
+                precision=p))
 
     ext_path.write_text(json.dumps(results, indent=2) + "\n")
 

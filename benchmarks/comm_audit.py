@@ -432,7 +432,8 @@ def _c(name, expected, measured, ok=None):
 
 
 def check_dp(prof, info):
-    ar = prof.get("all-reduce", {"count": 0, "bytes_total": 0})
+    ar = prof.get("all-reduce",
+                  {"count": 0, "bytes_total": 0, "count_in_loop": 0})
     payload = info["param_bytes"] + 4 * info["n_loss_scalars"]
     n = info["mesh"]["data"]
     from tpudist.utils.hlo_audit import ring_allreduce_wire_bytes
@@ -451,7 +452,8 @@ def check_dp(prof, info):
 
 def check_dp_bf16_reduce(prof, info):
     ar = prof.get("all-reduce",
-                  {"count": 0, "bytes_total": 0, "instructions": []})
+                  {"count": 0, "bytes_total": 0, "count_in_loop": 0,
+                   "instructions": []})
     # Wire payload: every f32 param-grad rides at 2 bytes (half) + the
     # f32 loss scalar's 4.  Checked on the pre-opt HLO (info["pre_opt"])
     # — exactly one f32 instruction (the loss) and the rest bf16.
