@@ -473,17 +473,56 @@ def _fail_record(error: str, exit_code: int) -> None:
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sections", default="all",
+                    help="comma list of toy,fused,dense,mfu,decode,long "
+                         "(default: all).  Targeted on-chip reruns merge "
+                         "into the existing BENCH_EXTENDED.json instead of "
+                         "clobbering other sections' evidence.")
+    cli = ap.parse_args()
+    want = {s.strip() for s in cli.sections.split(",") if s.strip()}
+    known = {"all", "toy", "fused", "dense", "mfu", "decode", "long"}
+    if not want or want - known:
+        # A typo'd section must not produce a success-looking empty run
+        # (the shepherd would record the step as terminally complete).
+        # EX_USAGE, not 2 — rc 2 means "device unreachable, retry me".
+        print(json.dumps({"error": f"unknown sections {sorted(want - known)}; "
+                          f"known: {sorted(known)}"}))
+        sys.exit(64)
+
+    def sec(name: str) -> bool:
+        return "all" in want or name in want
+
     if not _device_reachable():
         _fail_record("device unreachable (remote tunnel down?)", 2)
 
     results = {"device_kind": jax.devices()[0].device_kind,
                "n_chips": jax.local_device_count()}
+    ran_now: list = []  # sections THIS invocation executed (not merged)
+    ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
+    if want != {"all"} and ext_path.exists():
+        # Partial run: keep the sections this invocation doesn't touch —
+        # but never the run-global annotations, which describe the run
+        # that wrote them, not this one (a stale "gate wedged" label on
+        # freshly flash-certified rows corrupts cross-round comparison).
+        try:
+            prior = json.loads(ext_path.read_text())
+            for stale in ("attention_path", "last_run_error"):
+                prior.pop(stale, None)
+            results = {**prior, **results}
+        except Exception:
+            pass
 
     import os as _os
 
     gate_timeout = float(_os.environ.get("TPUDIST_GATE_TIMEOUT", "900"))
     gate_ok = True
-    if jax.devices()[0].platform == "tpu":
+    # The gate certifies the flash kernels; any section that can route
+    # through them needs it (dense/MFU at seq 2048 included).
+    need_gate = any(sec(s) for s in ("fused", "dense", "mfu", "long"))
+    if jax.devices()[0].platform == "tpu" and need_gate:
         # Correctness gate BEFORE any timing: a kernel MISMATCH must kill
         # the run (nonzero exit), never record a number.  A gate TIMEOUT is
         # a different animal — a Pallas compile wedging the tunnel (twice
@@ -515,15 +554,18 @@ def main() -> None:
         except Exception as e:
             _fail_record(f"numerics gate failed: {e!r}", 3)
 
-    try:
-        toy = _with_watchdog(bench_toy, 600.0, "toy bench")
-    except Exception as e:
-        _fail_record(f"toy bench failed: {e!r}", 4)
-    results["toy"] = toy
+    toy = None
+    if sec("toy"):
+        try:
+            toy = _with_watchdog(bench_toy, 600.0, "toy bench")
+        except Exception as e:
+            _fail_record(f"toy bench failed: {e!r}", 4)
+        results["toy"] = toy
 
-    if jax.devices()[0].platform == "tpu" and gate_ok:
+    if jax.devices()[0].platform == "tpu" and gate_ok and sec("fused"):
         # Kernel-vs-XLA A/B on the toy forward (the answer is interesting
         # either way; a failure must not cost the headline).
+        ran_now.append("toy_fused_mlp")
         try:
             results["toy_fused_mlp"] = _with_watchdog(
                 bench_fused_mlp, 600.0, "fused mlp bench")
@@ -538,11 +580,11 @@ def main() -> None:
     # and bail out of further on-chip sections after two consecutive
     # watchdog timeouts — a wedged tunnel makes every later compile wedge
     # too, and 600s apiece of confirmation adds nothing.
-    ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
     wedged = 0
 
     def run_section(key: str, fn, timeout: float = 600.0) -> None:
         nonlocal wedged
+        ran_now.append(key)
         if wedged >= 2:
             results[key] = {"error": "skipped: tunnel wedged "
                             "(2+ consecutive section timeouts)"}
@@ -568,6 +610,8 @@ def main() -> None:
     # (Dense/MFU still route seq 2048 through the flash kernel when the
     # gate certified it — the gate-timeout branch above reroutes them.)
     for precision in ("fp32", "bf16"):
+        if not sec("dense"):
+            break
         run_section(
             f"lm_dense_{precision}",
             lambda p=precision: bench_lm(
@@ -580,7 +624,7 @@ def main() -> None:
     # under a watchdog thread; a wedged tunnel records a timeout error
     # instead of hanging the artifact.  TPUDIST_BENCH_PROFILE=dir adds a
     # jax.profiler trace of the timed steps.
-    if jax.devices()[0].platform == "tpu":
+    if jax.devices()[0].platform == "tpu" and sec("mfu"):
         import os
 
         run_section(
@@ -593,13 +637,29 @@ def main() -> None:
             ),
             timeout=900.0)
 
-    run_section("lm_decode", bench_decode)
+        # MFU lever #1 — arithmetic intensity via batch (VERDICT r3 #2):
+        # the d1024 matmuls at b8 leave the MXU waiting on dispatch and
+        # HBM; doubling batch amortizes both.  Each rung has its own
+        # watchdog, so an OOM or wedge costs one row, not the ladder.
+        for b in (16, 32):
+            run_section(
+                f"lm_mfu_d1024_b{b}",
+                lambda b=b: bench_lm(
+                    name=f"mfu_d1024_bf16_b{b}", batch=b, seq_len=2048,
+                    d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
+                    precision="bf16", steps=3),
+                timeout=900.0)
+
+    if sec("decode"):
+        run_section("lm_decode", bench_decode)
 
     # Long-context LM config (BASELINE.md's measured row): flash-attention
     # regime, attention-dominated — tracks the kernel round over round.
     # Pallas compiles are the tunnel-wedge trigger, so these come last,
     # and only run when the gate actually certified the kernels.
     for precision in ("fp32", "bf16"):
+        if not sec("long"):
+            break
         if not gate_ok:
             results[f"lm_long_context_{precision}"] = {
                 "error": "skipped: numerics gate wedged, kernels uncertified"}
@@ -613,17 +673,24 @@ def main() -> None:
 
     ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
-    baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
-    vs = 1.0
-    if baseline_path.exists():
-        try:
-            recorded = json.loads(baseline_path.read_text()).get("value")
-            if recorded:
-                vs = toy["value"] / recorded
-        except Exception:
-            pass
-
-    print(json.dumps({**toy, "vs_baseline": round(vs, 3)}), flush=True)
+    if toy is not None:
+        baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
+        vs = 1.0
+        if baseline_path.exists():
+            try:
+                recorded = json.loads(baseline_path.read_text()).get("value")
+                if recorded:
+                    vs = toy["value"] / recorded
+            except Exception:
+                pass
+        print(json.dumps({**toy, "vs_baseline": round(vs, 3)}), flush=True)
+    else:  # targeted partial run — still exactly one JSON line
+        ok = [k for k in ran_now
+              if isinstance(results.get(k), dict)
+              and "error" not in results[k]]
+        print(json.dumps({"metric": "bench_sections_ok", "value": len(ok),
+                          "unit": "sections", "ran": sorted(ran_now),
+                          "ok": sorted(ok)}), flush=True)
 
     # Hard exit: a wedged MFU-row thread (or a stuck backend) must not be
     # able to hang interpreter teardown after the record is printed.

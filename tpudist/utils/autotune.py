@@ -1,0 +1,208 @@
+"""On-hardware autotuner for the flash-attention tuned constants.
+
+``benchmarks/flash_sweep.py`` prints A/B timings for a human to read;
+this module closes the loop — it times the candidate (block_q, block_k)
+tiles and the dense-vs-flash crossover ON THE CURRENT DEVICE and writes
+the winners to ``tpudist/tuned/<device_kind>.json``, where
+:func:`tpudist.utils.tuning.tuned` resolves them ahead of the baked v5e
+defaults (env vars still win over everything).  One command ports the
+kernel routing to a new TPU generation:
+
+    python -m tpudist.utils.autotune            # measure + write
+    python -m tpudist.utils.autotune --dry-run  # measure + print only
+
+Measurement method matches the sweep harness: each configuration is ONE
+dispatched XLA program chaining serially-dependent applications via
+``lax.scan``, so the axon tunnel's tens-of-ms per-dispatch latency is
+amortized out of the per-application number.
+
+Tuned keys written (see ``tuning._V5E_DEFAULTS``):
+- ``FLASH_BLOCK_Q`` / ``FLASH_BLOCK_K`` — fastest tile at the short
+  production shape (seq 2048, fwd+bwd);
+- ``FLASH_BLOCK_K_LONG`` — fastest KV tile at the long shape (seq 8192);
+- ``FLASH_MIN_SEQ`` — smallest measured seq where flash beats the dense
+  XLA reference (fwd+bwd), i.e. the routing crossover.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpudist.utils.tuning import tuned_file_path
+
+HEAD_DIM = 64  # the demo/transformer head width every harness times
+
+
+def time_one_program(fn: Callable, *args, steps: int = 8) -> float:
+    """Per-application seconds for ``fn(*args)`` measured as one
+    dispatched program scanning ``steps`` serially-dependent calls."""
+
+    def chained(*xs):
+        def body(carry, _):
+            out = fn(*carry[1:])
+            # re-feed the first operand so the chain is data-dependent
+            return (carry[0] + out.ravel()[0].astype(jnp.float32),
+                    *carry[1:]), None
+
+        (acc, *_), _ = lax.scan(body, (jnp.float32(0), *xs), None,
+                                length=steps)
+        return acc
+
+    compiled = jax.jit(chained)
+    acc = compiled(*args)
+    acc.block_until_ready()  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        compiled(*args).block_until_ready()
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best
+
+
+def _qkv(seq: int, heads: int = 4, batch: int = 1):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(11), 3)
+    shape = (batch, heads, seq, HEAD_DIM)
+    return (jax.random.normal(kq, shape, jnp.float32),
+            jax.random.normal(kk, shape, jnp.float32),
+            jax.random.normal(kv, shape, jnp.float32))
+
+
+def _flash_grad_fn(bq: int, bk: int):
+    from tpudist.ops import flash_attention
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, True, bq, bk, False, None) ** 2).sum()
+
+    return jax.grad(loss, argnums=(0, 1, 2))
+
+
+def _dense_grad_fn():
+    from tpudist.parallel import attention_reference
+
+    def loss(q, k, v):
+        return (attention_reference(q, k, v, causal=True) ** 2).sum()
+
+    return jax.grad(loss, argnums=(0, 1, 2))
+
+
+def _first_output(fn):
+    """Adapt a tuple-returning grad fn to the scalar-chaining timer."""
+
+    @functools.wraps(fn)
+    def one(*args):
+        return fn(*args)[0]
+
+    return one
+
+
+def autotune_flash(
+    *,
+    short_seq: int = 2048,
+    long_seq: int = 8192,
+    tiles: Sequence[tuple[int, int]] = ((256, 256), (512, 256), (512, 512),
+                                       (1024, 512)),
+    long_k_tiles: Sequence[int] = (512, 1024, 2048),
+    crossover_seqs: Sequence[int] = (512, 1024, 2048),
+    timer: Callable = time_one_program,
+    log: Callable = functools.partial(print, file=sys.stderr, flush=True),
+) -> dict:
+    """Measure and return the tuned-constant dict (no file IO here).
+
+    ``timer`` is injectable so the selection logic is testable without
+    hardware (tests feed synthetic timings)."""
+    report: dict = {"measurements": {}}
+
+    # --- short-shape tile: FLASH_BLOCK_Q / FLASH_BLOCK_K ---
+    best_t, best_tile = float("inf"), None
+    for bq, bk in tiles:
+        if short_seq % bq or short_seq % bk:
+            continue
+        t = timer(_first_output(_flash_grad_fn(bq, bk)), *_qkv(short_seq))
+        report["measurements"][f"short{short_seq}_{bq}x{bk}"] = t
+        log(f"# autotune short seq{short_seq} {bq}x{bk}: {t * 1e3:.3f} ms")
+        if t < best_t:
+            best_t, best_tile = t, (bq, bk)
+    if best_tile is None:
+        raise ValueError(f"no candidate tile divides seq {short_seq}")
+    report["FLASH_BLOCK_Q"], report["FLASH_BLOCK_K"] = best_tile
+
+    # --- long-shape KV tile: FLASH_BLOCK_K_LONG ---
+    bq = report["FLASH_BLOCK_Q"]
+    best_t, best_bk = float("inf"), None
+    for bk in long_k_tiles:
+        if long_seq % bk or long_seq % bq:
+            continue
+        t = timer(_first_output(_flash_grad_fn(bq, bk)), *_qkv(long_seq))
+        report["measurements"][f"long{long_seq}_{bq}x{bk}"] = t
+        log(f"# autotune long seq{long_seq} {bq}x{bk}: {t * 1e3:.3f} ms")
+        if t < best_t:
+            best_t, best_bk = t, bk
+    if best_bk is not None:
+        report["FLASH_BLOCK_K_LONG"] = best_bk
+
+    # --- routing crossover: FLASH_MIN_SEQ ---
+    # Smallest seq where flash (at the winning tile, clipped to fit)
+    # beats dense.  If flash never wins, the crossover sits above the
+    # largest probed seq — park it there so routing stays dense.
+    bq0, bk0 = best_tile
+    crossover = None
+    for s in sorted(crossover_seqs):
+        fb_q, fb_k = min(bq0, s), min(bk0, s)
+        if s % fb_q or s % fb_k:
+            continue
+        tf = timer(_first_output(_flash_grad_fn(fb_q, fb_k)), *_qkv(s))
+        td = timer(_first_output(_dense_grad_fn()), *_qkv(s))
+        report["measurements"][f"crossover{s}"] = {"flash": tf, "dense": td}
+        log(f"# autotune crossover seq{s}: flash {tf * 1e3:.3f} ms "
+            f"vs dense {td * 1e3:.3f} ms")
+        if tf < td and crossover is None:
+            crossover = s
+    report["FLASH_MIN_SEQ"] = (crossover if crossover is not None
+                               else max(crossover_seqs) * 2)
+    return report
+
+
+def write_tuned(report: dict, path=None) -> str:
+    """Persist the tuned keys (measurements stay out of the file — the
+    resolver wants an int table, the evidence goes to the caller/log)."""
+    path = tuned_file_path() if path is None else path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    keys = {k: v for k, v in report.items() if k.isupper()}
+    meta = {"device_kind": jax.devices()[0].device_kind,
+            "method": "tpudist.utils.autotune"}
+    path.write_text(json.dumps({**keys, "_meta": meta}, indent=2) + "\n")
+    return str(path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="measure and print, do not write the tuned file")
+    ap.add_argument("--short-seq", type=int, default=2048)
+    ap.add_argument("--long-seq", type=int, default=8192)
+    args = ap.parse_args(argv)
+
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"error": "autotune needs a real TPU "
+                          f"(got {jax.devices()[0].platform})"}))
+        return 2
+    report = autotune_flash(short_seq=args.short_seq, long_seq=args.long_seq)
+    out = {k: v for k, v in report.items() if k != "measurements"}
+    if not args.dry_run:
+        out["written_to"] = write_tuned(report)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,8 +11,12 @@ resolves here, through
    platform generation without touching code; the benchmark harnesses in
    ``benchmarks/`` are the re-derivation tools — ``flash_sweep.py`` for
    the crossover/blocks, ``bench.py`` for the scan window), then
-2. a per-``device_kind`` table of measured values, then
-3. the v5e-measured default (the only hardware this repo has ever seen).
+2. a measured tuned-constants file for this ``device_kind`` —
+   ``tpudist/tuned/<device_kind>.json``, written by
+   :mod:`tpudist.utils.autotune` on real hardware (or any path via
+   ``TPUDIST_TUNED_FILE``), then
+3. a per-``device_kind`` table of measured values, then
+4. the v5e-measured default (the only hardware this repo has ever seen).
 
 Values are read lazily at call time, so tests can monkeypatch env vars and
 a process that sets overrides before building models sees them.
@@ -20,7 +24,9 @@ a process that sets overrides before building models sees them.
 
 from __future__ import annotations
 
+import json
 import os
+from pathlib import Path
 from typing import Dict
 
 # Measured on TPU v5e (BASELINE.md round 2): dense XLA wins below seq
@@ -67,9 +73,51 @@ def _device_kind() -> str:
         return ""
 
 
+def tuned_file_path(device_kind: str | None = None) -> Path:
+    """Where measured tuned constants live for ``device_kind`` (defaults
+    to the current device).  ``TPUDIST_TUNED_FILE`` overrides the path
+    wholesale (one file, any location — e.g. a sweep-scratch dir)."""
+    env = os.environ.get("TPUDIST_TUNED_FILE")
+    if env:
+        return Path(env)
+    kind = _device_kind() if device_kind is None else device_kind
+    safe = kind.replace(" ", "_").replace("/", "_") or "unknown"
+    return Path(__file__).resolve().parent.parent / "tuned" / f"{safe}.json"
+
+
+_tuned_file_cache: Dict[str, tuple] = {}  # path -> (mtime_ns, parsed dict)
+
+
+def _from_tuned_file(key: str):
+    """Measured-constants file lookup — missing/invalid file is simply
+    'no measurement recorded', never an error.  Parsed content is cached
+    per (path, mtime): ``tuned()`` runs several times per layer at trace
+    time, and re-reading the JSON each call would pay 40+ read/parse
+    cycles per 8-layer compile (rewrites — e.g. the autotuner finishing
+    mid-session — invalidate via mtime)."""
+    path = tuned_file_path()
+    try:
+        mtime = path.stat().st_mtime_ns
+    except OSError:
+        return None
+    cached = _tuned_file_cache.get(str(path))
+    if cached is None or cached[0] != mtime:
+        try:
+            data = json.loads(path.read_text())
+            if not isinstance(data, dict):
+                data = {}
+        except Exception:
+            data = {}
+        _tuned_file_cache[str(path)] = (mtime, data)
+    else:
+        data = cached[1]
+    return data.get(key)
+
+
 def tuned(name: str) -> int:
     """Resolve the tuned constant ``name`` (see ``_V5E_DEFAULTS`` keys):
-    ``TPUDIST_<NAME>`` env var > device-kind table > v5e default."""
+    ``TPUDIST_<NAME>`` env var > autotuned file > device-kind table >
+    v5e default."""
     key = name.upper()
     if key not in _V5E_DEFAULTS:
         raise KeyError(f"unknown tuned constant {name!r}; "
@@ -77,5 +125,8 @@ def tuned(name: str) -> int:
     env = os.environ.get(f"TPUDIST_{key}")
     if env is not None:
         return int(env)
+    measured = _from_tuned_file(key)
+    if measured is not None:
+        return int(measured)
     return _BY_DEVICE_KIND.get(_device_kind(), {}).get(
         key, _V5E_DEFAULTS[key])
