@@ -85,9 +85,11 @@ def main(argv=None):
     mesh = Mesh(np.asarray(jax.devices()).reshape(data, S),
                 axis_names=(AXIS_DATA, AXIS_STAGE))
 
+    # 2 layers per stage so the same model also splits into the
+    # interleaved layout's 2·S virtual stages (1 layer per chunk).
     module, params = create_transformer(
         jax.random.PRNGKey(0), seq_len=args.seq_len, vocab=64,
-        d_model=args.d_model, n_layers=S, n_heads=4,
+        d_model=args.d_model, n_layers=2 * S, n_heads=4,
         d_ff=4 * args.d_model, max_len=args.seq_len)
     tx = optax.adam(1e-3)
     pp = stack_block_params(params, S)
@@ -121,6 +123,33 @@ def main(argv=None):
         if row["temp_bytes_gpipe"] and row["temp_bytes_1f1b"]:
             row["mem_ratio_1f1b_vs_gpipe"] = round(
                 row["temp_bytes_1f1b"] / row["temp_bytes_gpipe"], 3)
+
+        # Interleaved 1F1B at V=2: the 2S-layer model re-laid out into
+        # 2S one-layer virtual stages; needs layers % (V·S) == 0 and the
+        # Megatron grouping constraint M % S == 0.
+        V = 2
+        if (2 * S) % (V * S) == 0 and m % S == 0:
+            from tpudist.parallel import stack_block_params_interleaved
+            from tpudist.parallel.pipeline_interleaved import (
+                interleaved_schedule)
+            pp_i = stack_block_params_interleaved(params, S, V)
+            st_i = init_lm_state(pp_i, tx)
+            sh_i = pp_state_sharding(mesh, st_i)
+            st_i = jax.device_put(st_i, sh_i)
+            step_i = make_pp_lm_train_step(
+                mesh, module, tx, n_stages=S, num_microbatches=m,
+                schedule="interleaved", n_chunks=V, donate_state=False,
+                state_sharding=sh_i)
+            sched = interleaved_schedule(S, V, m)
+            # Tick duration scales ~1/V, so the plain tick fraction is
+            # already wall-clock-comparable to the analytic formulas.
+            row["bubble_interleaved_v2"] = round(
+                sched.bubble_ticks / sched.total_ticks, 4)
+            row["temp_bytes_interleaved_v2"] = _peak_temp_bytes(
+                step_i, st_i, tokens)
+        else:
+            print(json.dumps({"note": "interleaved row skipped",
+                              "needs": f"M % {S} == 0"}), flush=True)
         rows.append(row)
         print(json.dumps(row), flush=True)
     return rows

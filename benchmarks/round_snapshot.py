@@ -29,6 +29,13 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 _VIRTUAL_STUB = """
+import os
+# BOTH pins are required: jax.config for this process's first backend
+# resolution, and the env var for every code path that re-resolves from
+# the environment (tpudist initialize() honors an explicit JAX_PLATFORMS;
+# without it the axon sitecustomize re-pins the tunnel backend, and a
+# wedged tunnel kills the virtual-mesh run — observed r4 loss_parity).
+os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)

@@ -942,7 +942,7 @@ class Test1F1BSchedule:
         module, _, _ = self._states(mesh, tx)
         with pytest.raises(ValueError, match="gpipe|1f1b"):
             make_pp_lm_train_step(mesh, module, tx, n_stages=4,
-                                  schedule="interleaved")
+                                  schedule="zb-h1")
         moe_mod = module.clone(n_experts=2)
         with pytest.raises(ValueError, match="MoE"):
             make_pp_lm_train_step(mesh, moe_mod, tx, n_stages=4,

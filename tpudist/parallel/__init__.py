@@ -36,11 +36,18 @@ from tpudist.parallel.pipeline import (  # noqa: F401
     pipeline_1f1b_shard,
     pipeline_shard,
 )
+from tpudist.parallel.pipeline_interleaved import (  # noqa: F401
+    deinterleave_block_params,
+    interleave_block_params,
+    interleaved_schedule,
+    pipeline_interleaved_shard,
+)
 from tpudist.parallel.pipeline_lm import (  # noqa: F401
     make_pp_lm_apply,
     make_pp_lm_train_step,
     pp_state_sharding,
     stack_block_params,
+    stack_block_params_interleaved,
     unstack_block_params,
 )
 from tpudist.parallel.moe import MoEStats, make_moe, moe_shard  # noqa: F401

@@ -116,6 +116,21 @@ def unstack_block_params(pp_params):
     return {"params": p}
 
 
+def stack_block_params_interleaved(params, n_dev: int, n_chunks: int):
+    """TransformerLM params → the interleaved pipeline layout: blocks
+    stacked to ``n_dev·n_chunks`` virtual stages, then depth-strided so
+    device ``d`` holds global stages ``{c·n_dev + d}``
+    (:func:`tpudist.parallel.pipeline_interleaved.interleave_block_params`).
+    For checkpoint interop with the contiguous layout, apply
+    ``deinterleave_block_params`` to ``blocks`` before
+    :func:`unstack_block_params`."""
+    from tpudist.parallel.pipeline_interleaved import interleave_block_params
+
+    pp = stack_block_params(params, n_dev * n_chunks)
+    return {"blocks": interleave_block_params(pp["blocks"], n_dev),
+            "rest": pp["rest"]}
+
+
 def pp_state_sharding(mesh: Mesh, tree, *, axis_name: str = AXIS_STAGE):
     """Shardings for a pipeline ``ModelState`` pytree: every leaf under a
     ``blocks`` key is stage-sharded on its leading axis, everything else
@@ -242,6 +257,7 @@ def make_pp_lm_train_step(
     n_stages: int,
     num_microbatches: int = 4,
     schedule: str = "1f1b",
+    n_chunks: int = 1,
     axis_name: str = AXIS_STAGE,
     data_axis: Optional[str] = AXIS_DATA,
     donate_state: bool = True,
@@ -263,7 +279,16 @@ def make_pp_lm_train_step(
     parity).  MoE blocks are not supported under 1F1B (their expert
     all_to_all would nest inside this shard_map); use GPipe there.
 
-    ``state``: ``ModelState`` over the :func:`stack_block_params` layout,
+    ``schedule='interleaved'``: virtual-stage 1F1B
+    (:mod:`tpudist.parallel.pipeline_interleaved`) — each device holds
+    ``n_chunks`` depth-strided model chunks, shrinking the fill/drain
+    bubble ~÷``n_chunks`` at the cost of more (smaller) activation hops.
+    Requires ``num_microbatches % n_stages == 0`` and a state over the
+    :func:`stack_block_params_interleaved` layout.  MoE unsupported, as
+    for 1f1b.
+
+    ``state``: ``ModelState`` over the :func:`stack_block_params` layout
+    (:func:`stack_block_params_interleaved` for ``schedule='interleaved'``),
     sharded per :func:`pp_state_sharding`.
     """
     import optax
@@ -271,8 +296,12 @@ def make_pp_lm_train_step(
     from tpudist.models.transformer import lm_loss
     from tpudist.train.step import ModelState
 
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            f"schedule must be gpipe|1f1b|interleaved, got {schedule!r}")
+    if n_chunks != 1 and schedule != "interleaved":
+        raise ValueError(
+            f"n_chunks={n_chunks} requires schedule='interleaved'")
     if schedule == "gpipe":
         from tpudist.train.lm import make_lm_train_step
 
@@ -286,7 +315,7 @@ def make_pp_lm_train_step(
             state_sharding=state_sharding,
         )
     if module.n_experts > 0:
-        raise ValueError("schedule='1f1b' does not support MoE blocks")
+        raise ValueError(f"schedule={schedule!r} does not support MoE blocks")
 
     embed_mod, head_mod, stage_fn = _lm_pipeline_parts(module)
     data_in_spec = P(None, data_axis) if data_axis else P()
@@ -295,11 +324,24 @@ def make_pp_lm_train_step(
         logits = head_mod.apply({"params": head_params}, act)
         return lm_loss(logits, toks)
 
-    def body(blocks, head_params, xm, tm):
-        return pipeline_1f1b_shard(
-            blocks, head_params, xm, tm, stage_fn=stage_fn,
-            loss_fn=micro_loss, axis_name=axis_name, data_axis=data_axis,
-        )
+    if schedule == "interleaved":
+        from tpudist.parallel.pipeline_interleaved import (
+            interleaved_schedule, pipeline_interleaved_shard)
+
+        sched = interleaved_schedule(n_stages, n_chunks, num_microbatches)
+
+        def body(blocks, head_params, xm, tm):
+            return pipeline_interleaved_shard(
+                blocks, head_params, xm, tm, stage_fn=stage_fn,
+                loss_fn=micro_loss, schedule=sched, axis_name=axis_name,
+                data_axis=data_axis,
+            )
+    else:
+        def body(blocks, head_params, xm, tm):
+            return pipeline_1f1b_shard(
+                blocks, head_params, xm, tm, stage_fn=stage_fn,
+                loss_fn=micro_loss, axis_name=axis_name, data_axis=data_axis,
+            )
 
     sharded_body = jax.shard_map(
         body,
