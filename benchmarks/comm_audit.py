@@ -365,22 +365,32 @@ def _pp_regime(devices, schedule):
     from tpudist.train import init_lm_state, token_sharding
     from tpudist.utils.hlo_audit import tree_bytes
 
-    dp, stages, micro = 2, 4, 2
+    interleaved = schedule == "interleaved"
+    n_chunks = 2 if interleaved else 1
+    dp, stages = 2, 4
+    # Interleaved needs M % stages == 0 (Megatron grouping) and layers
+    # divisible into stages*n_chunks virtual stages.
+    micro, batch, n_layers = ((4, 8, 8) if interleaved else (2, 4, 4))
     mesh = Mesh(np.asarray(devices).reshape(dp, stages),
                 axis_names=(AXIS_DATA, AXIS_STAGE))
-    seq_len, batch, d_model = 16, 4, 32
+    seq_len, d_model = 16, 32
     module, params = create_transformer(
         jax.random.PRNGKey(0), seq_len=seq_len, vocab=32, d_model=d_model,
-        n_layers=4, n_heads=2, d_ff=64, max_len=seq_len,
+        n_layers=n_layers, n_heads=2, d_ff=64, max_len=seq_len,
     )
-    pp_params = stack_block_params(params, n_stages=stages)
+    if interleaved:
+        from tpudist.parallel import stack_block_params_interleaved
+
+        pp_params = stack_block_params_interleaved(params, stages, n_chunks)
+    else:
+        pp_params = stack_block_params(params, n_stages=stages)
     tx = optax.adam(1e-3)
     state = init_lm_state(pp_params, tx)
     sharding = pp_state_sharding(mesh, state)
     state = jax.device_put(state, sharding)
     step = make_pp_lm_train_step(
         mesh, module, tx, n_stages=stages, num_microbatches=micro,
-        schedule=schedule, state_sharding=sharding,
+        schedule=schedule, n_chunks=n_chunks, state_sharding=sharding,
     )
     toks = np.random.default_rng(2).integers(
         0, 32, size=(batch, seq_len)).astype(np.int32)
@@ -404,6 +414,10 @@ def regime_dp_pp_1f1b(devices):
     return _pp_regime(devices, "1f1b")
 
 
+def regime_dp_pp_interleaved(devices):
+    return _pp_regime(devices, "interleaved")
+
+
 REGIMES = {
     "dp": regime_dp,
     "dp_bf16_reduce": regime_dp_bf16_reduce,
@@ -415,6 +429,7 @@ REGIMES = {
     "fsdp": regime_fsdp,
     "dp_pp_gpipe": regime_dp_pp_gpipe,
     "dp_pp_1f1b": regime_dp_pp_1f1b,
+    "dp_pp_interleaved": regime_dp_pp_interleaved,
 }
 
 

@@ -169,6 +169,7 @@ REGIME_NAMES = (
     "fsdp",
     "dp_pp_gpipe",
     "dp_pp_1f1b",
+    "dp_pp_interleaved",
 )
 
 
