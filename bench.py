@@ -28,6 +28,13 @@ import jax
 import numpy as np
 import optax
 
+# Persistent XLA compilation cache: re-running bench after a tunnel
+# wedge skips every compile that ever succeeded on this machine (the
+# numerics gate alone is minutes of tunnel compiles otherwise).
+from tpudist.runtime.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
 
 def _sync(x) -> float:
     """Sync point is a VALUE FETCH of a scalar depending on the whole

@@ -22,6 +22,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
+
+from tpudist.runtime.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
 import numpy as np
 import optax
 from jax.sharding import Mesh

@@ -139,3 +139,44 @@ def test_hybrid_mesh_single_process_falls_back(devices):
     assert hybrid.axis_names == plain.axis_names
     assert hybrid.devices.shape == plain.devices.shape
     assert (hybrid.devices == plain.devices).all()
+
+
+class TestCompilationCache:
+    """Persistent XLA compilation cache wiring (wedge-retry mitigation)."""
+
+    def test_enables_and_creates_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        from tpudist.runtime import enable_compilation_cache
+
+        old = jax.config.jax_compilation_cache_dir
+        target = tmp_path / "xla-cache"
+        monkeypatch.setenv("TPUDIST_COMPILATION_CACHE", str(target))
+        try:
+            got = enable_compilation_cache()
+            assert got == str(target)
+            assert target.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(target)
+        finally:
+            # jax.config survives monkeypatch; a deleted tmp cache dir
+            # must not leak into later tests' compiles
+            jax.config.update("jax_compilation_cache_dir", old)
+
+    def test_off_switch(self, monkeypatch):
+        from tpudist.runtime import enable_compilation_cache
+
+        monkeypatch.setenv("TPUDIST_COMPILATION_CACHE", "off")
+        assert enable_compilation_cache() is None
+
+    def test_explicit_path_wins(self, tmp_path, monkeypatch):
+        import jax
+
+        from tpudist.runtime import enable_compilation_cache
+
+        old = jax.config.jax_compilation_cache_dir
+        monkeypatch.delenv("TPUDIST_COMPILATION_CACHE", raising=False)
+        try:
+            got = enable_compilation_cache(str(tmp_path / "explicit"))
+            assert got == str(tmp_path / "explicit")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)

@@ -4,6 +4,7 @@ from tpudist.runtime.bootstrap import (  # noqa: F401
     initialize,
     shutdown,
 )
+from tpudist.runtime.compilation_cache import enable_compilation_cache  # noqa: F401
 from tpudist.runtime.mesh import (  # noqa: F401
     MeshConfig,
     make_hybrid_mesh,

@@ -259,6 +259,12 @@ def initialize(
             backend_up = True
         if not backend_up:
             jax.config.update("jax_platforms", env_platforms)
+    # Persistent XLA compilation cache: a compile that succeeded once on
+    # this machine is never re-paid (tunnel compiles are the slow,
+    # wedge-prone step — see tpudist/runtime/compilation_cache.py).
+    from tpudist.runtime.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     if ctx is None:
         ctx = resolve_process_context(use_node_rank=use_node_rank)
     if ctx.is_distributed:

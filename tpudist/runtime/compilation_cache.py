@@ -1,0 +1,60 @@
+"""Persistent XLA compilation cache wiring.
+
+Compilation is the expensive, failure-prone step in this environment:
+over the remote-device tunnel a single Pallas kernel compile has been
+observed to hang for 37+ minutes (BASELINE.md round-4 log), and every
+process — bench, demo, sweep agent — otherwise re-pays every compile
+from scratch.  JAX ships a persistent on-disk cache keyed by HLO hash
+(``jax_compilation_cache_dir``); enabling it means a compile that
+succeeded ONCE this machine-lifetime is never re-run, so a retry after
+a tunnel wedge skips straight to execution of everything previously
+compiled.
+
+``enable_compilation_cache()`` is called from ``initialize()`` (the
+runtime bootstrap every entry point goes through) and from the bench
+harnesses.  Controls:
+
+- ``TPUDIST_COMPILATION_CACHE=off`` disables it;
+- ``TPUDIST_COMPILATION_CACHE=<dir>`` relocates it (e.g. a fast scratch
+  filesystem on a pod, or a per-job dir a SLURM epilogue clears);
+- default location: ``~/.cache/tpudist/xla-cache``.
+
+The min-compile-time floor is lowered to 0.5 s so the flash-attention
+kernels (fast to compile on CPU, slow over the tunnel) are cached on
+every backend.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+_OFF_VALUES = ("0", "off", "false", "disabled", "no")
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a writable directory.
+
+    Returns the directory in use, or None when disabled (by env or
+    because jax.config rejects the options — old jax).  Safe to call
+    repeatedly and before/after backend init; compiled-executable reuse
+    starts with the next compile either way.
+    """
+    env = os.environ.get("TPUDIST_COMPILATION_CACHE", "")
+    if env.lower() in _OFF_VALUES:
+        return None
+    target = path or env or str(
+        Path(os.path.expanduser("~")) / ".cache" / "tpudist" / "xla-cache")
+    try:
+        Path(target).mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return None  # unwritable home (containers) — run uncached
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", target)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        return None
+    return target
