@@ -17,8 +17,11 @@ emitted collectives against analytic predictions:
 - **fsdp**         per-use all-gather of sharded params + reduce-scatter
                    of their grads (ZeRO-3's manual machinery, emitted by
                    the SPMD partitioner from the layout alone)
-- **gpipe/1f1b**   stage-boundary collective-permutes inside the scan
-                   loop (per-tick activation hop), not unrolled
+- **zero1**        plain-DP gradient all-reduce + all-gather of exactly
+                   the sharded updated params (weight-update sharding,
+                   arXiv:2004.13336)
+- **gpipe/1f1b/interleaved**  stage-boundary collective-permutes inside
+                   the scan loop (per-tick activation hop), not unrolled
 
 Writes ``COMM_AUDIT_r04.json`` and exits nonzero if any check fails.
 This is the no-hardware half of the multi-chip scaling story: the
@@ -349,6 +352,42 @@ def regime_fsdp(devices):
     return step, args, info
 
 
+def regime_dp_zero1(devices):
+    """(8,) ZeRO-1: replicated params, data-sharded optimizer state — the
+    weight-update sharding of arXiv:2004.13336 as a pure layout."""
+    from jax.sharding import Mesh
+
+    from tpudist.parallel import zero1_sharding
+    from tpudist.runtime.mesh import AXIS_DATA
+
+    mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+
+    holder = {}
+
+    def shard_fn(mesh, state):
+        sh = zero1_sharding(mesh, state, min_size=64)
+        holder["sharding"] = sh
+        holder["state"] = state
+        return sh
+
+    step, args, info = _lm_regime(mesh, seq_len=16, batch=8,
+                                  state_sharding_fn=shard_fn)
+    import jax as _jax
+    from jax.sharding import NamedSharding
+
+    sharded_opt = 0
+    for leaf, sh in zip(
+        _jax.tree.leaves(holder["state"].opt_state),
+        _jax.tree.leaves(holder["sharding"].opt_state,
+                         is_leaf=lambda x: isinstance(x, NamedSharding)),
+    ):
+        if not all(a is None for a in tuple(sh.spec)):
+            sharded_opt += int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+    info.update({"mesh": {"data": 8}, "sharded_opt_bytes": sharded_opt,
+                 "param_bytes": info.get("param_bytes")})
+    return step, args, info
+
+
 def _pp_regime(devices, schedule):
     import jax
     import optax
@@ -427,6 +466,7 @@ REGIMES = {
     "dp_sp_tp": regime_dp_sp_tp,
     "dp_ep_moe": regime_dp_ep_moe,
     "fsdp": regime_fsdp,
+    "dp_zero1": regime_dp_zero1,
     "dp_pp_gpipe": regime_dp_pp_gpipe,
     "dp_pp_1f1b": regime_dp_pp_1f1b,
     "dp_pp_interleaved": regime_dp_pp_interleaved,
@@ -567,6 +607,28 @@ def check_moe(prof, info):
     ]
 
 
+def check_zero1(prof, info):
+    ar = prof.get("all-reduce",
+                  {"count": 0, "bytes_total": 0, "count_in_loop": 0})
+    ag = prof.get("all-gather",
+                  {"count": 0, "bytes_total": 0, "count_in_loop": 0})
+    # ZeRO-1's wire signature: plain-DP gradient all-reduce (params are
+    # replicated, so backward is untouched) + one all-gather per sharded
+    # updated param — total exactly the sharded param bytes, i.e. half
+    # the sharded Adam-moment bytes (mu + nu mirror the params).
+    return [
+        _c("collective kinds are all-reduce + all-gather",
+           ["all-gather", "all-reduce"], sorted(prof)),
+        _c("one combined gradient all-reduce", 1, ar["count"]),
+        _c("all-reduce payload = grad + loss bytes",
+           info["param_bytes"] + 4, ar["bytes_total"]),
+        _c("all-gathered update bytes = sharded param bytes",
+           info["sharded_opt_bytes"] // 2, ag["bytes_total"]),
+        _c("no loop-resident collectives", 0,
+           ar["count_in_loop"] + ag["count_in_loop"]),
+    ]
+
+
 def check_fsdp(prof, info):
     ag = prof.get("all-gather", {"count": 0, "bytes_total": 0})
     rs = prof.get("reduce-scatter", {"count": 0, "bytes_total": 0})
@@ -659,6 +721,8 @@ def main(argv=None) -> int:
                 checks = check_moe(prof, info)
             elif name == "fsdp":
                 checks = check_fsdp(prof, info)
+            elif name == "dp_zero1":
+                checks = check_zero1(prof, info)
             else:
                 checks = check_pp(prof, info)
             row["checks"] = checks

@@ -155,6 +155,8 @@ def _checks_for(name, prof, info):
         return ca.check_moe(prof, info)
     if name == "fsdp":
         return ca.check_fsdp(prof, info)
+    if name == "dp_zero1":
+        return ca.check_zero1(prof, info)
     return ca.check_pp(prof, info)
 
 
@@ -167,6 +169,7 @@ REGIME_NAMES = (
     "dp_sp_tp",
     "dp_ep_moe",
     "fsdp",
+    "dp_zero1",
     "dp_pp_gpipe",
     "dp_pp_1f1b",
     "dp_pp_interleaved",

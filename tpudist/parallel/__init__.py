@@ -55,4 +55,5 @@ from tpudist.parallel.fsdp import (  # noqa: F401
     fsdp_sharding,
     merge_shardings,
     state_bytes_per_device,
+    zero1_sharding,
 )

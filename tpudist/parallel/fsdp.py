@@ -71,6 +71,43 @@ def fsdp_sharding(
     return jax.tree.map(shard_for, tree)
 
 
+def zero1_sharding(
+    mesh: Mesh,
+    state,
+    *,
+    axis_name: str = AXIS_DATA,
+    min_size: int = 1024,
+):
+    """ZeRO-1-style weight-update sharding: parameters stay REPLICATED
+    (forward/backward identical to plain DP — no per-layer all-gathers),
+    only the optimizer state shards over the data axis.
+
+    The XLA-native form of "Automatic Cross-Replica Sharding of Weight
+    Update in Data-Parallel Training" (arXiv:2004.13336, the technique
+    ZeRO-1 popularized): with Adam moments laid out sharded and gradients
+    replicated after the all-reduce, the SPMD partitioner computes each
+    moment/update on its owning shard only and all-gathers the updated
+    parameters once per step — optimizer memory drops by the data-axis
+    size (Adam: 2/3 of a replicated f32 state) for one extra
+    param-sized all-gather, with zero change to the step function.
+
+    Middle rung of the DP memory ladder: plain DP (everything
+    replicated) → ``zero1_sharding`` (opt sharded) → :func:`fsdp_sharding`
+    (params + moments sharded, ZeRO-3).  Not composable with
+    ``grad_reduce_dtype`` (that path requires a pure-DP replicated
+    state, and validates so).
+
+    ``state``: a ``ModelState``; returns a matching sharding pytree.
+    """
+    from tpudist.train.step import ModelState
+
+    repl = jax.tree.map(
+        lambda _: NamedSharding(mesh, P()), state.params)
+    opt = fsdp_sharding(mesh, state.opt_state, axis_name=axis_name,
+                        min_size=min_size)
+    return ModelState(params=repl, opt_state=opt)
+
+
 def merge_shardings(primary, fallback):
     """Leaf-wise composition: use ``primary``'s spec unless it is fully
     replicated, else ``fallback``'s — e.g. TP specs where they exist, FSDP
