@@ -6,7 +6,9 @@ model-split parity shape in ``tpudist.models.split_mlp``.  This package
 holds the scalable strategies on the 4-axis mesh
 (``tpudist.runtime.mesh``):
 
-- :mod:`ring_attention` — sequence/context parallelism (``seq`` axis):
+- :mod:`ring_attention` — sequence/context parallelism (``seq`` axis,
+  incl. the zigzag causal-balanced layout — every (device, hop) costs the
+  same two half-chunk blocks):
   blockwise attention with K/V rotating over ICI via ``ppermute``.
 - :mod:`tensor_parallel` — Megatron-style column/row linear pairs
   (``model`` axis), both pjit-spec and explicit-``psum`` forms.
@@ -19,6 +21,9 @@ holds the scalable strategies on the 4-axis mesh
 """
 
 from tpudist.parallel.ring_attention import (  # noqa: F401
+    make_zigzag_ring_attention,
+    ring_attention_shard_zigzag,
+    zigzag_indices,
     attention_reference,
     make_ring_attention,
     ring_attention_shard,

@@ -385,3 +385,159 @@ def make_ring_attention(
         # so only the flash path advertises it.
         ring.supports_gqa = True
     return ring
+
+
+# ---------------------------------------------------------------------------
+# Zigzag (causal-balanced) ring layout
+# ---------------------------------------------------------------------------
+
+def zigzag_indices(seq_len: int, n_shards: int) -> jnp.ndarray:
+    """Token permutation for the zigzag causal-balanced ring layout.
+
+    The sequence splits into ``2n`` half-chunks; ring position ``i`` holds
+    half-chunks ``i`` and ``2n−1−i``.  Returns the gather indices ``π``
+    such that ``x[..., π, :]`` is the zigzag-ordered sequence whose
+    contiguous ``seq_len/n``-wide shards land one per device under the
+    usual ``P(seq)`` sharding.  Invert with ``jnp.argsort(π)``.
+    """
+    if seq_len % (2 * n_shards):
+        raise ValueError(
+            f"seq {seq_len} must divide into 2*{n_shards} half-chunks")
+    half = seq_len // (2 * n_shards)
+    order = []
+    for i in range(n_shards):
+        order += [i, 2 * n_shards - 1 - i]
+    import numpy as _np
+
+    chunks = [_np.arange(c * half, (c + 1) * half) for c in order]
+    return jnp.asarray(_np.concatenate(chunks), jnp.int32)
+
+
+def ring_attention_shard_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """Causal ring attention over the ZIGZAG layout — FLOP-balanced.
+
+    The contiguous causal ring wastes ~half the machine: at hop ``t``
+    only devices ``i ≥ t`` hold live (unmasked) K/V, so every hop runs at
+    single-block latency while early ranks idle (or, in the uniform
+    formulation, burn fully-masked FLOPs) — aggregate efficiency
+    ``(n+1)/2n → ½``.  The zigzag layout (Brandon et al., "Striped
+    Attention"-family; each device owns half-chunks ``i`` AND ``2n−1−i``)
+    makes every (device, hop) pair cost EXACTLY two half-chunk attention
+    blocks:
+
+    - my high chunk ``2n−1−i`` attends every arriving low chunk ``j``
+      (always fully live, never masked);
+    - exactly one of {my low × arriving low (live iff ``j ≤ i``), my
+      high × arriving high (live iff ``j ≥ i``)} is live per hop —
+      selected by a ``lax.cond`` whose branches cost the same, so the
+      ring never waits on a straggler;
+    - hop 0 (``j == i``) additionally carries the two triangular
+      diagonal blocks (statically unrolled — ``t`` is a Python int).
+
+    Inputs: this device's zigzag-local blocks ``[b, h, shard, d]`` with
+    ``shard = seq/n`` tokens = half-chunks ``(i, 2n−1−i)`` concatenated
+    (produce with :func:`zigzag_indices`).  Causal only (that is the
+    regime with the imbalance); equal q/kv heads (broadcast GQA first);
+    sliding windows not supported — the window's early-exit already
+    rebalances the contiguous ring.
+    """
+    axis_size = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    shard = q.shape[-2]
+    if shard % 2:
+        raise ValueError(f"zigzag shard must be even, got {shard}")
+    half = shard // 2
+    n2 = 2 * axis_size
+
+    q_lo, q_hi = q[..., :half, :], q[..., half:, :]
+
+    def fresh(qb):
+        return (
+            lax.pcast(jnp.full(qb.shape[:-1], _MASK_VALUE, jnp.float32),
+                      (axis_name,), to="varying"),
+            lax.pcast(jnp.zeros(qb.shape[:-1], jnp.float32),
+                      (axis_name,), to="varying"),
+            lax.pcast(jnp.zeros(qb.shape, jnp.float32),
+                      (axis_name,), to="varying"),
+        )
+
+    lo_carry, hi_carry = fresh(q_lo), fresh(q_hi)
+
+    def diag_mask():
+        qi = lax.broadcasted_iota(jnp.int32, (half, half), 0)
+        kj = lax.broadcasted_iota(jnp.int32, (half, half), 1)
+        return qi >= kj
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    for t in range(axis_size):
+        k_lo, k_hi = k[..., :half, :], k[..., half:, :]
+        v_lo, v_hi = v[..., :half, :], v[..., half:, :]
+        if t == 0:
+            # j == i: both diagonals (triangular) + the always-live full.
+            lo_carry = _block_update(q_lo, k_lo, v_lo, *lo_carry,
+                                     scale=scale, mask=diag_mask())
+            hi_carry = _block_update(q_hi, k_lo, v_lo, *hi_carry,
+                                     scale=scale)
+            hi_carry = _block_update(q_hi, k_hi, v_hi, *hi_carry,
+                                     scale=scale, mask=diag_mask())
+        else:
+            j = jnp.mod(my - t, axis_size)
+            # my high × arriving low: always fully live, maskless.
+            hi_carry = _block_update(q_hi, k_lo, v_lo, *hi_carry,
+                                     scale=scale)
+
+            # exactly one of (lo×lo | hi×hi) is live; equal-cost branches.
+            def lo_branch(args):
+                lo, hi, kl, vl, kh, vh = args
+                return (_block_update(q_lo, kl, vl, *lo, scale=scale), hi)
+
+            def hi_branch(args):
+                lo, hi, kl, vl, kh, vh = args
+                return (lo, _block_update(q_hi, kh, vh, *hi, scale=scale))
+
+            lo_carry, hi_carry = lax.cond(
+                j < my, lo_branch, hi_branch,
+                (lo_carry, hi_carry, k_lo, v_lo, k_hi, v_hi))
+        if t + 1 < axis_size:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    m_lo, l_lo, o_lo = lo_carry
+    m_hi, l_hi, o_hi = hi_carry
+    out_lo = (o_lo / l_lo[..., None]).astype(q.dtype)
+    out_hi = (o_hi / l_hi[..., None]).astype(q.dtype)
+    return jnp.concatenate([out_lo, out_hi], axis=-2)
+
+
+def make_zigzag_ring_attention(
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_SEQ,
+    batch_axis: Optional[str] = None,
+):
+    """Jitted global-view zigzag ring attention (causal).
+
+    Consumes/produces arrays in the ZIGZAG order — permute tokens with
+    ``zigzag_indices(seq, mesh.shape[axis_name])`` before, and apply the
+    inverse (``jnp.argsort``) after if positional order matters
+    downstream.  For an LM, permute the token stream once at the data
+    layer (positions travel with the tokens via RoPE/position ids) and
+    the loss — a per-position mean — needs no unpermute.
+    """
+    spec = P(batch_axis, None, axis_name, None)
+    sharded = jax.shard_map(
+        functools.partial(ring_attention_shard_zigzag, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    ring = jax.jit(sharded)
+    ring.window = None
+    return ring
