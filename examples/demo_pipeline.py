@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Pipeline-parallel LM training — data × stage parallelism with a
+selectable schedule (GPipe, 1F1B, interleaved virtual-stage 1F1B).
+
+The reference's only model parallelism is a manual 2-stage split
+(`demo_one_model_multi_gpu.py:17-42`); this entry point is its scalable
+TPU-native generalization: transformer blocks shard one stage (or V
+virtual chunks) per device over the ``stage`` mesh axis, activations hop
+the ring with ``lax.ppermute`` inside one jitted ``shard_map``, and the
+schedule is chosen per run:
+
+- ``--schedule gpipe``        all forwards, autodiff backward (O(M) mem)
+- ``--schedule 1f1b``         one-fwd-one-bwd ticks (O(stages) mem)
+- ``--schedule interleaved``  V virtual chunks/device (``--chunks``),
+                              fill/drain bubble shrinks ~÷V
+
+Same synthetic increment-chain task and convergence bar as the other
+LM demos (SURVEY.md §4's train-to-convergence philosophy).
+
+Run (single host, virtual 8-chip mesh → 2 data × 4 stages):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/demo_pipeline.py --dry_run --stages 4 \
+    --schedule interleaved --chunks 2 --total_iterations 100
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from demo_long_context import make_batch  # noqa: E402
+
+from tpudist.config import build_parser, get_args as parse_args  # noqa: E402
+from tpudist.models import create_transformer  # noqa: E402
+from tpudist.parallel import (  # noqa: E402
+    make_pp_lm_train_step,
+    pp_state_sharding,
+    stack_block_params,
+    stack_block_params_interleaved,
+)
+from tpudist.runtime import initialize, resolve_shared_seed  # noqa: E402
+from tpudist.runtime.mesh import MeshConfig, make_mesh  # noqa: E402
+from tpudist.runtime.rank_logging import rank_print  # noqa: E402
+from tpudist.train import init_lm_state, token_sharding  # noqa: E402
+from tpudist.utils import init_metrics  # noqa: E402
+from tpudist.utils.record import record  # noqa: E402
+
+
+def get_args(argv=None):
+    p = build_parser()
+    p.add_argument("--stages", default=4, type=int,
+                   help="size of the stage mesh axis (pipeline width)")
+    p.add_argument("--schedule", default="1f1b",
+                   choices=["gpipe", "1f1b", "interleaved"])
+    p.add_argument("--chunks", default=2, type=int,
+                   help="virtual chunks per device (interleaved only)")
+    p.add_argument("--microbatches", default=None, type=int,
+                   help="pipeline microbatches per step (default: stages, "
+                        "or 2*stages for interleaved)")
+    p.add_argument("--seq_len", default=64, type=int)
+    p.add_argument("--vocab", default=64, type=int)
+    p.add_argument("--d_model", default=128, type=int)
+    p.add_argument("--n_layers", default=8, type=int,
+                   help="must divide into stages (x chunks) even groups")
+    p.set_defaults(batch_size=16, total_iterations=300, lr=3e-4)
+    return parse_args(argv, parser=p)
+
+
+@record
+def main() -> None:
+    args = get_args()
+    initialize(use_node_rank=args.use_node_rank)
+    args.seed = resolve_shared_seed(args.seed)
+
+    chunks = args.chunks if args.schedule == "interleaved" else 1
+    micro = args.microbatches
+    if micro is None:
+        micro = args.stages * (2 if args.schedule == "interleaved" else 1)
+    total_stages = args.stages * chunks
+    if args.n_layers % total_stages:
+        raise SystemExit(f"--n_layers {args.n_layers} must divide into "
+                         f"{total_stages} (stages x chunks) groups")
+    if args.batch_size % micro:
+        raise SystemExit(f"--batch_size {args.batch_size} must divide into "
+                         f"{micro} microbatches")
+
+    mesh = make_mesh(MeshConfig(data=-1, stage=args.stages))
+    rank_print(f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
+               f"schedule={args.schedule}"
+               + (f" chunks={chunks}" if chunks > 1 else "")
+               + f" microbatches={micro}")
+
+    module, params = create_transformer(
+        jax.random.PRNGKey(args.seed), seq_len=args.seq_len,
+        vocab=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=4, d_ff=4 * args.d_model, max_len=args.seq_len,
+    )
+    if chunks > 1:
+        pp_params = stack_block_params_interleaved(params, args.stages,
+                                                   chunks)
+    else:
+        pp_params = stack_block_params(params, args.stages)
+    tx = optax.adam(args.lr)
+    state = init_lm_state(pp_params, tx)
+    sharding = pp_state_sharding(mesh, state)
+    state = jax.device_put(state, sharding)
+    step = make_pp_lm_train_step(
+        mesh, module, tx, n_stages=args.stages, num_microbatches=micro,
+        schedule=args.schedule, n_chunks=chunks, state_sharding=sharding,
+    )
+
+    metrics = init_metrics(args.project, args.group or "demo_pipeline",
+                           dry_run=args.dry_run)
+    rng = np.random.default_rng(args.seed)
+    loss = None
+    for it in range(args.total_iterations):
+        tokens = jax.device_put(
+            make_batch(rng, args.batch_size, args.seq_len, args.vocab),
+            token_sharding(mesh))
+        state, loss = step(state, tokens)
+        if it % 50 == 0 or it == args.total_iterations - 1:
+            metrics.log({"iteration": it, "loss": float(loss)})
+            rank_print(f"iter {it:4d}  loss {float(loss):.4f}")
+    metrics.finish()
+    rank_print(f"final loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

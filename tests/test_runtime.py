@@ -180,3 +180,13 @@ class TestCompilationCache:
             assert got == str(tmp_path / "explicit")
         finally:
             jax.config.update("jax_compilation_cache_dir", old)
+
+    def test_cpu_platform_not_cached_by_default(self, monkeypatch):
+        """Default-on is for accelerator platforms only: XLA:CPU AOT
+        entries are cpu-feature-sensitive (SIGILL risk) and CPU compiles
+        are cheap; an explicit env dir still opts in."""
+        from tpudist.runtime import enable_compilation_cache
+
+        monkeypatch.delenv("TPUDIST_COMPILATION_CACHE", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        assert enable_compilation_cache() is None

@@ -33,6 +33,19 @@ from typing import Optional
 _OFF_VALUES = ("0", "off", "false", "disabled", "no")
 
 
+def _cpu_platform_selected() -> bool:
+    """True when this process is pinned to the CPU backend (env var or
+    jax.config) — WITHOUT initializing any backend."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True
+    try:
+        import jax
+
+        return (jax.config.jax_platforms or "").strip().lower() == "cpu"
+    except Exception:
+        return False
+
+
 def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     """Point JAX's persistent compilation cache at a writable directory.
 
@@ -43,6 +56,14 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     """
     env = os.environ.get("TPUDIST_COMPILATION_CACHE", "")
     if env.lower() in _OFF_VALUES:
+        return None
+    if not env and path is None and _cpu_platform_selected():
+        # Default-on only for accelerator platforms: the cache exists to
+        # avoid re-paying TUNNEL compiles.  XLA:CPU AOT entries are
+        # feature-set-sensitive (observed: entries compiled with
+        # +prefer-no-scatter warn of possible SIGILL when loaded under a
+        # different cpu client config), and CPU compiles are cheap —
+        # opt in explicitly via TPUDIST_COMPILATION_CACHE=<dir> if wanted.
         return None
     target = path or env or str(
         Path(os.path.expanduser("~")) / ".cache" / "tpudist" / "xla-cache")
