@@ -718,3 +718,93 @@ class TestZigzagRing:
                 in_specs=(P(None, None, AXIS_SEQ, None),) * 3,
                 out_specs=P(None, None, AXIS_SEQ, None),
             )(q, q, q)
+
+    def test_lm_trains_end_to_end_via_standard_step(self, devices):
+        """Zigzag is first-class: permuted tokens + explicit positions +
+        make_zigzag_lm_loss through the UNMODIFIED make_lm_train_step
+        produce the same loss and parameter updates as natural-order
+        training (per-token sublayers are order-free; only attention and
+        the loss are layout-aware)."""
+        import optax
+
+        from tpudist.models import create_transformer
+        from tpudist.parallel import (make_zigzag_lm_loss,
+                                      make_zigzag_ring_attention,
+                                      zigzag_indices)
+        from tpudist.runtime.mesh import AXIS_DATA, AXIS_SEQ
+        from tpudist.train import (init_lm_state, make_lm_train_step,
+                                   token_sharding)
+
+        n_sp, S = 4, 64
+        mesh = Mesh(np.asarray(devices).reshape(2, 4),
+                    (AXIS_DATA, AXIS_SEQ))
+        pi = np.asarray(zigzag_indices(S, n_sp))
+
+        mod_nat, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=S, vocab=32, d_model=32,
+            n_layers=2, n_heads=2, d_ff=64, max_len=S)
+        mod_zz = mod_nat.clone(
+            attention_fn=make_zigzag_ring_attention(mesh,
+                                                    batch_axis=AXIS_DATA))
+        toks = np.random.default_rng(0).integers(
+            0, 32, size=(8, S)).astype(np.int32)
+        tx = optax.adam(1e-3)
+
+        step_n = make_lm_train_step(mod_nat.apply, tx, mesh,
+                                    donate_state=False)
+        st_n, loss_n = step_n(init_lm_state(params, tx),
+                              jax.device_put(toks, token_sharding(mesh)))
+
+        pos = jnp.asarray(pi, jnp.int32)
+        step_z = make_lm_train_step(
+            lambda p, t: mod_zz.apply(p, t, pos), tx, mesh,
+            donate_state=False, loss_fn=make_zigzag_lm_loss(S, n_sp))
+        st_z, loss_z = step_z(init_lm_state(params, tx),
+                              jax.device_put(toks[:, pi],
+                                             token_sharding(mesh)))
+
+        np.testing.assert_allclose(float(loss_n), float(loss_z),
+                                   rtol=1e-5, atol=1e-5)
+        for a, b in zip(jax.tree.leaves(st_n.params),
+                        jax.tree.leaves(st_z.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_loss_with_targets_matches_lm_loss_on_natural_order(self):
+        from tpudist.models import lm_loss, lm_loss_with_targets
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.standard_normal((2, 16, 32)), jnp.float32)
+        toks = jnp.asarray(rng.integers(0, 32, size=(2, 16)), jnp.int32)
+        # natural-order targets: next token, final position masked
+        tgt = jnp.concatenate(
+            [toks[:, 1:], jnp.full((2, 1), -1, jnp.int32)], axis=1)
+        np.testing.assert_allclose(
+            float(lm_loss(logits, toks)),
+            float(lm_loss_with_targets(logits, tgt)), rtol=1e-6)
+
+    def test_positions_guards(self):
+        """Explicit positions are rejected under rope, decode, AND the
+        default array-order attention (each silently wrong otherwise)."""
+        from tpudist.models import create_transformer
+        from tpudist.parallel import attention_reference
+
+        toks = jnp.zeros((1, 16), jnp.int32)
+        pos = jnp.arange(16, dtype=jnp.int32)
+
+        mod_r, params_r = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, vocab=32, d_model=32,
+            n_layers=1, n_heads=2, d_ff=64, max_len=16, rope=True)
+        with pytest.raises(ValueError, match="learned position table"):
+            mod_r.apply(params_r, toks, pos)
+
+        mod_n, params_n = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, vocab=32, d_model=32,
+            n_layers=1, n_heads=2, d_ff=64, max_len=16)
+        mod_d = mod_n.clone(decode=True)
+        with pytest.raises(ValueError, match="learned position table"):
+            mod_d.apply(params_n, toks, pos, mutable=["cache"])
+
+        # default attention masks over array order: must refuse
+        with pytest.raises(ValueError, match="layout-aware"):
+            mod_n.apply(params_n, toks, pos)

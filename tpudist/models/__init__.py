@@ -3,6 +3,7 @@ from tpudist.models.transformer import (  # noqa: F401
     TransformerLM,
     create_transformer,
     lm_loss,
+    lm_loss_with_targets,
 )
 from tpudist.models.generate import (  # noqa: F401
     decode_logits,

@@ -357,8 +357,32 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
-        """``tokens: [batch, seq] int32`` → logits ``[batch, seq, vocab]``."""
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+        """``tokens: [batch, seq] int32`` → logits ``[batch, seq, vocab]``.
+
+        ``positions``: explicit ``[seq] int32`` position ids for the
+        learned position table — for permuted sequence layouts (e.g. the
+        zigzag causal-balanced ring, ``zigzag_indices``) where token
+        order on device differs from temporal order.  Every non-attention
+        sublayer is position-wise, so permuted tokens + matching position
+        ids + a layout-aware ``attention_fn`` train identically to the
+        natural order (tests assert it).  Unsupported with ``rope`` (the
+        rotary path derives positions from array index; use the learned
+        table for permuted layouts) and with ``decode``.
+        """
+        if positions is not None and (self.rope or self.decode):
+            raise ValueError("explicit positions require the learned "
+                             "position table in training mode "
+                             "(rope=False, decode=False)")
+        if positions is not None and self.attention_fn is None:
+            # The default/windowed attention masks over ARRAY order; on a
+            # permuted stream that attends temporally-future tokens with
+            # no error and a decreasing loss.  Permuted layouts must
+            # inject a layout-aware attention_fn (e.g. the zigzag ring).
+            raise ValueError("explicit positions require a layout-aware "
+                             "attention_fn (the built-in causal mask is "
+                             "array-order)")
         if self.sliding_window is not None:
             if self.attention_fn is not None:
                 raise ValueError(
@@ -380,7 +404,7 @@ class TransformerLM(nn.Module):
                                    lambda: jnp.zeros((), jnp.int32))
                 positions = pi.value + jnp.arange(seq, dtype=jnp.int32)
                 pi.value = pi.value + seq
-            else:
+            elif positions is None:
                 positions = jnp.arange(seq, dtype=jnp.int32)
             pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
                            dtype=self.dtype)(positions)
@@ -471,3 +495,18 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def lm_loss_with_targets(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Cross entropy against EXPLICIT per-position targets; ``-1`` masks a
+    position out (mean over unmasked).  For permuted sequence layouts
+    (zigzag ring), where "next token" is not the array neighbor: compute
+    targets in temporal order, permute them alongside the tokens, mask
+    the final temporal position with ``-1``.  Identical to :func:`lm_loss`
+    on natural order (tests assert it)."""
+    valid = targets >= 0
+    safe = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return -jnp.sum(jnp.where(valid, ll, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1)

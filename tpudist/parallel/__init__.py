@@ -21,6 +21,7 @@ holds the scalable strategies on the 4-axis mesh
 """
 
 from tpudist.parallel.ring_attention import (  # noqa: F401
+    make_zigzag_lm_loss,
     make_zigzag_ring_attention,
     ring_attention_shard_zigzag,
     zigzag_indices,

@@ -541,3 +541,33 @@ def make_zigzag_ring_attention(
     ring = jax.jit(sharded)
     ring.window = None
     return ring
+
+
+def make_zigzag_lm_loss(seq_len: int, n_shards: int):
+    """Next-token LM loss for a zigzag-permuted token stream.
+
+    Under ``π = zigzag_indices(seq_len, n_shards)``, array position ``p``
+    holds the token of temporal position ``π(p)``; its prediction target
+    is the token at temporal ``π(p)+1``, which lives at array position
+    ``argsort(π)[π(p)+1]``.  Both maps are static, so targets are one
+    gather of the (permuted) token batch itself, with the final temporal
+    position masked out.  Returns ``loss_fn(logits, tokens)`` —
+    drop-in for ``make_lm_train_step(..., loss_fn=...)`` — numerically
+    identical to :func:`tpudist.models.transformer.lm_loss` on the
+    natural order (tests assert it).
+    """
+    import numpy as _np
+
+    from tpudist.models.transformer import lm_loss_with_targets
+
+    pi = _np.asarray(zigzag_indices(seq_len, n_shards))
+    inv = _np.argsort(pi)
+    nxt = _np.where(pi + 1 < seq_len, inv[(pi + 1) % seq_len], -1)
+    nxt_idx = jnp.asarray(_np.where(nxt >= 0, nxt, 0), jnp.int32)
+    mask = jnp.asarray(nxt >= 0)
+
+    def loss_fn(logits, tokens):
+        targets = jnp.where(mask[None, :], tokens[:, nxt_idx], -1)
+        return lm_loss_with_targets(logits, targets)
+
+    return loss_fn

@@ -43,6 +43,7 @@ def _make_lm_train_step_compressed(
     *,
     donate_state: bool,
     reduce_dtype,
+    loss_fn: Callable = lm_loss,
 ):
     """The ``grad_reduce_dtype`` body of :func:`make_lm_train_step`:
     per-shard grads inside ``shard_map``, explicit narrow-dtype ``pmean``
@@ -62,7 +63,7 @@ def _make_lm_train_step_compressed(
         # Local mean over this shard's rows; equal shards (the sharded
         # batch contract) make pmean-of-means the exact global mean.
         loss, grads = jax.value_and_grad(
-            lambda p: lm_loss(apply_fn(p, toks), toks))(params)
+            lambda p: loss_fn(apply_fn(p, toks), toks))(params)
         narrow = jax.tree.map(
             lambda g: lax.pmean(g.astype(reduce_dtype), AXIS_DATA), grads)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), narrow)
@@ -93,6 +94,7 @@ def make_lm_eval_step(
     apply_fn: Callable,
     mesh: Mesh,
     *,
+    loss_fn: Callable = lm_loss,
     params_sharding=None,
 ):
     """Jitted no-grad evaluation: ``eval_step(params, tokens) -> loss``.
@@ -104,7 +106,7 @@ def make_lm_eval_step(
     p_shard = repl if params_sharding is None else params_sharding
 
     def eval_step(params, tokens):
-        return lm_loss(apply_fn(params, tokens), tokens)
+        return loss_fn(apply_fn(params, tokens), tokens)
 
     return jax.jit(
         eval_step,
@@ -124,6 +126,7 @@ def make_lm_train_step(
     moe_balance_weight: float = 0.0,
     accum_steps: int = 1,
     grad_reduce_dtype=None,
+    loss_fn: Callable = lm_loss,
 ):
     """Build ``step(state, tokens) -> (state, loss)``, compiled once.
 
@@ -187,7 +190,7 @@ def make_lm_train_step(
                 f"{extra} have size > 1")
         return _make_lm_train_step_compressed(
             apply_fn, tx, mesh, donate_state=donate_state,
-            reduce_dtype=grad_reduce_dtype)
+            reduce_dtype=grad_reduce_dtype, loss_fn=loss_fn)
     repl = NamedSharding(mesh, P())
     tok_shard = token_sharding(mesh)
     state_out = repl if state_sharding is None else state_sharding
@@ -213,7 +216,7 @@ def make_lm_train_step(
                 logits, mut = apply_fn(p, toks, mutable=["intermediates"])
                 # flax omits the collection entirely when nothing was sown
                 collected = _collect_aux(mut.get("intermediates", {}))
-                lm = lm_loss(logits, toks)
+                lm = loss_fn(logits, toks)
                 total = lm
                 if moe_balance_weight > 0.0 and "moe_balance_loss" in collected:
                     total = total + moe_balance_weight * collected[
@@ -227,7 +230,7 @@ def make_lm_train_step(
             return out, grads
 
         def loss_of(p):
-            return lm_loss(apply_fn(p, toks), toks)
+            return loss_fn(apply_fn(p, toks), toks)
 
         loss, grads = jax.value_and_grad(loss_of)(params)
         return (loss, {}), grads
