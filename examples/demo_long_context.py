@@ -67,6 +67,11 @@ def get_args(argv=None):
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3-style fully-sharded params + optimizer "
                         "state over the data axis (1/n state memory/chip)")
+    p.add_argument("--zigzag", action="store_true",
+                   help="causal-balanced zigzag ring layout: every "
+                        "(device, hop) costs the same two half-chunk "
+                        "blocks (requires --seq_shards > 1; excludes "
+                        "--sliding_window/--rope/--inner_block)")
     p.add_argument("--sliding_window", default=None, type=int,
                    help="local attention: attend the previous N positions "
                         "only (flash band kernels on TPU; with --seq_shards"
@@ -137,13 +142,28 @@ def main() -> None:
         f"seq_len={args.seq_len} (block {args.seq_len // args.seq_shards}/chip)"
     )
 
-    attention = (
-        make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA,
-                            inner_block=args.inner_block,
-                            window=args.sliding_window)
-        if args.seq_shards > 1
-        else None  # single seq shard: length-aware default (dense/flash)
-    )
+    zz_pi = None
+    if args.zigzag:
+        from tpudist.parallel import (make_zigzag_lm_loss,
+                                      make_zigzag_ring_attention,
+                                      zigzag_indices)
+
+        if args.seq_shards < 2:
+            raise SystemExit("--zigzag balances the RING; needs --seq_shards > 1")
+        if args.sliding_window or args.rope or args.inner_block:
+            raise SystemExit("--zigzag excludes --sliding_window/--rope/"
+                             "--inner_block (window already rebalances; "
+                             "rope derives positions from array order)")
+        zz_pi = np.asarray(zigzag_indices(args.seq_len, args.seq_shards))
+        attention = make_zigzag_ring_attention(mesh, batch_axis=AXIS_DATA)
+    else:
+        attention = (
+            make_ring_attention(mesh, causal=True, batch_axis=AXIS_DATA,
+                                inner_block=args.inner_block,
+                                window=args.sliding_window)
+            if args.seq_shards > 1
+            else None  # single seq shard: length-aware default (dense/flash)
+        )
     moe_fn = None
     if args.moe_experts > 0:
         from tpudist.models.transformer import moe_expert_fn
@@ -184,11 +204,21 @@ def main() -> None:
             f"fsdp: {state_bytes_per_device(state, state_sharding) / 2**20:.1f}"
             " MiB state/chip (ZeRO-3 layout)"
         )
-    step = make_lm_train_step(module.apply, tx, mesh,
+    apply_fn = module.apply
+    loss_fn_kw = {}
+    if zz_pi is not None:
+        from tpudist.parallel import make_zigzag_lm_loss
+
+        zz_pos = jnp.asarray(zz_pi, jnp.int32)
+        apply_fn = lambda p, t: module.apply(p, t, zz_pos)  # noqa: E731
+        loss_fn_kw = {"loss_fn": make_zigzag_lm_loss(args.seq_len,
+                                                     args.seq_shards)}
+    step = make_lm_train_step(apply_fn, tx, mesh,
                               aux=args.moe_experts > 0,
                               state_sharding=state_sharding,
                               moe_balance_weight=args.moe_balance,
-                              accum_steps=args.accum_steps)
+                              accum_steps=args.accum_steps,
+                              **loss_fn_kw)
 
     logger = init_metrics(args.project, args.group or "demo_long_context",
                           dry_run=args.dry_run)
@@ -221,6 +251,8 @@ def main() -> None:
         """Synthetic batches are identical on every process (shared-seed
         rng) so a plain transfer slices consistently; corpus shards are
         per-process-DISJOINT and must assemble via process-local data."""
+        if zz_pi is not None:
+            batch = np.asarray(batch)[:, zz_pi]
         if corpus is not None:
             from tpudist.comm.collectives import device_put_global
 
@@ -240,9 +272,10 @@ def main() -> None:
         from tpudist.train import make_lm_eval_step
 
         eval_step = make_lm_eval_step(
-            module.apply, mesh,
+            apply_fn, mesh,
             params_sharding=None if state_sharding is None
             else state_sharding.params,
+            **loss_fn_kw,
         )
         # fixed held-out batches (up to 4), identical on every process;
         # placed through the same global-assembly path as training batches

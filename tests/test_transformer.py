@@ -538,6 +538,34 @@ class TestWindowedRingExample:
         assert final < 2.0
 
 
+class TestZigzagRingExample:
+    def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
+        """--zigzag: the causal-balanced ring layout trains the chain
+        task end to end through the entry point (permuted stream +
+        explicit positions + zigzag loss)."""
+        final = _run_example("demo_long_context", [
+            "--dry_run", "--seq_shards", "4", "--seq_len", "64",
+            "--zigzag", "--d_model", "64", "--total_iterations", "60",
+            "--batch_size", "8", "--seed", "0", "--log_every", "20",
+        ], tmp_path, monkeypatch, capsys)
+        assert final < 2.0
+
+    def test_zigzag_flag_validation(self, tmp_path, monkeypatch, capsys):
+        import pytest as _pytest
+
+        with _pytest.raises(SystemExit, match="seq_shards"):
+            _run_example("demo_long_context", [
+                "--dry_run", "--zigzag", "--seq_len", "64",
+                "--total_iterations", "1",
+            ], tmp_path, monkeypatch, capsys)
+        with _pytest.raises(SystemExit, match="excludes"):
+            _run_example("demo_long_context", [
+                "--dry_run", "--zigzag", "--seq_shards", "4",
+                "--sliding_window", "16", "--seq_len", "64",
+                "--total_iterations", "1",
+            ], tmp_path, monkeypatch, capsys)
+
+
 class Test3DParallelExample:
     def test_demo_runs_and_converges(self, tmp_path, monkeypatch, capsys):
         final = _run_example("demo_3d_parallel", [
