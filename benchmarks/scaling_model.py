@@ -144,6 +144,26 @@ def ring_sp_row(*, name, batch, heads, seq, head_dim, ring, link_bw,
     }
 
 
+def ring_causal_balance_row(ring: int) -> dict:
+    """Schedule FLOP efficiency of the causal ring, contiguous vs zigzag.
+
+    Hops are ppermute-lockstepped, so a hop lasts one block-compute
+    whenever ANY device is live.  Contiguous layout: hop ``t`` keeps
+    ``n−t`` devices live → useful/executed = (n+1)/2n → ½ as n grows.
+    Zigzag (``zigzag_indices`` layout, tests assert the per-hop balance):
+    every device executes 2 half-blocks per hop (+1 at the diagonal hop,
+    whose two triangular blocks run as fulls) → 2n/(2n+1) → 1.  Pure
+    schedule math — no bandwidth assumptions; the comm side is identical
+    to the contiguous ring (same payload, same hop count)."""
+    n = ring
+    contiguous = (n + 1) / (2 * n)
+    zigzag = (2 * n) / (2 * n + 1)
+    return {"ring": n,
+            "contiguous_schedule_efficiency": round(contiguous, 4),
+            "zigzag_schedule_efficiency": round(zigzag, 4),
+            "zigzag_speedup": round(zigzag / contiguous, 3)}
+
+
 def main() -> int:
     _force_cpu()
     from tpudist.utils.flops import (
@@ -230,6 +250,10 @@ def main() -> int:
             name="lm_long_context_bf16_sp", batch=4, heads=4, seq=8192,
             head_dim=64, ring=ring,
             link_bw=link_bw, peak_flops=peak, mfu_measured=lc_mfu))
+
+    # --- causal-balance (layout) ----------------------------------------
+    out["sp_ring_causal_balance"] = [
+        ring_causal_balance_row(r) for r in (2, 4, 8, 16)]
 
     path = REPO / "SCALING_MODEL_r04.json"
     path.write_text(json.dumps(out, indent=2) + "\n")

@@ -242,3 +242,18 @@ class TestScalingModel:
             mfu_measured=0.2, dtype_bytes=4)
         # audit dp_sp_ring: kv_shard_bytes (ONE tensor) == 4096.
         assert row["kv_hop_bytes"] == 2 * 4096
+
+    def test_ring_causal_balance_algebra(self):
+        import scaling_model as sm
+
+        rows = {r["ring"]: r for r in
+                (sm.ring_causal_balance_row(n) for n in (2, 8, 16))}
+        # closed forms: (n+1)/2n and 2n/(2n+1)
+        assert rows[8]["contiguous_schedule_efficiency"] == round(9 / 16, 4)
+        assert rows[8]["zigzag_schedule_efficiency"] == round(16 / 17, 4)
+        # contiguous decays toward 1/2; zigzag climbs toward 1
+        assert rows[16]["contiguous_schedule_efficiency"] < \
+            rows[2]["contiguous_schedule_efficiency"]
+        assert rows[16]["zigzag_schedule_efficiency"] > \
+            rows[2]["zigzag_schedule_efficiency"]
+        assert rows[16]["zigzag_speedup"] > 1.7
