@@ -153,9 +153,10 @@ def main(argv=None) -> int:
         OUT.write_text(json.dumps(results, indent=2) + "\n")
         LOG(f"{name}: {'ok' if rec.get('rc') == 0 else rec.get('error', 'failed')} "
             f"({rec['seconds']}s)")
+    final = _load()
     left = [n for n, *_ in STEPS
-            if _load().get(n) is None or _load()[n].get("rc") is None]
-    LOG(f"budget exhausted or done; unresolved steps: {left}")
+            if final.get(n) is None or final[n].get("rc") != 0]
+    LOG(f"budget exhausted or done; steps without a success: {left}")
     return 0 if not left else 1
 
 

@@ -207,3 +207,86 @@ class TestHardwareRound:
         assert "--window 1024" in joined
         assert "--sliding-window 1024" in joined
         assert "profile_summary" in joined
+
+
+class TestShepherd:
+    """Retry semantics of the measurement shepherd: timeouts (rc None)
+    and device-unreachable exits (rc 2) retry behind fresh probes up to
+    --max-attempts; deterministic failures are terminal; completed steps
+    never re-run."""
+
+    def _run(self, tmp_path, monkeypatch, records, probe_results,
+             step_results, hours=0.001):
+        import importlib.util
+        import json as _json
+        from pathlib import Path as _P
+
+        spec = importlib.util.spec_from_file_location(
+            "shepherd", _P(__file__).resolve().parent.parent
+            / "benchmarks" / "shepherd.py")
+        sh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sh)
+
+        out = tmp_path / "HW.json"
+        out.write_text(_json.dumps(records))
+        monkeypatch.setattr(sh, "OUT", out)
+        monkeypatch.setattr(sh, "STEPS", [
+            ("s1", ["true"], 5, {}),
+            ("s2", ["true"], 5, {}),
+        ])
+        probes = iter(probe_results)
+        monkeypatch.setattr(sh, "probe", lambda **kw: next(probes, False))
+        results = iter(step_results)
+        monkeypatch.setattr(
+            sh, "run_step",
+            lambda name, cmd, timeout, env: {"seconds": 0.0,
+                                             **dict(next(results))})
+        monkeypatch.setattr(sh.time, "sleep", lambda s: None)
+        rc = sh.main(["--hours", str(hours), "--probe-every", "0.01",
+                      "--max-attempts", "2"])
+        return rc, _json.loads(out.read_text())
+
+    def test_completed_steps_not_rerun(self, tmp_path, monkeypatch):
+        rc, out = self._run(
+            tmp_path, monkeypatch,
+            records={"s1": {"rc": 0}},
+            probe_results=[True],
+            step_results=[{"rc": 0}],
+        )
+        assert rc == 0
+        assert out["s1"] == {"rc": 0}          # untouched
+        assert out["s2"]["rc"] == 0
+
+    def test_rc2_retries_then_succeeds(self, tmp_path, monkeypatch):
+        rc, out = self._run(
+            tmp_path, monkeypatch,
+            records={},
+            probe_results=[True, True, True],
+            step_results=[{"rc": 2}, {"rc": 0}, {"rc": 0}],
+        )
+        assert rc == 0
+        assert out["s1"]["rc"] == 0
+        assert out["s1"]["attempt"] == 2       # retried once
+
+    def test_deterministic_failure_terminal(self, tmp_path, monkeypatch):
+        rc, out = self._run(
+            tmp_path, monkeypatch,
+            records={},
+            probe_results=[True, True, True],
+            step_results=[{"rc": 1}, {"rc": 0}],
+        )
+        assert rc == 1                          # s1 unresolved (failed)
+        assert out["s1"]["rc"] == 1             # never re-run
+        assert out["s2"]["rc"] == 0             # later steps still ran
+
+    def test_timeout_exhausts_max_attempts(self, tmp_path, monkeypatch):
+        rc, out = self._run(
+            tmp_path, monkeypatch,
+            records={},
+            probe_results=[True] * 6,
+            step_results=[{"rc": None, "error": "timeout"}] * 2
+            + [{"rc": 0}],
+        )
+        assert out["s1"]["rc"] is None
+        assert out["s1"]["attempt"] == 2        # capped at --max-attempts
+        assert out["s2"]["rc"] == 0
