@@ -291,14 +291,22 @@ def main() -> None:
             return float(np.mean([float(eval_step(params, b))
                                   for b in eval_batches]))
 
+    def batch_source():
+        for _ in range(args.total_iterations):
+            yield (next(corpus) if corpus is not None
+                   else make_batch(rng, args.batch_size, args.seq_len,
+                                   args.vocab))
+
+    from tpudist.data import prefetch_to_device
+
+    # Double-buffered device prefetch: batch k+1's host assembly AND
+    # transfer overlap step k's compute (place() composes the zigzag
+    # permute / multi-host assembly into the put).
+    batches = prefetch_to_device(batch_source(), put_fn=place)
+
     loss = None
     with trace(args.profile_dir):
-        for it in range(args.total_iterations):
-            tokens = place(
-                next(corpus) if corpus is not None
-                else make_batch(rng, args.batch_size, args.seq_len,
-                                args.vocab),
-            )
+        for it, tokens in enumerate(batches):
             if args.moe_experts > 0:
                 state, loss, aux = step(state, tokens)
             else:

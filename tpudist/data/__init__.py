@@ -6,6 +6,7 @@ from tpudist.data.native_loader import (  # noqa: F401
     make_loader,
     native_available,
 )
+from tpudist.data.prefetch import prefetch_to_device  # noqa: F401
 from tpudist.data.lm import (  # noqa: F401
     TokenWindows,
     lm_batches,
