@@ -89,6 +89,11 @@ def get_args(argv=None):
                    help="rematerialize each transformer block in the "
                         "backward (jax.checkpoint): activation memory down "
                         "to block boundaries for ~1 extra forward of FLOPs")
+    p.add_argument("--remat_policy", default="nothing",
+                   choices=["nothing", "dots", "dots_no_batch"],
+                   help="what the remat'd backward may keep: 'dots' saves "
+                        "matmul outputs (most of the memory win, a sliver "
+                        "of the recompute)")
     p.add_argument("--gen_temperature", default=0.0, type=float,
                    help="sampling temperature for --generate (0 = greedy)")
     p.add_argument("--gen_top_k", default=None, type=int,
@@ -189,6 +194,7 @@ def main() -> None:
         # owns it end-to-end (training band + decode cache mask).
         sliding_window=None if args.seq_shards > 1 else args.sliding_window,
         remat=args.remat,
+        remat_policy=args.remat_policy,
     )
     from tpudist.train import build_optimizer_from_args
 

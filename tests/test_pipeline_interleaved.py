@@ -203,3 +203,16 @@ class TestLMInterleaved:
             make_pp_lm_train_step(mesh, module, optax.adam(1e-3),
                                   n_stages=4, num_microbatches=8,
                                   schedule="1f1b", n_chunks=2)
+
+
+    def test_format_timeline_smoke(self):
+        from tpudist.parallel.pipeline_interleaved import format_timeline
+
+        s = interleaved_schedule(2, 2, 4)
+        txt = format_timeline(s)
+        assert "D=2 V=2 M=4" in txt
+        assert txt.count("dev") == 2
+        # every unit appears: 4 micros x 2 chunks, F and B
+        for m in range(4):
+            for c in range(2):
+                assert f"F{m}.{c}" in txt and f"B{m}.{c}" in txt

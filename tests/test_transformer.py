@@ -1035,3 +1035,37 @@ class TestRemat:
             np.asarray(decode_logits(module, params, toks)),
             np.asarray(module.apply(params, toks)),
             atol=1e-4, rtol=1e-4)
+
+
+class TestRematPolicies:
+    """remat_policy is a memory/FLOPs dial, never a numerics change."""
+
+    def test_policies_numerically_identical(self):
+        from tpudist.models import create_transformer
+
+        cfg = dict(vocab=32, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                   max_len=32)
+        toks = _tokens(batch=2, seq=32)
+        mod0, params = create_transformer(jax.random.PRNGKey(0), seq_len=32,
+                                          **cfg)
+
+        def grad_of(mod):
+            return jax.grad(
+                lambda p: float(0) + lm_loss(mod.apply(p, toks), toks))(params)
+
+        base = grad_of(mod0)
+        for policy in ("nothing", "dots", "dots_no_batch"):
+            g = grad_of(mod0.clone(remat=True, remat_policy=policy))
+            for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(g)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_unknown_policy_rejected(self):
+        from tpudist.models import create_transformer
+
+        mod, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, vocab=32, d_model=32,
+            n_layers=1, n_heads=2, d_ff=64, max_len=16)
+        bad = mod.clone(remat=True, remat_policy="everything")
+        with pytest.raises(ValueError, match="remat_policy"):
+            bad.apply(params, _tokens(batch=1, seq=16))

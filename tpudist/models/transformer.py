@@ -355,6 +355,18 @@ class TransformerLM(nn.Module):
     # block boundaries, at ~1 extra forward of FLOPs — the lever that fits
     # d_model≥1024 configs in HBM.  Identical numerics (tests assert it).
     remat: bool = False
+    # What the remat'd backward may keep (jax.checkpoint policies — the
+    # memory/FLOPs dial between full remat and no remat):
+    #   "nothing"  save only block boundaries (max memory savings, ~1
+    #              extra forward of recompute) — the default;
+    #   "dots"     save matmul outputs (jax.checkpoint_policies.
+    #              checkpoint_dots): recompute only the cheap elementwise/
+    #              norm chains — most of the memory win at a sliver of
+    #              the recompute, usually the best MFU under mild
+    #              memory pressure;
+    #   "dots_no_batch"  save non-batch matmul outputs only (scan-
+    #              friendly variant).
+    remat_policy: str = "nothing"
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -411,9 +423,20 @@ class TransformerLM(nn.Module):
             x = x + pos[None]
         block_cls = Block
         if self.remat and not self.decode:
-            # static_argnums: nothing — Block takes only the activation;
-            # policy: save nothing inside the block (boundaries only).
-            block_cls = nn.remat(Block)
+            # static_argnums: nothing — Block takes only the activation.
+            policies = {
+                "nothing": None,  # save only block boundaries
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch":
+                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            }
+            if self.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy must be one of {sorted(policies)}, "
+                    f"got {self.remat_policy!r}")
+            pol = policies[self.remat_policy]
+            block_cls = (nn.remat(Block) if pol is None
+                         else nn.remat(Block, policy=pol))
         for i in range(self.n_layers):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, attn,

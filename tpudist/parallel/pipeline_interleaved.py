@@ -458,3 +458,33 @@ def pipeline_interleaved_shard(
         og_sum = jax.tree.map(lambda g: lax.pmean(g, data_axis), og_sum)
         cg_acc = jax.tree.map(lambda g: lax.pmean(g, data_axis), cg_acc)
     return loss_sum, cg_acc, og_sum, dx_sum
+
+
+def format_timeline(schedule: InterleavedSchedule) -> str:
+    """ASCII timeline of the schedule (one row per device, one column per
+    tick, ``F<m>``/``B<m>``/``·``) — the at-a-glance view of warmup,
+    steady 1F1B pairs, and drain.  ``python -m
+    tpudist.parallel.pipeline_interleaved D V M`` prints it."""
+    t = schedule.tables
+    rows = []
+    for d in range(schedule.n_dev):
+        cells = []
+        for tick in range(schedule.total_ticks):
+            f = (f"F{t['fwd_m'][tick, d]}.{t['fwd_c'][tick, d]}"
+                 if t["fwd_valid"][tick, d] else "")
+            b = (f"B{t['bwd_m'][tick, d]}.{t['bwd_c'][tick, d]}"
+                 if t["bwd_valid"][tick, d] else "")
+            cells.append(f"{f}{'+' if f and b else ''}{b}" or "·")
+        rows.append(f"dev{d}: " + " ".join(c.ljust(9) for c in cells))
+    head = (f"D={schedule.n_dev} V={schedule.n_chunks} M={schedule.n_micro}"
+            f"  ticks={schedule.total_ticks}"
+            f" (bubble {schedule.bubble_ticks})"
+            f"  act_bank={schedule.act_depth} cot_bank={schedule.cot_depth}")
+    return "\n".join([head] + rows)
+
+
+if __name__ == "__main__":  # pragma: no cover - debug CLI
+    import sys as _sys
+
+    d_, v_, m_ = (int(x) for x in _sys.argv[1:4])
+    print(format_timeline(interleaved_schedule(d_, v_, m_)))
