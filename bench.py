@@ -189,6 +189,7 @@ def bench_fused_mlp(batch: int = 4096) -> dict:
 def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
              n_layers: int, n_heads: int, d_ff: int, vocab: int = 256,
              steps: int = 5, precision: str = "fp32",
+             remat: bool = False, remat_policy: str = "nothing",
              profile_dir: str | None = None) -> dict:
     """Time the TransformerLM train step and report tokens/sec/chip + MFU.
 
@@ -210,6 +211,7 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
         jax.random.PRNGKey(0), seq_len=seq_len, vocab=vocab, d_model=d_model,
         n_layers=n_layers, n_heads=n_heads, d_ff=d_ff, max_len=seq_len,
         dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32,
+        remat=remat, remat_policy=remat_policy,
     )
     tx = optax.adam(3e-4)
     state = init_lm_state(params, tx)
@@ -250,7 +252,9 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
         "step_ms": round(step_s * 1e3, 2),
         "config": {"batch": batch, "seq_len": seq_len, "d_model": d_model,
                    "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
-                   "vocab": vocab, "precision": precision},
+                   "vocab": vocab, "precision": precision,
+                   "remat": remat,
+                   "remat_policy": remat_policy if remat else None},
         "model_flops_per_step": flops,
         # Always against the bf16 MXU peak (the chip's one headline number)
         # so fp32 and bf16 rows share a denominator: an fp32 row's value is

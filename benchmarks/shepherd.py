@@ -12,6 +12,7 @@ matmul) between steps, and the most-wanted measurements first, so a short
 live window yields the highest-value rows before the next wedge:
 
   1. bench --sections mfu       — the d1024 MFU ladder (VERDICT r3 #2)
+  1a. mfu_hunt                  — lever search (batch x remat) + trace
   2. bench --sections decode,fused
   3. bench --sections long      — flash-path long-context rows
   4. flash_sweep GQA            — kernel A/B vs repeated-KV
@@ -45,6 +46,7 @@ LOG = lambda msg: print(f"[shepherd {time.strftime('%H:%M:%S')}] {msg}",
 STEPS = [
     ("1_bench_mfu", [sys.executable, "bench.py", "--sections", "mfu"],
      2400, {"TPUDIST_BENCH_PROFILE": "runs/profile_mfu"}),
+    ("1a_mfu_hunt", [sys.executable, "benchmarks/mfu_hunt.py"], 3600, {}),
     ("1b_bench_decode_fused",
      [sys.executable, "bench.py", "--sections", "decode,fused"], 1500, {}),
     ("1c_bench_long", [sys.executable, "bench.py", "--sections", "long"],
