@@ -133,6 +133,13 @@ def ring_attention_shard(
     my_idx = lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     block = q.shape[-2]
+    # Grouped-query K/V: the RING carries the small hkv-headed tensors
+    # (group x fewer bytes per ICI hop) and each device broadcasts to
+    # full heads only at compute time, inside consume_shard.
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"q heads {q.shape[1]} not a multiple of "
+                         f"kv heads {k.shape[1]}")
+    kv_group = q.shape[1] // k.shape[1]
 
     # pcast-to-varying: the carries join a scan whose outputs vary over the
     # seq axis (they mix in the sharded q/k/v), so the initial values must
@@ -152,6 +159,9 @@ def ring_attention_shard(
 
     def consume_shard(kv_idx, k, v, m, l, o):
         """Fold one ring step's KV shard into the (m, l, o) carry."""
+        if kv_group > 1:  # broadcast AFTER the hop — wire stays narrow
+            k = jnp.repeat(k, kv_group, axis=1)
+            v = jnp.repeat(v, kv_group, axis=1)
         if inner_block is None:
             mask = _causal_mask(q_off, kv_idx * block, block, block,
                                 window) if causal else None
@@ -379,11 +389,11 @@ def make_ring_attention(
     ring = jax.jit(sharded)
     # Window tag consumed by Block's sliding_window training-path guard.
     ring.window = window
-    if kernel == "flash":
-        # The per-hop flash kernels consume grouped-query K/V natively
-        # (Block then skips its repeat); the xla body needs equal heads,
-        # so only the flash path advertises it.
-        ring.supports_gqa = True
+    # BOTH bodies consume grouped-query K/V natively (Block then skips
+    # its repeat): the flash kernels fetch KV tiles once per group; the
+    # xla body hops the small hkv-headed tensors and broadcasts post-hop
+    # — either way the ring wire carries group x fewer KV bytes.
+    ring.supports_gqa = True
     return ring
 
 
@@ -454,7 +464,10 @@ def ring_attention_shard_zigzag(
     if shard % 2:
         raise ValueError(f"zigzag shard must be even, got {shard}")
     half = shard // 2
-    n2 = 2 * axis_size
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"q heads {q.shape[1]} not a multiple of "
+                         f"kv heads {k.shape[1]}")
+    kv_group = q.shape[1] // k.shape[1]
 
     q_lo, q_hi = q[..., :half, :], q[..., half:, :]
 
@@ -477,8 +490,13 @@ def ring_attention_shard_zigzag(
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     for t in range(axis_size):
-        k_lo, k_hi = k[..., :half, :], k[..., half:, :]
-        v_lo, v_hi = v[..., :half, :], v[..., half:, :]
+        if kv_group > 1:  # hop the small tensors, broadcast at compute
+            kf = jnp.repeat(k, kv_group, axis=1)
+            vf = jnp.repeat(v, kv_group, axis=1)
+        else:
+            kf, vf = k, v
+        k_lo, k_hi = kf[..., :half, :], kf[..., half:, :]
+        v_lo, v_hi = vf[..., :half, :], vf[..., half:, :]
         if t == 0:
             # j == i: both diagonals (triangular) + the always-live full.
             lo_carry = _block_update(q_lo, k_lo, v_lo, *lo_carry,
@@ -540,6 +558,7 @@ def make_zigzag_ring_attention(
     )
     ring = jax.jit(sharded)
     ring.window = None
+    ring.supports_gqa = True  # hops hkv-headed K/V, broadcasts post-hop
     return ring
 
 
