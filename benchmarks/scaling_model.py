@@ -115,7 +115,7 @@ def dp_rows(name, *, grad_bytes, step_s, link_bw, target=0.8,
 
 
 def ring_sp_row(*, name, batch, heads, seq, head_dim, ring, link_bw,
-                peak_flops, mfu_measured, dtype_bytes=2):
+                peak_flops, mfu_measured, dtype_bytes=2, kv_heads=None):
     """Ring attention over `ring` chips: per-hop KV bytes vs per-hop
     compute.  The audit pins the payload (one KV shard per hop per
     tensor); the per-hop compute is the flash block attention over one
@@ -123,7 +123,11 @@ def ring_sp_row(*, name, batch, heads, seq, head_dim, ring, link_bw,
     (batch·heads·shard·head_dim) and achieved FLOPs drive this — the
     rest of the model never rides the ring."""
     shard = seq // ring
-    kv_hop_bytes = 2 * batch * heads * shard * head_dim * dtype_bytes
+    # GQA: the ring hops the small kv-headed tensors (ring bodies are
+    # GQA-native — broadcast happens post-hop), so the wire scales with
+    # kv_heads while compute still scales with query heads.
+    kv_hop_bytes = (2 * batch * (kv_heads or heads) * shard * head_dim
+                    * dtype_bytes)
     # Per-hop attention FLOPs (fwd): one [shard x shard] block of the
     # score+value matmuls for every query shard position.
     hop_flops = 4.0 * batch * heads * shard * shard * head_dim
@@ -249,6 +253,12 @@ def main() -> int:
         out["sp_ring"].append(ring_sp_row(
             name="lm_long_context_bf16_sp", batch=4, heads=4, seq=8192,
             head_dim=64, ring=ring,
+            link_bw=link_bw, peak_flops=peak, mfu_measured=lc_mfu))
+        # GQA at group 2: half the hop bytes, same compute — the
+        # crossover where hops stop hiding moves out ~2 x in ring size.
+        out["sp_ring"].append(ring_sp_row(
+            name="lm_long_context_bf16_sp_gqa2", batch=4, heads=4,
+            kv_heads=2, seq=8192, head_dim=64, ring=ring,
             link_bw=link_bw, peak_flops=peak, mfu_measured=lc_mfu))
 
     # --- causal-balance (layout) ----------------------------------------
