@@ -866,3 +866,24 @@ class TestRingGQAWire:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5)
+
+    def test_gqa_composes_with_window_on_xla_ring(self, devices):
+        """GQA + sliding window + xla ring in one body: post-hop repeat
+        must not disturb the band masking or the early ring exit."""
+        from tpudist.parallel import attention_reference, make_ring_attention
+        from tpudist.runtime.mesh import AXIS_SEQ
+
+        n, B, H, HKV, S, D, W = 4, 2, 4, 2, 64, 16, 12
+        mesh = Mesh(np.asarray(devices[:n]), (AXIS_SEQ,))
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (B, H, S, D))
+        k = jax.random.normal(ks[1], (B, HKV, S, D))
+        v = jax.random.normal(ks[2], (B, HKV, S, D))
+        ring = make_ring_attention(mesh, causal=True, kernel="xla",
+                                   window=W)
+        rep = lambda x: jnp.repeat(x, H // HKV, 1)
+        np.testing.assert_allclose(
+            np.asarray(ring(q, k, v)),
+            np.asarray(attention_reference(q, rep(k), rep(v), causal=True,
+                                           window=W)),
+            rtol=2e-5, atol=2e-5)
