@@ -573,6 +573,22 @@ def main() -> None:
             _fail_record(f"toy bench failed: {e!r}", 4)
         results["toy"] = toy
 
+    try:
+        _prior = json.loads(ext_path.read_text()) if ext_path.exists() else {}
+    except Exception:
+        _prior = {}
+
+    def record_failure(key: str, error: str) -> None:
+        """A failed section must never CLOBBER a previously measured row
+        (observed risk: a round-end full run through a half-wedged tunnel
+        would overwrite a live window's good rows with timeout records).
+        Keep the old measurement and stamp the failed attempt on it."""
+        old = _prior.get(key)
+        if isinstance(old, dict) and "error" not in old and "value" in old:
+            results[key] = {**old, "last_attempt_error": error}
+        else:
+            results[key] = {"error": error}
+
     if jax.devices()[0].platform == "tpu" and gate_ok and sec("fused"):
         # Kernel-vs-XLA A/B on the toy forward (the answer is interesting
         # either way; a failure must not cost the headline).
@@ -581,7 +597,7 @@ def main() -> None:
             results["toy_fused_mlp"] = _with_watchdog(
                 bench_fused_mlp, 600.0, "fused mlp bench")
         except Exception as e:
-            results["toy_fused_mlp"] = {"error": repr(e)}
+            record_failure("toy_fused_mlp", repr(e))
             print(f"# toy_fused_mlp failed: {e!r}", file=sys.stderr)
 
     # MXU-dense LM config: matmul-dominated, the MFU yardstick — timed at
@@ -597,18 +613,18 @@ def main() -> None:
         nonlocal wedged
         ran_now.append(key)
         if wedged >= 2:
-            results[key] = {"error": "skipped: tunnel wedged "
-                            "(2+ consecutive section timeouts)"}
+            record_failure(key, "skipped: tunnel wedged "
+                           "(2+ consecutive section timeouts)")
             return
         try:
             results[key] = _with_watchdog(fn, timeout, key)
             wedged = 0
         except TimeoutError as e:
             wedged += 1
-            results[key] = {"error": repr(e)}
+            record_failure(key, repr(e))
             print(f"# {key} failed: {e!r}", file=sys.stderr)
         except Exception as e:  # keep the headline alive on small hosts
-            results[key] = {"error": repr(e)}
+            record_failure(key, repr(e))
             print(f"# {key} failed: {e!r}", file=sys.stderr)
         ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
