@@ -7,9 +7,11 @@ from tpudist.train.step import (  # noqa: F401
 )
 from tpudist.train.loop import TrainLoopConfig, run_training  # noqa: F401
 from tpudist.train.lm import (  # noqa: F401
+    chunk_token_sharding,
     init_lm_state,
     make_lm_eval_step,
     make_lm_train_step,
+    make_scanned_lm_train_step,
     token_sharding,
 )
 from tpudist.train.optim import (  # noqa: F401

@@ -276,3 +276,57 @@ def make_lm_train_step(
         out_shardings=out_shardings,
         donate_argnums=(0,) if donate_state else (),
     )
+
+
+def chunk_token_sharding(mesh: Mesh) -> NamedSharding:
+    """``[K, batch, seq]`` token windows: iteration axis replicated, the
+    rest sharded like :func:`token_sharding`."""
+    data = AXIS_DATA if AXIS_DATA in mesh.axis_names else None
+    seq = AXIS_SEQ if AXIS_SEQ in mesh.axis_names else None
+    return NamedSharding(mesh, P(None, data, seq))
+
+
+def make_scanned_lm_train_step(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    loss_fn: Callable = lm_loss,
+    donate_state: bool = True,
+    state_sharding=None,
+):
+    """The chunked (``lax.scan``) LM train step — K optimizer steps per
+    dispatch, the same amortization that makes the toy headline fast
+    through the tunnel (``make_scanned_train_step``), for the LM family.
+
+    Returns ``chunk_step(state, tokens_chunk) -> (state, losses)`` with
+    ``tokens_chunk: [K, batch, seq] int32`` (sharded per
+    :func:`chunk_token_sharding`) and ``losses: (K,)`` per-iteration
+    values — per-step logging semantics preserved while dispatch and
+    host sync amortize K×.  Numerics are bit-identical to K calls of the
+    plain step (tests assert it).  The plain step's extras (MoE aux,
+    accum, grad_reduce_dtype) are out of scope here — use it for the
+    small-model/tunnel regime they don't apply to.
+    """
+    from jax import lax as _lax
+
+    repl = NamedSharding(mesh, P())
+    state_out = repl if state_sharding is None else state_sharding
+
+    def chunk(state: ModelState, tokens_chunk):
+        def body(st, toks):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(apply_fn(p, toks), toks))(st.params)
+            updates, new_opt = tx.update(grads, st.opt_state, st.params)
+            new = ModelState(params=optax.apply_updates(st.params, updates),
+                             opt_state=new_opt)
+            return new, loss
+
+        return _lax.scan(body, state, tokens_chunk)
+
+    return jax.jit(
+        chunk,
+        in_shardings=(state_out, chunk_token_sharding(mesh)),
+        out_shardings=(state_out, repl),
+        donate_argnums=(0,) if donate_state else (),
+    )
