@@ -269,6 +269,73 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
     }
 
 
+def bench_lm_scanned(*, batch: int = 8, seq_len: int = 2048,
+                     d_model: int = 512, n_layers: int = 4,
+                     n_heads: int = 8, d_ff: int = 2048, vocab: int = 256,
+                     scan_k: int = 8, repeats: int = 3) -> dict:
+    """A/B the scanned LM step (K optimizer steps per dispatch) against
+    the per-step path at the dense-row geometry — measures what the
+    dispatch/sync tax costs the LM family through the tunnel (the toy
+    row's amortization trick, quantified at transformer scale)."""
+    import jax.numpy as jnp
+
+    from tpudist.models import create_transformer
+    from tpudist.runtime.mesh import data_parallel_mesh
+    from tpudist.train import (chunk_token_sharding, init_lm_state,
+                               make_lm_train_step,
+                               make_scanned_lm_train_step, token_sharding)
+
+    mesh = data_parallel_mesh()
+    module, params = create_transformer(
+        jax.random.PRNGKey(0), seq_len=seq_len, vocab=vocab,
+        d_model=d_model, n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
+        max_len=seq_len, dtype=jnp.bfloat16)
+    tx = optax.adam(3e-4)
+    toks = np.random.default_rng(0).integers(
+        0, vocab, size=(scan_k, batch, seq_len)).astype(np.int32)
+
+    # plain: K separate dispatches
+    st = init_lm_state(params, tx)
+    plain = make_lm_train_step(module.apply, tx, mesh, donate_state=False)
+    t_p = jax.device_put(toks[0], token_sharding(mesh))
+    st, loss = plain(st, t_p)
+    _sync(loss)  # compile
+    best_plain = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for k in range(scan_k):
+            st, loss = plain(st, t_p)
+        _sync(loss)
+        best_plain = min(best_plain, (time.perf_counter() - t0) / scan_k)
+
+    # scanned: one dispatch for K steps
+    st2 = init_lm_state(params, tx)
+    chunk = make_scanned_lm_train_step(module.apply, tx, mesh,
+                                       donate_state=False)
+    t_c = jax.device_put(toks, chunk_token_sharding(mesh))
+    st2, losses = chunk(st2, t_c)
+    _sync(losses)  # compile
+    best_scan = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        st2, losses = chunk(st2, t_c)
+        _sync(losses)
+        best_scan = min(best_scan, (time.perf_counter() - t0) / scan_k)
+
+    return {
+        "metric": "lm_dense_bf16_scanned_step_ms",
+        "unit": "ms/step",
+        "config": {"batch": batch, "seq_len": seq_len, "d_model": d_model,
+                   "scan_k": scan_k},
+        "step_ms_plain": round(best_plain * 1e3, 2),
+        "step_ms_scanned": round(best_scan * 1e3, 2),
+        "dispatch_tax_ms": round((best_plain - best_scan) * 1e3, 2),
+        "speedup": round(best_plain / best_scan, 3),
+        "tokens_per_sec_per_chip_scanned": round(
+            batch * seq_len / best_scan / jax.local_device_count(), 1),
+    }
+
+
 def bench_decode(*, batch: int = 8, prompt_len: int = 16, max_new: int = 240,
                  d_model: int = 512, n_layers: int = 4, n_heads: int = 8,
                  d_ff: int = 2048, vocab: int = 256) -> dict:
@@ -644,6 +711,11 @@ def main() -> None:
             lambda p=precision: bench_lm(
                 name=f"dense_{p}", batch=8, seq_len=2048, d_model=512,
                 n_layers=4, n_heads=8, d_ff=2048, precision=p))
+
+    if jax.devices()[0].platform == "tpu" and sec("dense"):
+        # Dispatch-tax A/B: the scanned LM step (K steps/dispatch) vs the
+        # per-step path at the dense geometry.
+        run_section("lm_dense_bf16_scanned", bench_lm_scanned)
 
     # MXU-saturating MFU row (VERDICT r2: demonstrate >=35% or profile
     # why not): d1024/L8/ff4096/seq2048 bf16 — wide enough matmuls that
