@@ -887,3 +887,38 @@ class TestRingGQAWire:
             np.asarray(attention_reference(q, rep(k), rep(v), causal=True,
                                            window=W)),
             rtol=2e-5, atol=2e-5)
+
+    def test_zigzag_eval_step_matches_natural(self, devices):
+        """make_lm_eval_step(loss_fn=zigzag) on permuted batches equals
+        the natural-order eval loss (the demo's --zigzag eval path)."""
+        from tpudist.models import create_transformer
+        from tpudist.parallel import (make_zigzag_lm_loss,
+                                      make_zigzag_ring_attention,
+                                      zigzag_indices)
+        from tpudist.runtime.mesh import AXIS_DATA, AXIS_SEQ
+        from tpudist.train import make_lm_eval_step, token_sharding
+
+        n_sp, S = 4, 64
+        mesh = Mesh(np.asarray(devices).reshape(2, 4),
+                    (AXIS_DATA, AXIS_SEQ))
+        pi = np.asarray(zigzag_indices(S, n_sp))
+        mod_nat, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=S, vocab=32, d_model=32,
+            n_layers=1, n_heads=2, d_ff=64, max_len=S)
+        mod_zz = mod_nat.clone(
+            attention_fn=make_zigzag_ring_attention(mesh,
+                                                    batch_axis=AXIS_DATA))
+        toks = np.random.default_rng(3).integers(
+            0, 32, size=(8, S)).astype(np.int32)
+
+        ev_n = make_lm_eval_step(mod_nat.apply, mesh)
+        loss_n = ev_n(params, jax.device_put(toks, token_sharding(mesh)))
+
+        pos = jnp.asarray(pi, jnp.int32)
+        ev_z = make_lm_eval_step(
+            lambda p, t: mod_zz.apply(p, t, pos), mesh,
+            loss_fn=make_zigzag_lm_loss(S, n_sp))
+        loss_z = ev_z(params, jax.device_put(toks[:, pi],
+                                             token_sharding(mesh)))
+        np.testing.assert_allclose(float(loss_n), float(loss_z),
+                                   rtol=1e-5, atol=1e-5)
