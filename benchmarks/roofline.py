@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Analytic roofline for the MFU-row configs — the no-hardware half of
+"drive MFU ≥40% or prove the ceiling" (VERDICT r3 #2).
+
+For each lever-matrix rung of ``mfu_hunt.py`` this computes, from the
+model geometry alone:
+
+- model FLOPs per step (``tpudist.utils.flops`` accounting);
+- HBM bytes per step: parameter traffic (bf16 weights read in fwd AND
+  bwd; f32 master params, grads, and both Adam moments read+written at
+  the update) + activation traffic (every residual tensor written once
+  in fwd and read once in bwd — or recomputed under remat, which moves
+  the traffic to the recompute's reads);
+- the resulting compute time at peak vs HBM time at peak bandwidth, and
+  the MFU CEILING ``t_compute / max(t_compute, t_hbm)`` — what the chip
+  allows if every matmul ran at peak and all traffic streamed at full
+  bandwidth.
+
+The point of the number: if the ceiling is ~1.0 (compute-bound) and the
+measured MFU is far below it, the residual is schedulable work — kernel
+quality, fusion, dispatch — NOT a bandwidth wall; the profile trace is
+the tool that names it.  If the ceiling itself is low, the config is
+bandwidth-bound and batch/remat are the levers.  Writes
+``ROOFLINE_r04.json`` and prints one row per rung.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Public v5e spec: 197 bf16 TFLOP/s, 819 GB/s HBM BW, 16 GiB HBM.
+HBM_BYTES_PER_S = 8.19e11
+HBM_CAPACITY = 16 * 2 ** 30
+
+GEOM = dict(seq_len=2048, d_model=1024, n_layers=8, d_ff=4096, vocab=256)
+
+RUNGS = [  # (tag, batch, remat)
+    ("b8", 8, False),
+    ("b16", 16, False),
+    ("b32", 32, False),
+    ("b32_remat", 32, True),
+    ("b64_remat", 64, True),
+]
+
+
+def param_count(*, d_model, n_layers, d_ff, vocab, seq_len, **_):
+    per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
+    return n_layers * per_layer + 2 * vocab * d_model + seq_len * d_model
+
+
+def activation_bytes(*, batch, seq_len, d_model, d_ff, n_layers, remat,
+                     dtype_bytes=2, **_):
+    """Residual tensors saved for backward, per step (write in fwd + read
+    in bwd => x2 traffic).  Per block: the attention inputs/outputs and
+    MLP intermediates that autodiff keeps ~ (6*d + 2*ff) values/token
+    (q,k,v,attn-out,2 norms ~ 6d; two MLP intermediates ~ 2ff).  Under
+    block remat only the block INPUT is saved (d values/token); the
+    recompute re-reads weights instead (counted in weight traffic)."""
+    tokens = batch * seq_len
+    per_token = (d_model if remat
+                 else 6 * d_model + 2 * d_ff)
+    return 2 * tokens * per_token * n_layers * dtype_bytes
+
+
+def weight_traffic_bytes(n_params, *, remat):
+    """Per step: bf16 weights read by fwd + bwd (x3 with the remat
+    re-forward), f32 grads written+read, f32 master read+written, two
+    f32 Adam moments read+written."""
+    fwd_bwd_reads = (3 if remat else 2) * 2 * n_params      # bf16
+    optimizer = (2 + 2 + 4) * 4 * n_params                  # f32 r/w
+    return fwd_bwd_reads + optimizer
+
+
+def main(argv=None) -> int:
+    from tpudist.utils.flops import PEAK_BF16_FLOPS, transformer_train_flops
+
+    peak = PEAK_BF16_FLOPS["TPU v5 lite"]
+    n_params = param_count(**GEOM)
+    rows = []
+    for tag, batch, remat in RUNGS:
+        flops = transformer_train_flops(batch=batch, **GEOM)
+        if remat:  # one extra forward of the block stack
+            flops = flops * 4 / 3
+        act_b = activation_bytes(batch=batch, remat=remat, **GEOM)
+        w_b = weight_traffic_bytes(n_params, remat=remat)
+        t_c = flops / peak
+        t_h = (act_b + w_b) / HBM_BYTES_PER_S
+        # Peak live memory sanity: f32 master+grads+moments + bf16 copy
+        # + saved activations (absolute lower bound).
+        mem = n_params * (4 * 4 + 2) + act_b / 2
+        rows.append({
+            "rung": tag, "batch": batch, "remat": remat,
+            "model_flops_per_step": flops,
+            "hbm_bytes_per_step": int(act_b + w_b),
+            "t_compute_ms_at_peak": round(t_c * 1e3, 2),
+            "t_hbm_ms_at_peak_bw": round(t_h * 1e3, 2),
+            "mfu_ceiling": round(t_c / max(t_c, t_h), 4),
+            "bound": "compute" if t_c >= t_h else "bandwidth",
+            "est_min_live_bytes": int(mem),
+            "fits_hbm": mem < HBM_CAPACITY * 0.9,
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    out = {"geometry": GEOM, "n_params": n_params,
+           "peak_bf16_flops": peak, "hbm_bytes_per_s": HBM_BYTES_PER_S,
+           "accounting": "see module docstring", "rows": rows}
+    (REPO / "ROOFLINE_r04.json").write_text(json.dumps(out, indent=2) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
