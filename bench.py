@@ -750,13 +750,19 @@ def main() -> None:
         # the d1024 matmuls at b8 leave the MXU waiting on dispatch and
         # HBM; doubling batch amortizes both.  Each rung has its own
         # watchdog, so an OOM or wedge costs one row, not the ladder.
-        for b in (16, 32):
+        # b32 runs under remat(dots): the roofline (ROOFLINE_r04.json)
+        # shows plain b32 exceeds the 16 GiB HBM while the dots-policy
+        # rung fits at ~1/5 the live bytes — and the config is compute-
+        # bound either way, so the recompute sliver is the whole cost.
+        for b, rm in ((16, False), (32, True)):
             run_section(
-                f"lm_mfu_d1024_b{b}",
-                lambda b=b: bench_lm(
-                    name=f"mfu_d1024_bf16_b{b}", batch=b, seq_len=2048,
+                f"lm_mfu_d1024_b{b}" + ("_remat" if rm else ""),
+                lambda b=b, rm=rm: bench_lm(
+                    name=f"mfu_d1024_bf16_b{b}" + ("_remat" if rm else ""),
+                    batch=b, seq_len=2048,
                     d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
-                    precision="bf16", steps=3),
+                    precision="bf16", steps=3,
+                    remat=rm, remat_policy="dots" if rm else "nothing"),
                 timeout=900.0)
 
     if sec("decode"):
