@@ -47,6 +47,8 @@ STEPS = [
     ("1_bench_mfu", [sys.executable, "bench.py", "--sections", "mfu"],
      2400, {"TPUDIST_BENCH_PROFILE": "runs/profile_mfu"}),
     ("1a_mfu_hunt", [sys.executable, "benchmarks/mfu_hunt.py"], 3600, {}),
+    ("1a2_bench_mfu_scanned",
+     [sys.executable, "bench.py", "--sections", "mfu_scanned"], 2000, {}),
     ("1b_bench_decode_fused",
      [sys.executable, "bench.py", "--sections", "decode,fused"], 1500, {}),
     ("1c_bench_long", [sys.executable, "bench.py", "--sections", "long"],
