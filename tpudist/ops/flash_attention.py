@@ -203,7 +203,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         ).astype(o_ref.dtype)
         # Per-row logsumexp (scaled-score domain) — the backward's residual:
         # p = exp(s·scale − lse) reconstructs the softmax tile exactly.
-        lse_ref[0, :, 0] = jnp.where(
+        lse_ref[0, 0, :] = jnp.where(
             dead, _MASK_VALUE, m_ref[:, 0] + jnp.log(safe_l)
         )
 
@@ -245,6 +245,15 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide seq lengths ({seq_q}, {seq_k})"
         )
+    if bq < seq_q and bq % 128 and not interpret:
+        # The (bh, 1, seq_q) stats layout puts the Q block on the LANE dim
+        # of the lse/delta blocks, so a partial block must be a lane-tile
+        # multiple on TPU.  Catch it here with a clear message instead of
+        # deep in Mosaic's block-shape check.  (Interpret mode has no tile
+        # constraints — tests exercise band edges with small blocks.)
+        raise ValueError(
+            f"block_q ({bq}) must be a multiple of 128 (or the full seq_q)"
+        )
     scale = d ** -0.5
     bh = batch * heads
     qr = q.reshape(bh, seq_q, d)
@@ -275,10 +284,16 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d),
                                  jnp.float32 if out_f32 else q.dtype),
-            # Trailing singleton lane dim: (1, bq, 1) blocks satisfy the TPU
-            # (8, 128)-or-full-dim tiling rule at 1/128th the HBM of the
-            # lane-padded layout the in-tree kernel uses.
-            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+            # Stats with seq on the LANE dim.  A trailing singleton
+            # ((bh, seq_q, 1)) looks harmless but the T(8,128) HBM layout
+            # pads the lane dim 1 → 128 — measured 128× expansion
+            # (4 MB → 512 MB at bh=512/seq=2048, the r4 b64 OOM dump) on
+            # every lse residual held live until the backward.  The
+            # middle singleton here is a SUBLANE dim (1 → 8, 8× pad) —
+            # the cheapest layout Pallas' block rule admits: a 2D
+            # (bh, seq_q) array would need (1, bq) blocks, whose sublane
+            # size 1 is neither divisible by 8 nor equal to bh.
+            jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
         ],
         grid=(bh, seq_q // bq, seq_k // bk),
         in_specs=[
@@ -290,7 +305,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         scratch_shapes=[
@@ -449,11 +464,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # Softmax tile from the saved row logsumexp — no m/l recurrence.
         # Dead rows carry the _MASK_VALUE lse sentinel: exp(s − lse) would
         # be exp(0)=1 on their masked entries, so zero them explicitly.
-        row_lse = lse_ref[0, :, 0]
-        p = jnp.exp(s - row_lse[:, None]) * (
-            row_lse > _MASK_VALUE * 0.5)[:, None]
+        row_lse = lse_ref[0, 0, :]
+        # Dead-row mask as f32: a bool ([:, None]) minor-dim insert on the
+        # lane-layout row vector is unsupported by Mosaic (i1 relayout);
+        # the f32 multiply lowers cleanly and is numerically identical.
+        live = (row_lse > _MASK_VALUE * 0.5).astype(jnp.float32)
+        p = jnp.exp(s - row_lse[:, None]) * live[:, None]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale
         dq_acc_ref[:] += jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
@@ -490,13 +508,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = _tile_band_mask(s, qi, kv, block_q, block_k, lo, hi)
-        row_lse = lse_ref[0, :, 0]
-        p = jnp.exp(s - row_lse[:, None]) * (
-            row_lse > _MASK_VALUE * 0.5)[:, None]
+        row_lse = lse_ref[0, 0, :]
+        live = (row_lse > _MASK_VALUE * 0.5).astype(jnp.float32)  # see dq
+        p = jnp.exp(s - row_lse[:, None]) * live[:, None]
         pt = p.astype(do.dtype).T
         dv_acc_ref[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale
         dk_acc_ref[:] += jnp.dot(
             ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
@@ -523,8 +541,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     kr = k.reshape(bh_kv, seq_k, d)
     vr = v.reshape(bh_kv, seq_k, d)
     dor = do.reshape(bh, seq_q, d).astype(q.dtype)
-    lser = lse.reshape(bh, seq_q, 1)
-    deltar = delta.reshape(bh, seq_q, 1)
+    lser = lse.reshape(bh, 1, seq_q)
+    deltar = delta.reshape(bh, 1, seq_q)
     nq = seq_q // bq
     nkv = seq_k // bk
 
@@ -540,7 +558,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         return (b, i, 0)
 
     q_spec = pl.BlockSpec((1, bq, d), q_row_index, memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, bq, 1), q_row_index, memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+                            memory_space=pltpu.VMEM)
     band_j = _band_kv_index(bq, bk, lo, hi, nkv)
 
     def kv_index(b, i, j):
@@ -584,7 +603,13 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         return (q_row(b, gi // nq), jnp.clip(qi, 0, nq - 1), 0)
 
     q_spec_t = pl.BlockSpec((1, bq, d), q_index, memory_space=pltpu.VMEM)
-    row_spec_t = pl.BlockSpec((1, bq, 1), q_index, memory_space=pltpu.VMEM)
+
+    def row_index_t(b, j, gi):
+        r, qi, _ = q_index(b, j, gi)
+        return (r, 0, qi)
+
+    row_spec_t = pl.BlockSpec((1, 1, bq), row_index_t,
+                              memory_space=pltpu.VMEM)
     kv_spec_t = pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0),
                              memory_space=pltpu.VMEM)
 
