@@ -71,6 +71,13 @@ STEPS = [
                            "runs/profile_mfu", "--json"], 300, {}),
     ("7_autotune", [sys.executable, "-m", "tpudist.utils.autotune"],
      1800, {}),
+    # Post-kernel-fix reruns: the unpadded stats layout (dbf42b2) changes
+    # the flash rows' HBM traffic; re-measure them, and capture the dense
+    # scanned-vs-plain A/B the 03:15 full run predated.
+    ("8_bench_long_fixedstats",
+     [sys.executable, "bench.py", "--sections", "long"], 1800, {}),
+    ("9_bench_dense_ab",
+     [sys.executable, "bench.py", "--sections", "dense"], 1800, {}),
 ]
 
 
