@@ -613,6 +613,9 @@ def main() -> None:
     results = {"device_kind": jax.devices()[0].device_kind,
                "n_chips": jax.local_device_count()}
     ran_now: list = []  # sections THIS invocation executed (not merged)
+    measured_now: list = []  # sections THIS invocation actually measured
+    # (distinct from "no error in the merged row": record_failure keeps a
+    # prior run's good measurement, which must not report as ok NOW)
     ext_path = Path(__file__).parent / "BENCH_EXTENDED.json"
     if want != {"all"} and ext_path.exists():
         # Partial run: keep the sections this invocation doesn't touch —
@@ -698,6 +701,7 @@ def main() -> None:
         try:
             results["toy_fused_mlp"] = _with_watchdog(
                 bench_fused_mlp, 600.0, "fused mlp bench")
+            measured_now.append("toy_fused_mlp")
         except Exception as e:
             record_failure("toy_fused_mlp", repr(e))
             print(f"# toy_fused_mlp failed: {e!r}", file=sys.stderr)
@@ -721,6 +725,7 @@ def main() -> None:
         try:
             results[key] = _with_watchdog(fn, timeout, key)
             wedged = 0
+            measured_now.append(key)
         except TimeoutError as e:
             wedged += 1
             record_failure(key, repr(e))
@@ -841,12 +846,10 @@ def main() -> None:
                 pass
         print(json.dumps({**toy, "vs_baseline": round(vs, 3)}), flush=True)
     else:  # targeted partial run — still exactly one JSON line
-        ok = [k for k in ran_now
-              if isinstance(results.get(k), dict)
-              and "error" not in results[k]]
-        print(json.dumps({"metric": "bench_sections_ok", "value": len(ok),
+        print(json.dumps({"metric": "bench_sections_ok",
+                          "value": len(measured_now),
                           "unit": "sections", "ran": sorted(ran_now),
-                          "ok": sorted(ok)}), flush=True)
+                          "ok": sorted(measured_now)}), flush=True)
 
     # Hard exit: a wedged MFU-row thread (or a stuck backend) must not be
     # able to hang interpreter teardown after the record is printed.

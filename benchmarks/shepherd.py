@@ -69,15 +69,26 @@ STEPS = [
                               "--batch", "4"], 1200, {}),
     ("6_profile_summary", [sys.executable, "benchmarks/profile_summary.py",
                            "runs/profile_mfu", "--json"], 300, {}),
-    ("7_autotune", [sys.executable, "-m", "tpudist.utils.autotune"],
-     1800, {}),
-    # Post-kernel-fix reruns: the unpadded stats layout (dbf42b2) changes
-    # the flash rows' HBM traffic; re-measure them, and capture the dense
-    # scanned-vs-plain A/B the 03:15 full run predated.
-    ("8_bench_long_fixedstats",
+    # Renamed from 7_autotune: the rc-0 record that name carries in
+    # HW_ROUND.json came from the broken (loop-hoisted, non-syncing)
+    # timer — a resumed shepherd must re-run the two-point rewrite, not
+    # trust that record.
+    ("7_autotune_twopoint",
+     [sys.executable, "-m", "tpudist.utils.autotune"], 1800, {}),
+    # Post-kernel-fix + post-FINAL-autotune reruns (renamed from
+    # 8_bench_long_fixedstats / 9_bench_dense_ab / 10_bench_mfu_tuned:
+    # those rc-0 records predate the two-point autotune rewrite, so a
+    # resumed shepherd must re-measure under the final tuned file, not
+    # trust them): the unpadded stats layout (dbf42b2) changes the flash
+    # rows' HBM traffic and the tuned 1024x1024 tiles change the
+    # attention share of every seq>=1024 row.
+    ("8b_bench_long_tuned",
      [sys.executable, "bench.py", "--sections", "long"], 1800, {}),
-    ("9_bench_dense_ab",
+    ("9b_bench_dense_tuned",
      [sys.executable, "bench.py", "--sections", "dense"], 1800, {}),
+    ("10b_bench_mfu_tuned",
+     [sys.executable, "bench.py", "--sections", "mfu,mfu_scanned"],
+     2400, {}),
 ]
 
 
