@@ -41,11 +41,18 @@ _GROUPS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
 )
 
 
-def _group_of(name: str) -> str:
-    low = name.lower()
-    for group, keys in _GROUPS:
-        if any(k in low for k in keys):
-            return group
+def _group_of(name: str, hlo_category: str = "") -> str:
+    # TPU traces stamp each op with args.hlo_category ("loop fusion",
+    # "custom-call", "convolution", ...) — authoritative where present
+    # (instruction NAMES need not mention their opcode: the flash pallas
+    # calls appear as "block_3.5").  Name heuristics are the fallback
+    # for traces without args.
+    for probe in (hlo_category.lower(), name.lower()):
+        if not probe:
+            continue
+        for group, keys in _GROUPS:
+            if any(k in probe for k in keys):
+                return group
     return "other"
 
 
@@ -79,33 +86,83 @@ def _device_pids(events: List[dict]) -> set:
     return pids
 
 
+def _op_track_tids(events: List[dict]) -> set:
+    """(pid, tid) pairs whose thread metadata names the leaf-op track.
+
+    A TPU trace lays the same device time out on PARALLEL tracks — "XLA
+    Modules" (one span per executable), "Steps" (one per step), "XLA
+    Ops" (the leaf ops).  Summing across tracks counts each microsecond
+    once per track (observed: a 3-step d1024 trace reporting 'other
+    77%', which was just the module+step wrappers re-counting their
+    ops).  When an ops track exists, attribution uses it alone."""
+    tids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            name = str(e.get("args", {}).get("name", "")).lower()
+            if "xla ops" in name or name == "ops":
+                tids.add((e.get("pid"), e.get("tid")))
+    return tids
+
+
 def summarize(path: str | Path, top: int = 25) -> dict:
     files = list(_iter_trace_files(Path(path)))
     if not files:
         return {"error": f"no *.trace.json[.gz] under {path}"}
     by_name: Dict[str, float] = defaultdict(float)
+    cat_of: Dict[str, str] = {}
     total = 0.0
     for f in files:
         events = _load_events(f)
         dev = _device_pids(events)
+        op_tids = _op_track_tids(events)
+        # Within the chosen track(s), "X" spans can still NEST; account
+        # EXCLUSIVE (self) time — each span's duration minus its direct
+        # children's — via an interval stack per track.
+        tracks: Dict[tuple, list] = defaultdict(list)
         for e in events:
             if e.get("ph") != "X" or "dur" not in e:
                 continue
             if dev and e.get("pid") not in dev:
                 continue
+            key = (e.get("pid"), e.get("tid"))
+            if op_tids and key not in op_tids:
+                continue  # module/step wrapper tracks re-count op time
             name = e.get("name", "?")
             # host-side python frames ("$file.py:123 fn") leak into traces
             # on backends without a distinct device track — drop them.
             if name.startswith("$") or ".py:" in name:
                 continue
-            dur = float(e["dur"])  # microseconds
-            by_name[name] += dur
-            total += dur
+            cat = str(e.get("args", {}).get("hlo_category", ""))
+            if cat and name not in cat_of:
+                cat_of[name] = cat
+            if "ts" not in e:
+                # No timestamp → nesting is unknowable; a 0.0 default
+                # would stack every span under the longest one and
+                # undercount.  Plain summation for these.
+                by_name[name] += float(e["dur"])
+                total += float(e["dur"])
+                continue
+            tracks[key].append([float(e["ts"]), float(e["dur"]), name])
+        for evs in tracks.values():
+            # parents sort before their children (same start → longer first)
+            evs.sort(key=lambda r: (r[0], -r[1]))
+            selfs = [r[1] for r in evs]
+            stack: list = []  # [end_ts, index] of open enclosing spans
+            for i, (ts, dur, _name) in enumerate(evs):
+                while stack and stack[-1][0] <= ts:
+                    stack.pop()
+                if stack:
+                    selfs[stack[-1][1]] -= dur  # child time is not self time
+                stack.append([ts + dur, i])
+            for (_ts, _dur, name), sd in zip(evs, selfs):
+                sd = max(sd, 0.0)
+                by_name[name] += sd
+                total += sd
     if total == 0.0:
         return {"error": "no complete ('X') events with durations found"}
     by_group: Dict[str, float] = defaultdict(float)
     for name, dur in by_name.items():
-        by_group[_group_of(name)] += dur
+        by_group[_group_of(name, cat_of.get(name, ""))] += dur
     ops = sorted(by_name.items(), key=lambda kv: -kv[1])[:top]
     return {
         "files": [str(f) for f in files],

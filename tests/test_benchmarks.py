@@ -160,11 +160,16 @@ class TestProfileSummary:
         events = [
             {"ph": "M", "name": "process_name", "pid": 7,
              "args": {"name": "/device:TPU:0 TensorCore"}},
-            {"ph": "X", "pid": 7, "name": "fusion.3", "dur": 300.0},
-            {"ph": "X", "pid": 7, "name": "dot_general.1", "dur": 600.0},
-            {"ph": "X", "pid": 7, "name": "all-reduce.2", "dur": 100.0},
-            {"ph": "X", "pid": 7, "name": "$loop.py:10 run", "dur": 999.0},
-            {"ph": "X", "pid": 9, "name": "host_thread_junk", "dur": 999.0},
+            {"ph": "X", "pid": 7, "ts": 700.0, "name": "fusion.3",
+             "dur": 300.0},
+            {"ph": "X", "pid": 7, "ts": 0.0, "name": "dot_general.1",
+             "dur": 600.0},
+            {"ph": "X", "pid": 7, "ts": 1100.0, "name": "all-reduce.2",
+             "dur": 100.0},
+            {"ph": "X", "pid": 7, "ts": 0.0, "name": "$loop.py:10 run",
+             "dur": 999.0},
+            {"ph": "X", "pid": 9, "ts": 0.0, "name": "host_thread_junk",
+             "dur": 999.0},
         ]
         f = tmp_path / "x.trace.json.gz"
         with gzip.open(f, "wt") as fh:
@@ -176,6 +181,70 @@ class TestProfileSummary:
         names = [r["name"] for r in s["top_ops"]]
         assert "$loop.py:10 run" not in names
         assert "host_thread_junk" not in names
+
+    def test_nested_spans_count_self_time_once(self, tmp_path):
+        """A wrapper span enclosing ops on the same track contributes only
+        its EXCLUSIVE time — nested device time is never double-counted."""
+        import gzip
+        import json as _json
+
+        from benchmarks.profile_summary import summarize
+
+        events = [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            # wrapper [0, 1000) encloses dot [100, 700) and fusion
+            # [700, 950): wrapper self = 1000 − 600 − 250 = 150
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0,
+             "name": "while.9", "dur": 1000.0},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 100.0,
+             "name": "dot_general.1", "dur": 600.0},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 700.0,
+             "name": "fusion.2", "dur": 250.0},
+        ]
+        f = tmp_path / "x.trace.json.gz"
+        with gzip.open(f, "wt") as fh:
+            _json.dump({"traceEvents": events}, fh)
+        s = summarize(tmp_path)
+        assert s["total_us"] == 1000.0
+        by_name = {r["name"]: r["us"] for r in s["top_ops"]}
+        assert by_name["while.9"] == 150.0
+        assert by_name["dot_general.1"] == 600.0
+
+    def test_wrapper_tracks_excluded_when_ops_track_exists(self, tmp_path):
+        """TPU traces duplicate device time on parallel tracks (XLA
+        Modules / Steps / XLA Ops); attribution uses the ops track only."""
+        import gzip
+        import json as _json
+
+        from benchmarks.profile_summary import summarize
+
+        events = [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "name": "thread_name", "pid": 7, "tid": 1,
+             "args": {"name": "XLA Modules"}},
+            {"ph": "M", "name": "thread_name", "pid": 7, "tid": 2,
+             "args": {"name": "Steps"}},
+            {"ph": "M", "name": "thread_name", "pid": 7, "tid": 3,
+             "args": {"name": "XLA Ops"}},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0,
+             "name": "jit_step(123)", "dur": 1000.0},
+            {"ph": "X", "pid": 7, "tid": 2, "ts": 0.0,
+             "name": "0", "dur": 1000.0},
+            {"ph": "X", "pid": 7, "tid": 3, "ts": 0.0,
+             "name": "dot_general.1", "dur": 900.0},
+            {"ph": "X", "pid": 7, "tid": 3, "ts": 900.0,
+             "name": "fusion.1", "dur": 100.0},
+        ]
+        f = tmp_path / "x.trace.json.gz"
+        with gzip.open(f, "wt") as fh:
+            _json.dump({"traceEvents": events}, fh)
+        s = summarize(tmp_path)
+        assert s["total_us"] == 1000.0  # not 3000: one track, counted once
+        names = [r["name"] for r in s["top_ops"]]
+        assert "jit_step(123)" not in names and "0" not in names
+        assert s["groups"]["matmul (MXU)"]["pct"] == 90.0
 
     def test_empty_dir_reports_error(self, tmp_path):
         from benchmarks.profile_summary import summarize
