@@ -38,6 +38,33 @@ from tpudist.runtime.mesh import AXIS_STAGE
 StageFn = Callable[[dict, jax.Array], jax.Array]
 
 
+def head_grad_branches(loss_fn):
+    """``(head, head_zeros)`` cond branches for the vocab head: value and
+    grad of ``loss_fn(out_params, activation, aux)`` vs shape-matched
+    zeros.  Shared by both hand-scheduled pipelines so only the device
+    holding the last global stage's fresh activation pays head FLOPs.
+
+    HARD REQUIREMENT on ``loss_fn``: it must be collective-free (no
+    psum/pmean/ppermute).  It runs inside a ``lax.cond`` whose predicate
+    VARIES per device — a collective in the true branch would be executed
+    by a subset of the mesh and deadlock at runtime (``check_vma=False``
+    on the wrapping shard_maps means nothing catches it at trace time).
+    Reduce over the data axis AFTER the pipeline call, as
+    ``pipeline_1f1b_shard``'s ``data_axis`` handling does."""
+
+    def head(args):
+        out_p, a_out, aux_m = args
+        return jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            out_p, a_out, aux_m)
+
+    def head_zeros(args):
+        # trace-time only — eval_shape does no FLOPs
+        shapes = jax.eval_shape(head, args)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    return head, head_zeros
+
+
 def pipeline_shard(
     stage_params,
     x_microbatches: jax.Array,
@@ -159,17 +186,17 @@ def pipeline_1f1b_shard(
     its saved INPUT (stage-granular rematerialization), so no
     ``jax.checkpoint`` is needed — 1F1B implies it.
 
-    SPMD-uniformity cost: the ``jnp.where``-gated formulation evaluates
-    ``loss_fn`` — the full vocab-projection head, forward and backward via
-    ``value_and_grad`` — on EVERY stage at EVERY tick, masking all but the
-    last stage's result.  That is ``n_stages×`` redundant head FLOPs per
-    step, inherent to running one uniform program on all stages (the
-    alternative — ``lax.cond`` per stage — still executes both branches
-    under vmap-style SPMD).  For the block-dominated models this schedule
-    targets the head is a sliver of stage FLOPs; for large-vocab models
-    (head ≳ a block) prefer GPipe, or shrink the masked work by evaluating
-    the head on a reduced/zeroed activation before scaling this schedule
-    up (r3 advisor finding).
+    Head cost (r3 advisor finding, resolved): the head — the full
+    vocab-projection loss, forward and backward via ``value_and_grad`` —
+    runs under ``lax.cond`` on ``my_stage == last AND fwd_valid``.  Under
+    ``shard_map`` each device evaluates the predicate with its OWN axis
+    index at runtime, so this is a true per-device branch (NOT the
+    both-branches-execute degeneration ``cond`` suffers under ``vmap``):
+    non-last stages — and the last stage's warmup/drain ticks — run the
+    zero-cost false branch, so the step pays exactly ``M`` head
+    evaluations total.  Divergent control flow is safe only because
+    ``loss_fn`` MUST be collective-free — see
+    :func:`head_grad_branches` for the contract.
 
     Returns ``(loss_sum, stage_grads, out_grads, dx_microbatches)`` —
     all UNNORMALIZED sums over this shard's microbatches (caller divides
@@ -191,6 +218,8 @@ def pipeline_1f1b_shard(
     perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
     perm_bwd = [(i + 1, i) for i in range(n_stages - 1)]
 
+    head, head_zeros = head_grad_branches(loss_fn)
+
     def fwd_bwd(carry, t):
         (act_state, cot_state, ring, dx_bank,
          loss_acc, sg_acc, og_acc) = carry
@@ -211,14 +240,21 @@ def pipeline_1f1b_shard(
         ring = lax.dynamic_update_index_in_dim(
             ring, jnp.where(fwd_valid, a_in, old), slot, 0)
 
-        # last stage: loss + its cotangent for THIS micro, this tick
+        # last stage: loss + its cotangent for THIS micro, this tick —
+        # a true runtime branch; non-last stages skip the head entirely
+        # (see the docstring's head-cost note).  Predicate includes
+        # fwd_valid: the last stage's warmup/drain ticks carry garbage
+        # activations whose head results are fully masked anyway — safe
+        # to skip because on the last stage the backward of micro m runs
+        # the SAME tick as its forward (2(S-1)-(S-1)+m = (S-1)+m), so
+        # d_act is never consumed on a tick the head skipped.
         aux_m = lax.dynamic_index_in_dim(aux_microbatches, m_f_c, 0,
                                          keepdims=False)
-        (l_m, lgrads) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-            out_params, a_out, aux_m)
-        d_og, d_act = lgrads
         on_last = my_stage == last
         take_loss = jnp.logical_and(on_last, fwd_valid)
+        (l_m, lgrads) = lax.cond(
+            take_loss, head, head_zeros, (out_params, a_out, aux_m))
+        d_og, d_act = lgrads
         loss_acc = loss_acc + jnp.where(take_loss, l_m, 0.0)
         og_acc = jax.tree.map(
             lambda acc, g: acc + jnp.where(take_loss, g, 0.0), og_acc, d_og)

@@ -35,9 +35,10 @@ TPU-first formulation — everything is ONE jitted ``lax.scan`` inside one
 - backward recomputes each chunk's forward from the saved chunk INPUT
   (stage-granular remat), exactly like the non-interleaved schedule.
 
-The SPMD-uniformity cost note from ``pipeline_1f1b_shard`` applies
-unchanged: ``loss_fn`` (the vocab head) is evaluated masked on every
-device every tick.
+The head-cost note from ``pipeline_1f1b_shard`` applies unchanged:
+``loss_fn`` (the vocab head) runs under a true per-device ``lax.cond``
+branch, so only the device holding the last global stage's fresh
+activation pays head FLOPs at any tick.
 
 Reference lineage: the reference repo has no pipeline schedules at all
 (its only model parallelism is the manual 2-stage split,
@@ -55,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tpudist.parallel.pipeline import head_grad_branches
 from tpudist.runtime.mesh import AXIS_STAGE
 
 _INF = 10**9
@@ -364,6 +366,12 @@ def pipeline_interleaved_shard(
             lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
             stage_params)
 
+    # The vocab head runs under a true per-device runtime branch — only
+    # the tick/device holding the last global stage's fresh activation
+    # pays head FLOPs.  See head_grad_branches for the rationale and the
+    # collective-free requirement on loss_fn.
+    head, head_zeros = head_grad_branches(loss_fn)
+
     def tick(carry, rows):
         (act_bank, cot_bank, dx_bank, loss_acc, cg_acc, og_acc) = carry
         r = {k: jnp.take(v, my) for k, v in rows.items()}
@@ -378,8 +386,9 @@ def pipeline_interleaved_shard(
 
         aux_m = lax.dynamic_index_in_dim(aux_microbatches, fm, 0,
                                          keepdims=False)
-        (l_m, (d_og, d_act)) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1))(out_params, a_out, aux_m)
+        need_head = (r["take_loss"] | r["loss_cot_valid"]).astype(bool)
+        (l_m, (d_og, d_act)) = lax.cond(
+            need_head, head, head_zeros, (out_params, a_out, aux_m))
         take_loss = (r["take_loss"] & r["fwd_valid"]).astype(bool)
         loss_acc = loss_acc + jnp.where(take_loss, l_m, 0.0)
         og_acc = jax.tree.map(
