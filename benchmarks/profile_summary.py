@@ -104,6 +104,15 @@ def _op_track_tids(events: List[dict]) -> set:
     return tids
 
 
+def _op_track_pids(op_tids: set) -> set:
+    """pids that labeled an ops track.  The wrapper-track filter is
+    applied PER PID: a device pid without an identified "XLA Ops" thread
+    keeps plain summation — filtering it against another pid's ops track
+    would silently drop that whole chip from the attribution (multi-chip
+    traces do not all label the same thread names)."""
+    return {pid for (pid, _tid) in op_tids}
+
+
 def summarize(path: str | Path, top: int = 25) -> dict:
     files = list(_iter_trace_files(Path(path)))
     if not files:
@@ -115,6 +124,7 @@ def summarize(path: str | Path, top: int = 25) -> dict:
         events = _load_events(f)
         dev = _device_pids(events)
         op_tids = _op_track_tids(events)
+        op_pids = _op_track_pids(op_tids)
         # Within the chosen track(s), "X" spans can still NEST; account
         # EXCLUSIVE (self) time — each span's duration minus its direct
         # children's — via an interval stack per track.
@@ -125,7 +135,7 @@ def summarize(path: str | Path, top: int = 25) -> dict:
             if dev and e.get("pid") not in dev:
                 continue
             key = (e.get("pid"), e.get("tid"))
-            if op_tids and key not in op_tids:
+            if e.get("pid") in op_pids and key not in op_tids:
                 continue  # module/step wrapper tracks re-count op time
             name = e.get("name", "?")
             # host-side python frames ("$file.py:123 fn") leak into traces
@@ -152,7 +162,12 @@ def summarize(path: str | Path, top: int = 25) -> dict:
                 while stack and stack[-1][0] <= ts:
                     stack.pop()
                 if stack:
-                    selfs[stack[-1][1]] -= dur  # child time is not self time
+                    # child time is not self time — but only the part
+                    # INSIDE the parent: a malformed span that starts in
+                    # the parent and ends after it must not charge its
+                    # overhang against the parent's self time.
+                    overlap = min(ts + dur, stack[-1][0]) - ts
+                    selfs[stack[-1][1]] -= max(overlap, 0.0)
                 stack.append([ts + dur, i])
             for (_ts, _dur, name), sd in zip(evs, selfs):
                 sd = max(sd, 0.0)

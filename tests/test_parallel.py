@@ -316,6 +316,44 @@ class TestPipeline:
             np.testing.assert_allclose(np.asarray(g_pipe[k]), np.asarray(g_ref[k]),
                                        atol=1e-4, rtol=1e-4)
 
+    def test_head_with_collective_raises_at_trace_time(self):
+        """A user loss_fn containing a collective deadlocks the mesh at
+        runtime (the head runs under a per-device-varying lax.cond), so
+        head_grad_branches must refuse it at trace time with a clear
+        error — not hang (ADVICE r4 #1)."""
+        from tpudist.parallel.pipeline import head_grad_branches
+
+        def bad_loss(out_p, a, aux):
+            return jax.lax.pmean(jnp.sum(a @ out_p["w"]), "stage")
+
+        head, _ = head_grad_branches(bad_loss)
+        args = ({"w": jnp.ones((4, 4))}, jnp.ones((2, 4)), jnp.zeros((2,)))
+
+        def run(a):
+            return head((a[0], a[1], a[2]))
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("stage",))
+        with pytest.raises(ValueError, match="collective"):
+            jax.eval_shape(
+                jax.shard_map(run, mesh=mesh,
+                          in_specs=P(), out_specs=P(),
+                          check_vma=False),
+                args)
+
+    def test_head_collective_free_loss_passes(self):
+        """The trace-time guard must not reject a legal (collective-free)
+        loss_fn."""
+        from tpudist.parallel.pipeline import head_grad_branches
+
+        def ok_loss(out_p, a, aux):
+            return jnp.sum((a @ out_p["w"]) ** 2)
+
+        head, head_zeros = head_grad_branches(ok_loss)
+        args = ({"w": jnp.ones((4, 4))}, jnp.ones((2, 4)), jnp.zeros((2,)))
+        loss_and_grads = head(args)
+        z = head_zeros(args)
+        assert jax.tree.structure(loss_and_grads) == jax.tree.structure(z)
+
 
 def _expert_fn(params, tokens):
     return jax.nn.relu(tokens @ params["w"]) @ params["wo"]

@@ -38,6 +38,31 @@ from tpudist.runtime.mesh import AXIS_STAGE
 StageFn = Callable[[dict, jax.Array], jax.Array]
 
 
+# Substring match: this JAX lowers pmean/psum to `psum_invariant`, and
+# names have shifted across versions (psum/psum2/psum_invariant), so
+# matching exact names would silently stop detecting anything on upgrade.
+_COLLECTIVE_PRIM_SUBSTRINGS = (
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter", "pgather",
+)
+
+
+def _collectives_in_jaxpr(jaxpr, found: set) -> None:
+    """Recursively collect collective primitive names in ``jaxpr``
+    (descending into call/scan/cond sub-jaxprs via eqn params)."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if any(s in name for s in _COLLECTIVE_PRIM_SUBSTRINGS):
+            found.add(name)
+        for v in eqn.params.values():
+            for cand in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(cand, "jaxpr", None)
+                if inner is not None:
+                    _collectives_in_jaxpr(inner, found)
+                elif hasattr(cand, "eqns"):
+                    _collectives_in_jaxpr(cand, found)
+
+
 def head_grad_branches(loss_fn):
     """``(head, head_zeros)`` cond branches for the vocab head: value and
     grad of ``loss_fn(out_params, activation, aux)`` vs shape-matched
@@ -50,9 +75,39 @@ def head_grad_branches(loss_fn):
     by a subset of the mesh and deadlock at runtime (``check_vma=False``
     on the wrapping shard_maps means nothing catches it at trace time).
     Reduce over the data axis AFTER the pipeline call, as
-    ``pipeline_1f1b_shard``'s ``data_axis`` handling does."""
+    ``pipeline_1f1b_shard``'s ``data_axis`` handling does.
+
+    The contract is ENFORCED at trace time: the first trace of ``head``
+    scans ``loss_fn``'s jaxpr for collective primitives and raises
+    ``ValueError`` naming them — without this, a user loss containing a
+    ``pmean`` would hang the whole mesh at runtime with no diagnostic."""
+    _checked = []  # once per head_grad_branches() instance
+
+    def _assert_collective_free(args):
+        def vg(a):
+            return jax.value_and_grad(loss_fn, argnums=(0, 1))(*a)
+
+        try:
+            jaxpr = jax.make_jaxpr(vg)(args).jaxpr
+        except Exception:
+            return  # never let the guard break a traceable loss_fn
+        found: set = set()
+        _collectives_in_jaxpr(jaxpr, found)
+        if found:
+            raise ValueError(
+                "head_grad_branches: loss_fn contains collective "
+                f"primitive(s) {sorted(found)}. The vocab head runs inside "
+                "a lax.cond whose predicate varies per device, so a "
+                "collective here is executed by only a subset of the mesh "
+                "and deadlocks at runtime. Make loss_fn collective-free "
+                "and reduce over the data axis AFTER the pipeline call "
+                "(see pipeline_1f1b_shard's data_axis handling)."
+            )
 
     def head(args):
+        if not _checked:
+            _assert_collective_free(args)
+            _checked.append(True)
         out_p, a_out, aux_m = args
         return jax.value_and_grad(loss_fn, argnums=(0, 1))(
             out_p, a_out, aux_m)
