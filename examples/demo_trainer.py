@@ -19,13 +19,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import jax  # noqa: E402
 import optax  # noqa: E402
 
+import numpy as np  # noqa: E402
+
 from common import build_loader  # noqa: E402
 
 from tpudist.config import build_parser, get_args as parse_args  # noqa: E402
 from tpudist.comm.collectives import MetricBackend  # noqa: E402
-from tpudist.models import create_toy_model  # noqa: E402
+from tpudist.models import create_toy_model, create_transformer  # noqa: E402
 from tpudist.runtime import initialize, resolve_shared_seed  # noqa: E402
-from tpudist.trainer import Trainer, TrainerModule  # noqa: E402
+from tpudist.trainer import LMTrainerModule, Trainer, TrainerModule  # noqa: E402
 from tpudist.utils.record import record  # noqa: E402
 
 
@@ -43,11 +45,63 @@ class ToyTrainerModule(TrainerModule):
         return {"model_X": optax.adam(1e-3), "model_Y": optax.adam(1e-3)}
 
 
+class ChainLMModule(LMTrainerModule):
+    """Small TransformerLM on the increment-chain task — the module the
+    transformer strategies (fsdp / zero1 / pp) drive through the facade."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def configure_lm(self, rng):
+        a = self.args
+        return create_transformer(
+            rng, seq_len=a.seq_len, vocab=a.vocab, d_model=a.d_model,
+            n_layers=a.n_layers, n_heads=2, d_ff=4 * a.d_model,
+            max_len=a.seq_len)
+
+    def configure_optimizers(self):
+        return optax.adam(self.args.lr)
+
+
+class ChainLoader:
+    """Deterministic increment-chain token batches (set_epoch reshuffles
+    the chain starts — the DistributedSampler semantics)."""
+
+    def __init__(self, *, batch, seq, vocab, batches_per_epoch=16, seed=0):
+        self.batch, self.seq, self.vocab = batch, seq, vocab
+        self.n, self.seed, self.epoch = batches_per_epoch, seed, 0
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.n
+
+    def __iter__(self):
+        rng = np.random.default_rng((self.seed, self.epoch))
+        for _ in range(self.n):
+            start = rng.integers(0, self.vocab, size=(self.batch, 1))
+            ramp = np.arange(self.seq, dtype=np.int64)[None, :]
+            yield ((start + ramp) % self.vocab).astype(np.int32)
+
+
 def get_args(argv=None):
     p = build_parser()
     p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
                    help="bf16 = fp32 master weights, bf16 compute "
                         "(the Lightning precision= analog)")
+    p.add_argument("--strategy", default="dp",
+                   choices=["dp", "dp_model", "fsdp", "zero1", "pp"],
+                   help="the Lightning strategy= analog, opened to the "
+                        "full layout set (fsdp/zero1/pp run the LM module)")
+    p.add_argument("--stages", default=2, type=int,
+                   help="pipeline stage count (strategy=pp)")
+    p.add_argument("--pp_schedule", default="1f1b",
+                   choices=["gpipe", "1f1b", "interleaved"])
+    p.add_argument("--seq_len", default=32, type=int)
+    p.add_argument("--vocab", default=32, type=int)
+    p.add_argument("--d_model", default=64, type=int)
+    p.add_argument("--n_layers", default=4, type=int)
     p.set_defaults(batch_size=128)  # lightning variant: batch 128 (:50)
     return parse_args(argv, parser=p)
 
@@ -61,8 +115,10 @@ def main() -> None:
     args.seed = resolve_shared_seed(args.seed)
     trainer = Trainer(
         max_steps=args.total_iterations,
-        strategy="dp",
+        strategy=args.strategy,
         precision=args.precision,
+        pipeline_stages=args.stages,
+        pp_schedule=args.pp_schedule,
         log_every=args.log_every,
         metric_backend=MetricBackend(args.backend),
         project=args.project,
@@ -74,10 +130,17 @@ def main() -> None:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
     )
-    module = ToyTrainerModule()
-    loader = build_loader(args, seed=args.seed)
-    losses = trainer.fit(module, loader)
-    loader.close()
+    if args.strategy in ("fsdp", "zero1", "pp"):
+        # transformer strategies: the LM module on the chain task
+        module = ChainLMModule(args)
+        loader = ChainLoader(batch=args.batch_size, seq=args.seq_len,
+                             vocab=args.vocab, seed=args.seed)
+        losses = trainer.fit(module, loader)
+    else:
+        module = ToyTrainerModule()
+        loader = build_loader(args, seed=args.seed)
+        losses = trainer.fit(module, loader)
+        loader.close()
     print(f"final losses: {losses}")
     trainer.teardown()
 

@@ -16,6 +16,7 @@ path while preserving per-iteration semantics at the default cadence of 1.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Callable, Dict, Optional
 
@@ -67,6 +68,60 @@ def _preemption_check() -> bool:
     from tpudist.runtime import preemption
 
     return preemption.check_all()
+
+
+@contextlib.contextmanager
+def preemption_scope(enabled: bool):
+    """Per-run preemption bracket, shared by every training loop (the
+    per-step and scanned paths here, the Trainer's LM loop): clear the
+    sticky per-run record unconditionally — a later run without
+    checkpointing must not inherit an earlier run's preempted status —
+    install the SIGTERM handler when ``enabled``, and ALWAYS restore the
+    process-wide handler on exit (a library must not leave one behind)."""
+    from tpudist.runtime import preemption
+
+    preemption.clear_last_run_preempted()
+    installed = False
+    if enabled:
+        try:
+            installed = preemption.install()
+        except ValueError:
+            pass  # not the main thread — caller owns signal handling
+    try:
+        yield
+    finally:
+        if installed:
+            preemption.reset()
+
+
+def finalize_run(states, *, iteration, epoch, preempted, ckpt, logger,
+                 flush=None) -> None:
+    """The run-teardown ordering CONTRACT (shared by every loop; parity
+    with demo.py:130-136 — metrics finish before the end barrier):
+
+    1. final checkpoint save — forced on preemption, because the boundary
+       may coincide with a cadence save whose meta lacks the stamp;
+    2. sticky preempted note (survives the handler reset in
+       :func:`preemption_scope` — callers must be able to tell a
+       partially-trained early exit from a completed run);
+    3. queued metric rows flushed (``flush``), then ``logger.finish()``;
+    4. the end-of-training barrier.
+    """
+    if ckpt is not None:
+        ckpt.save(iteration, states,
+                  {"iteration": iteration, "epoch": epoch,
+                   **({"preempted": True} if preempted else {})},
+                  force=preempted)
+        ckpt.wait_until_finished()
+    if preempted:
+        from tpudist.runtime import preemption
+
+        preemption.note_run_preempted()
+    if flush is not None:
+        flush()
+    if logger is not None:
+        logger.finish()
+    barrier("end_of_training")
 
 
 def _make_pbar(config: TrainLoopConfig, initial: int = 0):
@@ -149,28 +204,10 @@ def run_training(
     Numerics and log rows are identical to the per-step path.
     """
     config = config or TrainLoopConfig()
-    from tpudist.runtime import preemption
-
-    # Per-run record, cleared UNCONDITIONALLY: a later run without
-    # checkpointing must not inherit an earlier run's preempted status.
-    preemption.clear_last_run_preempted()
-    installed_here = False
-    if config.preempt_save and ckpt is not None:
-        try:
-            installed_here = preemption.install()
-        except ValueError:
-            pass  # not the main thread — caller owns signal handling
-    try:
+    with preemption_scope(config.preempt_save and ckpt is not None):
         return _dispatch_training(
             states, step_fn, loader, mesh, logger, config,
             ckpt, start_iteration, chunk_step_fn)
-    finally:
-        if installed_here:
-            # SIGTERM must terminate the process again after training —
-            # a library must not leave a process-wide handler behind.
-            from tpudist.runtime import preemption
-
-            preemption.reset()
 
 
 def _dispatch_training(states, step_fn, loader, mesh, logger, config,
@@ -230,26 +267,9 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
 
     if pbar is not None:
         pbar.close()
-    if ckpt is not None:
-        # force on preemption: the boundary may coincide with a cadence
-        # save whose meta lacks the preempted stamp.
-        ckpt.save(iteration, states,
-                  {"iteration": iteration, "epoch": epoch,
-                   **({"preempted": True} if preempted else {})},
-                  force=preempted)
-        ckpt.wait_until_finished()
-    if preempted:
-        # Sticky, surviving the handler reset below: callers must be able
-        # to tell a partially-trained early exit from a completed run.
-        from tpudist.runtime import preemption
-
-        preemption.note_run_preempted()
-    # Teardown ordering parity (demo.py:130-136): metrics first, then barrier.
-    if deferred is not None:
-        deferred.flush()
-    if logger is not None:
-        logger.finish()
-    barrier("end_of_training")
+    finalize_run(states, iteration=iteration, epoch=epoch,
+                 preempted=preempted, ckpt=ckpt, logger=logger,
+                 flush=deferred.flush if deferred is not None else None)
     final_losses = (
         {k: float(jax.device_get(v)) for k, v in last_losses.items()}
         if last_losses is not None
@@ -347,24 +367,11 @@ def _run_scanned(
 
     if pbar is not None:
         pbar.close()
-    if ckpt is not None:
-        # force on preemption: the boundary may coincide with a cadence
-        # save whose meta lacks the preempted stamp.
-        ckpt.save(iteration, states,
-                  {"iteration": iteration, "epoch": epoch,
-                   **({"preempted": True} if preempted else {})},
-                  force=preempted)
-        ckpt.wait_until_finished()
-    if preempted:
-        # Sticky, surviving the handler reset below: callers must be able
-        # to tell a partially-trained early exit from a completed run.
-        from tpudist.runtime import preemption
-
-        preemption.note_run_preempted()
-    if logger is not None:
-        _flush_scanned(pending_losses, logger, config)
-        logger.finish()
-    barrier("end_of_training")
+    finalize_run(states, iteration=iteration, epoch=epoch,
+                 preempted=preempted, ckpt=ckpt, logger=logger,
+                 flush=(lambda: _flush_scanned(pending_losses, logger,
+                                               config))
+                 if logger is not None else None)
     final_losses = {}
     if last_losses is not None:
         fetched = jax.device_get(last_losses)

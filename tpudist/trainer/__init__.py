@@ -1,4 +1,8 @@
 """High-level Trainer facade (Lightning-equivalent, parity with
 ``demo_pytorch_lightning.py``)."""
 
-from tpudist.trainer.trainer import Trainer, TrainerModule  # noqa: F401
+from tpudist.trainer.trainer import (  # noqa: F401
+    LMTrainerModule,
+    Trainer,
+    TrainerModule,
+)
