@@ -190,8 +190,15 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
              n_layers: int, n_heads: int, d_ff: int, vocab: int = 256,
              steps: int = 5, precision: str = "fp32",
              remat: bool = False, remat_policy: str = "nothing",
+             repeats: int = 1,
              profile_dir: str | None = None) -> dict:
     """Time the TransformerLM train step and report tokens/sec/chip + MFU.
+
+    ``repeats`` > 1 re-times the ``steps``-long loop that many times on
+    the ONE compiled executable and reports the MEDIAN run as the row's
+    headline (plus ``step_ms_runs`` with every sample) — the band
+    methodology of ``benchmarks/bands.py``: one compile, N timings, so
+    the band is execution/tunnel noise, not compile variance.
 
     ``profile_dir``: capture a ``jax.profiler`` trace of the timed steps
     (the per-op breakdown behind the MFU number — BASELINE.md records the
@@ -236,11 +243,16 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
     else:
         profiling = contextlib.nullcontext()
     with profiling:
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss = step(state, tokens)
-        _sync(loss)
-        step_s = (time.perf_counter() - t0) / steps
+        step_runs = []
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, loss = step(state, tokens)
+            _sync(loss)
+            step_runs.append((time.perf_counter() - t0) / steps)
+        import statistics as _stats
+
+        step_s = _stats.median(step_runs)
 
     flops = transformer_train_flops(
         batch=batch, seq_len=seq_len, d_model=d_model, n_layers=n_layers,
@@ -260,6 +272,8 @@ def bench_lm(*, name: str, batch: int, seq_len: int, d_model: int,
                    "remat": remat,
                    "remat_policy": remat_policy if remat else None},
         "model_flops_per_step": flops,
+        **({"step_ms_runs": [round(s * 1e3, 2) for s in step_runs]}
+           if len(step_runs) > 1 else {}),
         # Always against the bf16 MXU peak (the chip's one headline number)
         # so fp32 and bf16 rows share a denominator: an fp32 row's value is
         # "fraction of the chip's best case", not utilization of some fp32

@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Multi-run measurement bands for the headline bench rows (VERDICT r4
+next #4: single tunnel-noisy runs were being narrated as stable facts).
+
+Methodology, per row family (stated per row in the artifact):
+
+- per-step LM rows: ONE ``bench_lm`` invocation with ``repeats=N`` —
+  one compile, N raw timings of the 5-step loop on the same executable,
+  so the band is execution/tunnel noise, not compile variance;
+- scanned rows: N invocations of ``bench_lm_scanned`` with its default
+  min-of-3 statistic — the scan path's published number.  Its band is a
+  band of MINIMA and therefore tighter by construction than the raw
+  per-step bands; the artifact labels it so the two families are never
+  read as the same statistic;
+- decode rows: N invocations of ``bench_decode`` (its published
+  best-of-3-gens statistic), labeled likewise.
+
+Each invocation APPENDS a session to ``BANDS_r05.json`` and re-pools
+all sessions per row (median + [min, max] over every sample) — a later
+healthy tunnel window adds evidence instead of overwriting it.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def _band(values):
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return {"runs": list(values), "median": None, "min": None,
+                "max": None}
+    return {"runs": list(values), "median": statistics.median(vals),
+            "min": min(vals), "max": max(vals)}
+
+
+def lm_rows(repeats: int, **cfg) -> dict:
+    """One compile, ``repeats`` raw timings (bench_lm's repeats param)."""
+    import jax
+
+    row = bench.bench_lm(steps=5, repeats=repeats, **cfg)
+    c = row["config"]
+    peak = row.get("peak_bf16_flops_per_chip")
+    n_chips = jax.local_device_count()  # bench_lm's own per-chip divisor
+    toks, mfus = [], []
+    for ms in row.get("step_ms_runs", [row["step_ms"]]):
+        toks.append(round(c["batch"] * c["seq_len"] / (ms / 1e3)
+                          / n_chips, 1))
+        mfus.append(round(100 * row["model_flops_per_step"]
+                          / (ms / 1e3) / (n_chips * peak), 2)
+                    if peak else None)
+    return {"statistic": "raw 5-step timings, one shared compile",
+            "config": c,
+            "tokens_per_sec_per_chip_runs": toks,
+            "mfu_pct_vs_bf16_peak_runs": mfus}
+
+
+def pool(sessions) -> dict:
+    """Per-row bands over every session's samples."""
+    merged: dict = {}
+    for s in sessions:
+        for name, row in s.get("rows", {}).items():
+            if "error" in row:
+                continue
+            slot = merged.setdefault(
+                name, {"statistic": row.get("statistic"),
+                       "config": row.get("config"), "samples": {}})
+            for key, vals in row.items():
+                if key.endswith("_runs"):
+                    slot["samples"].setdefault(key[:-5], []).extend(vals)
+    pooled = {
+        name: {"statistic": slot["statistic"], "config": slot["config"],
+               **{k: _band(v) for k, v in slot["samples"].items()}}
+        for name, slot in merged.items()
+    }
+    # Decode rows carry a pooled roofline percentage (the ceiling is
+    # deterministic per config, so it belongs next to the pooled median,
+    # not only inside per-session medians).
+    for row in pooled.values():
+        cfg = row.get("config") or {}
+        band = row.get("tokens_per_sec")
+        if band and band["median"] and {"prompt_len", "max_new"} <= set(cfg):
+            from tpudist.utils.flops import decode_roofline
+
+            roof = decode_roofline(
+                batch=cfg["batch"], prompt_len=cfg["prompt_len"],
+                max_new=cfg["max_new"], d_model=cfg["d_model"],
+                n_layers=cfg["n_layers"], d_ff=cfg["d_ff"],
+                vocab=cfg["vocab"], param_bytes=4, cache_bytes=4)
+            if roof:
+                row["pct_of_roofline_pooled_median"] = round(
+                    100 * band["median"]
+                    / roof["ceiling_tokens_per_sec"], 1)
+    return pooled
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--out", default=str(REPO / "BANDS_r05.json"))
+    p.add_argument("--configs", default="dense,long,d1024_b8,d1024_b16,"
+                                        "scanned_dense,scanned_d1024,decode")
+    p.add_argument("--session", default=None,
+                   help="label for this session (default: seq number)")
+    args = p.parse_args(argv)
+    want = set(args.configs.split(","))
+
+    out_path = Path(args.out)
+    try:
+        artifact = json.loads(out_path.read_text())
+        assert "sessions" in artifact
+    except Exception:
+        artifact = {"sessions": [], "pooled": {}}
+
+    import jax
+
+    session = {"label": args.session or f"s{len(artifact['sessions']) + 1}",
+               "device_kind": jax.devices()[0].device_kind,
+               "repeats": args.repeats, "rows": {}}
+    artifact["sessions"].append(session)
+
+    def run(name, fn):
+        if name not in want:
+            return
+        t0 = time.perf_counter()
+        try:
+            session["rows"][name] = fn()
+        except Exception as e:  # a wedged section must not void the rest
+            session["rows"][name] = {"error": repr(e)}
+        session["rows"][name]["wall_s"] = round(time.perf_counter() - t0, 1)
+        artifact["pooled"] = pool(artifact["sessions"])
+        print(json.dumps({name: session["rows"][name]}), flush=True)
+        out_path.write_text(json.dumps(artifact, indent=2) + "\n")
+
+    run("dense", lambda: lm_rows(
+        args.repeats, name="dense_bf16", batch=8, seq_len=2048, d_model=512,
+        n_layers=4, n_heads=8, d_ff=2048, vocab=256, precision="bf16"))
+    run("long", lambda: lm_rows(
+        args.repeats, name="long_context_bf16", batch=4, seq_len=8192,
+        d_model=256, n_layers=4, n_heads=4, d_ff=1024, vocab=256,
+        precision="bf16"))
+    run("d1024_b8", lambda: lm_rows(
+        args.repeats, name="mfu_d1024_bf16", batch=8, seq_len=2048,
+        d_model=1024, n_layers=8, n_heads=8, d_ff=4096, vocab=256,
+        precision="bf16"))
+    run("d1024_b16", lambda: lm_rows(
+        args.repeats, name="mfu_d1024_bf16_b16", batch=16, seq_len=2048,
+        d_model=1024, n_layers=8, n_heads=8, d_ff=4096, vocab=256,
+        precision="bf16"))
+
+    def scanned(name, **cfg):
+        rows = [bench.bench_lm_scanned(name=name, skip_plain=True, **cfg)
+                for _ in range(args.repeats)]
+        return {"statistic": ("min-of-3 per sample (the scan path's "
+                              "published statistic) — tighter than the "
+                              "raw per-step bands by construction"),
+                "config": rows[0]["config"],
+                "mfu_pct_vs_bf16_peak_runs":
+                    [r["mfu_pct_vs_bf16_peak"] for r in rows]}
+
+    run("scanned_dense", lambda: scanned(
+        "dense_bf16_scanned", batch=8, seq_len=2048, d_model=512,
+        n_layers=4, n_heads=8, d_ff=2048, vocab=256, scan_k=8))
+    run("scanned_d1024", lambda: scanned(
+        "mfu_d1024_bf16_b16_scanned", batch=16, seq_len=2048, d_model=1024,
+        n_layers=8, n_heads=8, d_ff=4096, vocab=256, scan_k=4))
+
+    def decode():
+        rows = [bench.bench_decode() for _ in range(args.repeats)]
+        roof = rows[0].get("roofline")
+        vals = [r["value"] for r in rows]
+        med = statistics.median(vals)
+        return {"statistic": "best-of-3 internal gens per sample "
+                             "(bench_decode's published statistic)",
+                "config": rows[0]["config"],
+                "tokens_per_sec_runs": vals,
+                "pct_of_roofline_median": round(
+                    100 * med / roof["ceiling_tokens_per_sec"], 1)
+                if roof else None}
+
+    run("decode", decode)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
