@@ -66,6 +66,8 @@ def lm_rows(repeats: int, **cfg) -> dict:
 
 def pool(sessions) -> dict:
     """Per-row bands over every session's samples."""
+    device_kind = next((s["device_kind"] for s in sessions
+                        if s.get("device_kind")), None)
     merged: dict = {}
     for s in sessions:
         for name, row in s.get("rows", {}).items():
@@ -89,13 +91,17 @@ def pool(sessions) -> dict:
         cfg = row.get("config") or {}
         band = row.get("tokens_per_sec")
         if band and band["median"] and {"prompt_len", "max_new"} <= set(cfg):
-            from tpudist.utils.flops import decode_roofline
+            from tpudist.utils.flops import HBM_BYTES_PER_S, decode_roofline
 
+            nbytes = 2 if cfg.get("precision") == "bf16" else 4
             roof = decode_roofline(
                 batch=cfg["batch"], prompt_len=cfg["prompt_len"],
                 max_new=cfg["max_new"], d_model=cfg["d_model"],
                 n_layers=cfg["n_layers"], d_ff=cfg["d_ff"],
-                vocab=cfg["vocab"], param_bytes=4, cache_bytes=4)
+                vocab=cfg["vocab"], param_bytes=nbytes, cache_bytes=nbytes,
+                # the sessions' chip, not the pooling host's (pooling may
+                # run on a CPU box over TPU-measured sessions)
+                hbm_bytes_per_s=HBM_BYTES_PER_S.get(device_kind))
             if roof:
                 row["pct_of_roofline_pooled_median"] = round(
                     100 * band["median"]
@@ -110,18 +116,35 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--out", default=str(REPO / "BANDS_r05.json"))
     p.add_argument("--configs", default="dense,long,d1024_b8,d1024_b16,"
-                                        "scanned_dense,scanned_d1024,decode")
+                                        "scanned_dense,scanned_d1024,decode,"
+                                        "decode_bf16")
     p.add_argument("--session", default=None,
                    help="label for this session (default: seq number)")
     args = p.parse_args(argv)
     want = set(args.configs.split(","))
 
     out_path = Path(args.out)
-    try:
-        artifact = json.loads(out_path.read_text())
-        assert "sessions" in artifact
-    except Exception:
+    if out_path.exists():
+        try:
+            artifact = json.loads(out_path.read_text())
+            assert "sessions" in artifact
+        except Exception:
+            # NEVER silently reset accumulated band history: back the
+            # unparseable file up and start fresh, loudly.
+            backup = out_path.with_suffix(".corrupt")
+            out_path.replace(backup)
+            print(json.dumps({"warning": f"unparseable {out_path.name} "
+                              f"moved to {backup.name}; starting a fresh "
+                              "artifact"}), flush=True)
+            artifact = {"sessions": [], "pooled": {}}
+    else:
         artifact = {"sessions": [], "pooled": {}}
+
+    def write_artifact():
+        # atomic: a kill mid-write must not truncate the accumulated file
+        tmp = out_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(artifact, indent=2) + "\n")
+        tmp.replace(out_path)
 
     import jax
 
@@ -141,7 +164,7 @@ def main(argv=None) -> int:
         session["rows"][name]["wall_s"] = round(time.perf_counter() - t0, 1)
         artifact["pooled"] = pool(artifact["sessions"])
         print(json.dumps({name: session["rows"][name]}), flush=True)
-        out_path.write_text(json.dumps(artifact, indent=2) + "\n")
+        write_artifact()
 
     run("dense", lambda: lm_rows(
         args.repeats, name="dense_bf16", batch=8, seq_len=2048, d_model=512,
@@ -176,20 +199,26 @@ def main(argv=None) -> int:
         "mfu_d1024_bf16_b16_scanned", batch=16, seq_len=2048, d_model=1024,
         n_layers=8, n_heads=8, d_ff=4096, vocab=256, scan_k=4))
 
-    def decode():
-        rows = [bench.bench_decode() for _ in range(args.repeats)]
+    def decode(precision="fp32"):
+        rows = [bench.bench_decode(precision=precision)
+                for _ in range(args.repeats)]
         roof = rows[0].get("roofline")
         vals = [r["value"] for r in rows]
         med = statistics.median(vals)
         return {"statistic": "best-of-3 internal gens per sample "
-                             "(bench_decode's published statistic)",
+                             "(bench_decode's published statistic); "
+                             "device runs are traced busy-time rates",
                 "config": rows[0]["config"],
                 "tokens_per_sec_runs": vals,
+                "tokens_per_sec_device_runs":
+                    [r.get("tokens_per_sec_device") for r in rows],
                 "pct_of_roofline_median": round(
                     100 * med / roof["ceiling_tokens_per_sec"], 1)
                 if roof else None}
 
     run("decode", decode)
+    run("decode_bf16", lambda: decode(precision="bf16"))
+    write_artifact()  # even a zero-row session leaves a valid artifact
     return 0
 
 

@@ -43,6 +43,53 @@ class TestScalingMultiproc:
         assert 0 < rungs[2]["contention_corrected_efficiency"] <= 1.5
 
 
+class TestBands:
+    def test_pool_merges_sessions_and_computes_decode_roofline(self):
+        from benchmarks.bands import pool
+
+        sessions = [
+            {"device_kind": "TPU v5 lite",
+             "rows": {"dense": {
+                "statistic": "raw", "config": {"batch": 8},
+                "mfu_pct_vs_bf16_peak_runs": [20.0, 22.0]}}},
+            {"rows": {"dense": {
+                "statistic": "raw", "config": {"batch": 8},
+                "mfu_pct_vs_bf16_peak_runs": [24.0]},
+                "bad": {"error": "boom"},
+                "decode": {
+                    "statistic": "best-of-3", "config": {
+                        "batch": 8, "prompt_len": 16, "max_new": 240,
+                        "d_model": 512, "n_layers": 4, "d_ff": 2048,
+                        "vocab": 256, "precision": "bf16"},
+                    "tokens_per_sec_runs": [40000.0, 50000.0, None]}}},
+        ]
+        pooled = pool(sessions)
+        band = pooled["dense"]["mfu_pct_vs_bf16_peak"]
+        assert band["runs"] == [20.0, 22.0, 24.0]
+        assert band["median"] == 22.0
+        assert "bad" not in pooled  # errored rows never pollute the pool
+        dec = pooled["decode"]
+        # bf16 precision -> 2-byte roofline (ceiling ~187.7k on v5e)
+        assert dec["pct_of_roofline_pooled_median"] == pytest.approx(
+            100 * 45000.0 / 187747.6, abs=0.1)
+
+    def test_corrupt_artifact_backed_up_not_reset(self, tmp_path):
+        """A truncated artifact must be preserved as .corrupt, never
+        silently overwritten (accumulated band history is evidence)."""
+        from benchmarks.bands import main
+
+        out = tmp_path / "BANDS.json"
+        out.write_text('{"sessions": [{"label": "old"')  # truncated
+        rc = main(["--configs", "none", "--out", str(out),
+                   "--session", "t"])
+        assert rc == 0
+        assert (tmp_path / "BANDS.corrupt").exists()
+        import json as _json
+
+        fresh = _json.loads(out.read_text())
+        assert [s["label"] for s in fresh["sessions"]] == ["t"]
+
+
 class TestLossParity:
     def test_all_entry_points_match(self):
         from benchmarks.loss_parity import main

@@ -36,6 +36,22 @@ class TestDecodeConsistency:
                                    np.asarray(full.astype(jnp.float32)),
                                    atol=1e-4, rtol=1e-4)
 
+    def test_cache_matches_full_forward_bf16_stored_weights(self):
+        """The serving configuration (weights STORED bf16 + bf16 KV
+        cache — bench.py's lm_decode_bf16 row): the cached path must
+        track the full forward within the bf16 numerics band."""
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, dtype=jnp.bfloat16, **CFG)
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+        tokens = _tokens(batch=3, seq=16)
+        full = module.apply(params, tokens)
+        cached = decode_logits(module, params, tokens)
+        np.testing.assert_allclose(np.asarray(cached),
+                                   np.asarray(full.astype(jnp.float32)),
+                                   atol=0.15, rtol=0.1)
+
     @pytest.mark.parametrize("rope", [False, True])
     def test_sliding_window_cache_matches_full_forward(self, rope):
         """Windowed model: the decode cache's band mask must reproduce the
