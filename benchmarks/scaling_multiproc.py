@@ -31,7 +31,12 @@ Per-rung overhead split (slowest-rank times, per iteration):
   metric_ms  host-fabric (gloo) scalar loss all-reduce (demo.py:84's
              second-fabric analog)
 
-Writes ``SCALING_r05.json`` and prints one JSON line per rung.
+Writes the detailed artifact to ``SCALING_MULTIPROC_r{NN}.json`` (NN =
+the round being built).  Per-rung progress goes to STDERR as each rung
+finishes; STDOUT carries only the final enriched rows (with the
+efficiency columns) plus the summary — that is what
+``benchmarks/round_snapshot.py`` merges into ``SCALING_r{NN}.json``
+next to the virtual-cpu regime.
 """
 
 from __future__ import annotations
@@ -208,6 +213,7 @@ def run_rung(n_procs: int, *, iters: int, batch_per_proc: int) -> dict:
     agg = n_procs * batch_per_proc / (worst["e2e_ms"] / 1e3)
     agg_step_only = n_procs * batch_per_proc / (worst["step_ms"] / 1e3)
     return {
+        "regime": "multiprocess-cpu",
         "n_procs": n_procs,
         "iters": iters,
         "batch_per_proc": batch_per_proc,
@@ -225,7 +231,18 @@ def main(argv=None) -> int:
     p.add_argument("--n-procs", default="1,2,4")
     p.add_argument("--iters", type=int, default=64)
     p.add_argument("--batch-per-proc", type=int, default=256)
-    p.add_argument("--out", default=str(REPO / "SCALING_r05.json"))
+    # Detailed artifact (columns doc + interpretation).  The round
+    # snapshot merges this harness's rung LINES into SCALING_r{NN}.json
+    # next to the virtual-cpu regime (benchmarks/round_snapshot.py).
+    # Default round = the one being built (same detection as the
+    # snapshotter), so a standalone run never clobbers a frozen round.
+    import re
+
+    rounds = [int(m.group(1)) for pth in REPO.glob("BENCH_r*.json")
+              if (m := re.match(r"BENCH_r(\d+)\.json", pth.name))]
+    rnd = (max(rounds) + 1) if rounds else 1
+    p.add_argument("--out",
+                   default=str(REPO / f"SCALING_MULTIPROC_r{rnd:02d}.json"))
     args = p.parse_args(argv)
 
     cores = os.cpu_count() or 1
@@ -233,7 +250,10 @@ def main(argv=None) -> int:
     for n in [int(x) for x in args.n_procs.split(",")]:
         r = run_rung(n, iters=args.iters, batch_per_proc=args.batch_per_proc)
         rungs.append(r)
-        print(json.dumps(r), flush=True)
+        # progress to stderr; stdout carries only the FINAL enriched rows
+        # (round_snapshot merges stdout lines into SCALING_r{NN}.json,
+        # which must show the corrected-efficiency columns)
+        print(json.dumps(r), file=sys.stderr, flush=True)
 
     ok = [r for r in rungs if "error" not in r]
     base = next((r for r in ok if r["n_procs"] == 1), None)
@@ -290,7 +310,10 @@ def main(argv=None) -> int:
         "rungs": rungs,
     }
     Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+    for r in rungs:
+        print(json.dumps(r), flush=True)
     print(json.dumps({"summary": "multiproc_scaling",
+                      "host_cores": cores,
                       "rungs": [(r["n_procs"],
                                  r.get("contention_corrected_efficiency"))
                                 for r in ok]}), flush=True)
