@@ -23,7 +23,8 @@ emitted collectives against analytic predictions:
 - **gpipe/1f1b/interleaved**  stage-boundary collective-permutes inside
                    the scan loop (per-tick activation hop), not unrolled
 
-Writes ``COMM_AUDIT_r04.json`` and exits nonzero if any check fails.
+Writes ``COMM_AUDIT_r{NN}.json`` (NN = the round being built,
+``benchmarks/_round.py``) and exits nonzero if any check fails.
 This is the no-hardware half of the multi-chip scaling story: the
 collective *structure* is exactly what a pod would execute; only the link
 bandwidths need hardware.  (VERDICT r3 #3; SURVEY.md §2.4.)
@@ -676,8 +677,11 @@ def check_pp(prof, info):
 
 
 def main(argv=None) -> int:
+    from benchmarks._round import current_round  # REPO is on sys.path
+
     p = argparse.ArgumentParser()
-    p.add_argument("--out", default=str(REPO / "COMM_AUDIT_r04.json"))
+    p.add_argument("--out", default=str(
+        REPO / f"COMM_AUDIT_r{current_round():02d}.json"))
     p.add_argument("--only", default=None, help="comma list of regime names")
     p.add_argument("--measure-only", action="store_true",
                    help="print profiles, skip checks")

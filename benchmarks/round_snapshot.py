@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import re
 import subprocess
 import sys
 from pathlib import Path
@@ -52,12 +51,12 @@ mod.main({argv!r})
 
 
 def detect_round() -> int:
-    rounds = [
-        int(m.group(1))
-        for p in REPO.glob("BENCH_r*.json")
-        if (m := re.match(r"BENCH_r(\d+)\.json", p.name))
-    ]
-    return (max(rounds) + 1) if rounds else 1
+    try:
+        from benchmarks._round import current_round
+    except ImportError:
+        from _round import current_round
+
+    return current_round()
 
 
 def run_lines(cmd: list[str], timeout: int) -> list[dict]:

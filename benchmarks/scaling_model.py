@@ -27,7 +27,7 @@ Model (ring all-reduce over one mesh axis): per-chip wire bytes
 2/3 of the 3x-forward train step and is where XLA schedules the grad
 reduce-scatter/all-reduce).
 
-Writes ``SCALING_MODEL_r04.json``.  Every input is recorded in the
+Writes ``SCALING_MODEL_r{NN}.json`` (round auto-detected).  Every input is recorded in the
 artifact so the prediction is checkable the day a pod exists.
 """
 
@@ -202,7 +202,9 @@ def main() -> int:
             "assumed_dcn_host_GBps": DCN_HOST_BYTES_PER_S / 1e9,
             "peak_bf16_tflops": peak / 1e12,
             "measured_from": "BENCH_EXTENDED.json",
-            "audited_by": "COMM_AUDIT_r04.json",
+            "audited_by": (max((p.name for p in
+                                REPO.glob("COMM_AUDIT_r*.json")),
+                               default="COMM_AUDIT (none found)")),
         },
         "dp": [],
         "sp_ring": [],
@@ -265,7 +267,9 @@ def main() -> int:
     out["sp_ring_causal_balance"] = [
         ring_causal_balance_row(r) for r in (2, 4, 8, 16)]
 
-    path = REPO / "SCALING_MODEL_r04.json"
+    from benchmarks._round import current_round  # REPO is on sys.path
+
+    path = REPO / f"SCALING_MODEL_r{current_round():02d}.json"
     path.write_text(json.dumps(out, indent=2) + "\n")
     # Human-readable headline.
     for d in out["dp"]:

@@ -234,15 +234,13 @@ def main(argv=None) -> int:
     # Detailed artifact (columns doc + interpretation).  The round
     # snapshot merges this harness's rung LINES into SCALING_r{NN}.json
     # next to the virtual-cpu regime (benchmarks/round_snapshot.py).
-    # Default round = the one being built (same detection as the
-    # snapshotter), so a standalone run never clobbers a frozen round.
-    import re
+    # Default round = the one being built, so a standalone run never
+    # clobbers a frozen round (benchmarks/_round.py; REPO is on sys.path).
+    from benchmarks._round import current_round
 
-    rounds = [int(m.group(1)) for pth in REPO.glob("BENCH_r*.json")
-              if (m := re.match(r"BENCH_r(\d+)\.json", pth.name))]
-    rnd = (max(rounds) + 1) if rounds else 1
-    p.add_argument("--out",
-                   default=str(REPO / f"SCALING_MULTIPROC_r{rnd:02d}.json"))
+    p.add_argument(
+        "--out",
+        default=str(REPO / f"SCALING_MULTIPROC_r{current_round():02d}.json"))
     args = p.parse_args(argv)
 
     cores = os.cpu_count() or 1
@@ -260,23 +258,25 @@ def main(argv=None) -> int:
     if base:
         for r in ok:
             n = r["n_procs"]
+            # Weak-scaling contention ideal: per-proc work is constant,
+            # so n procs on c cores take base x n/min(n, c) per
+            # iteration (x1 while cores cover the procs, x n/c once
+            # they oversubscribe).  Both overhead columns subtract THIS
+            # ideal — core contention must never be misattributed to
+            # framework/collective overhead.
+            ideal_factor = n / min(n, cores)
             ideal = base["agg_samples_per_sec"] * min(n, cores)
             r["naive_efficiency_vs_1"] = round(
                 r["agg_samples_per_sec"]
                 / (base["agg_samples_per_sec"] * n), 3)
             r["contention_corrected_efficiency"] = round(
                 r["agg_samples_per_sec"] / ideal, 3)
-            # overhead split vs the 1-proc rung, per iteration
             r["boundary_overhead_ms"] = round(
-                r["e2e_ms"] - min(n, cores) / cores * n * base["e2e_ms"]
-                if cores == 1 else r["e2e_ms"] - base["e2e_ms"], 3)
+                r["e2e_ms"] - ideal_factor * base["e2e_ms"], 3)
             # the dominant term, named: the in-step cross-process
-            # collective (contention-ideal step = n/cores x the 1-proc
-            # step when cores < n)
-            ideal_step = (n * base["step_ms"] if cores == 1
-                          else base["step_ms"])
+            # collective
             r["collective_ms_per_step_est"] = round(
-                max(r["step_ms"] - ideal_step, 0.0), 3)
+                max(r["step_ms"] - ideal_factor * base["step_ms"], 0.0), 3)
     out = {
         "regime": "multiprocess-cpu",
         "host_cores": cores,
