@@ -318,11 +318,14 @@ def bench_lm_scanned(*, name: str = "dense_bf16_scanned",
     toks = np.random.default_rng(0).integers(
         0, vocab, size=(scan_k, batch, seq_len)).astype(np.int32)
 
-    # plain: K separate dispatches
+    # plain: K separate dispatches.  BOTH arms donate state — the ladder
+    # rows (bench_lm) donate, and donation is worth ~2% at d1024 (r5
+    # measured 215.6 vs 220.0 ms scanned); a no-donate scanned arm made
+    # the A/B read as a scanned slowdown that was really buffer churn.
     best_plain = float("inf")
     if not skip_plain:
         st = init_lm_state(params, tx)
-        plain = make_lm_train_step(module.apply, tx, mesh, donate_state=False)
+        plain = make_lm_train_step(module.apply, tx, mesh)
         t_p = jax.device_put(toks[0], token_sharding(mesh))
         st, loss = plain(st, t_p)
         _sync(loss)  # compile
@@ -336,8 +339,7 @@ def bench_lm_scanned(*, name: str = "dense_bf16_scanned",
 
     # scanned: one dispatch for K steps
     st2 = init_lm_state(params, tx)
-    chunk = make_scanned_lm_train_step(module.apply, tx, mesh,
-                                       donate_state=False)
+    chunk = make_scanned_lm_train_step(module.apply, tx, mesh)
     t_c = jax.device_put(toks, chunk_token_sharding(mesh))
     st2, losses = chunk(st2, t_c)
     _sync(losses)  # compile

@@ -15,9 +15,10 @@ Methodology, per row family (stated per row in the artifact):
 - decode rows: N invocations of ``bench_decode`` (its published
   best-of-3-gens statistic), labeled likewise.
 
-Each invocation APPENDS a session to ``BANDS_r05.json`` and re-pools
-all sessions per row (median + [min, max] over every sample) — a later
-healthy tunnel window adds evidence instead of overwriting it.
+Each invocation APPENDS a session to ``BANDS_r{NN}.json`` (NN = the
+round being built, ``benchmarks/_round.py``) and re-pools all sessions
+per row (median + [min, max] over every sample) — a later healthy
+tunnel window adds evidence instead of overwriting it.
 """
 
 from __future__ import annotations
@@ -71,7 +72,11 @@ def pool(sessions) -> dict:
     merged: dict = {}
     for s in sessions:
         for name, row in s.get("rows", {}).items():
-            if "error" in row:
+            if "error" in row or "superseded" in row:
+                # superseded: the row's measurement CONFIG changed in a
+                # later session (e.g. the scanned arm's donate_state
+                # fix); raw samples stay in the session record, but the
+                # pooled band must not mix configurations.
                 continue
             slot = merged.setdefault(
                 name, {"statistic": row.get("statistic"),
@@ -114,7 +119,13 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser()
     p.add_argument("--repeats", type=int, default=3)
-    p.add_argument("--out", default=str(REPO / "BANDS_r05.json"))
+    try:
+        from benchmarks._round import current_round
+    except ImportError:
+        from _round import current_round
+
+    p.add_argument("--out", default=str(
+        REPO / f"BANDS_r{current_round():02d}.json"))
     p.add_argument("--configs", default="dense,long,d1024_b8,d1024_b16,"
                                         "scanned_dense,scanned_d1024,decode,"
                                         "decode_bf16")
