@@ -322,9 +322,15 @@ def bench_lm_scanned(*, name: str = "dense_bf16_scanned",
     # rows (bench_lm) donate, and donation is worth ~2% at d1024 (r5
     # measured 215.6 vs 220.0 ms scanned); a no-donate scanned arm made
     # the A/B read as a scanned slowdown that was really buffer churn.
+    # init_lm_state holds `params` BY REFERENCE, and donated steps delete
+    # their input buffers — each arm gets its own copy or the second arm
+    # would run on deleted arrays (TPU: "Array has been deleted").
+    def fresh_state():
+        return init_lm_state(jax.tree.map(lambda a: a.copy(), params), tx)
+
     best_plain = float("inf")
     if not skip_plain:
-        st = init_lm_state(params, tx)
+        st = fresh_state()
         plain = make_lm_train_step(module.apply, tx, mesh)
         t_p = jax.device_put(toks[0], token_sharding(mesh))
         st, loss = plain(st, t_p)
@@ -338,7 +344,7 @@ def bench_lm_scanned(*, name: str = "dense_bf16_scanned",
                              (time.perf_counter() - t0) / scan_k)
 
     # scanned: one dispatch for K steps
-    st2 = init_lm_state(params, tx)
+    st2 = fresh_state()
     chunk = make_scanned_lm_train_step(module.apply, tx, mesh)
     t_c = jax.device_put(toks, chunk_token_sharding(mesh))
     st2, losses = chunk(st2, t_c)

@@ -163,6 +163,8 @@ _SLOW_PATTERNS = (
     "TestTrainerStrategies::test_lm_strategies_loss_parity",
     # real multi-process scaling rung (subprocess rendezvous)
     "TestScalingMultiproc",
+    # LM facade resume chain (three compiled fits)
+    "test_lm_checkpoint_resume_matches_unbroken",
 )
 
 

@@ -376,6 +376,41 @@ class TestTrainerStrategies:
         assert axes(t.final_states.params) == set()       # replicated
         assert "data" in axes(t.final_states.opt_state)   # sharded
 
+    def test_lm_checkpoint_resume_matches_unbroken(self, monkeypatch,
+                                                   tmp_path):
+        """The LM loop's resume contract: fit 6 steps with checkpointing,
+        resume to 12, and land on the same loss as an unbroken 12-step
+        fit (epoch/skip fast-forward through the deterministic loader)."""
+        monkeypatch.chdir(tmp_path)
+        import tpudist.runtime.bootstrap as bs
+
+        bs._INITIALIZED_CTX = None
+        mod = load_example("demo_trainer")
+        from tpudist.trainer import Trainer
+
+        args = mod.get_args([
+            "--dry_run", "--seed", "0", "--batch_size", "16",
+            "--seq_len", "16", "--vocab", "16", "--d_model", "32",
+            "--n_layers", "2",
+        ])
+
+        def loader():
+            return mod.ChainLoader(batch=16, seq=16, vocab=16, seed=0,
+                                   batches_per_epoch=4)
+
+        ck = tmp_path / "ck"
+        common = dict(strategy="dp", dry_run=True, progress_bar=False,
+                      log_every=100, seed=0)
+        t1 = Trainer(max_steps=6, checkpoint_dir=str(ck),
+                     checkpoint_every=3, **common)
+        t1.fit(mod.ChainLMModule(args), loader())
+        t2 = Trainer(max_steps=12, checkpoint_dir=str(ck),
+                     checkpoint_every=3, resume=True, **common)
+        resumed = t2.fit(mod.ChainLMModule(args), loader())
+        t3 = Trainer(max_steps=12, **common)
+        unbroken = t3.fit(mod.ChainLMModule(args), loader())
+        assert resumed["lm"] == pytest.approx(unbroken["lm"], abs=1e-5)
+
     def test_strategy_validation(self, monkeypatch, tmp_path):
         monkeypatch.chdir(tmp_path)
         import tpudist.runtime.bootstrap as bs
