@@ -21,7 +21,8 @@ measured MFU is far below it, the residual is schedulable work — kernel
 quality, fusion, dispatch — NOT a bandwidth wall; the profile trace is
 the tool that names it.  If the ceiling itself is low, the config is
 bandwidth-bound and batch/remat are the levers.  Writes
-``ROOFLINE_r04.json`` and prints one row per rung.
+``ROOFLINE_r{NN}.json`` (round auto-detected; r05 added the decode
+rung) and prints one row per rung.
 """
 
 from __future__ import annotations
@@ -131,10 +132,13 @@ def main(argv=None) -> int:
         print(json.dumps(rows[-1]), flush=True)
     rows.append(decode_row())
     print(json.dumps(rows[-1]), flush=True)
+    from benchmarks._round import current_round  # REPO is on sys.path
+
     out = {"geometry": GEOM, "n_params": n_params,
            "peak_bf16_flops": peak, "hbm_bytes_per_s": HBM_BYTES_PER_S,
            "accounting": "see module docstring", "rows": rows}
-    (REPO / "ROOFLINE_r05.json").write_text(json.dumps(out, indent=2) + "\n")
+    (REPO / f"ROOFLINE_r{current_round():02d}.json").write_text(
+        json.dumps(out, indent=2) + "\n")
     return 0
 
 
