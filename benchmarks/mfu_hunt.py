@@ -35,7 +35,7 @@ GEOM = dict(seq_len=2048, d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
 
 # (tag, batch, remat, remat_policy) — ordered cheap-to-risky so an OOM or
 # wedge keeps every earlier rung's row.
-# Plain b32 is omitted: the roofline (ROOFLINE_r04.json) shows it
+# Plain b32 is omitted: the roofline (ROOFLINE_r{NN}.json) shows it
 # exceeds the 16 GiB HBM — a guaranteed OOM would burn minutes of a
 # live tunnel window confirming arithmetic.
 RUNGS = [
