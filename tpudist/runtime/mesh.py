@@ -90,6 +90,7 @@ def make_hybrid_mesh(
     *,
     axis_names: Sequence[str] = ALL_AXES,
     force_granules: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Multi-host mesh with DCN/ICI-aware device placement.
 
@@ -111,8 +112,11 @@ def make_hybrid_mesh(
     iterates granules in its OUTER positions (granule-major), so every
     non-data axis stays inside one granule.
     """
-    devices = jax.devices()
-    n_procs = max(d.process_index for d in devices) + 1
+    if devices is None:
+        devices = jax.devices()
+    # distinct indices, not max+1: a caller-passed subset may exclude
+    # lower-indexed processes (matches the n_slices counting below)
+    n_procs = len({d.process_index for d in devices})
     config = (config or MeshConfig()).resolve(len(devices))
     if force_granules is not None and n_procs > 1:
         raise ValueError(
@@ -120,7 +124,7 @@ def make_hybrid_mesh(
             f"this job has {n_procs} processes — real granules are "
             "detected from process/slice indices")
     if n_procs == 1 and force_granules is None:
-        return make_mesh(config, axis_names=axis_names)
+        return make_mesh(config, axis_names=axis_names, devices=devices)
 
     # Granule = what DCN separates: distinct TPU slices when present
     # (multi-slice pods), else processes (multi-host single slice, or the
