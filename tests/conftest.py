@@ -58,6 +58,9 @@ def dm_mesh():
 _SLOW_PATTERNS = (
     # multi-process integration (real subprocess rendezvous)
     "test_multiprocess.py",
+    # subprocess kill/restart chaos harness (fast single-process fault
+    # tests stay default in test_faults.py / test_watchdog.py)
+    "test_chaos.py",
     # driver-shaped end-to-end smokes
     "test_graft_entry.py::test_dryrun_multichip",
     # benchmark-harness end-to-end runs

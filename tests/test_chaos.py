@@ -1,0 +1,178 @@
+"""End-to-end chaos tests: real subprocess worker groups under ``tpurun``
+with faults armed via ``TPUDIST_FAULT`` — the acceptance story of the
+fault-tolerance layer.  Slow lane (subprocess jax imports + compiles);
+the fast single-process halves live in ``test_faults.py``."""
+
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpudist.launch.run import main as tpurun_main
+
+pytestmark = pytest.mark.chaos
+
+REPO = Path(__file__).resolve().parent.parent
+
+# A self-contained training worker: toy multi-model DP with checkpointing,
+# resuming from the latest valid step when one exists, and appending one
+# JSONL progress row per attempt so the test can assert the resume point.
+WORKER = """
+    import json, os
+
+    import jax
+    import optax
+
+    from tpudist.checkpoint import CheckpointConfig, CheckpointManager
+    from tpudist.checkpoint.manager import abstract_like
+    from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
+    from tpudist.models import create_toy_model
+    from tpudist.runtime.mesh import data_parallel_mesh
+    from tpudist.train import (TrainLoopConfig, init_model_states,
+                               make_multi_model_train_step, run_training)
+
+    attempt = os.environ.get("TPUDIST_RESTART_COUNT", "0")
+    out = os.environ["CHAOS_OUT"]
+
+    mesh = data_parallel_mesh()
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    mx, px = create_toy_model(kx)
+    my, py = create_toy_model(ky)
+    models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+    tx = optax.adam(1e-3)
+    states = init_model_states(models, tx)
+    step = make_multi_model_train_step(
+        {k: f for k, (f, _) in models.items()}, tx, mesh)
+    data = make_toy_data(seed=0)
+    plan = ShardPlan(num_samples=len(data), num_shards=1, shard_id=0, seed=0)
+    loader = ShardedLoader(data, batch_size=64, plan=plan)
+
+    mgr = CheckpointManager(CheckpointConfig(
+        directory=os.environ["CHAOS_CKPT"], save_every=8, async_save=False))
+    start = 0
+    if mgr.latest_step is not None:
+        states, meta = mgr.restore(abstract_like(states))
+        start = int(meta["iteration"])
+    with open(out, "a") as f:
+        f.write(json.dumps({"attempt": attempt, "start": start}) + "\\n")
+
+    cfg = TrainLoopConfig(total_iterations=24, progress_bar=False,
+                          sync_every=4, device_cache=False)
+    states, _ = run_training(states, step, loader, mesh, config=cfg,
+                             ckpt=mgr, start_iteration=start)
+    mgr.wait_until_finished()
+    with open(out, "a") as f:
+        f.write(json.dumps({"attempt": attempt, "done": True,
+                            "latest": mgr.latest_step}) + "\\n")
+    mgr.close()
+"""
+
+
+@pytest.fixture
+def chaos_env(tmp_path, monkeypatch):
+    """Clean launch-contract env + the chaos worker's in/out plumbing."""
+    import os
+
+    for var in list(os.environ):
+        if var.startswith("TPUDIST_") or var in (
+                "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK"):
+            monkeypatch.delenv(var, raising=False)
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(WORKER))
+    monkeypatch.setenv("PYTHONPATH", str(REPO))
+    monkeypatch.setenv("CHAOS_CKPT", str(tmp_path / "ckpt"))
+    monkeypatch.setenv("CHAOS_OUT", str(tmp_path / "progress.jsonl"))
+    return worker
+
+
+def _rows(tmp_path):
+    return [json.loads(l) for l in
+            (tmp_path / "progress.jsonl").read_text().splitlines()]
+
+
+def test_kill_restart_resumes_from_last_checkpoint(
+        tmp_path, chaos_env, monkeypatch):
+    """The acceptance chain: ``TPUDIST_FAULT=kill@step:13`` SIGKILLs the
+    worker mid-run (after the step-8 cadence save) → tpurun restarts the
+    group → the restarted attempt (restart-count gating disarms the kill)
+    resumes from the last valid checkpoint at the EXACT saved iteration
+    and completes the budget."""
+    monkeypatch.setenv("TPUDIST_FAULT", "kill@step:13")
+    rc = tpurun_main(["--nprocs", "1", "--max-restarts", "2",
+                      "--restart-backoff", "0.1",
+                      "--tmpdir", str(tmp_path / "s"),
+                      "--", sys.executable, str(chaos_env)])
+    assert rc == 0
+    rows = _rows(tmp_path)
+    starts = [r for r in rows if "start" in r]
+    dones = [r for r in rows if r.get("done")]
+    assert [r["attempt"] for r in starts] == ["0", "1"]
+    assert starts[0]["start"] == 0
+    assert starts[1]["start"] == 8, rows   # exact saved iteration
+    assert dones == [{"attempt": "1", "done": True, "latest": 24}]
+
+
+def test_corrupt_latest_falls_back_then_completes(
+        tmp_path, chaos_env, monkeypatch, capfd):
+    """Composed faults: the step-16 save is corrupted in place, then the
+    worker is killed at step 19.  The restarted attempt finds latest=16
+    corrupt, falls back to step 8 (degraded-mode restore), resumes there,
+    and completes — corrupt-latest skipped in favor of the previous valid
+    step, end to end."""
+    monkeypatch.setenv("TPUDIST_FAULT", "ckpt_corrupt@step:16;kill@step:19")
+    rc = tpurun_main(["--nprocs", "1", "--max-restarts", "2",
+                      "--restart-backoff", "0.1",
+                      "--tmpdir", str(tmp_path / "s"),
+                      "--", sys.executable, str(chaos_env)])
+    assert rc == 0
+    rows = _rows(tmp_path)
+    starts = [r for r in rows if "start" in r]
+    dones = [r for r in rows if r.get("done")]
+    assert starts[0] == {"attempt": "0", "start": 0}
+    assert starts[1]["attempt"] == "1"
+    assert starts[1]["start"] == 8, rows   # fell PAST corrupt step 16
+    assert dones and dones[-1]["latest"] == 24
+    err = capfd.readouterr().err
+    assert "degraded restore" in err
+    assert "corrupted checkpoint step 16" in err
+
+
+def test_watchdog_stall_is_restarted_by_tpurun(tmp_path, monkeypatch):
+    """A worker whose loop wedges (never pets the watchdog) is aborted
+    with exit 124 and restarted by the agent; the restarted attempt (which
+    doesn't wedge) succeeds.  Proves the hang → abort → whole-group
+    restart chain without a scheduler timeout."""
+    import os
+
+    for var in list(os.environ):
+        if var.startswith("TPUDIST_") or var in (
+                "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK"):
+            monkeypatch.delenv(var, raising=False)
+    worker = tmp_path / "wedge.py"
+    worker.write_text(textwrap.dedent("""
+        import os, time
+        from tpudist.runtime.watchdog import Watchdog
+
+        wd = Watchdog(0.5, name="chaos", poll_interval_s=0.1).start()
+        if os.environ.get("TPUDIST_RESTART_COUNT", "0") == "0":
+            time.sleep(60)   # wedged: never pets -> watchdog aborts (124)
+        for _ in range(5):
+            wd.pet()
+            time.sleep(0.05)
+        wd.stop()
+    """))
+    monkeypatch.setenv("PYTHONPATH", str(REPO))
+    err_dir = tmp_path / "errors"
+    rc = tpurun_main(["--nprocs", "1", "--max-restarts", "1",
+                      "--restart-backoff", "0.1",
+                      "--tmpdir", str(tmp_path / "s"),
+                      "--error-dir", str(err_dir),
+                      "--", sys.executable, str(worker)])
+    assert rc == 0
+    recs = list(err_dir.glob("error_attempt0_rank*.json"))
+    assert recs, "watchdog stall must leave a crash record"
+    rec = json.loads(recs[0].read_text())
+    assert rec["exc_type"] == "WatchdogStall"
+    assert "stacks" in rec

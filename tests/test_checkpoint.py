@@ -191,7 +191,151 @@ def test_tp_sharded_lm_checkpoint_restores_replicated(devices, tmp_path):
     mgr.close()
 
 
+class TestDegradedRestore:
+    """Corrupt/incomplete latest step → logged fallback to the newest
+    earlier valid step (bounded by retention); explicit steps never fall
+    back; transient save I/O is retried."""
+
+    def _corrupt(self, ckdir, step):
+        from tpudist.runtime import faults
+
+        assert faults.corrupt_checkpoint(ckdir / str(step)) > 0
+
+    def test_falls_back_to_previous_valid_step(self, dp_mesh, tmp_path,
+                                               capfd):
+        states, _, _ = _build(dp_mesh)
+        ckdir = tmp_path / "dg"
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(ckdir), async_save=False))
+        mgr.save(1, states, {"iteration": 1})
+        mgr.save(2, states, {"iteration": 2})
+        self._corrupt(ckdir, 2)
+        assert mgr.latest_step == 2  # still listed: detection is restore's job
+        restored, meta = mgr.restore(abstract_like(states))
+        assert meta["iteration"] == 1
+        for a, b in zip(_leaves(states), _leaves(restored)):
+            np.testing.assert_array_equal(a, b)
+        err = capfd.readouterr().err
+        assert "restore(step=2) failed" in err
+        assert "degraded restore: step 1" in err
+        mgr.close()
+
+    def test_explicit_step_does_not_fall_back(self, dp_mesh, tmp_path):
+        states, _, _ = _build(dp_mesh)
+        ckdir = tmp_path / "ex"
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(ckdir), async_save=False))
+        mgr.save(1, states, {"iteration": 1})
+        mgr.save(2, states, {"iteration": 2})
+        self._corrupt(ckdir, 2)
+        with pytest.raises(Exception):
+            mgr.restore(abstract_like(states), step=2)
+        mgr.close()
+
+    def test_all_steps_corrupt_raises(self, dp_mesh, tmp_path):
+        from tpudist.checkpoint import CheckpointRestoreError
+
+        states, _, _ = _build(dp_mesh)
+        ckdir = tmp_path / "all"
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(ckdir), async_save=False))
+        mgr.save(1, states, {"iteration": 1})
+        mgr.save(2, states, {"iteration": 2})
+        self._corrupt(ckdir, 1)
+        self._corrupt(ckdir, 2)
+        with pytest.raises(CheckpointRestoreError):
+            mgr.restore(abstract_like(states))
+        mgr.close()
+
+    def test_fallback_opt_out(self, dp_mesh, tmp_path):
+        states, _, _ = _build(dp_mesh)
+        ckdir = tmp_path / "opt"
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(ckdir), async_save=False, restore_fallback=False))
+        mgr.save(1, states, {"iteration": 1})
+        mgr.save(2, states, {"iteration": 2})
+        self._corrupt(ckdir, 2)
+        with pytest.raises(Exception):
+            mgr.restore(abstract_like(states))
+        mgr.close()
+
+    def test_multihost_agreement_prefilters_corrupt_steps(
+            self, dp_mesh, tmp_path, capfd):
+        """The multi-host path must agree on the candidate BEFORE the
+        collective restore (no exception-driven fallback across a
+        collective boundary): the structural check flags the corrupt step
+        and the agreed earlier step is restored directly."""
+        states, _, _ = _build(dp_mesh)
+        ckdir = tmp_path / "mh"
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(ckdir), async_save=False))
+        mgr.save(1, states, {"iteration": 1})
+        mgr.save(2, states, {"iteration": 2})
+        self._corrupt(ckdir, 2)
+        assert mgr._step_locally_plausible(1)
+        assert not mgr._step_locally_plausible(2)
+        restored, meta = mgr._restore_agreed([2, 1], abstract_like(states))
+        assert meta["iteration"] == 1
+        assert "all ranks agree" in capfd.readouterr().err
+        mgr.close()
+
+    def test_save_retries_transient_io(self, dp_mesh, tmp_path):
+        states, _, _ = _build(dp_mesh)
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(tmp_path / "rt"), async_save=False,
+            save_retries=2, save_retry_backoff_s=0.01))
+        real_save = mgr._mgr.save
+        calls = {"n": 0}
+
+        def flaky(step, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise OSError("transient I/O blip")
+            return real_save(step, *a, **kw)
+
+        mgr._mgr.save = flaky
+        assert mgr.save(1, states, {"iteration": 1})
+        assert calls["n"] == 3
+        assert mgr.latest_step == 1
+
+        # a PERSISTENT error still surfaces once the budget is spent
+        def broken(step, *a, **kw):
+            raise OSError("disk on fire")
+
+        mgr._mgr.save = broken
+        with pytest.raises(OSError, match="disk on fire"):
+            mgr.save(2, states, {"iteration": 2})
+        mgr.close()
+
+
 class TestPreemption:
+    def test_install_off_main_thread_degrades_to_noop(self):
+        """signal.signal is main-thread-only: a threaded caller (Trainer
+        under a test runner) gets a warned no-op False, not ValueError —
+        it still trains, just without preemption saves."""
+        import threading
+        import warnings
+
+        from tpudist.runtime import preemption
+
+        preemption.reset()
+        results = []
+
+        def run():
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                results.append(
+                    (preemption.install(), [str(x.message) for x in w]))
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+        installed, warns = results[0]
+        assert installed is False
+        assert any("main thread" in m for m in warns), warns
+        assert not preemption._installed  # nothing half-installed
+        preemption.reset()
+
     def test_sigterm_flag_and_reset(self):
         """The handler catches a real SIGTERM to this process and sets the
         flag without killing anything; reset() restores the old handler."""

@@ -1,0 +1,306 @@
+"""Fault-injection registry (tpudist.runtime.faults): grammar, gating,
+and the four injection seams — plus the fast single-process halves of the
+chaos story (sigterm-at-step preemption drill, ckpt_corrupt → degraded
+restore, host_delay → deadline timeout, init_fail → retry/backoff).
+The subprocess kill/restart chaos tests live in ``test_chaos.py`` (slow
+lane)."""
+
+import os
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tpudist.runtime import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def disarmed(monkeypatch):
+    """Every test starts and ends disarmed, with no ambient chaos env."""
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    monkeypatch.delenv("TPUDIST_RESTART_COUNT", raising=False)
+    monkeypatch.delenv("TPUDIST_PROCESS_ID", raising=False)
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+class TestGrammar:
+    def test_parse_full_grammar(self):
+        plan = faults.parse(
+            "kill@step:7,rank:1;sigterm@step:5;ckpt_corrupt@step:10;"
+            "host_delay@ms:500;init_fail@attempts:2")
+        kinds = [s.kind for s in plan]
+        assert kinds == ["kill", "sigterm", "ckpt_corrupt", "host_delay",
+                         "init_fail"]
+        assert plan[0].params == {"step": 7, "rank": 1}
+        assert plan[3].params == {"ms": 500}
+        assert plan[4].params == {"attempts": 2}
+
+    @pytest.mark.parametrize("bad", [
+        "explode@step:1",            # unknown kind
+        "kill@when:1",               # unknown param
+        "kill@step:soon",            # non-integer value
+        "kill",                      # missing required step
+        "host_delay@step:1",         # step not allowed for host_delay
+        "",                          # empty
+        ";;",                        # empty after split
+    ])
+    def test_malformed_specs_fail_loud(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse(bad)
+
+    def test_arm_from_env(self, monkeypatch):
+        assert not faults.arm_from_env()  # unset -> stays disarmed
+        assert not faults.armed()
+        monkeypatch.setenv(faults.ENV_VAR, "sigterm@step:3")
+        assert faults.arm_from_env()
+        assert faults.armed()
+        # idempotent re-arm keeps fired state (same env string)
+        faults._PLAN[0].fired = 1
+        faults.arm_from_env()
+        assert faults._PLAN[0].fired == 1
+        # changed env re-parses
+        monkeypatch.setenv(faults.ENV_VAR, "sigterm@step:9")
+        faults.arm_from_env()
+        assert faults._PLAN[0].params["step"] == 9 and faults._PLAN[0].fired == 0
+        # unset env disarms an env-armed plan...
+        monkeypatch.delenv(faults.ENV_VAR)
+        faults.arm_from_env()
+        assert not faults.armed()
+        # ...but never clobbers an explicit arm()
+        faults.arm("host_delay@ms:1")
+        monkeypatch.setenv(faults.ENV_VAR, "sigterm@step:9")
+        faults.arm_from_env()
+        assert faults._PLAN[0].kind == "host_delay"
+
+    def test_disarmed_injection_points_are_noops(self):
+        faults.inject_step(0)
+        faults.inject_host()
+        faults.inject_init(0)
+        assert faults.inject_ckpt_save(0, "/nonexistent") is False
+
+
+class TestGating:
+    def test_sigterm_fires_at_step_and_only_once(self):
+        """A real (caught) SIGTERM at the armed step, exactly once."""
+        from tpudist.runtime import preemption
+
+        preemption.reset()
+        preemption.install()
+        try:
+            faults.arm("sigterm@step:3")
+            faults.inject_step(2)
+            assert not preemption.requested()
+            faults.inject_step(3)
+            assert preemption.requested()
+            preemption._flag.clear()
+            faults.inject_step(4)  # one-shot: must not re-fire
+            assert not preemption.requested()
+        finally:
+            preemption.reset()
+
+    def test_step_fires_at_first_point_past_target(self):
+        """Window-edge semantics: the scanned loop only visits window
+        starts, so `step >= target` fires at the first edge after it."""
+        from tpudist.runtime import preemption
+
+        preemption.reset()
+        preemption.install()
+        try:
+            faults.arm("sigterm@step:10")
+            faults.inject_step(8)
+            assert not preemption.requested()
+            faults.inject_step(16)  # first window edge past 10
+            assert preemption.requested()
+        finally:
+            preemption.reset()
+
+    def test_rank_gating(self, monkeypatch):
+        from tpudist.runtime import preemption
+
+        preemption.reset()
+        preemption.install()
+        try:
+            monkeypatch.setenv("TPUDIST_PROCESS_ID", "0")
+            faults.arm("sigterm@step:1,rank:1")
+            faults.inject_step(5)
+            assert not preemption.requested()  # wrong rank
+            monkeypatch.setenv("TPUDIST_PROCESS_ID", "1")
+            faults.inject_step(5)
+            assert preemption.requested()
+        finally:
+            preemption.reset()
+
+    def test_restart_attempt_gating(self, monkeypatch):
+        """A tpurun-restarted group (TPUDIST_RESTART_COUNT=1) is NOT
+        re-killed by a default (attempt 0) one-shot fault — the property
+        the kill→restart→resume chaos test depends on."""
+        from tpudist.runtime import preemption
+
+        preemption.reset()
+        preemption.install()
+        try:
+            monkeypatch.setenv("TPUDIST_RESTART_COUNT", "1")
+            faults.arm("sigterm@step:1")
+            faults.inject_step(5)
+            assert not preemption.requested()
+            # an explicit attempt:1 fault targets the restarted group
+            faults.arm("sigterm@step:1,attempt:1")
+            faults.inject_step(5)
+            assert preemption.requested()
+        finally:
+            preemption.reset()
+
+
+class TestInitFail:
+    def test_injects_then_clears(self):
+        faults.arm("init_fail@attempts:2")
+        with pytest.raises(faults.TransientInitError):
+            faults.inject_init(0)
+        with pytest.raises(faults.TransientInitError):
+            faults.inject_init(1)
+        faults.inject_init(2)  # budget spent: passes
+
+    def test_retry_loop_absorbs_injected_failures(self):
+        """The bootstrap retry/backoff helper rides through the injected
+        transient failures with jittered exponential sleeps."""
+        from tpudist.runtime.bootstrap import _retry_with_backoff
+
+        faults.arm("init_fail@attempts:2")
+        sleeps = []
+
+        def attempt(i):
+            faults.inject_init(i)
+            return "connected"
+
+        out = _retry_with_backoff(attempt, retries=3, backoff_s=1.0,
+                                  what="test-init", sleep=sleeps.append)
+        assert out == "connected"
+        assert len(sleeps) == 2
+        # jittered exponential: backoff * 2**i * (0.5..1.5)
+        assert 0.5 <= sleeps[0] <= 1.5
+        assert 1.0 <= sleeps[1] <= 3.0
+
+    def test_retry_budget_exhausted_raises(self):
+        from tpudist.runtime.bootstrap import _retry_with_backoff
+
+        faults.arm("init_fail@attempts:5")
+
+        def attempt(i):
+            faults.inject_init(i)
+
+        with pytest.raises(faults.TransientInitError):
+            _retry_with_backoff(attempt, retries=2, backoff_s=0.0,
+                                what="test-init", sleep=lambda s: None)
+
+
+class TestHostFabric:
+    def test_host_delay_adds_latency(self):
+        from tpudist.comm.collectives import host_allreduce_sum
+
+        faults.arm("host_delay@ms:120")
+        t0 = time.monotonic()
+        out = host_allreduce_sum(np.float64(2.0))
+        assert time.monotonic() - t0 >= 0.12
+        assert float(out) == 2.0
+
+    def test_deadline_converts_wedge_to_timeout(self):
+        from tpudist.comm.collectives import HostFabricTimeout, host_allreduce_sum
+
+        faults.arm("host_delay@ms:500")
+        with pytest.raises(HostFabricTimeout):
+            host_allreduce_sum(np.float64(1.0), timeout_s=0.05)
+
+    def test_env_default_deadline(self, monkeypatch):
+        from tpudist.comm.collectives import HostFabricTimeout, barrier
+
+        faults.arm("host_delay@ms:500")
+        monkeypatch.setenv("TPUDIST_HOST_TIMEOUT_S", "0.05")
+        with pytest.raises(HostFabricTimeout):
+            barrier("chaos_test")
+
+    def test_timeout_passes_value_through(self):
+        from tpudist.comm.collectives import host_allreduce_sum
+
+        num, den = host_allreduce_sum(
+            (np.float64(3.0), np.float64(1.5)), timeout_s=5.0)
+        assert float(num) == 3.0 and float(den) == 1.5
+
+    def test_barrier_with_deadline_is_noop_single_process(self):
+        from tpudist.comm.collectives import barrier
+
+        barrier("chaos_test", timeout_s=5.0)
+
+
+def _build_toy(mesh):
+    from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
+    from tpudist.models import create_toy_model
+    from tpudist.train import init_model_states, make_multi_model_train_step
+
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    mx, px = create_toy_model(kx)
+    my, py = create_toy_model(ky)
+    models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+    tx = optax.adam(1e-3)
+    states = init_model_states(models, tx)
+    step = make_multi_model_train_step(
+        {k: f for k, (f, _) in models.items()}, tx, mesh)
+    data = make_toy_data(seed=0)
+    plan = ShardPlan(num_samples=len(data), num_shards=1, shard_id=0, seed=0)
+    loader = ShardedLoader(data, batch_size=64, plan=plan)
+    return states, step, loader
+
+
+class TestLoopIntegration:
+    def test_env_armed_sigterm_drives_preemption_save(
+            self, dp_mesh, tmp_path, monkeypatch):
+        """The full fast chaos chain in one process: TPUDIST_FAULT in the
+        env → run_training arms it → injected SIGTERM at step 2 → the
+        preemption machinery saves at the next sync boundary, stamps
+        `preempted`, and exits early."""
+        from tpudist.checkpoint import CheckpointConfig, CheckpointManager
+        from tpudist.checkpoint.manager import abstract_like
+        from tpudist.runtime import preemption
+        from tpudist.train import TrainLoopConfig, run_training
+
+        monkeypatch.setenv(faults.ENV_VAR, "sigterm@step:2")
+        states, step, loader = _build_toy(dp_mesh)
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(tmp_path / "ck"), async_save=False))
+        cfg = TrainLoopConfig(total_iterations=12, progress_bar=False,
+                              sync_every=4, device_cache=False)
+        try:
+            states, _ = run_training(states, step, loader, dp_mesh,
+                                     config=cfg, ckpt=mgr)
+            assert preemption.last_run_preempted()
+            assert mgr.latest_step == 4  # boundary after the injected signal
+            _, meta = mgr.restore(abstract_like(states))
+            assert meta["preempted"] is True and meta["iteration"] == 4
+            mgr.close()
+        finally:
+            preemption.reset()
+
+    def test_ckpt_corrupt_fault_then_degraded_restore(
+            self, dp_mesh, tmp_path):
+        """ckpt_corrupt@step:N garbles the save at/after step N in place;
+        restore() logs the corruption and falls back to the previous valid
+        step — the degraded-mode half of the acceptance story, fast."""
+        from tpudist.checkpoint import CheckpointConfig, CheckpointManager
+        from tpudist.checkpoint.manager import abstract_like
+
+        states, _, _ = _build_toy(dp_mesh)
+        faults.arm("ckpt_corrupt@step:2")
+        mgr = CheckpointManager(CheckpointConfig(
+            directory=str(tmp_path / "cc"), async_save=False))
+        assert mgr.save(1, states, {"iteration": 1})
+        assert mgr.save(2, states, {"iteration": 2})
+        assert faults._PLAN[0].fired == 1
+        assert mgr.latest_step == 2  # corrupt step still listed...
+        _, meta = mgr.restore(abstract_like(states))
+        assert meta["iteration"] == 1  # ...but restore fell back
+        mgr.close()

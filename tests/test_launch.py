@@ -145,6 +145,130 @@ class TestTpurun:
         assert time.time() - t0 < 60
 
 
+class TestTerminate:
+    """`_terminate`'s grace window: SIGTERM first, SIGKILL escalation only
+    after `grace_s` (satellite coverage — the window is what lets workers
+    finish a collective preemption save before dying)."""
+
+    def _spawn(self, tmp_path, body, monkeypatch):
+        import subprocess as sp
+        import time
+
+        script = tmp_path / "t.py"
+        script.write_text(textwrap.dedent(body))
+        ready = tmp_path / "ready"
+        env = dict(os.environ, READY=str(ready))
+        p = sp.Popen([sys.executable, str(script)], env=env)
+        deadline = time.time() + 30
+        while not ready.exists():
+            assert time.time() < deadline and p.poll() is None
+            time.sleep(0.02)
+        return p
+
+    def test_grace_escalates_to_sigkill(self, tmp_path, monkeypatch):
+        import time
+
+        from tpudist.launch.run import _terminate
+
+        p = self._spawn(tmp_path, """
+            import os, signal, time
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            open(os.environ["READY"], "w").close()
+            time.sleep(120)
+        """, monkeypatch)
+        t0 = time.time()
+        _terminate([p], grace_s=0.7)
+        dt = time.time() - t0
+        assert p.poll() == -9, "SIGTERM-ignoring worker must be SIGKILLed"
+        assert dt >= 0.5, "killed before the grace window elapsed"
+        assert dt < 30
+
+    def test_graceful_exit_skips_kill(self, tmp_path, monkeypatch):
+        import time
+
+        from tpudist.launch.run import _terminate
+
+        p = self._spawn(tmp_path, """
+            import os, signal, sys, time
+            signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+            open(os.environ["READY"], "w").close()
+            time.sleep(120)
+        """, monkeypatch)
+        t0 = time.time()
+        _terminate([p], grace_s=30.0)
+        dt = time.time() - t0
+        assert p.poll() == 0, "graceful worker must keep its clean exit"
+        assert dt < 20, "waited out the grace window despite a clean exit"
+
+
+def test_sigterm_during_backoff_skips_restart(tmp_path, monkeypatch, capsys):
+    """SIGTERM landing BETWEEN attempts (during the restart backoff) must
+    not launch a fresh group onto a node being reclaimed — the fresh group
+    would never receive the group signal and would train until SLURM's
+    SIGKILL."""
+    import time as _time
+
+    import tpudist.launch.run as run_mod
+
+    _clean_env(monkeypatch)
+    worker = _write_worker(tmp_path, """
+        import os, pathlib
+        pathlib.Path(os.environ["OUT_DIR"],
+                     "a" + os.environ["TPUDIST_RESTART_COUNT"]).touch()
+        raise SystemExit(3)
+    """)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    monkeypatch.setenv("OUT_DIR", str(out_dir))
+
+    real_sleep = _time.sleep
+
+    def sleep_with_sigterm(s):
+        # The backoff sleep (>= 1s here) is where the "signal" lands; the
+        # agent's 0.2s poll sleeps pass through (shortened to keep it fast).
+        if s >= 1.0:
+            run_mod._preempt_state["flag"] = True
+        real_sleep(min(s, 0.05))
+
+    monkeypatch.setattr(run_mod.time, "sleep", sleep_with_sigterm)
+    rc = tpurun_main(["--nprocs", "1", "--max-restarts", "2",
+                      "--restart-backoff", "1.5",
+                      "--tmpdir", str(tmp_path / "s"),
+                      "--", sys.executable, str(worker)])
+    assert rc == 1
+    assert sorted(p.name for p in out_dir.iterdir()) == ["a0"], (
+        "a worker group was launched after the preemption signal")
+    assert ("preemption signal during restart window"
+            in capsys.readouterr().err)
+
+
+def test_crash_record_written_atomically(tmp_path, monkeypatch):
+    """Satellite: record writes go tmp + os.replace — a reader never sees
+    a torn file, and failures to write never mask the original error."""
+    import pytest as _pytest
+
+    from tpudist.utils.record import record, write_error_record
+
+    monkeypatch.setenv("TPUDIST_ERROR_FILE", str(tmp_path / "e_%r.json"))
+    monkeypatch.setenv("TPUDIST_PROCESS_ID", "5")
+
+    @record
+    def boom():
+        raise RuntimeError("kaboom")
+
+    with _pytest.raises(RuntimeError, match="kaboom"):
+        boom()
+    rec = json.load(open(tmp_path / "e_5.json"))
+    assert rec["exc_type"] == "RuntimeError" and rec["process_id"] == 5
+    assert rec["pid"] == os.getpid()
+    assert not list(tmp_path.glob("*.tmp*")), "tmp file leaked past replace"
+
+    # unwritable destination: returns None, never raises
+    monkeypatch.setenv("TPUDIST_ERROR_FILE",
+                       str(tmp_path / "nodir" / "e_%r.json"))
+    assert write_error_record({"exc_type": "X"}) is None
+
+
 class TestStaging:
     def test_tarball_roundtrip(self, tmp_path):
         src = tmp_path / "dataset"

@@ -1,0 +1,171 @@
+"""Hang watchdog (tpudist.runtime.watchdog): heartbeat semantics, stall
+detection with stack-dump crash records, env arming, loop integration, and
+the real ``os._exit(124)`` abort in a subprocess."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpudist.runtime import watchdog
+from tpudist.runtime.watchdog import WATCHDOG_EXIT_CODE, Watchdog
+
+pytestmark = pytest.mark.chaos
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestHeartbeat:
+    def test_petted_watchdog_never_fires(self):
+        fired = []
+        with Watchdog(0.3, poll_interval_s=0.05, abort=fired.append) as wd:
+            for _ in range(12):
+                wd.pet()
+                time.sleep(0.05)
+        assert not fired and not wd.stalled
+
+    def test_stall_aborts_with_stacks_in_crash_record(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUDIST_ERROR_FILE",
+                           str(tmp_path / "err_%r.json"))
+        monkeypatch.setenv("TPUDIST_PROCESS_ID", "0")
+        fired = []
+        wd = Watchdog(0.2, name="unit", poll_interval_s=0.05,
+                      abort=fired.append)
+        wd.start()
+        try:
+            deadline = time.time() + 5
+            while not fired and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert fired == [WATCHDOG_EXIT_CODE]
+        assert wd.stalled
+        rec = json.loads((tmp_path / "err_0.json").read_text())
+        assert rec["exc_type"] == "WatchdogStall"
+        assert "unit" in rec["message"]
+        # the stack dump must include this (main) thread, mid-sleep here
+        assert any("MainThread" in k for k in rec["stacks"])
+        assert "test_watchdog" in rec["traceback"]
+        # atomic write left no tmp turds
+        assert not list(tmp_path.glob("*.tmp*"))
+
+    def test_first_deadline_grants_compile_slack(self):
+        """Before the first pet the deadline is first_deadline_s; after it,
+        the tight stall deadline applies."""
+        fired = []
+        wd = Watchdog(0.15, poll_interval_s=0.05, first_deadline_s=10.0,
+                      abort=fired.append)
+        wd.start()
+        try:
+            time.sleep(0.5)  # would have fired without the first-pet slack
+            assert not fired
+            wd.pet()
+            time.sleep(0.5)  # now the 0.15s deadline applies
+            assert fired == [WATCHDOG_EXIT_CODE]
+        finally:
+            wd.stop()
+
+    def test_invalid_timeout_rejected(self):
+        with pytest.raises(ValueError):
+            Watchdog(0.0)
+
+    def test_restartable_after_stop(self):
+        """stop() must not leave the object terminal: a second start()
+        really supervises again (the _stop event is cleared)."""
+        fired = []
+        wd = Watchdog(0.2, poll_interval_s=0.05, abort=fired.append)
+        wd.start()
+        wd.stop()
+        wd.start()
+        try:
+            deadline = time.time() + 5
+            while not fired and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert fired == [WATCHDOG_EXIT_CODE]
+
+
+class TestArming:
+    def test_timeout_from_env(self, monkeypatch):
+        monkeypatch.delenv(watchdog.TIMEOUT_ENV, raising=False)
+        assert watchdog.timeout_from_env() is None
+        monkeypatch.setenv(watchdog.TIMEOUT_ENV, "45")
+        assert watchdog.timeout_from_env() == 45.0
+        monkeypatch.setenv(watchdog.TIMEOUT_ENV, "0")
+        assert watchdog.timeout_from_env() is None  # 0 = disabled
+        monkeypatch.setenv(watchdog.TIMEOUT_ENV, "soon")
+        assert watchdog.timeout_from_env() is None
+
+    def test_from_config(self, monkeypatch):
+        monkeypatch.delenv(watchdog.TIMEOUT_ENV, raising=False)
+        assert watchdog.from_config(None) is None
+        wd = watchdog.from_config(12.0)
+        assert wd is not None and wd.stall_timeout_s == 12.0
+        monkeypatch.setenv(watchdog.TIMEOUT_ENV, "7.5")
+        wd = watchdog.from_config(None)
+        assert wd is not None and wd.stall_timeout_s == 7.5
+
+
+def test_real_subprocess_stall_exits_124(tmp_path):
+    """The production abort path: a stalled process really dies with
+    exit 124 (os._exit — no atexit/finally rescue) leaving the record."""
+    script = tmp_path / "stall.py"
+    script.write_text(
+        "import time\n"
+        "from tpudist.runtime.watchdog import Watchdog\n"
+        "Watchdog(0.3, name='e2e', poll_interval_s=0.05).start()\n"
+        "time.sleep(60)\n"
+        "raise SystemExit(0)\n")
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "TPUDIST_ERROR_FILE": str(tmp_path / "err_%r.json"),
+        "TPUDIST_PROCESS_ID": "3",
+    })
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == WATCHDOG_EXIT_CODE, r.stderr[-2000:]
+    assert "no heartbeat from 'e2e'" in r.stderr
+    rec = json.loads((tmp_path / "err_3.json").read_text())
+    assert rec["exc_type"] == "WatchdogStall" and rec["process_id"] == 3
+    assert "time.sleep" in rec["traceback"] or "stall.py" in rec["traceback"]
+
+
+def test_loop_runs_clean_under_watchdog(dp_mesh, monkeypatch):
+    """A healthy training run under an armed (env) watchdog completes and
+    stops the supervisor thread on exit."""
+    import threading
+
+    import jax
+    import optax
+
+    from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
+    from tpudist.models import create_toy_model
+    from tpudist.train import (TrainLoopConfig, init_model_states,
+                               make_multi_model_train_step, run_training)
+
+    monkeypatch.setenv(watchdog.TIMEOUT_ENV, "300")
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    mx, px = create_toy_model(kx)
+    my, py = create_toy_model(ky)
+    models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+    tx = optax.adam(1e-3)
+    states = init_model_states(models, tx)
+    step = make_multi_model_train_step(
+        {k: f for k, (f, _) in models.items()}, tx, dp_mesh)
+    data = make_toy_data(seed=0)
+    plan = ShardPlan(num_samples=len(data), num_shards=1, shard_id=0, seed=0)
+    loader = ShardedLoader(data, batch_size=64, plan=plan)
+    cfg = TrainLoopConfig(total_iterations=6, progress_bar=False,
+                          sync_every=2, device_cache=False)
+    run_training(states, step, loader, dp_mesh, config=cfg)
+    time.sleep(0.1)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("tpudist-watchdog")]

@@ -18,6 +18,7 @@ scaffolding implies, TPU-natively via Orbax:
 from tpudist.checkpoint.manager import (  # noqa: F401
     CheckpointConfig,
     CheckpointManager,
+    CheckpointRestoreError,
     abstract_like,
     checkpoint_dir_for,
     resolve_checkpoint_location,

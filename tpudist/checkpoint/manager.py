@@ -29,6 +29,17 @@ class CheckpointConfig:
     save_every: int = 1000          # sweeper.yml:26-31 --checkpoint_every
     max_to_keep: Optional[int] = 3
     async_save: bool = True
+    # Transient save I/O errors (filesystem blips on network storage) are
+    # retried this many times with a short backoff before surfacing.
+    save_retries: int = 2
+    save_retry_backoff_s: float = 0.5
+    # restore(): fall back to the newest EARLIER valid step when the latest
+    # is corrupt/incomplete (bounded by max_to_keep's retention window).
+    restore_fallback: bool = True
+
+
+class CheckpointRestoreError(RuntimeError):
+    """Every retained checkpoint step failed to restore."""
 
 
 def checkpoint_dir_for(
@@ -55,8 +66,10 @@ class CheckpointManager:
 
         self.config = config
         path = Path(config.directory).resolve()
-        if jax.process_index() == 0:
-            path.mkdir(parents=True, exist_ok=True)
+        # All ranks mkdir (idempotent, race-free): gating on process 0 raced
+        # every other process's immediate `ocp.CheckpointManager(path)`
+        # construction below against the creation.
+        path.mkdir(parents=True, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=config.max_to_keep,
             enable_async_checkpointing=config.async_save,
@@ -98,14 +111,43 @@ class CheckpointManager:
             if jax.process_index() == 0:
                 self._write_meta_overlay(step, meta)
             return True
-        ok = self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(states),
-                meta=ocp.args.JsonSave(meta),
+        # Transient I/O blips (network FS) are retried before surfacing —
+        # losing a whole run to one failed cadence save is the wrong trade;
+        # persistent errors still raise after the budget.  NOTE: with
+        # async_save=True the OSError Orbax re-raises here may originate
+        # from a PREVIOUS step's background write (it surfaces at the next
+        # save call) — that step is already lost; the retry keeps THIS
+        # step and the run alive.  Single-process only: an Orbax save is
+        # COLLECTIVE, and one rank re-entering it alone while its peers
+        # already completed would wedge at the internal barrier (the same
+        # no-exception-driven-divergence rule _restore_agreed enforces) —
+        # multi-host saves surface the error immediately instead.
+        from tpudist.runtime.bootstrap import _retry_with_backoff
+
+        save_retries = (self.config.save_retries
+                        if jax.process_count() == 1 else 0)
+        ok = _retry_with_backoff(
+            lambda attempt: self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(states),
+                    meta=ocp.args.JsonSave(meta),
+                ),
             ),
+            retries=save_retries,
+            backoff_s=self.config.save_retry_backoff_s,
+            retry_on=(OSError,),
+            what=f"checkpoint save(step={step})" + (
+                " (error may be from an earlier async save)"
+                if self.config.async_save else ""),
         )
         self._gc_meta_overlays()
+        # Chaos harness: a due ckpt_corrupt fault garbles this step after
+        # the (possibly async) write completes.  One None-check when unarmed.
+        from tpudist.runtime import faults
+
+        faults.inject_ckpt_save(step, self._dir / str(step),
+                                wait=self._mgr.wait_until_finished)
         return ok
 
     # -- meta overlays ------------------------------------------------------
@@ -158,13 +200,155 @@ class CheckpointManager:
         ``abstract_state`` is a pytree of ``jax.ShapeDtypeStruct`` (with
         shardings) matching the saved state — build it from a freshly
         initialized state via :func:`abstract_like`.
+
+        Degraded mode: with no explicit ``step``, a corrupt/incomplete
+        latest step (torn files after a mid-save SIGKILL, bit rot) is
+        logged and skipped in favor of the newest earlier step that
+        restores cleanly — resuming slightly stale beats dying deep inside
+        Orbax and burning the restart budget on the same bad step.  The
+        fallback window is whatever retention kept (``max_to_keep``).  An
+        explicit ``step`` means the caller wants THAT step: no fallback.
+        Raises :class:`CheckpointRestoreError` when every retained step
+        fails.
         """
-        ocp = self._ocp
-        step = self._mgr.latest_step() if step is None else step
+        explicit = step is not None
+        if step is None:
+            step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.config.directory}"
             )
+        if explicit or not self.config.restore_fallback:
+            return self._restore_step(step, abstract_state)
+        candidates = sorted(
+            (s for s in self._mgr.all_steps() if s <= step), reverse=True
+        ) or [step]
+        if jax.process_count() > 1:
+            return self._restore_agreed(candidates, abstract_state)
+        failures = []
+        for s in candidates:
+            try:
+                restored = self._restore_step(s, abstract_state)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — corruption surfaces as
+                # whatever layer noticed it first (Orbax/zarr/json/OS)
+                import sys
+
+                print(
+                    f"[tpudist.checkpoint] restore(step={s}) failed: "
+                    f"{type(e).__name__}: {e}"
+                    + ("; falling back to an earlier step"
+                       if s != candidates[-1] else ""),
+                    file=sys.stderr, flush=True,
+                )
+                failures.append((s, e))
+                continue
+            if failures:
+                import sys
+
+                print(
+                    f"[tpudist.checkpoint] degraded restore: step {s} used "
+                    f"instead of corrupt step(s) "
+                    f"{[f_s for f_s, _ in failures]}",
+                    file=sys.stderr, flush=True,
+                )
+            return restored
+        raise CheckpointRestoreError(
+            f"all retained checkpoint steps failed to restore under "
+            f"{self.config.directory}: "
+            f"{[(s, type(e).__name__) for s, e in failures]}"
+        ) from failures[-1][1]
+
+    def _step_locally_plausible(self, step: int) -> bool:
+        """Cheap structural sanity of THIS process's view of a step (its
+        json metadata parses) — no collective work, so every rank can run
+        it independently before agreeing on a restore candidate."""
+        import json
+
+        d = self._dir / str(step)
+        try:
+            if not d.is_dir():
+                return False
+            for md in (d / "meta" / "metadata", d / "state" / "_METADATA"):
+                if md.exists():
+                    json.loads(md.read_text())
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def _restore_agreed(
+        self, candidates, abstract_state: Any
+    ) -> Tuple[Any, dict]:
+        """Multi-host degraded restore.  An Orbax restore is COLLECTIVE:
+        a rank that falls back on a local exception while its peers
+        restore the original step would wedge the collective or silently
+        diverge the states (one host's shards may be torn while the
+        others' are intact).  So the fallback decision is agreed FIRST —
+        each rank structurally checks its local view of every candidate,
+        the verdicts are OR-reduced over the host fabric, and the newest
+        step every rank deems plausible is restored once, collectively.
+        A failure of that agreed restore propagates (no exception-driven
+        fallback across a collective boundary)."""
+        import sys
+
+        import numpy as np
+
+        from tpudist.comm.collectives import host_allreduce_sum
+
+        # Agree on the candidate LIST first: on eventually-consistent
+        # shared storage ranks can see different all_steps() views, and a
+        # positional verdict reduce over misaligned lists would pair one
+        # rank's verdict for step A with another's for step B (or crash
+        # the allgather on length mismatch).  Fixed-size pad -> allgather
+        # -> intersect; every rank derives the same ordered `common`.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            # Pad to the globally largest candidate count (scalar gather
+            # first) — a fixed cap would silently shrink the fallback
+            # window under unbounded retention (max_to_keep=None).
+            lengths = np.asarray(multihost_utils.process_allgather(
+                np.int64(len(candidates))))
+            pad = max(1, int(lengths.max()))
+            local_steps = np.full(pad, -1, dtype=np.int64)
+            local_steps[:len(candidates)] = candidates
+            gathered = np.asarray(
+                multihost_utils.process_allgather(local_steps))
+            step_sets = [set(int(s) for s in row if s >= 0)
+                         for row in gathered.reshape(-1, pad)]
+            common = sorted(set.intersection(*step_sets), reverse=True)
+        else:
+            common = list(candidates)
+        if not common:
+            raise CheckpointRestoreError(
+                f"ranks see disjoint checkpoint steps under "
+                f"{self.config.directory} (eventually-consistent "
+                f"storage?); local candidates: {candidates}")
+        local_bad = np.array(
+            [0.0 if self._step_locally_plausible(s) else 1.0
+             for s in common], dtype=np.float64)
+        total_bad = np.asarray(host_allreduce_sum(local_bad))
+        agreed = [s for s, bad in zip(common, total_bad) if bad == 0.0]
+        if not agreed:
+            raise CheckpointRestoreError(
+                f"no retained checkpoint step passed every rank's "
+                f"structural check under {self.config.directory}: "
+                f"{common}")
+        if agreed[0] != candidates[0]:
+            skipped = [s for s in candidates if s > agreed[0]]
+            print(
+                f"[tpudist.checkpoint] degraded restore (all ranks agree): "
+                f"step {agreed[0]} used instead of corrupt step(s) "
+                f"{skipped}",
+                file=sys.stderr, flush=True,
+            )
+        return self._restore_step(agreed[0], abstract_state)
+
+    def _restore_step(
+        self, step: int, abstract_state: Any
+    ) -> Tuple[Any, dict]:
+        ocp = self._ocp
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(

@@ -23,7 +23,8 @@ The reference's ``--backend {nccl,mpi,gloo}`` flag survives as
 from __future__ import annotations
 
 import enum
-from typing import Any, Mapping
+import threading
+from typing import Any, Callable, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,92 @@ import numpy as np
 class MetricBackend(str, enum.Enum):
     ICI = "ici"    # reduce on-device inside the compiled step (NCCL analog)
     HOST = "host"  # reduce host-side over DCN (Gloo analog)
+
+
+class HostFabricTimeout(TimeoutError):
+    """A host-fabric collective exceeded its deadline.
+
+    Without one, a peer that died un-noticed wedges every other process
+    inside ``process_allgather``/``sync_global_devices`` forever — the
+    deadline converts that indefinite hang into an error the watchdog /
+    crash-record / restart machinery can see and act on."""
+
+
+_TIMEOUT_ENV = "TPUDIST_HOST_TIMEOUT_S"
+
+
+def _default_host_timeout() -> Optional[float]:
+    from tpudist.utils.envutil import env_positive_float
+
+    return env_positive_float(_TIMEOUT_ENV)
+
+
+class _DeadlineWorker:
+    """One long-lived daemon thread executing deadline-guarded host ops in
+    order — reused across calls so the metric path doesn't pay a thread
+    spawn per op.  A worker whose op wedged past its deadline is abandoned
+    (the caller installs a fresh one); thread creation is then bounded by
+    timeout *events*, not op count."""
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._t = threading.Thread(target=self._run,
+                                   name="tpudist-host-fabric", daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            fn, result, done = self._q.get()
+            try:
+                result["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in caller
+                result["error"] = e
+            finally:
+                done.set()
+
+    def submit(self, fn):
+        result: dict = {}
+        done = threading.Event()
+        self._q.put((fn, result, done))
+        return result, done
+
+
+_deadline_worker: Optional[_DeadlineWorker] = None
+_deadline_lock = threading.Lock()
+
+
+def _with_deadline(fn: Callable[[], Any], timeout_s: Optional[float],
+                   what: str) -> Any:
+    """Run ``fn`` under an optional deadline (explicit arg >
+    ``TPUDIST_HOST_TIMEOUT_S`` env > none).  The op runs on the shared
+    worker thread; on expiry the caller gets :class:`HostFabricTimeout`
+    while the wedged op is left to the abandoned (daemon) worker — the
+    process is expected to abort/restart shortly after, which is the
+    point.  Ops queue in order on one worker, so a caller queued behind a
+    wedged op times out too — semantically fine: its deadline measured no
+    progress either."""
+    global _deadline_worker
+    if timeout_s is None:
+        timeout_s = _default_host_timeout()
+    if timeout_s is None:
+        return fn()
+    with _deadline_lock:
+        if _deadline_worker is None:
+            _deadline_worker = _DeadlineWorker()
+        worker = _deadline_worker
+    result, done = worker.submit(fn)
+    if not done.wait(timeout_s):
+        with _deadline_lock:
+            if _deadline_worker is worker:  # wedged: next op gets a fresh one
+                _deadline_worker = None
+        raise HostFabricTimeout(
+            f"host-fabric op '{what}' exceeded its {timeout_s:.1f}s "
+            f"deadline (wedged peer or dead coordinator?)")
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")
 
 
 def psum_tree(tree: Any, axis_name: str) -> Any:
@@ -47,18 +134,26 @@ def pmean_tree(tree: Any, axis_name: str) -> Any:
     return jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), tree)
 
 
-def host_allreduce_sum(x: Any) -> Any:
+def host_allreduce_sum(x: Any, *, timeout_s: Optional[float] = None) -> Any:
     """Sum pytree leaves across *processes* on the host (Gloo-group analog).
 
     Uses ``multihost_utils.process_allgather`` (DCN / coordination service)
     when the job is multi-process; identity in a single process.
+    ``timeout_s`` (or ``TPUDIST_HOST_TIMEOUT_S``) bounds the wait — see
+    :class:`HostFabricTimeout`.
     """
-    if jax.process_count() == 1:
-        return jax.tree.map(np.asarray, x)
-    from jax.experimental import multihost_utils
+    from tpudist.runtime import faults
 
-    gathered = multihost_utils.process_allgather(x)  # leading axis = process
-    return jax.tree.map(lambda g: np.sum(np.asarray(g), axis=0), gathered)
+    def op():
+        faults.inject_host()
+        if jax.process_count() == 1:
+            return jax.tree.map(np.asarray, x)
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(x)  # leading axis = process
+        return jax.tree.map(lambda g: np.sum(np.asarray(g), axis=0), gathered)
+
+    return _with_deadline(op, timeout_s, "host_allreduce_sum")
 
 
 def cross_process_mean_scalar(value, weight: float) -> float:
@@ -87,13 +182,22 @@ def batch_weighted_loss_mean(
     return {k: cross_process_mean_scalar(v, batch_size) for k, v in local.items()}
 
 
-def barrier(name: str = "tpudist_barrier") -> None:
-    """Cross-process barrier (``dist.barrier()``, ``demo.py:177``)."""
-    if jax.process_count() == 1:
-        return
-    from jax.experimental import multihost_utils
+def barrier(name: str = "tpudist_barrier", *,
+            timeout_s: Optional[float] = None) -> None:
+    """Cross-process barrier (``dist.barrier()``, ``demo.py:177``).
+    ``timeout_s`` (or ``TPUDIST_HOST_TIMEOUT_S``) bounds the wait — see
+    :class:`HostFabricTimeout`."""
+    from tpudist.runtime import faults
 
-    multihost_utils.sync_global_devices(name)
+    def op():
+        faults.inject_host()
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+    _with_deadline(op, timeout_s, f"barrier[{name}]")
 
 
 def device_put_global(x: np.ndarray, sharding, global_shape=None) -> jax.Array:

@@ -49,6 +49,7 @@ import time
 from typing import Dict, List, Optional
 
 from tpudist.runtime.bootstrap import find_free_port
+from tpudist.runtime.watchdog import WATCHDOG_EXIT_CODE
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -300,6 +301,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 1
             rc = _run_attempt(cmd, args, coordinator, world, run_id, attempt,
                               error_template, tmpdir)
+            if rc == WATCHDOG_EXIT_CODE:
+                # The hang watchdog aborted a wedged worker on purpose so
+                # THIS restart loop could re-admit the group — say so (the
+                # stall stack dump is in the crash record below).
+                print("[tpurun] worker group aborted by the hang watchdog "
+                      f"(exit {WATCHDOG_EXIT_CODE}): a stalled step or "
+                      "wedged collective was detected", file=sys.stderr)
             if _preempt_state["flag"]:
                 ok = rc == 0
                 print("[tpurun] preemption: worker group "

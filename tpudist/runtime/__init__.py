@@ -16,3 +16,8 @@ from tpudist.runtime.seeding import (  # noqa: F401
     resolve_shared_seed,
 )
 from tpudist.runtime.rank_logging import rank_print, rank_zero_only, describe_runtime  # noqa: F401
+from tpudist.runtime.watchdog import (  # noqa: F401
+    WATCHDOG_EXIT_CODE,
+    Watchdog,
+)
+from tpudist.runtime import faults  # noqa: F401

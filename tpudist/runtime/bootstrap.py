@@ -29,8 +29,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import socket
-from typing import Optional
+import sys
+import time
+from typing import Callable, Optional
 
 
 class BootstrapError(RuntimeError):
@@ -227,11 +230,50 @@ def find_free_port() -> int:
 _INITIALIZED_CTX: Optional[ProcessContext] = None
 
 
+def _retry_with_backoff(
+    fn: Callable[[int], "object"],
+    *,
+    retries: int,
+    backoff_s: float,
+    what: str,
+    retry_on: tuple = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Callable[[], float] = random.random,
+):
+    """Run ``fn(attempt)`` with up to ``retries`` retries on ``retry_on``
+    failures, sleeping a jittered exponential backoff between attempts:
+    ``backoff_s * 2**attempt * (0.5 + rng())`` — the jitter (0.5x–1.5x)
+    decorrelates a whole worker group hammering a recovering coordinator
+    at the same instant.  KeyboardInterrupt/SystemExit (and anything not
+    in ``retry_on``) pass through.  Shared by distributed init and the
+    checkpoint manager's save path."""
+    attempt = 0
+    while True:
+        try:
+            return fn(attempt)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except retry_on as e:  # noqa: BLE001 — bounded by `retries`
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt) * (0.5 + rng())
+            print(
+                f"[tpudist.retry] {what} failed "
+                f"(attempt {attempt + 1}/{retries + 1}): "
+                f"{type(e).__name__}: {e}; retrying in {delay:.1f}s",
+                file=sys.stderr, flush=True,
+            )
+            sleep(delay)
+            attempt += 1
+
+
 def initialize(
     ctx: Optional[ProcessContext] = None,
     *,
     use_node_rank: bool = False,
     initialization_timeout_s: int = 3600,
+    init_retries: Optional[int] = None,
+    init_backoff_s: Optional[float] = None,
 ) -> ProcessContext:
     """Bring up the JAX coordination service for this process.
 
@@ -239,6 +281,12 @@ def initialize(
     1-hour init timeout (``demo.py:27``) is preserved as
     ``initialization_timeout_s``.  Idempotent: a second call returns the
     context from the first.
+
+    ``jax.distributed.initialize`` is retried with jittered exponential
+    backoff on transient coordinator failures (a worker restarted by
+    ``tpurun`` often races the coordinator's own restart): ``init_retries``
+    retries (default ``TPUDIST_INIT_RETRIES`` or 3) starting at
+    ``init_backoff_s`` (default ``TPUDIST_INIT_BACKOFF_S`` or 1.0s).
     """
     global _INITIALIZED_CTX
     if _INITIALIZED_CTX is not None:
@@ -267,14 +315,39 @@ def initialize(
     enable_compilation_cache()
     if ctx is None:
         ctx = resolve_process_context(use_node_rank=use_node_rank)
+    # Chaos harness: honor TPUDIST_FAULT from the earliest runtime seam.
+    from tpudist.runtime import faults
+
+    faults.arm_from_env()
     if ctx.is_distributed:
         import jax
 
-        jax.distributed.initialize(
-            coordinator_address=ctx.coordinator_address,
-            num_processes=ctx.num_processes,
-            process_id=ctx.process_id,
-            initialization_timeout=initialization_timeout_s,
+        from tpudist.utils.envutil import env_float
+
+        if init_retries is None:
+            init_retries = max(0, int(env_float("TPUDIST_INIT_RETRIES", 3)))
+        if init_backoff_s is None:
+            init_backoff_s = env_float("TPUDIST_INIT_BACKOFF_S", 1.0)
+
+        def _attempt(attempt: int) -> None:
+            faults.inject_init(attempt)
+            if attempt > 0:
+                # A failed connect leaves jax's global distributed state
+                # half-initialized (State.initialize sets .client BEFORE
+                # connect()), so a bare retry would raise 'should only be
+                # called once' forever.  shutdown() clears it and is a
+                # documented no-op when nothing is running.
+                jax.distributed.shutdown()
+            jax.distributed.initialize(
+                coordinator_address=ctx.coordinator_address,
+                num_processes=ctx.num_processes,
+                process_id=ctx.process_id,
+                initialization_timeout=initialization_timeout_s,
+            )
+
+        _retry_with_backoff(
+            _attempt, retries=init_retries, backoff_s=init_backoff_s,
+            what=f"jax.distributed.initialize({ctx.coordinator_address})",
         )
     _INITIALIZED_CTX = ctx
     return ctx

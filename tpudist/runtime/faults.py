@@ -1,0 +1,278 @@
+"""Deterministic fault injection for chaos testing the tpudist runtime.
+
+The failure paths (preemption saves, ``tpurun`` restarts, degraded-mode
+checkpoint restore, init retry) are only as trustworthy as their tests,
+and none of them can be exercised without a way to *cause* the failure on
+demand.  This registry injects faults at four seams — the train loop, the
+host fabric, checkpoint saves, and distributed init — driven by one env
+var so chaos tests (and operators reproducing an incident) need no code
+changes::
+
+    TPUDIST_FAULT=kill@step:7,rank:1        # SIGKILL rank 1 at step 7
+    TPUDIST_FAULT=sigterm@step:5            # preemption drill at step 5
+    TPUDIST_FAULT=ckpt_corrupt@step:10      # garble the save at/after step 10
+    TPUDIST_FAULT=host_delay@ms:500         # stall every host collective 500ms
+    TPUDIST_FAULT=init_fail@attempts:2      # fail the first 2 init attempts
+    TPUDIST_FAULT=ckpt_corrupt@step:16;kill@step:19   # compose with ';'
+
+Grammar: ``kind@key:int[,key:int][;kind@...]``.  Common keys: ``rank``
+restricts the fault to one process (default: all); ``attempt`` fires only
+on that ``TPUDIST_RESTART_COUNT`` (default 0 for the one-shot kinds, so a
+``tpurun``-restarted group is NOT re-killed — the whole point of the
+kill→restart→resume chaos test).
+
+Cost when disarmed (production): every injection point is one module
+attribute load and a ``None`` check — no parsing, no env reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+ENV_VAR = "TPUDIST_FAULT"
+
+# kind -> (required params, allowed params)
+_SCHEMA: Dict[str, tuple] = {
+    "kill": ({"step"}, {"step", "rank", "attempt"}),
+    "sigterm": ({"step"}, {"step", "rank", "attempt"}),
+    "ckpt_corrupt": ({"step"}, {"step", "rank", "attempt"}),
+    "host_delay": ({"ms"}, {"ms", "rank"}),
+    "init_fail": ({"attempts"}, {"attempts", "rank"}),
+}
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``TPUDIST_FAULT`` value."""
+
+
+class TransientInitError(RuntimeError):
+    """Injected coordinator-init failure (``init_fail``) — shaped like the
+    transient connect errors the bootstrap retry loop exists to absorb."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    params: Dict[str, int]
+    fired: int = 0
+
+    def param(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        return self.params.get(key, default)
+
+
+def parse(spec: str) -> List[FaultSpec]:
+    """Parse the ``TPUDIST_FAULT`` grammar; raises :class:`FaultSpecError`
+    on unknown kinds/keys or non-integer values (fail loud: a typo'd chaos
+    spec silently doing nothing would defeat the test that armed it)."""
+    out: List[FaultSpec] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, sep, rest = part.partition("@")
+        kind = kind.strip()
+        if kind not in _SCHEMA:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} in {part!r} "
+                f"(known: {sorted(_SCHEMA)})")
+        required, allowed = _SCHEMA[kind]
+        params: Dict[str, int] = {}
+        if sep:
+            for kv in rest.split(","):
+                key, sep2, val = kv.partition(":")
+                key = key.strip()
+                if not sep2 or key not in allowed:
+                    raise FaultSpecError(
+                        f"bad param {kv!r} for fault {kind!r} "
+                        f"(allowed: {sorted(allowed)})")
+                try:
+                    params[key] = int(val)
+                except ValueError as e:
+                    raise FaultSpecError(
+                        f"param {key!r} of fault {kind!r} must be an "
+                        f"integer, got {val!r}") from e
+        missing = required - params.keys()
+        if missing:
+            raise FaultSpecError(
+                f"fault {kind!r} missing required param(s) {sorted(missing)}")
+        out.append(FaultSpec(kind=kind, params=params))
+    if not out:
+        raise FaultSpecError(f"empty fault spec {spec!r}")
+    return out
+
+
+# -- arming -----------------------------------------------------------------
+
+_PLAN: Optional[List[FaultSpec]] = None
+_SOURCE: Optional[str] = None  # "env" | "explicit"
+_ENV_SPEC: Optional[str] = None  # the env string _PLAN was parsed from
+
+
+def arm(spec: str) -> List[FaultSpec]:
+    """Arm the registry from an explicit spec string (tests)."""
+    global _PLAN, _SOURCE, _ENV_SPEC
+    _PLAN = parse(spec)
+    _SOURCE = "explicit"
+    _ENV_SPEC = None
+    return _PLAN
+
+
+def arm_from_env() -> bool:
+    """Arm from ``TPUDIST_FAULT`` if set (idempotent; re-parses only when
+    the env value changed).  Called by ``run_training`` and
+    ``runtime.bootstrap.initialize`` so the grammar works with zero code
+    changes in the job.  An explicit :func:`arm` is never clobbered, and an
+    unset env var disarms only an env-armed plan."""
+    global _PLAN, _SOURCE, _ENV_SPEC
+    spec = os.environ.get(ENV_VAR)
+    if not spec:
+        if _SOURCE == "env":
+            disarm()
+        return False
+    if _SOURCE == "explicit":
+        return False
+    if _SOURCE == "env" and spec == _ENV_SPEC:
+        return True
+    _PLAN = parse(spec)
+    _SOURCE = "env"
+    _ENV_SPEC = spec
+    return True
+
+
+def disarm() -> None:
+    global _PLAN, _SOURCE, _ENV_SPEC
+    _PLAN = None
+    _SOURCE = None
+    _ENV_SPEC = None
+
+
+def armed() -> bool:
+    return _PLAN is not None
+
+
+# -- gating helpers ---------------------------------------------------------
+
+def _restart_count() -> int:
+    from tpudist.utils.envutil import env_int
+
+    return env_int("TPUDIST_RESTART_COUNT", 0)
+
+
+def _current_rank() -> int:
+    from tpudist.utils.envutil import env_rank
+
+    rank = env_rank()
+    if rank is not None:
+        return rank
+    if "jax" in sys.modules:  # never import jax just to gate a fault
+        try:
+            return sys.modules["jax"].process_index()
+        except Exception:
+            pass
+    return 0
+
+
+def _rank_matches(spec: FaultSpec) -> bool:
+    rank = spec.param("rank")
+    return rank is None or rank == _current_rank()
+
+
+def _one_shot_due(spec: FaultSpec, step: int) -> bool:
+    """kill/sigterm/ckpt_corrupt: fire once, at the first injection point
+    whose step is >= the spec's, on the matching restart attempt/rank."""
+    return (
+        spec.fired == 0
+        and step >= spec.params["step"]
+        and spec.param("attempt", 0) == _restart_count()
+        and _rank_matches(spec)
+    )
+
+
+def _log(msg: str) -> None:
+    print(f"[tpudist.faults] {msg}", file=sys.stderr, flush=True)
+
+
+# -- injection points -------------------------------------------------------
+
+def inject_step(step: int) -> None:
+    """Train-loop injection point (called once per iteration/window)."""
+    if _PLAN is None:
+        return
+    for spec in _PLAN:
+        if spec.kind in ("kill", "sigterm") and _one_shot_due(spec, step):
+            spec.fired += 1
+            signum = signal.SIGKILL if spec.kind == "kill" else signal.SIGTERM
+            _log(f"injecting {spec.kind} at step {step} "
+                 f"(rank {_current_rank()}, attempt {_restart_count()})")
+            os.kill(os.getpid(), signum)
+
+
+def inject_host() -> None:
+    """Host-fabric injection point (``host_allreduce_sum`` / ``barrier``)."""
+    if _PLAN is None:
+        return
+    for spec in _PLAN:
+        if spec.kind == "host_delay" and _rank_matches(spec):
+            spec.fired += 1
+            time.sleep(spec.params["ms"] / 1000.0)
+
+
+def inject_init(attempt: int) -> None:
+    """Distributed-init injection point: raises :class:`TransientInitError`
+    for the first ``attempts`` calls (exercises the bootstrap retry loop).
+    ``attempt`` is informational (logged)."""
+    if _PLAN is None:
+        return
+    for spec in _PLAN:
+        if (spec.kind == "init_fail" and _rank_matches(spec)
+                and spec.fired < spec.params["attempts"]):
+            spec.fired += 1
+            _log(f"injecting init failure "
+                 f"({spec.fired}/{spec.params['attempts']}, "
+                 f"attempt {attempt})")
+            raise TransientInitError(
+                f"injected transient init failure "
+                f"{spec.fired}/{spec.params['attempts']}")
+
+
+def inject_ckpt_save(step: int, step_dir: os.PathLike,
+                     wait: Optional[Callable[[], None]] = None) -> bool:
+    """Checkpoint-save injection point: after a (possibly async) save of
+    ``step``, a due ``ckpt_corrupt`` fault waits for the write to finish
+    and garbles the step's payload in place.  Returns whether it fired."""
+    if _PLAN is None:
+        return False
+    for spec in _PLAN:
+        if spec.kind == "ckpt_corrupt" and _one_shot_due(spec, step):
+            spec.fired += 1
+            if wait is not None:
+                wait()
+            n = corrupt_checkpoint(step_dir)
+            _log(f"corrupted checkpoint step {step} "
+                 f"({n} files garbled under {os.fspath(step_dir)})")
+            return True
+    return False
+
+
+def corrupt_checkpoint(step_dir: os.PathLike) -> int:
+    """Garble every payload file under an Orbax step directory, keeping the
+    step *listed* (its commit metadata survives) so restore has to detect
+    the corruption the hard way — the scenario degraded-mode restore
+    exists for.  Returns the number of files garbled."""
+    root = Path(step_dir)
+    n = 0
+    for f in sorted(root.rglob("*")):
+        if not f.is_file() or "_CHECKPOINT_METADATA" in f.name:
+            continue
+        try:
+            f.write_bytes(b"tpudist-fault-injected-corruption")
+            n += 1
+        except OSError:
+            pass
+    return n

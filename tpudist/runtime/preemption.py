@@ -38,19 +38,42 @@ _last_run_preempted = False  # sticky: survives reset() (callers consult it)
 
 
 def install(signals: Iterable[int] = (signal.SIGTERM,)) -> bool:
-    """Install the preemption handler (idempotent; main thread only —
-    CPython restricts ``signal.signal`` to it).  Returns whether anything
-    NEW was installed — the caller that got True owns the matching
+    """Install the preemption handler (idempotent).  Returns whether
+    anything NEW was installed — the caller that got True owns the matching
     :func:`reset` (``run_training`` restores handlers on exit so SIGTERM
-    terminates the process again once training is done)."""
-    new = False
+    terminates the process again once training is done).
+
+    CPython restricts ``signal.signal`` to the main thread; called off it
+    (Trainer under a threaded test runner), this degrades to a no-op
+    returning ``False`` with a one-line warning — the caller still trains,
+    just without preemption saves — instead of crashing with ValueError."""
+    new = []
     for signum in signals:
         if any(s == signum for s, _ in _installed):
             continue
-        prev = signal.signal(signum, _handle)
+        try:
+            prev = signal.signal(signum, _handle)
+        except ValueError:
+            # Roll back what THIS call installed: a False return means the
+            # caller will never own reset(), so nothing may stay behind.
+            for s, p in reversed(new):
+                try:
+                    signal.signal(s, p)
+                except (ValueError, OSError):
+                    pass
+                _installed.remove((s, p))
+            import warnings
+
+            warnings.warn(
+                "tpudist.runtime.preemption.install() could not install a "
+                "signal handler (not on the main thread, or an invalid "
+                "signal); preemption-save handling disabled for this run",
+                RuntimeWarning, stacklevel=2,
+            )
+            return False
         _installed.append((signum, prev))
-        new = True
-    return new
+        new.append((signum, prev))
+    return bool(new)
 
 
 def _handle(signum, frame):  # noqa: ARG001

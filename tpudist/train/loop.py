@@ -57,6 +57,17 @@ class TrainLoopConfig:
     # (all processes agree on it via the host fabric) and return early.
     preempt_save: bool = True
 
+    # Hang watchdog (tpudist.runtime.watchdog): abort the process with
+    # exit 124 + all-thread stack dump when no iteration/window completes
+    # within this deadline, so tpurun's restart loop re-admits the group
+    # instead of burning the allocation until scheduler timeout.  None =
+    # resolve from TPUDIST_WATCHDOG_S (unset = disabled).  Size it above
+    # the slowest legitimate gap between PETS — that includes a synchronous
+    # checkpoint save and the end-of-run save drain / teardown barrier,
+    # not just a step — the first deadline gets 10x slack for XLA
+    # compilation.
+    watchdog_timeout_s: Optional[float] = None
+
     def __post_init__(self):
         if self.sync_every is None:
             from tpudist.utils.tuning import tuned
@@ -83,10 +94,8 @@ def preemption_scope(enabled: bool):
     preemption.clear_last_run_preempted()
     installed = False
     if enabled:
-        try:
-            installed = preemption.install()
-        except ValueError:
-            pass  # not the main thread — caller owns signal handling
+        # Off the main thread install() degrades to a warned no-op (False).
+        installed = preemption.install()
     try:
         yield
     finally:
@@ -204,14 +213,30 @@ def run_training(
     Numerics and log rows are identical to the per-step path.
     """
     config = config or TrainLoopConfig()
+    from tpudist.runtime import faults, watchdog
+
+    faults.arm_from_env()  # chaos harness: TPUDIST_FAULT grammar, no code changes
+    wd = watchdog.from_config(
+        config.watchdog_timeout_s, name="train_loop",
+        first_deadline_s=(config.watchdog_timeout_s or
+                          watchdog.timeout_from_env() or 0.0) * 10,
+    )
     with preemption_scope(config.preempt_save and ckpt is not None):
-        return _dispatch_training(
-            states, step_fn, loader, mesh, logger, config,
-            ckpt, start_iteration, chunk_step_fn)
+        if wd is not None:
+            wd.start()
+        try:
+            return _dispatch_training(
+                states, step_fn, loader, mesh, logger, config,
+                ckpt, start_iteration, chunk_step_fn, wd)
+        finally:
+            if wd is not None:
+                wd.stop()
 
 
 def _dispatch_training(states, step_fn, loader, mesh, logger, config,
-                       ckpt, start_iteration, chunk_step_fn):
+                       ckpt, start_iteration, chunk_step_fn, wd=None):
+    from tpudist.runtime import faults
+
     if (
         chunk_step_fn is not None
         and config.device_cache
@@ -221,7 +246,8 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
         <= config.device_cache_max_bytes
     ):
         return _run_scanned(
-            states, chunk_step_fn, loader, mesh, logger, config, ckpt, start_iteration
+            states, chunk_step_fn, loader, mesh, logger, config, ckpt,
+            start_iteration, wd
         )
     sharding = batch_sharding(mesh)
     # resume fast-forward: whole epochs are skipped arithmetically; only the
@@ -243,9 +269,14 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
         for x, y in loader.iter_from(skip):
             if iteration >= config.total_iterations:
                 break
+            faults.inject_step(iteration)  # chaos: kill/sigterm@step
             bs = x.shape[0]
             gx, gy = shard_batch((x, y), sharding)
             states, losses = step_fn(states, gx, gy)
+            if wd is not None:
+                # Pet AFTER the step: the first pet must land past the XLA
+                # compile so the watchdog's first-deadline slack covers it.
+                wd.pet()
             last_losses = losses
             if deferred is not None and iteration % config.log_every == 0:
                 deferred.add(iteration, bs, losses)
@@ -254,6 +285,8 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
                 ckpt.maybe_save(
                     iteration, states, {"iteration": iteration, "epoch": epoch}
                 )
+                if wd is not None:
+                    wd.pet()  # a save making I/O progress is not a hang
             if (config.preempt_save and ckpt is not None
                     and iteration < config.total_iterations
                     and iteration % max(1, config.sync_every) == 0
@@ -279,7 +312,8 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
 
 
 def _run_scanned(
-    states, chunk_step_fn, loader, mesh, logger, config, ckpt, start_iteration
+    states, chunk_step_fn, loader, mesh, logger, config, ckpt,
+    start_iteration, wd=None
 ):
     """Device-cached scan loop (see ``run_training``).
 
@@ -323,8 +357,11 @@ def _run_scanned(
     pending_losses = []  # (first_iteration, device dict of (K,) losses)
     last_losses = None
 
+    from tpudist.runtime import faults
+
     preempted = False
     while iteration < total:
+        faults.inject_step(iteration)  # chaos: kill/sigterm at window edges
         # window length: sync cadence, save cadence, and budget boundaries
         k = min(max(1, config.sync_every), total - iteration)
         if save_every > 0:
@@ -346,6 +383,10 @@ def _run_scanned(
                 epoch += 1
         idx = jax.device_put(np.stack(idx_rows).astype(np.int32), repl)
         states, losses = chunk_step_fn(states, x_all, y_all, idx)
+        if wd is not None:
+            # Pet AFTER the window: the first pet must land past the XLA
+            # compile so the watchdog's first-deadline slack covers it.
+            wd.pet()
         last_losses = losses
         if logger is not None:
             pending_losses.append((iteration, losses))
@@ -355,6 +396,8 @@ def _run_scanned(
         iteration += len(idx_rows)
         if ckpt is not None:
             ckpt.maybe_save(iteration, states, {"iteration": iteration, "epoch": epoch})
+            if wd is not None:
+                wd.pet()  # a save making I/O progress is not a hang
         if pbar is not None:
             pbar.update(len(idx_rows))
         # Window edges are the natural (all-process-agreed) preemption

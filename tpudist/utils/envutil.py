@@ -1,0 +1,56 @@
+"""Env-var parsing shared by the runtime knobs (watchdog deadline, host
+fabric timeout, init retry/backoff).  Kept dependency-free: the watchdog
+imports this and must stay importable without jax."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    """``float(os.environ[name])``, falling back to ``default`` when the
+    var is unset, empty, or unparseable (a typo'd knob must never take a
+    job down — the default is always a safe behavior)."""
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def env_positive_float(name: str,
+                       default: Optional[float] = None) -> Optional[float]:
+    """Like :func:`env_float`, with ``<= 0`` meaning "explicitly disabled"
+    (maps to ``default``) — the contract of the deadline/timeout knobs."""
+    v = env_float(name, None)
+    return default if v is None or v <= 0 else v
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    """``int(os.environ[name])``, falling back to ``default`` when the var
+    is unset, empty, or unparseable."""
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def env_rank(default: Optional[int] = None) -> Optional[int]:
+    """This process's global rank from the launcher env contracts, in
+    precedence order (tpudist > torchrun > SLURM) — the ONE resolution
+    chain shared by crash-record attribution and fault-injection gating,
+    so they can never disagree about which rank a process is."""
+    for var in ("TPUDIST_PROCESS_ID", "RANK", "SLURM_PROCID"):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                continue
+    return default

@@ -24,6 +24,51 @@ def error_file_path(process_id: int) -> str:
     return template.replace("%r", str(process_id))
 
 
+def _resolve_process_id() -> int:
+    from tpudist.utils.envutil import env_rank
+
+    return env_rank(0)
+
+
+def write_error_record(payload: dict, process_id: "int | None" = None) -> "str | None":
+    """Write a crash record atomically (tmp + ``os.replace``, same pattern
+    as the checkpoint manager's meta overlays) and return its path.
+
+    Atomicity matters: the record is written while the process is dying —
+    a SIGKILL landing mid-``json.dump`` of a plain ``open(...,"w")`` left a
+    torn file that ``tpurun``'s ``_read_crash_records`` silently skipped,
+    losing the first-failure record the launcher exists to surface.
+    Identity fields (process_id/pid/timestamp/argv) are filled in; the
+    caller's ``payload`` wins on collision.  Returns ``None`` when the
+    record could not be written (never raises — the original failure must
+    still propagate).
+    """
+    if process_id is None:
+        process_id = _resolve_process_id()
+    full = {
+        "process_id": process_id,
+        "pid": os.getpid(),
+        "timestamp": time.time(),
+        "argv": sys.argv,
+    }
+    full.update(payload)
+    path = error_file_path(process_id)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(full, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
 def record(fn: Callable) -> Callable:
     """Decorate an entry point ``main``; on exception, write a structured
     error record and re-raise."""
@@ -33,26 +78,11 @@ def record(fn: Callable) -> Callable:
         try:
             return fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — we re-raise
-            try:
-                pid = int(os.environ.get("TPUDIST_PROCESS_ID")
-                          or os.environ.get("RANK")
-                          or os.environ.get("SLURM_PROCID") or 0)
-            except ValueError:
-                pid = 0
-            payload = {
-                "process_id": pid,
-                "pid": os.getpid(),
-                "timestamp": time.time(),
+            write_error_record({
                 "exc_type": type(e).__name__,
                 "message": str(e),
                 "traceback": traceback.format_exc(),
-                "argv": sys.argv,
-            }
-            try:
-                with open(error_file_path(pid), "w") as f:
-                    json.dump(payload, f, indent=2)
-            except OSError:
-                pass
+            })
             raise
 
     return wrapper
