@@ -45,12 +45,20 @@ def main() -> None:
     local_seed = per_process_seed(args.seed)
     describe_runtime(ctx, local_seed)
 
-    mesh = data_parallel_mesh()
-    states, step, loader, loop_cfg, chunk_step = build_training(args, mesh)
-    logger = build_logger(args, default_group="demo_dp")
-    ckpt, states, start = build_checkpointing(args, states)
+    from tpudist.utils import StageTimer, trace
 
-    from tpudist.utils import trace
+    # Host-phase accounting: the setup stages land in the telemetry
+    # report's "Host stages" section.  Telemetry-only on purpose — a
+    # metrics row here would break the "metrics.jsonl non-empty ⇒
+    # training iterates" readiness signal the preemption tests poll.
+    stages = StageTimer()
+    mesh = data_parallel_mesh()
+    with stages.phase("build_training"):
+        states, step, loader, loop_cfg, chunk_step = build_training(args, mesh)
+    logger = build_logger(args, default_group="demo_dp")
+    with stages.phase("setup_checkpointing"):
+        ckpt, states, start = build_checkpointing(args, states)
+    stages.emit()
 
     with trace(args.profile_dir):
         states, losses = run_training(

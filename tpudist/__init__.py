@@ -20,6 +20,9 @@ A ground-up JAX/XLA re-design of the capabilities demonstrated by
 - ``tpudist.trainer``   — a Lightning-equivalent high-level Trainer facade
   (parity with ``demo_pytorch_lightning.py``).
 - ``tpudist.ops``       — Pallas TPU kernels for hot ops.
+- ``tpudist.telemetry`` — per-step span tracing, cross-rank/generation
+  aggregation, and end-of-run goodput reports (step vs compile vs data
+  vs checkpoint vs idle vs lost-to-restart, summing to wall-clock).
 - ``tpudist.utils``     — metrics/W&B-compatible logging, profiling, misc.
 """
 

@@ -22,6 +22,8 @@ from typing import Any, Optional, Tuple
 
 import jax
 
+from tpudist import telemetry
+
 
 @dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
@@ -100,6 +102,12 @@ class CheckpointManager:
         this runs inside a SIGTERM grace window, and a SIGKILL landing
         between a delete and a completed re-save would destroy the only
         valid checkpoint of that step (r3 advisor finding)."""
+        with telemetry.span("ckpt_save", step=step,
+                            blocking=not self.config.async_save):
+            return self._save(step, states, meta, force)
+
+    def _save(self, step: int, states: Any, meta: dict,
+              force: bool = False) -> bool:
         ocp = self._ocp
         if step in self._mgr.all_steps():
             if not force:
@@ -218,6 +226,11 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.config.directory}"
             )
+        with telemetry.span("ckpt_restore", step=step):
+            return self._restore(step, abstract_state, explicit)
+
+    def _restore(self, step: int, abstract_state: Any,
+                 explicit: bool) -> Tuple[Any, dict]:
         if explicit or not self.config.restore_fallback:
             return self._restore_step(step, abstract_state)
         candidates = sorted(
@@ -361,10 +374,14 @@ class CheckpointManager:
         return restored["state"], meta
 
     def wait_until_finished(self) -> None:
-        self._mgr.wait_until_finished()
+        """Drain in-flight async saves — recorded as ``ckpt_wait`` so the
+        goodput report attributes the background write's blocking tail."""
+        with telemetry.span("ckpt_wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        with telemetry.span("ckpt_wait"):
+            self._mgr.wait_until_finished()
         self._mgr.close()
 
 

@@ -99,7 +99,19 @@ def _with_deadline(fn: Callable[[], Any], timeout_s: Optional[float],
     process is expected to abort/restart shortly after, which is the
     point.  Ops queue in order on one worker, so a caller queued behind a
     wedged op times out too — semantically fine: its deadline measured no
-    progress either."""
+    progress either.
+
+    Every host-fabric op routes through here, so this is also the ONE
+    telemetry seam for the ``host_collective`` span (the goodput report's
+    ``comm`` component)."""
+    from tpudist import telemetry
+
+    with telemetry.span("host_collective", op=what):
+        return _with_deadline_inner(fn, timeout_s, what)
+
+
+def _with_deadline_inner(fn: Callable[[], Any], timeout_s: Optional[float],
+                         what: str) -> Any:
     global _deadline_worker
     if timeout_s is None:
         timeout_s = _default_host_timeout()

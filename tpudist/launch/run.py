@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "tempdir); exported to workers as TPUDIST_TMPDIR")
     p.add_argument("--error-dir", default=None,
                    help="directory for per-rank crash records (default: tmpdir)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="where workers stream per-rank telemetry JSONL and "
+                        "the end-of-run goodput report lands (default: "
+                        "$TPUDIST_TELEMETRY_DIR or <tmpdir>/telemetry; "
+                        "TPUDIST_TELEMETRY=0 disables)")
     p.add_argument("--no-python-check", action="store_true",
                    help="allow worker commands that do not start with 'python'")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -103,7 +108,8 @@ def _validate_cmd(cmd: List[str], allow_any: bool) -> List[str]:
 
 def _worker_env(base: Dict[str, str], *, coordinator: Optional[str], world: int,
                 rank: int, local_rank: int, nprocs: int, run_id: str,
-                restart_count: int, error_template: str, tmpdir: str) -> Dict[str, str]:
+                restart_count: int, error_template: str, tmpdir: str,
+                telemetry_dir: Optional[str] = None) -> Dict[str, str]:
     env = dict(base)
     env.update({
         "TPUDIST_NUM_PROCESSES": str(world),
@@ -117,6 +123,11 @@ def _worker_env(base: Dict[str, str], *, coordinator: Optional[str], world: int,
     })
     if coordinator:
         env["TPUDIST_COORDINATOR"] = coordinator
+    if telemetry_dir:
+        # All generations of all local workers stream into ONE dir — the
+        # per-rank/per-generation file names keep them apart, and the
+        # end-of-run merge joins them into the goodput report.
+        env["TPUDIST_TELEMETRY_DIR"] = telemetry_dir
     return env
 
 
@@ -169,7 +180,7 @@ def _terminate(procs: List[subprocess.Popen], grace_s: float = 10.0) -> None:
 
 def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
                  run_id: str, restart_count: int, error_template: str,
-                 tmpdir: str) -> int:
+                 tmpdir: str, telemetry_dir: Optional[str] = None) -> int:
     """Launch the local worker group once; return 0 iff all workers exit 0."""
     procs: List[subprocess.Popen] = []
     _preempt_state["procs"] = procs
@@ -193,7 +204,8 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
         env = _worker_env(base_env, coordinator=coordinator, world=world,
                           rank=rank, local_rank=i, nprocs=args.nprocs,
                           run_id=run_id, restart_count=restart_count,
-                          error_template=error_template, tmpdir=tmpdir)
+                          error_template=error_template, tmpdir=tmpdir,
+                          telemetry_dir=telemetry_dir)
         procs.append(subprocess.Popen(cmd, env=env))
     failed_rc = 0
     try:
@@ -259,9 +271,42 @@ def main(argv: Optional[List[str]] = None) -> int:
     error_dir = args.error_dir or tmpdir
     os.makedirs(error_dir, exist_ok=True)
 
+    # Telemetry: workers stream per-rank/per-generation span JSONL into one
+    # dir; the agent merges it into report.json/report.md on exit — every
+    # run (clean, crashed, preempted, restart-exhausted) ends with a
+    # goodput report next to the crash records.
+    from tpudist.telemetry import enabled_from_env as _telemetry_enabled
+
+    telemetry_dir: Optional[str] = None
+    if _telemetry_enabled():
+        # Default placement must survive the agent: an agent-owned tmpdir
+        # is rmtree'd at exit, which would delete the very report a
+        # crashed run exists to leave behind — fall back to the bare-run
+        # default (runs/telemetry, cwd) in that case.
+        telemetry_dir = (args.telemetry_dir
+                         or os.environ.get("TPUDIST_TELEMETRY_DIR")
+                         or (os.path.join("runs", "telemetry") if owns_tmpdir
+                             else os.path.join(tmpdir, "telemetry")))
+
     if args.stage_data:
         from tpudist.launch.staging import extract_tarballs
-        extract_tarballs(args.stage_data.split(","), tmpdir)
+        from tpudist.utils.profiling import StageTimer
+
+        stage_timer = StageTimer()
+        with stage_timer.phase("stage_data"):
+            extract_tarballs(args.stage_data.split(","), tmpdir)
+        if telemetry_dir:
+            # The agent has no global session; record the staging phase
+            # into its own stream (pseudo-rank = world + node_rank: past
+            # every worker rank AND distinct per node, so agents sharing
+            # a --telemetry-dir never clobber each other's stream).
+            from tpudist import telemetry as _tele
+
+            s = _tele.TelemetrySession(telemetry_dir,
+                                       rank=world + args.node_rank,
+                                       generation=0)
+            stage_timer.emit(session=s)
+            s.close()
 
     # Preemption protocol: SLURM SIGTERMs the agent's process group; the
     # agent must survive it (forwarding to workers that missed the group
@@ -300,7 +345,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       "not launching a new worker group", file=sys.stderr)
                 return 1
             rc = _run_attempt(cmd, args, coordinator, world, run_id, attempt,
-                              error_template, tmpdir)
+                              error_template, tmpdir,
+                              telemetry_dir=telemetry_dir)
             if rc == WATCHDOG_EXIT_CODE:
                 # The hang watchdog aborted a wedged worker on purpose so
                 # THIS restart loop could re-admit the group — say so (the
@@ -338,6 +384,44 @@ def main(argv: Optional[List[str]] = None) -> int:
                 signal.signal(signal.SIGTERM, prev_handler)
             except (ValueError, OSError):
                 pass
+        # Every exit path — clean, crashed, preempted, restart-exhausted —
+        # ends with the merged goodput report next to the crash records
+        # (a crashed run is exactly the one whose wall-clock needs
+        # attributing).  A run-training rank 0 may already have written
+        # one at its own finalize; the agent's merge supersedes it with
+        # the view joined across ALL generations.
+        _emit_telemetry_report(telemetry_dir)
+
+
+def _emit_telemetry_report(telemetry_dir: Optional[str]) -> None:
+    """Merge the workers' telemetry into report.json/report.md and print
+    the headline.  Best-effort by design: report failure must never mask
+    the run's own exit status."""
+    if not telemetry_dir:
+        return
+    try:
+        from tpudist.telemetry.aggregate import write_reports
+
+        report, paths = write_reports(telemetry_dir)
+        if report.get("num_records", 0) == 0:
+            return
+        g = report["goodput"]
+        print(
+            f"[tpurun] goodput report ({paths['md'] or telemetry_dir}): "
+            f"wall {report['wall_clock_s']:.1f}s over "
+            f"{report['num_ranks']} rank(s) x "
+            f"{report['generations']} generation(s) — "
+            f"step {g['step']['frac'] * 100:.0f}%, "
+            f"compile {g['compile']['frac'] * 100:.0f}%, "
+            f"data {g['data']['frac'] * 100:.0f}%, "
+            f"ckpt {g['ckpt']['frac'] * 100:.0f}%, "
+            f"idle {g['idle']['frac'] * 100:.0f}%, "
+            f"lost-to-restart {g['lost_restart']['frac'] * 100:.0f}%",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 — never mask the run's status
+        print(f"[tpurun] telemetry report failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -257,6 +257,10 @@ def _retry_with_backoff(
             if attempt >= retries:
                 raise
             delay = backoff_s * (2 ** attempt) * (0.5 + rng())
+            from tpudist import telemetry
+
+            telemetry.event("retry", what=what, attempt=attempt,
+                            error=type(e).__name__, backoff_s=round(delay, 3))
             print(
                 f"[tpudist.retry] {what} failed "
                 f"(attempt {attempt + 1}/{retries + 1}): "
@@ -315,10 +319,14 @@ def initialize(
     enable_compilation_cache()
     if ctx is None:
         ctx = resolve_process_context(use_node_rank=use_node_rank)
-    # Chaos harness: honor TPUDIST_FAULT from the earliest runtime seam.
+    # Chaos harness: honor TPUDIST_FAULT from the earliest runtime seam;
+    # telemetry starts here too so the init span lands in the same session
+    # the training loop records into.
+    from tpudist import telemetry
     from tpudist.runtime import faults
 
     faults.arm_from_env()
+    telemetry.ensure_started()
     if ctx.is_distributed:
         import jax
 
@@ -345,10 +353,12 @@ def initialize(
                 initialization_timeout=initialization_timeout_s,
             )
 
-        _retry_with_backoff(
-            _attempt, retries=init_retries, backoff_s=init_backoff_s,
-            what=f"jax.distributed.initialize({ctx.coordinator_address})",
-        )
+        with telemetry.span("init", world=ctx.num_processes,
+                            source=ctx.launch_source):
+            _retry_with_backoff(
+                _attempt, retries=init_retries, backoff_s=init_backoff_s,
+                what=f"jax.distributed.initialize({ctx.coordinator_address})",
+            )
     _INITIALIZED_CTX = ctx
     return ctx
 

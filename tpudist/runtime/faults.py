@@ -210,6 +210,13 @@ def inject_step(step: int) -> None:
             signum = signal.SIGKILL if spec.kind == "kill" else signal.SIGTERM
             _log(f"injecting {spec.kind} at step {step} "
                  f"(rank {_current_rank()}, attempt {_restart_count()})")
+            # Stamp + flush the telemetry stream first: a SIGKILL gives no
+            # second chance, and the merged report joins this marker with
+            # the restart gap it causes (lost_restart attribution).
+            from tpudist import telemetry
+
+            telemetry.event("fault_injected", fault=spec.kind, step=step)
+            telemetry.flush()
             os.kill(os.getpid(), signum)
 
 
@@ -256,6 +263,10 @@ def inject_ckpt_save(step: int, step_dir: os.PathLike,
             n = corrupt_checkpoint(step_dir)
             _log(f"corrupted checkpoint step {step} "
                  f"({n} files garbled under {os.fspath(step_dir)})")
+            from tpudist import telemetry
+
+            telemetry.event("fault_injected", fault="ckpt_corrupt",
+                            step=step, files=n)
             return True
     return False
 

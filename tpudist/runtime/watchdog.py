@@ -147,6 +147,24 @@ class Watchdog:
 
     def _on_stall(self, stalled_for: float, deadline: float) -> None:
         self.stalled = True
+        # Tag the stall in the telemetry stream and flush BEFORE the
+        # abort: os._exit skips every atexit/buffer path, and the merged
+        # report needs this event to attribute the restart's lost time.
+        # Best-effort with a hard deadline — the wedged thread this abort
+        # exists to kill may itself hold the telemetry write lock (hung
+        # filesystem), and blocking here would defeat the whole watchdog.
+        from tpudist import telemetry
+
+        def _stamp():
+            telemetry.event("watchdog_stall", watchdog=self.name,
+                            stalled_for_s=round(stalled_for, 3),
+                            deadline_s=round(deadline, 3))
+            telemetry.flush()
+
+        stamp = threading.Thread(target=_stamp, daemon=True,
+                                 name="tpudist-watchdog-telemetry")
+        stamp.start()
+        stamp.join(2.0)
         message = (
             f"watchdog: no heartbeat from '{self.name}' for "
             f"{stalled_for:.1f}s (deadline {deadline:.1f}s) — "

@@ -1,0 +1,49 @@
+"""Telemetry & goodput subsystem.
+
+Production TPU training lives or dies on goodput accounting: what fraction
+of wall-clock went to useful step compute versus compilation, data stalls,
+checkpoint I/O, and restart overhead.  This package makes the framework
+attribute its own wall-clock:
+
+- :mod:`tpudist.telemetry.spans` — a low-overhead span/event API
+  (``span("step")``, ``span("ckpt_save")``, ``event("watchdog_stall")``)
+  recording monotonic start/duration per rank into a bounded in-memory
+  ring and streaming to a per-rank, per-generation ``telemetry`` JSONL.
+- :mod:`tpudist.telemetry.aggregate` — merges every rank's (and every
+  restarted process generation's) JSONL into ``report.json`` +
+  ``report.md``: step-time p50/p95/max, a goodput breakdown
+  (step / compile / data / ckpt / comm / init / other / idle /
+  lost_restart) that sums to wall-clock, per-rank stragglers, and the
+  joined fault/watchdog/restart event log.
+- ``python -m tpudist.telemetry report <run_dir>`` — the post-hoc CLI.
+
+Armed by default; ``TPUDIST_TELEMETRY=0`` disarms it — the disarmed cost
+at every span site is one module-attribute load and a ``None`` check
+(same discipline as :mod:`tpudist.runtime.faults`).  The whole package is
+importable without jax (rank/generation come from the launcher env
+contract), so the watchdog and fault registry can emit events from any
+process state.
+"""
+
+from tpudist.telemetry.spans import (  # noqa: F401
+    DEFAULT_DIR,
+    ENV_DIR,
+    ENV_ENABLE,
+    ENV_RING,
+    TelemetrySession,
+    abandon,
+    active,
+    enabled_from_env,
+    ensure_started,
+    event,
+    finish,
+    flush,
+    span,
+    start,
+)
+from tpudist.telemetry.aggregate import (  # noqa: F401
+    aggregate_run,
+    load_records,
+    render_markdown,
+    write_reports,
+)

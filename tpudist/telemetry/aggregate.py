@@ -1,0 +1,339 @@
+"""Cross-rank/cross-generation aggregation: JSONL → goodput report.
+
+Reads every ``rank<R>_gen<G>.jsonl`` a run's processes streamed (all
+ranks, all restart generations), and produces
+
+- ``report.json`` — machine-readable: wall-clock, step-time
+  p50/p95/max, a goodput breakdown whose components sum to wall-clock
+  (step / compile / data / ckpt / comm / init / other / idle /
+  lost_restart), per-rank rows for straggler hunting, the StageTimer
+  phase durations, and the joined fault/watchdog/retry event log;
+- ``report.md`` — the same, human-readable.
+
+Attribution rules (the math the tests pin down):
+
+- Only TOP-LEVEL spans (no ``parent``) enter the goodput sum — a
+  ``host_collective`` nested inside ``metric_flush`` is detail, not a
+  second copy of the same wall-clock.
+- Per rank: ``wall = last record end − first record start`` across all
+  generations; ``lost_restart = Σ gaps`` between one generation's last
+  record and the next generation's first (the time a killed process's
+  successor spent being re-launched, re-admitted, and re-initialized
+  before it recorded anything); ``idle = wall − Σ busy − lost``
+  (clamped at 0; clamped amount reported as ``overlap_s`` so
+  double-counted spans are visible, not silently absorbed).
+- The run's goodput components are the across-rank MEANS, so they sum
+  to the mean rank wall-clock (``wall_clock_s``); the envelope from the
+  earliest record of any rank to the latest (``run_span_s``) is
+  reported alongside.
+
+Dependency-free (stdlib only) so post-hoc report generation —
+``python -m tpudist.telemetry report <dir>`` — needs no jax.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: span name → goodput component; unmapped top-level spans land in "other".
+#: ``metric_flush`` counts as step time on purpose: a jitted step's span
+#: brackets only the async dispatch, and the device compute it ran ahead
+#: of surfaces in the next blocking loss fetch — attributing that wait to
+#: anything but step would make the headline step%% read near-zero on
+#: compute-bound runs.
+COMPONENT_OF = {
+    "step": "step",
+    "metric_flush": "step",
+    "compile": "compile",
+    "data_wait": "data",
+    "ckpt_save": "ckpt",
+    "ckpt_restore": "ckpt",
+    "ckpt_wait": "ckpt",
+    "host_collective": "comm",
+    "init": "init",
+}
+
+#: Every component of the breakdown, in report order.  The accounted ones
+#: (all but idle/lost_restart) come from spans; idle is the per-rank
+#: remainder; lost_restart the inter-generation gaps.
+COMPONENTS = ("step", "compile", "data", "ckpt", "comm", "init", "other",
+              "idle", "lost_restart")
+
+#: Event names surfaced in the report's event log (joined across ranks and
+#: generations on the wall-clock axis).
+_REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
+                    "prefetch_stats")
+
+
+def find_telemetry_dir(run_dir: "str | Path") -> Path:
+    """Accept either the telemetry dir itself or a run dir containing a
+    ``telemetry/`` subdirectory."""
+    d = Path(run_dir)
+    if list(d.glob("rank*_gen*.jsonl")):
+        return d
+    sub = d / "telemetry"
+    if sub.is_dir() and list(sub.glob("rank*_gen*.jsonl")):
+        return sub
+    return d
+
+
+def load_records(run_dir: "str | Path") -> List[dict]:
+    """Parse every per-rank/per-generation JSONL under ``run_dir``.
+    Torn trailing lines (SIGKILL mid-write) are skipped, not fatal."""
+    recs: List[dict] = []
+    for p in sorted(find_telemetry_dir(run_dir).glob("rank*_gen*.jsonl")):
+        try:
+            text = p.read_text()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn write at the kill point
+            if isinstance(rec, dict) and "t" in rec and "name" in rec:
+                recs.append(rec)
+    return recs
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list (stdlib-only)."""
+    if not sorted_vals:
+        return 0.0
+    idx = int(round(q / 100.0 * (len(sorted_vals) - 1)))
+    return sorted_vals[max(0, min(len(sorted_vals) - 1, idx))]
+
+
+def _rank_breakdown(rank_recs: List[dict]) -> dict:
+    """One rank's wall-clock accounting across all its generations."""
+    by_gen: Dict[int, List[dict]] = {}
+    for r in rank_recs:
+        by_gen.setdefault(int(r.get("gen", 0)), []).append(r)
+    gens = sorted(by_gen)
+    t0 = min(float(r["t"]) for r in rank_recs)
+    t1 = max(float(r["t"]) + float(r.get("dur", 0.0)) for r in rank_recs)
+    wall = max(0.0, t1 - t0)
+
+    # lost_restart: gap between one generation's last record and the next's
+    # first — the successor process's spawn/re-admit/re-init dead time.
+    lost = 0.0
+    for a, b in zip(gens, gens[1:]):
+        end_a = max(float(r["t"]) + float(r.get("dur", 0.0))
+                    for r in by_gen[a])
+        start_b = min(float(r["t"]) for r in by_gen[b])
+        lost += max(0.0, start_b - end_a)
+
+    comp = {c: 0.0 for c in COMPONENTS}
+    comp["lost_restart"] = lost
+    for r in rank_recs:
+        if r.get("kind") != "span" or "parent" in r:
+            continue  # nested spans are detail, not additional wall-clock
+        comp[COMPONENT_OF.get(r["name"], "other")] += float(r.get("dur", 0.0))
+    busy = sum(comp[c] for c in COMPONENTS if c not in ("idle", "lost_restart"))
+    idle = wall - busy - lost
+    comp["idle"] = max(0.0, idle)
+    return {
+        "rank": int(rank_recs[0].get("rank", 0)),
+        "generations": len(gens),
+        "wall_s": wall,
+        "t0": t0,
+        "t1": t1,
+        "components_s": comp,
+        # double-counted span time (overlapping top-level spans) surfaces
+        # here instead of silently shrinking idle below zero.
+        "overlap_s": max(0.0, -idle),
+    }
+
+
+def _step_stats(records: List[dict], num_ranks: int = 1) -> dict:
+    """Per-step time distribution.  A scanned window span carries a
+    ``steps`` tag; it contributes its per-step mean once per step so the
+    percentiles weight windows by the iterations they covered.
+    Percentiles pool every rank's samples, but ``count``/``total_s`` are
+    per-rank means — all ranks run the same loop, and summing their
+    parallel time would overstate the run by the rank count."""
+    vals: List[float] = []
+    total = 0.0
+    count = 0
+    for r in records:
+        if r.get("kind") != "span" or r.get("name") != "step":
+            continue
+        dur = float(r.get("dur", 0.0))
+        n = int(r.get("steps", 1) or 1)
+        total += dur
+        count += n
+        vals.extend([dur / n] * min(n, 100_000))
+    vals.sort()
+    ranks = max(1, num_ranks)
+    total /= ranks
+    count = round(count / ranks)
+    return {
+        "count": count,
+        "total_s": total,
+        "p50_s": _percentile(vals, 50),
+        "p95_s": _percentile(vals, 95),
+        "max_s": vals[-1] if vals else 0.0,
+        "steps_per_s": (count / total) if total > 0 else 0.0,
+    }
+
+
+def aggregate_run(run_dir: "str | Path") -> dict:
+    """Merge a run's telemetry into the report dict (see module doc)."""
+    records = load_records(run_dir)
+    if not records:
+        return {"error": f"no telemetry records under {run_dir}",
+                "num_records": 0}
+
+    by_rank: Dict[int, List[dict]] = {}
+    for r in records:
+        by_rank.setdefault(int(r.get("rank", 0)), []).append(r)
+    # Event-only streams (e.g. the tpurun agent's staging events) carry
+    # no wall-clock to account — they contribute events/stages below but
+    # must not enter the per-rank goodput means as phantom zero-wall ranks.
+    span_ranks = sorted(
+        k for k, rs in by_rank.items()
+        if any(r.get("kind") == "span" for r in rs)) or sorted(by_rank)
+    per_rank = [_rank_breakdown(by_rank[k]) for k in span_ranks]
+
+    n = len(per_rank)
+    wall_mean = sum(p["wall_s"] for p in per_rank) / n
+    goodput = {}
+    for c in COMPONENTS:
+        s = sum(p["components_s"][c] for p in per_rank) / n
+        goodput[c] = {
+            "s": round(s, 6),
+            "frac": round(s / wall_mean, 6) if wall_mean > 0 else 0.0,
+        }
+    goodput_sum = sum(v["s"] for v in goodput.values())
+
+    # Straggler view: the rank spending the most step time and the one
+    # idling the most, with the spread that makes it a straggler.
+    step_per_rank = {p["rank"]: p["components_s"]["step"] for p in per_rank}
+    max_rank = max(step_per_rank, key=step_per_rank.get)
+    min_rank = min(step_per_rank, key=step_per_rank.get)
+
+    stages: Dict[str, float] = {}
+    events: List[dict] = []
+    for r in records:
+        if r.get("kind") != "event":
+            continue
+        if r.get("name") == "stage" and "stage" in r:
+            stages[r["stage"]] = stages.get(r["stage"], 0.0) + float(
+                r.get("dur_s", 0.0))
+        elif r.get("name") in _REPORTED_EVENTS:
+            events.append(r)
+    events.sort(key=lambda e: e.get("t", 0.0))
+
+    report = {
+        "num_records": len(records),
+        "num_ranks": n,
+        "generations": max(p["generations"] for p in per_rank),
+        "wall_clock_s": round(wall_mean, 6),
+        "run_span_s": round(
+            max(p["t1"] for p in per_rank) - min(p["t0"] for p in per_rank),
+            6),
+        "step": _step_stats(records, num_ranks=n),
+        "goodput": goodput,
+        "goodput_sum_s": round(goodput_sum, 6),
+        "stragglers": {
+            "max_step_rank": max_rank,
+            "max_step_s": round(step_per_rank[max_rank], 6),
+            "min_step_rank": min_rank,
+            "min_step_s": round(step_per_rank[min_rank], 6),
+        },
+        "per_rank": [
+            {
+                "rank": p["rank"],
+                "generations": p["generations"],
+                "wall_s": round(p["wall_s"], 6),
+                "overlap_s": round(p["overlap_s"], 6),
+                **{c: round(p["components_s"][c], 6) for c in COMPONENTS},
+            }
+            for p in per_rank
+        ],
+        "stages": {k: round(v, 6) for k, v in sorted(stages.items())},
+        "events": events,
+    }
+    return report
+
+
+def render_markdown(report: dict) -> str:
+    """The human-readable twin of ``report.json``."""
+    if report.get("num_records", 0) == 0:
+        return f"# tpudist run report\n\n{report.get('error', 'no data')}\n"
+    lines = ["# tpudist run report", ""]
+    lines.append(
+        f"- wall-clock (mean over {report['num_ranks']} rank"
+        f"{'s' if report['num_ranks'] != 1 else ''}): "
+        f"**{report['wall_clock_s']:.3f} s** "
+        f"(run envelope {report['run_span_s']:.3f} s, "
+        f"{report['generations']} process generation"
+        f"{'s' if report['generations'] != 1 else ''})")
+    st = report["step"]
+    lines.append(
+        f"- steps: {st['count']} in {st['total_s']:.3f} s "
+        f"({st['steps_per_s']:.1f} steps/s) — "
+        f"p50 {st['p50_s'] * 1e3:.2f} ms, p95 {st['p95_s'] * 1e3:.2f} ms, "
+        f"max {st['max_s'] * 1e3:.2f} ms")
+    lines += ["", "## Goodput breakdown", "",
+              "| component | seconds | % of wall |",
+              "|---|---:|---:|"]
+    for c in COMPONENTS:
+        v = report["goodput"][c]
+        lines.append(f"| {c} | {v['s']:.3f} | {v['frac'] * 100:.1f}% |")
+    lines.append(f"| **total** | {report['goodput_sum_s']:.3f} | "
+                 f"{report['goodput_sum_s'] / report['wall_clock_s'] * 100:.1f}% |"
+                 if report["wall_clock_s"] > 0 else "| **total** | 0 | - |")
+    sg = report["stragglers"]
+    lines += ["", "## Per-rank", "",
+              f"straggler: rank {sg['max_step_rank']} spent "
+              f"{sg['max_step_s']:.3f} s in steps vs rank "
+              f"{sg['min_step_rank']}'s {sg['min_step_s']:.3f} s", "",
+              "| rank | gens | wall s | step | compile | data | ckpt | comm "
+              "| init | other | idle | lost_restart |",
+              "|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|"]
+    for p in report["per_rank"]:
+        lines.append(
+            f"| {p['rank']} | {p['generations']} | {p['wall_s']:.3f} | "
+            + " | ".join(f"{p[c]:.3f}" for c in COMPONENTS) + " |")
+    if report.get("stages"):
+        lines += ["", "## Host stages (StageTimer)", ""]
+        for k, v in report["stages"].items():
+            lines.append(f"- {k}: {v:.3f} s")
+    if report.get("events"):
+        lines += ["", "## Events", ""]
+        for e in report["events"]:
+            tags = {k: v for k, v in e.items()
+                    if k not in ("kind", "name", "t", "dur")}
+            lines.append(f"- t={e.get('t', 0.0):.3f} **{e['name']}** {tags}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_reports(run_dir: "str | Path",
+                  out_dir: "str | Path | None" = None
+                  ) -> Tuple[dict, Dict[str, Optional[Path]]]:
+    """Aggregate ``run_dir`` and write ``report.json`` + ``report.md``
+    (into ``out_dir``, default: the telemetry dir itself).  Returns
+    ``(report, {"json": path, "md": path})``; paths are ``None`` for
+    files that could not be written (the report dict is still returned)."""
+    tdir = find_telemetry_dir(run_dir)
+    report = aggregate_run(tdir)
+    out = Path(out_dir) if out_dir is not None else tdir
+    paths: Dict[str, Optional[Path]] = {"json": None, "md": None}
+    try:
+        out.mkdir(parents=True, exist_ok=True)
+        jp = out / "report.json"
+        jp.write_text(json.dumps(report, indent=2) + "\n")
+        paths["json"] = jp
+        mp = out / "report.md"
+        mp.write_text(render_markdown(report))
+        paths["md"] = mp
+    except OSError:
+        pass
+    return report, paths

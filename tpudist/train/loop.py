@@ -18,10 +18,12 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import time
 from typing import Callable, Dict, Optional
 
 import jax
 
+from tpudist import telemetry
 from tpudist.comm.collectives import MetricBackend, barrier
 from tpudist.data.loader import ShardedLoader, shard_batch
 from tpudist.train.step import ModelState, batch_sharding
@@ -114,7 +116,10 @@ def finalize_run(states, *, iteration, epoch, preempted, ckpt, logger,
        :func:`preemption_scope` — callers must be able to tell a
        partially-trained early exit from a completed run);
     3. queued metric rows flushed (``flush``), then ``logger.finish()``;
-    4. the end-of-training barrier.
+    4. the end-of-training barrier;
+    5. the telemetry session finished — rank 0 merges every rank's and
+       generation's JSONL into ``report.json``/``report.md`` so *every*
+       run ends with a goodput report.
     """
     if ckpt is not None:
         ckpt.save(iteration, states,
@@ -131,6 +136,28 @@ def finalize_run(states, *, iteration, epoch, preempted, ckpt, logger,
     if logger is not None:
         logger.finish()
     barrier("end_of_training")
+    telemetry.finish()
+
+
+def _data_wait_iter(source, tele):
+    """Yield from ``source``, recording each blocking ``next()`` as a
+    ``data_wait`` span — the consumer-side stall the goodput report's
+    ``data`` component measures.  Plain passthrough when disarmed.
+
+    Uses the stack-pushing ``span()`` form on purpose: a source that
+    records its own ``data_wait`` leaves (``prefetch_to_device``) then
+    nests under this span instead of double-counting the same stall."""
+    if tele is None:
+        yield from source
+        return
+    it = iter(source)
+    while True:
+        try:
+            with tele.span("data_wait"):
+                item = next(it)
+        except StopIteration:
+            return
+        yield item
 
 
 def _make_pbar(config: TrainLoopConfig, initial: int = 0):
@@ -162,8 +189,11 @@ class _DeferredMetrics:
         if not self._pending:
             return
         pending, self._pending = self._pending, []
-        # One transfer for the whole window.
-        fetched = jax.device_get([losses for _, _, losses in pending])
+        # One transfer for the whole window.  The blocking fetch (which
+        # absorbs whatever device compute the async dispatch ran ahead
+        # of) is its own span so it never masquerades as idle time.
+        with telemetry.span("metric_flush", rows=len(pending)):
+            fetched = jax.device_get([losses for _, _, losses in pending])
         if self.config.metric_backend == MetricBackend.HOST:
             from tpudist.comm.collectives import host_allreduce_sum
             import numpy as np
@@ -216,6 +246,7 @@ def run_training(
     from tpudist.runtime import faults, watchdog
 
     faults.arm_from_env()  # chaos harness: TPUDIST_FAULT grammar, no code changes
+    telemetry.ensure_started()  # goodput accounting: TPUDIST_TELEMETRY=0 disarms
     wd = watchdog.from_config(
         config.watchdog_timeout_s, name="train_loop",
         first_deadline_s=(config.watchdog_timeout_s or
@@ -260,19 +291,31 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
     pbar = _make_pbar(config, initial=start_iteration)
 
     deferred = _DeferredMetrics(logger, config) if logger is not None else None
+    tele = telemetry.active()
+    first_step = True  # first dispatch pays XLA compile → its own span
     last_losses = None
     preempted = False
     while iteration < config.total_iterations and not preempted:
         loader.set_epoch(epoch)
         iteration += skip_in_epoch
         skip, skip_in_epoch = skip_in_epoch, 0
-        for x, y in loader.iter_from(skip):
+        for x, y in _data_wait_iter(loader.iter_from(skip), tele):
             if iteration >= config.total_iterations:
                 break
             faults.inject_step(iteration)  # chaos: kill/sigterm@step
             bs = x.shape[0]
             gx, gy = shard_batch((x, y), sharding)
+            if tele is not None:
+                _t0 = time.monotonic()
             states, losses = step_fn(states, gx, gy)
+            if tele is not None:
+                if first_step:
+                    # Block on the first result so the span measures the
+                    # compile, not just the async dispatch.
+                    jax.block_until_ready(losses)
+                tele.record_span("compile" if first_step else "step",
+                                 _t0, time.monotonic() - _t0)
+            first_step = False
             if wd is not None:
                 # Pet AFTER the step: the first pet must land past the XLA
                 # compile so the watchdog's first-deadline slack covers it.
@@ -359,6 +402,8 @@ def _run_scanned(
 
     from tpudist.runtime import faults
 
+    tele = telemetry.active()
+    first_window = True  # first dispatch pays XLA compile → its own span
     preempted = False
     while iteration < total:
         faults.inject_step(iteration)  # chaos: kill/sigterm at window edges
@@ -367,6 +412,8 @@ def _run_scanned(
         if save_every > 0:
             to_save = save_every - (iteration % save_every)
             k = min(k, to_save)
+        if tele is not None:
+            _t0 = time.monotonic()
         idx_rows = []
         while len(idx_rows) < k:
             if gen is None:
@@ -381,8 +428,19 @@ def _run_scanned(
             else:
                 gen = None
                 epoch += 1
+        if tele is not None:
+            # host-side index/window assembly = the scanned path's data stall
+            tele.record_span("data_wait", _t0, time.monotonic() - _t0)
+            _t0 = time.monotonic()
         idx = jax.device_put(np.stack(idx_rows).astype(np.int32), repl)
         states, losses = chunk_step_fn(states, x_all, y_all, idx)
+        if tele is not None:
+            if first_window:
+                jax.block_until_ready(losses)  # span covers the compile
+            tele.record_span("compile" if first_window else "step",
+                             _t0, time.monotonic() - _t0,
+                             {"steps": len(idx_rows)})
+        first_window = False
         if wd is not None:
             # Pet AFTER the window: the first pet must land past the XLA
             # compile so the watchdog's first-deadline slack covers it.
@@ -428,7 +486,8 @@ def _flush_scanned(pending, logger, config):
     sharded batch inside the compiled window)."""
     if not pending:
         return
-    fetched = jax.device_get([losses for _, losses in pending])
+    with telemetry.span("metric_flush", rows=len(pending)):
+        fetched = jax.device_get([losses for _, losses in pending])
     for (first_it, _), window in zip(pending, fetched):
         length = len(next(iter(window.values())))
         for j in range(length):

@@ -368,16 +368,24 @@ class Trainer:
         ordering are the SHARED helpers in :mod:`tpudist.train.loop`
         (``preemption_scope`` / ``finalize_run``) — one copy of that
         contract for every loop in the framework."""
+        import time
+
         import numpy as np
 
+        from tpudist import telemetry
         from tpudist.train import token_sharding
         from tpudist.train.loop import (
             TrainLoopConfig,
+            _data_wait_iter,
             _make_pbar,
             _preemption_check,
             finalize_run,
             preemption_scope,
         )
+
+        telemetry.ensure_started()
+        tele = telemetry.active()
+        first_step = True  # first dispatch pays XLA compile → its own span
 
         ts = token_sharding(mesh)
         batches = len(loader) if hasattr(loader, "__len__") else None
@@ -402,13 +410,23 @@ class Trainer:
                     next(it, None)
                 skip = 0
                 advanced = False
-                for tokens in it:
+                for tokens in _data_wait_iter(it, tele):
                     advanced = True
                     if iteration >= self.max_steps:
                         break
+                    if tele is not None:
+                        _t0 = time.monotonic()
                     state, loss = step(
                         state, jax.device_put(
                             np.asarray(tokens, dtype=np.int32), ts))
+                    if tele is not None:
+                        if first_step:
+                            # Block on the first result so the span
+                            # measures the compile, not the dispatch.
+                            jax.block_until_ready(loss)
+                        tele.record_span("compile" if first_step else "step",
+                                         _t0, time.monotonic() - _t0)
+                    first_step = False
                     iteration += 1
                     # The compiled LM step already reduces the loss over
                     # the GLOBAL batch, so there is no per-rank value for

@@ -41,6 +41,16 @@ def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
         return default
 
 
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean env knob: unset → ``default``; set to ``0``/``false``/
+    ``off``/``no``/empty (case-insensitive) → False; anything else →
+    True.  The contract of the on/off switches (``TPUDIST_TELEMETRY``)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
 def env_rank(default: Optional[int] = None) -> Optional[int]:
     """This process's global rank from the launcher env contracts, in
     precedence order (tpudist > torchrun > SLURM) — the ONE resolution
@@ -54,3 +64,49 @@ def env_rank(default: Optional[int] = None) -> Optional[int]:
             except ValueError:
                 continue
     return default
+
+
+#: The inventory of every ``TPUDIST_*`` environment knob the package
+#: reads — name → one-line contract.  This registry is the gate that
+#: keeps knobs from shipping undocumented: ``tests/test_env_inventory.py``
+#: asserts (a) every ``TPUDIST_*`` name referenced anywhere in the
+#: package appears here, and (b) every name here is documented in
+#: ``docs/ARCHITECTURE.md``.  Add the entry and the doc row with the
+#: code, or the suite fails.
+ENV_VARS = {
+    # launch contract (set by launch/tpurun; consumed by runtime.bootstrap)
+    "TPUDIST_COORDINATOR": "host:port of process 0's coordination service",
+    "TPUDIST_NUM_PROCESSES": "world size of the launch contract",
+    "TPUDIST_PROCESS_ID": "this process's global rank",
+    "TPUDIST_LOCAL_RANK": "rank within the node",
+    "TPUDIST_LOCAL_WORLD_SIZE": "processes per node",
+    "TPUDIST_RUN_ID": "job-scoped rendezvous/run id",
+    "TPUDIST_RESTART_COUNT": "tpurun restart generation (0 on first launch)",
+    "TPUDIST_ERROR_FILE": "crash-record path template (%r → rank)",
+    "TPUDIST_TMPDIR": "job-local scratch directory",
+    # robustness knobs
+    "TPUDIST_WATCHDOG_S": "hang-watchdog stall deadline in seconds (<=0 off)",
+    "TPUDIST_HOST_TIMEOUT_S": "host-fabric collective deadline in seconds",
+    "TPUDIST_INIT_RETRIES": "jax.distributed.initialize retry budget",
+    "TPUDIST_INIT_BACKOFF_S": "initialize retry base backoff seconds",
+    "TPUDIST_FAULT": "chaos fault-injection grammar (runtime.faults)",
+    # telemetry & goodput
+    "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
+    "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
+    "TPUDIST_TELEMETRY_RING": "in-memory telemetry ring size (records)",
+    # caches / tuned constants
+    "TPUDIST_COMPILATION_CACHE": "persistent XLA compile cache dir (off = disable)",
+    "TPUDIST_CACHE": "native data-loader build cache base dir",
+    "TPUDIST_TUNED_FILE": "measured tuned-constants JSON path override",
+    "TPUDIST_SYNC_EVERY": "train-loop scan window / metric sync cadence",
+    "TPUDIST_FLASH_MIN_SEQ": "flash-attention routing crossover (seq len)",
+    "TPUDIST_FLASH_BLOCK_Q": "flash-attention query tile size",
+    "TPUDIST_FLASH_BLOCK_K": "flash-attention KV tile size",
+    "TPUDIST_FLASH_BLOCK_K_LONG": "flash-attention KV tile at long seq",
+    "TPUDIST_FLASH_LONG_SEQ": "seq length where the long KV tile kicks in",
+    # sweep harness contract (launch/sweep.py)
+    "TPUDIST_SWEEP_METRIC_FILE": "where a sweep trial writes its objective",
+    "TPUDIST_SWEEP_RESULTS": "sweep results.jsonl path for the report CLI",
+    "TPUDIST_SWEEP_INDEX": "trial index within the sweep",
+    "TPUDIST_SWEEP_CONFIG": "the trial's resolved config (repr)",
+}

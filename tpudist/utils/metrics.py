@@ -48,22 +48,50 @@ class MetricsLogger:
             self._run.log(record)
         if self._jsonl_file is not None:
             record = {"_step": self._step, "_time": time.time(), **record}
-            self._jsonl_file.write(json.dumps(record) + "\n")
-            self._jsonl_file.flush()
+            try:
+                # Flush EVERY committed line: a kill between commits must
+                # lose at most the line being written, never a window of
+                # already-committed rows sitting in the userspace buffer.
+                self._jsonl_file.write(json.dumps(record) + "\n")
+                self._jsonl_file.flush()
+            except (OSError, ValueError):
+                # ValueError = file closed underneath (finish() raced a
+                # straggling log call); metrics must not take the run
+                # down — but the fd must not leak either.
+                f, self._jsonl_file = self._jsonl_file, None
+                try:
+                    f.close()
+                except (OSError, ValueError):
+                    pass
         self._step += 1
 
     def finish(self) -> None:
         """Must run before ``runtime.shutdown()`` — same ordering discipline
         as ``wandb.finish()`` before ``destroy_process_group``
-        (``demo.py:133-136``)."""
+        (``demo.py:133-136``).  Idempotent, and safe to call (or to keep
+        ``log``-ging) after the underlying file is gone: a double teardown
+        path must never crash the run it is cleaning up.  The final fsync
+        makes every committed row durable — a SIGKILL right after loses at
+        most a trailing partial line."""
         if self._pending:
             self.log({}, commit=True)
         if self._run is not None:
-            self._run.finish()
-            self._run = None
-        if self._jsonl_file is not None:
-            self._jsonl_file.close()
-            self._jsonl_file = None
+            run, self._run = self._run, None
+            try:
+                run.finish()
+            except Exception:  # noqa: BLE001 — wandb teardown is best-effort
+                pass
+        f, self._jsonl_file = self._jsonl_file, None
+        if f is not None:
+            try:
+                f.flush()
+                os.fsync(f.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                f.close()
+            except OSError:
+                pass
 
 
 class _NullLogger(MetricsLogger):

@@ -36,7 +36,13 @@ def trace(profile_dir: Optional[str]) -> Iterator[None]:
 
 class StageTimer:
     """Wall-clock phase timer (the host-side analog of the reference's
-    ``time tar`` staging timing) — records named phase durations."""
+    ``time tar`` staging timing) — records named phase durations.
+
+    Collected durations are published with :meth:`emit` — one committed
+    metrics row (``stage/<name>``) plus one telemetry ``stage`` event per
+    phase, which the goodput report surfaces in its "Host stages" section.
+    Without an emit the durations die with the process, which is exactly
+    the collected-then-dropped failure mode this closes."""
 
     def __init__(self):
         self.durations: dict = {}
@@ -50,3 +56,24 @@ class StageTimer:
             self.durations[name] = self.durations.get(name, 0.0) + (
                 time.perf_counter() - t0
             )
+
+    def emit(self, logger=None, *, prefix: str = "stage/",
+             session=None) -> dict:
+        """Publish phase durations: telemetry ``stage`` events (into
+        ``session``, default the active session) and, when a
+        :class:`~tpudist.utils.metrics.MetricsLogger` is given, one
+        committed ``stage/<name>`` metrics row.  Returns the durations;
+        call it BEFORE the logger is finished (e.g. right before
+        ``run_training``, or at run end for post-loop phases)."""
+        from tpudist import telemetry
+
+        sess = session if session is not None else telemetry.active()
+        if sess is not None:
+            for name, dur in self.durations.items():
+                sess.event("stage", stage=name, dur_s=round(dur, 6))
+        if logger is not None and self.durations:
+            logger.log(
+                {f"{prefix}{k}": v for k, v in self.durations.items()},
+                commit=True,
+            )
+        return dict(self.durations)
