@@ -67,8 +67,13 @@ def lm_rows(repeats: int, **cfg) -> dict:
 
 def pool(sessions) -> dict:
     """Per-row bands over every session's samples."""
-    device_kind = next((s["device_kind"] for s in sessions
-                        if s.get("device_kind")), None)
+    # The decode roofline divides by ONE chip kind's HBM bandwidth; an
+    # artifact whose sessions were measured on different kinds has no
+    # single valid ceiling — refuse to stamp one rather than quietly
+    # using the first session's chip for everyone's samples.
+    kinds = sorted({s["device_kind"] for s in sessions
+                    if s.get("device_kind")})
+    device_kind = kinds[0] if len(kinds) == 1 else None
     merged: dict = {}
     for s in sessions:
         for name, row in s.get("rows", {}).items():
@@ -95,22 +100,31 @@ def pool(sessions) -> dict:
     for row in pooled.values():
         cfg = row.get("config") or {}
         band = row.get("tokens_per_sec")
-        if band and band["median"] and {"prompt_len", "max_new"} <= set(cfg):
-            from tpudist.utils.flops import HBM_BYTES_PER_S, decode_roofline
+        if not (band and band["median"]
+                and {"prompt_len", "max_new"} <= set(cfg)):
+            continue  # not a decode row: no roofline field either way
+        if len(kinds) > 1:
+            row["pct_of_roofline_pooled_median"] = None
+            row["roofline_note"] = (
+                "sessions span device kinds "
+                f"{kinds}: no single HBM ceiling applies to the pooled "
+                "median — re-pool per kind for a roofline percentage")
+            continue
+        from tpudist.utils.flops import HBM_BYTES_PER_S, decode_roofline
 
-            nbytes = 2 if cfg.get("precision") == "bf16" else 4
-            roof = decode_roofline(
-                batch=cfg["batch"], prompt_len=cfg["prompt_len"],
-                max_new=cfg["max_new"], d_model=cfg["d_model"],
-                n_layers=cfg["n_layers"], d_ff=cfg["d_ff"],
-                vocab=cfg["vocab"], param_bytes=nbytes, cache_bytes=nbytes,
-                # the sessions' chip, not the pooling host's (pooling may
-                # run on a CPU box over TPU-measured sessions)
-                hbm_bytes_per_s=HBM_BYTES_PER_S.get(device_kind))
-            if roof:
-                row["pct_of_roofline_pooled_median"] = round(
-                    100 * band["median"]
-                    / roof["ceiling_tokens_per_sec"], 1)
+        nbytes = 2 if cfg.get("precision") == "bf16" else 4
+        roof = decode_roofline(
+            batch=cfg["batch"], prompt_len=cfg["prompt_len"],
+            max_new=cfg["max_new"], d_model=cfg["d_model"],
+            n_layers=cfg["n_layers"], d_ff=cfg["d_ff"],
+            vocab=cfg["vocab"], param_bytes=nbytes, cache_bytes=nbytes,
+            # the sessions' chip, not the pooling host's (pooling may
+            # run on a CPU box over TPU-measured sessions)
+            hbm_bytes_per_s=HBM_BYTES_PER_S.get(device_kind))
+        if roof:
+            row["pct_of_roofline_pooled_median"] = round(
+                100 * band["median"]
+                / roof["ceiling_tokens_per_sec"], 1)
     return pooled
 
 

@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Serving load generator: offered load vs. achieved goodput.
+
+Drives the continuous-batching server (``tpudist.serve``) with synthetic
+open-loop traffic — Poisson arrivals at each offered rate, prompt and
+output lengths drawn per-request from seeded ranges — and records what
+the paper-facing serving questions need:
+
+- **throughput vs. offered load** (achieved requests/s and tokens/s per
+  rate rung, including the saturation rung where offered >> capacity);
+- **latency percentiles** — TTFT (submit → first token, queue wait
+  included) and TPOT (steady decode interval) at p50/p95;
+- **batch occupancy** — the utilization gauge continuous batching exists
+  to raise (sequential serving pins it at 1/num_slots);
+- **backpressure** — rejected counts once the bounded queue overflows.
+
+One warmup request absorbs XLA compilation before any timed rung, so
+rows measure the steady engine, not the first dispatch.  Artifact:
+``BENCH_SERVE_r{NN}.json`` (round-frozen like every other harness), with
+the run's merged telemetry serving section embedded for cross-checking.
+``--smoke`` shrinks everything to a CPU-CI scale (seconds, asserted by
+``tests/test_benchmarks.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _pct(vals, q):
+    """Nearest-rank percentile — the SAME statistic the telemetry
+    report's serving section uses, so the artifact's per-rung columns and
+    its embedded ``serving_report`` cross-check without definitional
+    skew."""
+    if not vals:
+        return None
+    from tpudist.telemetry.aggregate import _percentile
+
+    return _percentile(sorted(vals), q)
+
+
+def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
+             prompt_lens, max_news, seed: int) -> dict:
+    """One offered-load rung: open-loop Poisson arrivals at ``rate_rps``
+    (``inf``-like rates degenerate to a burst), wait for completion."""
+    import numpy as np
+
+    from tpudist.serve import AdmissionError
+
+    rng = np.random.default_rng(seed)
+    handles, rejected = [], 0
+    lock = threading.Lock()
+
+    def submit_all():
+        nonlocal rejected
+        for i in range(n_requests):
+            plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+            max_new = int(rng.integers(max_news[0], max_news[1] + 1))
+            prompt = rng.integers(0, vocab, size=plen).astype(np.int32)
+            try:
+                h = server.submit(prompt, max_new=max_new, seed=i)
+                with lock:
+                    handles.append(h)
+            except AdmissionError:
+                rejected += 1
+            if rate_rps < 1e6:
+                time.sleep(float(rng.exponential(1.0 / rate_rps)))
+
+    t0 = time.monotonic()
+    loader = threading.Thread(target=submit_all, daemon=True)
+    loader.start()
+    loader.join()
+    for h in handles:
+        h.wait()
+    wall = time.monotonic() - t0
+
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+    tpots = [h.tpot_s for h in handles if h.tpot_s is not None]
+    tokens = sum(len(h.tokens) for h in handles)
+    return {
+        "offered_rps": rate_rps if rate_rps < 1e6 else "burst",
+        "n_requests": n_requests,
+        "completed": len(handles),
+        "rejected": rejected,
+        "wall_s": round(wall, 3),
+        "achieved_rps": round(len(handles) / wall, 3) if wall > 0 else None,
+        "achieved_tokens_per_s": round(tokens / wall, 1) if wall > 0 else None,
+        "tokens_out": tokens,
+        "ttft_s_p50": round(_pct(ttfts, 50), 6) if ttfts else None,
+        "ttft_s_p95": round(_pct(ttfts, 95), 6) if ttfts else None,
+        "tpot_s_p50": round(_pct(tpots, 50), 6) if tpots else None,
+        "tpot_s_p95": round(_pct(tpots, 95), 6) if tpots else None,
+        "mean_tokens_per_request":
+            round(statistics.mean([len(h.tokens) for h in handles]), 1)
+            if handles else None,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU-CI scale: tiny model, two rungs, seconds")
+    p.add_argument("--rates", default=None,
+                   help="offered requests/sec per rung (comma list; "
+                        "'burst' = submit everything at once)")
+    p.add_argument("--requests", type=int, default=None)
+    p.add_argument("--slots", type=int, default=None)
+    p.add_argument("--queue", type=int, default=None)
+    p.add_argument("--d-model", type=int, default=None)
+    p.add_argument("--n-layers", type=int, default=None)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--max-len", type=int, default=None)
+    p.add_argument("--prompt-lens", default=None, help="min:max")
+    p.add_argument("--max-news", default=None, help="min:max")
+    p.add_argument("--seed", type=int, default=0)
+    try:
+        from benchmarks._round import current_round
+    except ImportError:
+        from _round import current_round
+
+    p.add_argument("--out", default=str(
+        REPO / f"BENCH_SERVE_r{current_round():02d}.json"))
+    args = p.parse_args(argv)
+
+    # smoke defaults, overridable flag by flag
+    smoke = args.smoke
+    slots = args.slots or (2 if smoke else 8)
+    queue = args.queue or (8 if smoke else 128)
+    requests = args.requests or (6 if smoke else 64)
+    d_model = args.d_model or (32 if smoke else 512)
+    n_layers = args.n_layers or (2 if smoke else 4)
+    max_len = args.max_len or (32 if smoke else 512)
+    plens = tuple(int(x) for x in (args.prompt_lens or
+                                   ("1:6" if smoke else "4:48")).split(":"))
+    mnews = tuple(int(x) for x in (args.max_news or
+                                   ("2:6" if smoke else "8:96")).split(":"))
+    rates = [(1e9 if r == "burst" else float(r)) for r in
+             (args.rates or ("8,burst" if smoke else "1,4,16,burst")
+              ).split(",")]
+
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from tpudist import telemetry
+    from tpudist.models import create_transformer
+    from tpudist.serve import InferenceServer, ServeConfig
+
+    tele_dir = tempfile.mkdtemp(prefix="serve_bench_tele_")
+    telemetry.start(tele_dir)
+    module, params = create_transformer(
+        jax.random.PRNGKey(args.seed), seq_len=16, vocab=args.vocab,
+        d_model=d_model, n_layers=n_layers, n_heads=max(2, d_model // 64),
+        d_ff=4 * d_model, max_len=max_len)
+    server = InferenceServer(
+        module, params,
+        ServeConfig(num_slots=slots, queue_limit=queue,
+                    prefill_pad=plens[1], max_new=mnews[1]),
+        install_signal_handler=False)
+    server.start()
+
+    # warmup: absorb the prefill/insert/decode compiles before timing
+    warm = server.submit(np.zeros(plens[0], np.int32), max_new=2)
+    warm.wait()
+
+    rows = []
+    for i, rate in enumerate(rates):
+        row = run_rate(server, rate_rps=rate, n_requests=requests,
+                       vocab=args.vocab, prompt_lens=plens, max_news=mnews,
+                       seed=args.seed + i)
+        row["occupancy_mean_cum"] = round(
+            server.stats()["occupancy_mean"], 4)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    stats = server.stats()
+    server.close()
+    report = telemetry.finish() or {}
+    artifact = {
+        "regime": ("cpu-smoke" if smoke else
+                   jax.devices()[0].device_kind),
+        "config": {
+            "slots": slots, "queue": queue, "requests_per_rung": requests,
+            "d_model": d_model, "n_layers": n_layers, "vocab": args.vocab,
+            "max_len": max_len, "prompt_lens": list(plens),
+            "max_news": list(mnews),
+        },
+        "rows": rows,
+        "server_stats": stats,
+        "serving_report": report.get("serving"),
+    }
+    out = Path(args.out)
+    tmp = out.with_suffix(".tmp")
+    tmp.write_text(json.dumps(artifact, indent=2) + "\n")
+    tmp.replace(out)
+    print(json.dumps({"wrote": str(out),
+                      "compile_counts": stats["compile_counts"]}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

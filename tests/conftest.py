@@ -94,6 +94,9 @@ _SLOW_PATTERNS = (
     "Test1F1BSchedule::test_1f1b_trains",
     "Test1F1BSchedule::test_gpipe_schedule_selectable",
     "test_loss_and_update_parity_with_gpipe[8]",
+    # serving: sustained-load dynamics (late join / backpressure / drain
+    # under load); the fast slot/scheduler/server cases stay default
+    "TestServeUnderLoad",
     # generation / checkpoint long chains
     "test_greedy_decodes_the_chain",
     "test_generate_with_filters_runs",

@@ -73,6 +73,28 @@ class TestBands:
         assert dec["pct_of_roofline_pooled_median"] == pytest.approx(
             100 * 45000.0 / 187747.6, abs=0.1)
 
+    def test_mixed_device_kinds_refuse_pooled_roofline(self):
+        """Sessions measured on different chip kinds share no HBM
+        ceiling: the pooled decode roofline must refuse (None + note),
+        not silently use the first session's bandwidth (ADVICE r5)."""
+        from benchmarks.bands import pool
+
+        decode_row = {
+            "statistic": "best-of-3", "config": {
+                "batch": 8, "prompt_len": 16, "max_new": 240,
+                "d_model": 512, "n_layers": 4, "d_ff": 2048,
+                "vocab": 256, "precision": "bf16"},
+            "tokens_per_sec_runs": [40000.0]}
+        pooled = pool([
+            {"device_kind": "TPU v5 lite", "rows": {"decode": decode_row}},
+            {"device_kind": "TPU v4", "rows": {"decode": dict(decode_row)}},
+        ])
+        dec = pooled["decode"]
+        assert dec["pct_of_roofline_pooled_median"] is None
+        assert "TPU v4" in dec["roofline_note"]
+        # band samples still pool (the refusal is roofline-only)
+        assert dec["tokens_per_sec"]["runs"] == [40000.0, 40000.0]
+
     def test_corrupt_artifact_backed_up_not_reset(self, tmp_path):
         """A truncated artifact must be preserved as .corrupt, never
         silently overwritten (accumulated band history is evidence)."""
@@ -88,6 +110,35 @@ class TestBands:
 
         fresh = _json.loads(out.read_text())
         assert [s["label"] for s in fresh["sessions"]] == ["t"]
+
+
+class TestServeBench:
+    def test_smoke_writes_artifact_with_required_columns(self, tmp_path):
+        """CI-smoke acceptance: the load generator runs on CPU and the
+        artifact carries TTFT/TPOT percentiles, throughput-vs-offered-load
+        rows, occupancy, and the merged telemetry serving section."""
+        from benchmarks.serve_bench import main
+
+        out = tmp_path / "BENCH_SERVE.json"
+        rc = main(["--smoke", "--out", str(out), "--requests", "4",
+                   "--rates", "burst"])
+        assert rc == 0
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["regime"] == "cpu-smoke"
+        (row,) = rec["rows"]
+        assert row["offered_rps"] == "burst"
+        assert row["completed"] == 4 and row["tokens_out"] > 0
+        for col in ("achieved_tokens_per_s", "ttft_s_p50", "ttft_s_p95",
+                    "tpot_s_p50", "tpot_s_p95", "occupancy_mean_cum"):
+            assert row[col] is not None, col
+        # continuous batching's whole point: nothing recompiled under load
+        cc = rec["server_stats"]["compile_counts"]
+        assert all(v in (1, -1) for v in cc.values()), cc
+        sv = rec["serving_report"]
+        assert sv and sv["requests_finished"] >= 5  # warmup + 4
+        assert sv["occupancy_mean"] is not None
 
 
 class TestLossParity:

@@ -431,3 +431,16 @@ class TestTrainerStrategies:
                 lm, mod.ChainLoader(batch=8, seq=32, vocab=32))
         with pytest.raises(ValueError, match="unknown strategy"):
             Trainer(strategy="3d").fit(mod.ToyTrainerModule(), [])
+
+    def test_lm_resume_requires_sized_loader(self, dp_mesh):
+        """Resume with a loader lacking __len__ must fail loudly at the
+        resume site: silently fast-forwarding would exhaust a shorter
+        iterator and replay epoch-0 data (ADVICE r5)."""
+        from tpudist.trainer import Trainer
+
+        def unsized():
+            yield np.zeros((2, 8), np.int32)
+
+        t = Trainer(max_steps=10, progress_bar=False)
+        with pytest.raises(ValueError, match="sized loader"):
+            t._run_lm_loop(None, None, unsized(), dp_mesh, None, None, 5)

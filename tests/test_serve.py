@@ -1,0 +1,469 @@
+"""Serving subsystem tests (tpudist.serve): slot engine correctness
+against the sequential `generate()` oracle, scheduler admission /
+backpressure / deadline semantics, server streaming + graceful drain,
+and the telemetry serving section.  The sustained-load / compile-count
+integration runs in the slow lane (TestServeUnderLoad)."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist.models import create_transformer, generate
+from tpudist.serve import (
+    AdmissionError,
+    InferenceServer,
+    Scheduler,
+    ServeConfig,
+    SlotEngine,
+)
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+def _prompt(plen, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _reference(model, prompt, max_new):
+    """Sequential single-request oracle: the tokens `generate()` emits."""
+    module, params = model
+    import jax.numpy as jnp
+
+    out = generate(module, params, jnp.asarray(prompt)[None], max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _run_through_engine(model, requests, *, num_slots=2, prefill_pad=8):
+    """Drive raw SlotEngine continuous batching: FIFO admission into free
+    slots, heterogeneous lengths, requests joining as others finish."""
+    module, params = model
+    eng = SlotEngine(module, params, num_slots=num_slots,
+                     prefill_pad=prefill_pad)
+    pending = list(enumerate(requests))
+    out = {rid: [] for rid, _ in pending}
+    slot_rid, slot_budget = {}, {}
+
+    def finish_if_done(slot):
+        rid = slot_rid[slot]
+        if len(out[rid]) >= slot_budget[slot]:
+            eng.evict(slot)
+            del slot_rid[slot], slot_budget[slot]
+
+    while pending or eng.num_active:
+        free = eng.free_slots()
+        items = []
+        while free and pending:
+            rid, (prompt, max_new) = pending.pop(0)
+            slot = free.pop(0)
+            slot_rid[slot], slot_budget[slot] = rid, max_new
+            items.append((slot, prompt, 0.0, 0))
+        for slot, tok in eng.insert_batch(items).items():
+            out[slot_rid[slot]].append(tok)
+            finish_if_done(slot)
+        for slot, tok in eng.step().items():
+            out[slot_rid[slot]].append(tok)
+            finish_if_done(slot)
+    return out, eng
+
+
+class TestSlotEngine:
+    def test_token_equivalence_heterogeneous(self, model):
+        """Acceptance oracle: concurrent requests with heterogeneous
+        prompt/output lengths, greedy-decoded through the slot engine,
+        must be byte-identical to sequential generate() calls."""
+        requests = [
+            (_prompt(3, 0), 4),
+            (_prompt(5, 1), 6),
+            (_prompt(2, 2), 3),
+            (_prompt(6, 3), 5),
+        ]
+        out, eng = _run_through_engine(model, requests, num_slots=2)
+        for rid, (prompt, max_new) in enumerate(requests):
+            assert out[rid] == _reference(model, prompt, max_new), rid
+        # everything freed at the end — no leaked lanes
+        assert eng.num_active == 0 and len(eng.free_slots()) == 2
+
+    def test_insert_evict_isolation(self, model):
+        """Evicting one slot mid-decode must not perturb a neighbor, and
+        a new tenant in the freed lane must decode as if alone."""
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        pa, pb, pc = _prompt(4, 10), _prompt(5, 11), _prompt(3, 12)
+        toks_b = []
+        firsts = eng.insert_batch([(0, pa, 0.0, 0), (1, pb, 0.0, 0)])
+        toks_b.append(firsts[1])
+        for _ in range(2):
+            toks_b.append(eng.step()[1])
+        eng.evict(0)  # A leaves mid-flight
+        toks_c = []
+        toks_c.append(eng.insert_batch([(0, pc, 0.0, 0)])[0])
+        for _ in range(3):
+            step = eng.step()
+            toks_b.append(step[1])
+            toks_c.append(step[0])
+        assert toks_b == _reference(model, pb, 6)
+        assert toks_c == _reference(model, pc, 4)
+
+    def test_budget_check_reasons(self, model):
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        assert eng.check_budget(4, 8) is None
+        assert eng.check_budget(0, 8) == "empty_prompt"
+        assert "prompt_too_long" in eng.check_budget(9, 1)
+        assert "budget_exceeded" in eng.check_budget(8, 25)  # 33 > max_len 32
+        assert "max_new" in eng.check_budget(4, 0)
+
+    def test_insert_into_occupied_slot_raises(self, model):
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        eng.insert_batch([(0, _prompt(3, 0), 0.0, 0)])
+        with pytest.raises(ValueError, match="occupied"):
+            eng.insert_batch([(0, _prompt(3, 1), 0.0, 0)])
+
+    def test_sampled_slots_draw_per_request_streams(self, model):
+        """temperature > 0: tokens stay in-vocab and two different seeds
+        in adjacent slots produce (overwhelmingly) different streams."""
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        p = _prompt(4, 42)
+        eng.insert_batch([(0, p, 1.5, 7), (1, p, 1.5, 8)])
+        seqs = {0: [], 1: []}
+        for _ in range(12):
+            for s, tok in eng.step().items():
+                seqs[s].append(tok)
+                assert 0 <= tok < CFG["vocab"]
+        assert seqs[0] != seqs[1]
+
+
+class TestScheduler:
+    def _sched(self, **kw):
+        kw.setdefault("queue_limit", 4)
+        kw.setdefault("check_budget", lambda plen, max_new: None)
+        return Scheduler(**kw)
+
+    def test_fifo_order_and_take_budget(self):
+        s = self._sched()
+        hs = [s.submit([1], max_new=4) for _ in range(3)]
+        got = s.take(2)
+        assert [h.id for h in got] == [hs[0].id, hs[1].id]
+        assert [h.id for h in s.take(5)] == [hs[2].id]
+        assert s.pending() == 0
+
+    def test_queue_full_backpressure(self):
+        s = self._sched(queue_limit=2)
+        s.submit([1]), s.submit([1])
+        with pytest.raises(AdmissionError) as e:
+            s.submit([1])
+        assert e.value.reason == "queue_full"
+        assert s.rejected == 1
+
+    def test_budget_rejection_propagates_reason(self):
+        s = self._sched(check_budget=lambda p, m: "budget_exceeded: nope")
+        with pytest.raises(AdmissionError, match="budget_exceeded"):
+            s.submit([1, 2])
+
+    def test_deadline_expired_in_queue(self):
+        s = self._sched()
+        h = s.submit([1], deadline_s=0.001)
+        time.sleep(0.005)
+        got = s.take(4)
+        assert got == [h] and h.done and h.finish_reason == "deadline"
+        assert h.tokens == []
+
+    def test_expire_queued_without_take(self):
+        """Deadlines hold while every slot is busy: expire_queued sweeps
+        the queue in place without consuming admission slots."""
+        s = self._sched()
+        doomed = s.submit([1], deadline_s=0.001)
+        alive = s.submit([1])
+        time.sleep(0.005)
+        expired = s.expire_queued()
+        assert expired == [doomed] and doomed.finish_reason == "deadline"
+        assert s.pending() == 1 and s.take(2) == [alive]
+
+    def test_deadline_zero_opts_out_of_default(self):
+        """submit(deadline_s<=0) means NO deadline (the env convention),
+        overriding a server-level default; None inherits the default."""
+        s = self._sched(default_deadline_s=0.001)
+        opted_out = s.submit([1], deadline_s=0)
+        inherits = s.submit([1])
+        assert opted_out.request.deadline_s is None
+        assert inherits.request.deadline_s == 0.001
+        time.sleep(0.005)
+        assert s.expire_queued() == [inherits]
+
+    def test_refuse_new_keeps_queued(self):
+        s = self._sched()
+        h = s.submit([1])
+        s.refuse_new("draining")
+        with pytest.raises(AdmissionError, match="draining"):
+            s.submit([1])
+        assert s.take(1) == [h]  # already-admitted work still drains
+        s.refuse_new(None)
+        s.submit([1])  # admission back on
+
+
+class TestServer:
+    def _server(self, model, **cfg):
+        module, params = model
+        cfg.setdefault("num_slots", 2)
+        cfg.setdefault("queue_limit", 8)
+        cfg.setdefault("prefill_pad", 8)
+        return InferenceServer(module, params, ServeConfig(**cfg),
+                               install_signal_handler=False)
+
+    def test_streaming_callbacks_and_equivalence(self, model):
+        server = self._server(model).start()
+        try:
+            streamed = {}
+            lock = threading.Lock()
+
+            def cb_for(rid):
+                def cb(tok, idx):
+                    with lock:
+                        streamed.setdefault(rid, []).append((idx, tok))
+                return cb
+
+            reqs = [(_prompt(3, 20), 4), (_prompt(5, 21), 5),
+                    (_prompt(2, 22), 3)]
+            handles = [server.submit(p, max_new=m, on_token=cb_for(i))
+                       for i, (p, m) in enumerate(reqs)]
+            for h in handles:
+                assert h.wait(60)
+            for i, (p, m) in enumerate(reqs):
+                h = handles[i]
+                assert h.finish_reason == "length"
+                assert h.tokens == _reference(model, p, m)
+                # callbacks fired in order, one per token, same payload
+                assert streamed[i] == list(enumerate(h.tokens))
+                assert h.ttft_s is not None and h.ttft_s > 0
+        finally:
+            assert server.close(30)
+
+    def test_deadline_mid_decode(self, model):
+        server = self._server(model).start()
+        try:
+            h = server.submit(_prompt(3, 30), max_new=25, deadline_s=0.05)
+            assert h.wait(60)
+            assert h.finish_reason == "deadline"
+            assert len(h.tokens) < 25
+        finally:
+            assert server.close(30)
+
+    def test_queue_full_before_start(self, model):
+        """Backpressure is synchronous at submit: with the engine loop not
+        running, the bounded queue fills and the next submit rejects."""
+        server = self._server(model, queue_limit=2)
+        h1 = server.submit(_prompt(2, 0), max_new=2)
+        h2 = server.submit(_prompt(2, 1), max_new=2)
+        with pytest.raises(AdmissionError) as e:
+            server.submit(_prompt(2, 2), max_new=2)
+        assert e.value.reason == "queue_full"
+        assert server.stats()["rejected"] == 1
+        # closing a never-started server must not strand the queued
+        # handles in wait() forever — they finish as "shutdown"
+        assert server.close(5)
+        for h in (h1, h2):
+            assert h.wait(5) and h.finish_reason == "shutdown"
+            assert h.tokens == []
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_loop_error_aborts_outstanding(self, model, monkeypatch):
+        """A device error inside the engine loop must not strand waiters:
+        every in-flight and queued handle finishes with "shutdown" and
+        new submits are refused."""
+        server = self._server(model).start()
+        try:
+            monkeypatch.setattr(
+                server.engine, "step",
+                lambda *a, **k: (_ for _ in ()).throw(
+                    RuntimeError("injected device error")))
+            handles = [server.submit(_prompt(3, 90 + i), max_new=8)
+                       for i in range(3)]
+            for h in handles:
+                assert h.wait(30)
+                assert h.finish_reason == "shutdown"
+            server._thread.join(30)
+            assert not server._thread.is_alive()
+            with pytest.raises(AdmissionError, match="draining"):
+                server.submit(_prompt(2, 99))
+        finally:
+            server.close(5)
+
+    def test_admission_budget_rejected(self, model):
+        server = self._server(model)
+        with pytest.raises(AdmissionError, match="prompt_too_long"):
+            server.submit(_prompt(9, 0))
+        with pytest.raises(AdmissionError, match="budget_exceeded"):
+            server.submit(_prompt(8, 0), max_new=25)
+
+    def test_sigterm_graceful_drain(self, model):
+        """The acceptance drain path: SIGTERM (via the shared preemption
+        flag) stops admission, in-flight requests run to completion, the
+        engine thread exits."""
+        from tpudist.runtime import preemption
+
+        module, params = model
+        server = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=2, queue_limit=8, prefill_pad=8),
+            install_signal_handler=True)
+        try:
+            server.start()
+            handles = [server.submit(_prompt(3, 40 + i), max_new=10)
+                       for i in range(4)]
+            os.kill(os.getpid(), signal.SIGTERM)
+            for h in handles:
+                assert h.wait(60)
+                assert h.finish_reason == "length"
+                assert len(h.tokens) == 10
+            # the loop notices the drain and exits on its own
+            server._thread.join(30)
+            assert not server._thread.is_alive()
+            with pytest.raises(AdmissionError, match="draining"):
+                server.submit(_prompt(2, 50))
+        finally:
+            server.close(30)
+            preemption.reset()
+            preemption.clear_last_run_preempted()
+
+
+class TestServingAggregation:
+    """The telemetry report's serving section (aggregate._serving_summary
+    through the public aggregate_run path)."""
+
+    def _write(self, tmp_path, records):
+        lines = []
+        for r in records:
+            r = {"rank": 0, "gen": 0, "dur": 0.0, **r}
+            lines.append(json.dumps(r))
+        (tmp_path / "rank0_gen0.jsonl").write_text("\n".join(lines) + "\n")
+
+    def test_serving_section_percentiles_and_occupancy(self, tmp_path):
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        recs = [
+            {"kind": "span", "name": "prefill", "t": 0.0, "dur": 0.1},
+            # occupancy weighted by span duration: (0.5*1 + 1.0*3)/4
+            {"kind": "span", "name": "decode_step", "t": 0.1, "dur": 1.0,
+             "occupancy": 0.5, "active": 1},
+            {"kind": "span", "name": "decode_step", "t": 1.1, "dur": 3.0,
+             "occupancy": 1.0, "active": 2},
+            {"kind": "event", "name": "request_finished", "t": 2.0,
+             "reason": "length", "tokens_out": 8, "ttft_s": 0.2,
+             "tpot_s": 0.01, "queue_wait_s": 0.05},
+            {"kind": "event", "name": "request_finished", "t": 3.0,
+             "reason": "deadline", "tokens_out": 3, "ttft_s": 0.6,
+             "tpot_s": 0.03, "queue_wait_s": 0.15},
+            {"kind": "event", "name": "serve_rejected", "t": 3.5,
+             "reason": "queue_full"},
+            {"kind": "event", "name": "serve_drain", "t": 4.0, "pending": 0,
+             "active": 0},
+        ]
+        self._write(tmp_path, recs)
+        report = aggregate_run(tmp_path)
+        sv = report["serving"]
+        assert sv["requests_finished"] == 2
+        assert sv["requests_rejected"] == 1
+        assert sv["finish_reasons"] == {"length": 1, "deadline": 1}
+        assert sv["tokens_out"] == 11
+        assert sv["occupancy_mean"] == pytest.approx(0.875)
+        assert sv["occupancy_max"] == 1.0
+        assert sv["ttft"]["p50_s"] == pytest.approx(0.2)
+        assert sv["ttft"]["p95_s"] == pytest.approx(0.6)
+        assert sv["tpot"]["p50_s"] == pytest.approx(0.01)
+        assert sv["decode_s"] == pytest.approx(4.0)
+        assert sv["prefill_s"] == pytest.approx(0.1)
+        # serving device time lands in the goodput "step" component
+        assert report["goodput"]["step"]["s"] == pytest.approx(4.1)
+        # the drain event makes the joined event log
+        assert any(e["name"] == "serve_drain" for e in report["events"])
+        # markdown renders the section
+        from tpudist.telemetry.aggregate import render_markdown
+
+        md = render_markdown(report)
+        assert "## Serving" in md and "batch occupancy" in md
+
+    def test_no_serving_section_without_serve_records(self, tmp_path):
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        self._write(tmp_path, [
+            {"kind": "span", "name": "step", "t": 0.0, "dur": 1.0}])
+        assert "serving" not in aggregate_run(tmp_path)
+
+
+class TestServeUnderLoad:
+    """Slow-lane dynamics: late join without recompilation, backpressure
+    at the queue bound, SIGTERM drain under load (acceptance criteria)."""
+
+    def test_late_join_compile_flat_backpressure_and_drain(self, model):
+        from tpudist.runtime import preemption
+
+        module, params = model
+        server = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=2, queue_limit=2, prefill_pad=8),
+            install_signal_handler=True)
+        try:
+            server.start()
+            # occupy both slots with long decodes
+            early = [server.submit(_prompt(3, 60 + i), max_new=20)
+                     for i in range(2)]
+            for h in early:
+                while h.t_first_token is None and not h.done:
+                    time.sleep(0.005)
+            compiles_before = server.stats()["compile_counts"]
+            # a late request joins the RUNNING batch the moment a slot
+            # frees — no recompilation of any engine program
+            late = server.submit(_prompt(5, 70), max_new=6)
+            # backpressure: the bounded queue (the late request occupies
+            # one of 2 queue places only until admitted) overflows
+            fillers = []
+            rejected = None
+            for i in range(4):
+                try:
+                    fillers.append(
+                        server.submit(_prompt(2, 80 + i), max_new=18))
+                except AdmissionError as e:
+                    rejected = e.reason
+                    break
+            assert rejected == "queue_full"
+            # drain under load: everything admitted completes
+            os.kill(os.getpid(), signal.SIGTERM)
+            for h in early + [late] + fillers:
+                assert h.wait(120)
+                assert h.finish_reason == "length"
+            server._thread.join(60)
+            assert not server._thread.is_alive()
+            compiles_after = server.stats()["compile_counts"]
+            # the programs that were already running (prefill, insert,
+            # decode) did not recompile when the late request joined, and
+            # every engine program ends the run at exactly ONE compile
+            # (evict first fires when the first request finishes, which
+            # may be after the snapshot)
+            for name in ("prefill", "insert_from", "decode_step"):
+                assert compiles_after[name] == compiles_before[name], name
+            assert all(v in (1, -1) for v in compiles_after.values()), \
+                compiles_after
+            # the late arrival produced the exact sequential-oracle tokens
+            assert late.tokens == _reference(model, _prompt(5, 70), 6)
+            stats = server.stats()
+            assert stats["completed"] == len(early) + 1 + len(fillers)
+            assert stats["occupancy_mean"] > 0.5
+        finally:
+            server.close(60)
+            preemption.reset()
+            preemption.clear_last_run_preempted()

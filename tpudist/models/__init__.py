@@ -6,9 +6,11 @@ from tpudist.models.transformer import (  # noqa: F401
     lm_loss_with_targets,
 )
 from tpudist.models.generate import (  # noqa: F401
+    SlotDecode,
     decode_logits,
     generate,
     make_decode_step,
     make_generator,
+    make_slot_decode,
     sample_logits,
 )

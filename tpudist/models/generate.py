@@ -17,7 +17,8 @@ TPU-first:
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -189,6 +190,144 @@ def generate(
         module, params, max_new, temperature=temperature, top_k=top_k,
         top_p=top_p,
     )(prompt, rng)
+
+
+class SlotDecode(NamedTuple):
+    """The compiled primitives of the continuous-batching serving engine
+    (:mod:`tpudist.serve`): ``num_slots`` independent KV-cache lanes, each
+    a batch-1 decode cache with its OWN position cursor (the single-batch
+    decode step vmapped over a leading slot axis — per-slot cursors, masks,
+    and RoPE offsets fall out of the vmap for free).
+
+    Every callable is jitted once with fixed shapes, so requests of any
+    prompt/output length join and leave a running batch with ZERO
+    recompilation — the SPMD fixed-shape discipline, applied to serving:
+
+    - ``init_slots()`` → all-zeros slot cache (leading ``[num_slots]``
+      axis on every leaf, scalar cursors become ``[num_slots]`` vectors);
+    - ``prefill(prompts [S, pad], plens [S])`` → ``(caches, last_logits)``:
+      teacher-force up to ``S`` prompts at once through the cached forward
+      (a masked fixed-length scan: steps at ``i >= plen`` keep the old
+      cache, so any ``plen <= prefill_pad`` shares one program); returns
+      per-sequence caches (cursor at ``plen``) and the logits after the
+      LAST prompt token — the distribution the first generated token is
+      drawn from, exactly as :func:`generate` does it;
+    - ``insert_from(slot_cache, batch_cache, i, slot)`` → slot cache with
+      prefill lane ``i`` scattered into ``slot`` (indices traced: one
+      compile serves every (i, slot) pair);
+    - ``evict(slot_cache, slot)`` → that lane zeroed (a freed slot must
+      not leak a tenant's K/V into the next request's garbage window);
+    - ``decode_step(cache, toks, active, keys, temps, counts)`` →
+      ``(cache, next_toks)``: ONE compiled step over all slots — inactive
+      lanes compute too (fixed shape) but their cache writes are undone by
+      the ``active`` select, so they neither advance nor corrupt;
+    - ``sample(logits, keys, temps, counts)`` → per-slot token draw:
+      greedy argmax where ``temps <= 0``, else categorical at that slot's
+      temperature from ``fold_in(key, count)`` — a deterministic
+      per-request stream independent of which slot/batch neighbors the
+      request decoded beside.
+    """
+
+    num_slots: int
+    prefill_pad: int
+    init_slots: Callable
+    prefill: Callable
+    insert_from: Callable
+    evict: Callable
+    decode_step: Callable
+    sample: Callable
+
+
+def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
+                 counts: jax.Array) -> jax.Array:
+    """Per-slot sampling (see :class:`SlotDecode`): ``logits [S, vocab]``,
+    ``keys [S, 2] uint32``, ``temps [S]``, ``counts [S]`` → ``[S] int32``."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def one(key, lg, t, c):
+        k = jax.random.fold_in(key, c)
+        return jax.random.categorical(k, lg / jnp.maximum(t, 1e-6))
+
+    sampled = jax.vmap(one)(keys, logits, temps, counts).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def make_slot_decode(module, params, num_slots: int,
+                     prefill_pad: int) -> SlotDecode:
+    """Build the slot-decode primitive set over ``module``/``params`` —
+    see :class:`SlotDecode` for the contract of each callable."""
+    if num_slots < 1:
+        raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+    if not 1 <= prefill_pad <= module.max_len:
+        raise ValueError(
+            f"prefill_pad {prefill_pad} must be in [1, {module.max_len}] "
+            "(the KV-cache size)")
+    init_cache, step = make_decode_step(module, params)
+    vocab = module.vocab
+    vstep = jax.vmap(step, in_axes=(0, 0))
+
+    def init_slots():
+        one = init_cache(1)
+        return jax.tree.map(
+            lambda a: jnp.zeros((num_slots,) + a.shape, a.dtype), one)
+
+    @jax.jit
+    def prefill(prompts, plens):
+        def one_seq(prompt, plen):
+            cache = init_cache(1)
+
+            def body(carry, i):
+                cache, last = carry
+                tok = lax.dynamic_index_in_dim(prompt, i, keepdims=False)
+                nc, logits = step(cache, tok[None, None])
+                live = i < plen
+                cache = jax.tree.map(
+                    lambda n, o: jnp.where(live, n, o), nc, cache)
+                last = jnp.where(i == plen - 1, logits[0], last)
+                return (cache, last), None
+
+            (cache, last), _ = lax.scan(
+                body, (cache, jnp.zeros((vocab,), jnp.float32)),
+                jnp.arange(prefill_pad))
+            return cache, last
+
+        return jax.vmap(one_seq)(prompts, plens)
+
+    # The slot cache is donated in every primitive that threads it: the
+    # engine always overwrites its cache with the result, and without
+    # donation each iteration would copy the whole [num_slots × layers ×
+    # max_len] K/V arena into fresh buffers — doubling peak cache memory
+    # and paying a full-arena memcpy per decode step.
+    @partial(jax.jit, donate_argnums=0)
+    def insert_from(slot_cache, batch_cache, i, slot):
+        return jax.tree.map(
+            lambda full, b: lax.dynamic_update_index_in_dim(
+                full, lax.dynamic_index_in_dim(b, i, 0, keepdims=False),
+                slot, 0),
+            slot_cache, batch_cache)
+
+    @partial(jax.jit, donate_argnums=0)
+    def evict(slot_cache, slot):
+        return jax.tree.map(
+            lambda full: lax.dynamic_update_index_in_dim(
+                full, jnp.zeros(full.shape[1:], full.dtype), slot, 0),
+            slot_cache)
+
+    @partial(jax.jit, donate_argnums=0)
+    def decode_step(slot_cache, toks, active, keys, temps, counts):
+        new_cache, logits = vstep(slot_cache, toks[:, None, None])
+
+        def sel(n, o):
+            m = active.reshape((-1,) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        cache = jax.tree.map(sel, new_cache, slot_cache)
+        return cache, _slot_sample(logits[:, 0], keys, temps, counts)
+
+    return SlotDecode(
+        num_slots=num_slots, prefill_pad=prefill_pad, init_slots=init_slots,
+        prefill=prefill, insert_from=insert_from, evict=evict,
+        decode_step=decode_step, sample=jax.jit(_slot_sample))
 
 
 def decode_logits(module, params, tokens: jax.Array) -> jax.Array:

@@ -1,0 +1,28 @@
+"""Continuous-batching inference serving (the north star's "heavy
+traffic" half — training alone was the repo's whole surface before this
+subsystem).
+
+Three layers, device-to-host:
+
+- :mod:`tpudist.serve.engine` — ``SlotEngine``: fixed-shape slot lanes
+  over one compiled decode step (zero recompilation as requests churn);
+- :mod:`tpudist.serve.scheduler` — bounded FIFO with admission control,
+  deadline enforcement, reject-with-reason backpressure;
+- :mod:`tpudist.serve.server` — ``InferenceServer``: threaded ingestion,
+  streaming token callbacks, SIGTERM graceful drain, telemetry.
+
+``python -m tpudist.serve`` runs a self-contained CPU demo.
+"""
+
+from tpudist.serve.engine import SlotEngine  # noqa: F401
+from tpudist.serve.scheduler import (  # noqa: F401
+    AdmissionError,
+    Request,
+    RequestHandle,
+    Scheduler,
+)
+from tpudist.serve.server import (  # noqa: F401
+    InferenceServer,
+    ServeConfig,
+    serve_forever,
+)

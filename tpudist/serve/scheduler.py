@@ -1,0 +1,266 @@
+"""Iteration-level request scheduler: the host half of continuous batching.
+
+The engine (:mod:`tpudist.serve.engine`) exposes slots; this module
+decides WHAT goes into them.  Responsibilities, in the order a request
+meets them:
+
+- **admission control** — a request is checked against the engine's
+  budget rule (prompt fits the prefill pad, prompt + max_new fits the
+  KV cache) and the queue bound AT SUBMIT TIME, synchronously: the
+  caller gets an :class:`AdmissionError` with a machine-readable
+  ``reason`` instead of a request that can never complete
+  (reject-with-reason backpressure — a bounded queue is the only thing
+  standing between a traffic spike and an unbounded-memory host);
+- **FIFO-with-budget assignment** — each engine iteration, the server
+  pulls up to ``len(free_slots)`` requests off the queue head; there is
+  no reordering (fairness is arrival order, the budget is the slot
+  count);
+- **deadline enforcement** — a request carries an optional relative
+  ``deadline_s``; expired requests finish with reason ``"deadline"``
+  whether they are still queued (checked when pulled) or mid-decode
+  (checked by the server every iteration).
+
+Thread contract: ``submit`` is called from any number of ingestion
+threads; ``take``/``drain`` from the single engine thread.  Everything
+shared sits behind one lock + condition.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+import warnings
+from typing import Callable, List, Optional
+
+import numpy as np
+
+#: finish reasons a handle can carry (``finish_reason`` is always one of
+#: these once ``done`` is set): completed its token budget, missed its
+#: deadline, or was cut off by a non-graceful server stop.
+FINISH_REASONS = ("length", "deadline", "shutdown")
+
+
+class AdmissionError(RuntimeError):
+    """A request the scheduler refused; ``reason`` is machine-readable
+    (``queue_full``, ``draining``, ``prompt_too_long: ...``,
+    ``budget_exceeded: ...``, ``empty_prompt``)."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request rejected: {reason}")
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (token-id space; tokenization is the
+    caller's concern, as everywhere else in the LM family)."""
+
+    prompt: np.ndarray  # [plen] int32
+    max_new: int
+    temperature: float = 0.0  # 0 = greedy (the token-equivalence mode)
+    deadline_s: Optional[float] = None  # relative to submit; None = none
+    seed: int = 0  # per-request sampling stream (temperature > 0)
+    on_token: Optional[Callable[[int, int], None]] = None  # (token, index)
+
+
+class RequestHandle:
+    """The caller's view of an in-flight request: streamed tokens, a
+    ``done`` event, the finish reason, and the latency stamps the
+    serving metrics (TTFT/TPOT) are computed from."""
+
+    def __init__(self, request: Request, req_id: int):
+        self.request = request
+        self.id = req_id
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self._done = threading.Event()
+        now = time.monotonic()
+        self.t_submit = now
+        self.t_admitted: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.slot: Optional[int] = None
+
+    # -- caller side --------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes; True iff it did."""
+        return self._done.wait(timeout)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token, queue wait included (submit → token 0)."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Time per output token AFTER the first (the steady decode
+        rate); None until at least two tokens exist."""
+        if (self.t_done is None or self.t_first_token is None
+                or len(self.tokens) < 2):
+            return None
+        return (self.t_done - self.t_first_token) / (len(self.tokens) - 1)
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_admitted is None:
+            return None
+        return self.t_admitted - self.t_submit
+
+    # -- engine side (single engine thread) ---------------------------------
+
+    def _expired(self, now: float) -> bool:
+        d = self.request.deadline_s
+        return d is not None and (now - self.t_submit) > d
+
+    def _deliver(self, token: int) -> None:
+        now = time.monotonic()
+        if self.t_first_token is None:
+            self.t_first_token = now
+        self.tokens.append(int(token))
+        cb = self.request.on_token
+        if cb is not None:
+            try:
+                cb(int(token), len(self.tokens) - 1)
+            except Exception as e:  # a user callback must not kill the loop
+                warnings.warn(f"on_token callback raised: {e!r}",
+                              RuntimeWarning, stacklevel=2)
+
+    def _finish(self, reason: str) -> None:
+        if self._done.is_set():
+            return
+        self.finish_reason = reason
+        self.t_done = time.monotonic()
+        self._done.set()
+
+
+class Scheduler:
+    """Bounded FIFO + admission control (module doc has the contract)."""
+
+    def __init__(self, *, queue_limit: int,
+                 check_budget: Callable[[int, int], Optional[str]],
+                 default_max_new: int = 64,
+                 default_deadline_s: Optional[float] = None):
+        if queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.queue_limit = queue_limit
+        self.check_budget = check_budget
+        self.default_max_new = default_max_new
+        self.default_deadline_s = default_deadline_s
+        self._q: "collections.deque[RequestHandle]" = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._refuse_reason: Optional[str] = None
+        self._next_id = 0
+        self.rejected = 0
+
+    # -- ingestion side -----------------------------------------------------
+
+    def submit(self, prompt, *, max_new: Optional[int] = None,
+               temperature: float = 0.0, deadline_s: Optional[float] = None,
+               seed: Optional[int] = None,
+               on_token: Optional[Callable[[int, int], None]] = None,
+               ) -> RequestHandle:
+        """Admit a request or raise :class:`AdmissionError` (backpressure
+        is synchronous — the caller learns NOW, not after a timeout)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        # Deadline convention matches TPUDIST_SERVE_DEADLINE_S: ``None``
+        # inherits the server default, ``<= 0`` means explicitly NO
+        # deadline — the per-request opt-out when a default is set.
+        if deadline_s is None:
+            deadline = self.default_deadline_s
+        else:
+            deadline = float(deadline_s) if deadline_s > 0 else None
+        req = Request(
+            prompt=prompt,
+            max_new=self.default_max_new if max_new is None else int(max_new),
+            temperature=float(temperature),
+            deadline_s=deadline,
+            seed=0 if seed is None else int(seed),
+            on_token=on_token,
+        )
+        with self._lock:
+            reason = self._refuse_reason
+            if reason is None and len(self._q) >= self.queue_limit:
+                reason = "queue_full"
+            if reason is None:
+                reason = self.check_budget(len(prompt), req.max_new)
+            if reason is not None:
+                self.rejected += 1
+                raise AdmissionError(reason)
+            handle = RequestHandle(req, self._next_id)
+            self._next_id += 1
+            self._q.append(handle)
+            self._work.notify_all()
+            return handle
+
+    # -- engine side --------------------------------------------------------
+
+    def take(self, k: int, now: Optional[float] = None
+             ) -> List[RequestHandle]:
+        """Pop up to ``k`` admissible requests (FIFO).  Requests whose
+        deadline already expired in the queue finish as ``"deadline"`` on
+        the spot; they are returned too (already ``done``) so the caller
+        can account for them, but they do not consume an admission slot."""
+        if k <= 0:
+            return []
+        now = time.monotonic() if now is None else now
+        out: List[RequestHandle] = []
+        alive = 0
+        with self._lock:
+            while self._q and alive < k:
+                h = self._q.popleft()
+                if h._expired(now):
+                    h._finish("deadline")
+                else:
+                    alive += 1
+                out.append(h)
+        return out
+
+    def expire_queued(self, now: Optional[float] = None
+                      ) -> List[RequestHandle]:
+        """Finish (and remove) every queued request whose deadline has
+        passed — called every engine iteration, so a queued request's
+        deadline holds even while every slot is busy with long decodes
+        (``take`` only runs when a slot frees).  Returns the expired
+        handles for accounting."""
+        now = time.monotonic() if now is None else now
+        out: List[RequestHandle] = []
+        with self._lock:
+            keep: "collections.deque[RequestHandle]" = collections.deque()
+            while self._q:
+                h = self._q.popleft()
+                if h._expired(now):
+                    h._finish("deadline")
+                    out.append(h)
+                else:
+                    keep.append(h)
+            self._q = keep
+        return out
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def wait_for_work(self, timeout: float) -> None:
+        """Park the engine thread until a submit lands (or timeout — the
+        loop also needs to notice drain/stop flags)."""
+        with self._lock:
+            if not self._q:
+                self._work.wait(timeout)
+
+    def refuse_new(self, reason: Optional[str]) -> None:
+        """Turn admission off (``reason``, e.g. ``"draining"``) or back
+        on (``None``).  Queued requests are unaffected — drain completes
+        everything already admitted."""
+        with self._lock:
+            self._refuse_reason = reason
+            self._work.notify_all()

@@ -1,0 +1,304 @@
+"""Threaded serving front-end: ingestion, the engine loop, graceful drain.
+
+Wiring (one picture)::
+
+    submit() threads ──> Scheduler (bounded FIFO, admission)      host
+                              │ take(free_slots)
+                              ▼
+    engine thread ───> SlotEngine.insert_batch / step / evict     device
+                              │ tokens
+                              ▼
+                       RequestHandle streaming callbacks, done events
+
+One background thread drives the engine (the device programs are
+serialized anyway — a thread per request would only add contention);
+any number of caller threads submit.  SIGTERM reuses the training
+stack's preemption flag (:mod:`tpudist.runtime.preemption`): the loop
+checks it every iteration and, once set, stops admitting (new submits
+reject with ``"draining"``), finishes everything already admitted —
+queued AND in-slot — then exits.  The same drain runs on
+:meth:`InferenceServer.close`, so a deploy rollover never cuts a
+response mid-stream.
+
+Telemetry (the PR-2 subsystem) brackets the two device programs —
+``prefill`` and ``decode_step`` spans, the latter tagged with the batch
+occupancy gauge — and stamps a ``request_finished`` event per request
+carrying TTFT/TPOT/queue-wait, which the aggregator folds into the
+run report's serving section (:mod:`tpudist.telemetry.aggregate`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from tpudist.serve.engine import SlotEngine
+from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
+
+#: poll interval of an idle engine loop (also the latency to notice a
+#: drain request while idle) — host-side only, no device work while idle.
+_IDLE_WAIT_S = 0.01
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs; :meth:`from_env` reads the ``TPUDIST_SERVE_*``
+    family (registered in ``tpudist.utils.envutil.ENV_VARS``)."""
+
+    num_slots: int = 4
+    queue_limit: int = 64
+    max_new: int = 64  # default per-request token budget
+    prefill_pad: Optional[int] = None  # None: min(max_len, 64)
+    deadline_s: Optional[float] = None  # default per-request deadline
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        from tpudist.utils.envutil import env_int, env_positive_float
+
+        return cls(
+            num_slots=env_int("TPUDIST_SERVE_SLOTS", 4) or 4,
+            queue_limit=env_int("TPUDIST_SERVE_QUEUE", 64) or 64,
+            max_new=env_int("TPUDIST_SERVE_MAX_NEW", 64) or 64,
+            prefill_pad=env_int("TPUDIST_SERVE_PREFILL_PAD", None),
+            deadline_s=env_positive_float("TPUDIST_SERVE_DEADLINE_S", None),
+        )
+
+
+class InferenceServer:
+    """Continuous-batching server over a ``TransformerLM`` decode path.
+
+    Usage::
+
+        server = InferenceServer(module, params, ServeConfig(num_slots=8))
+        server.start()
+        h = server.submit(prompt_ids, max_new=32, on_token=stream_cb)
+        h.wait(); print(h.tokens, h.finish_reason)
+        server.close()          # graceful drain (same path as SIGTERM)
+    """
+
+    def __init__(self, module, params, config: Optional[ServeConfig] = None,
+                 *, install_signal_handler: bool = True):
+        self.config = config or ServeConfig.from_env()
+        self.engine = SlotEngine(
+            module, params, num_slots=self.config.num_slots,
+            prefill_pad=self.config.prefill_pad)
+        self.scheduler = Scheduler(
+            queue_limit=self.config.queue_limit,
+            check_budget=self.engine.check_budget,
+            default_max_new=self.config.max_new,
+            default_deadline_s=self.config.deadline_s)
+        self._install_signal = install_signal_handler
+        self._installed_preemption = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._draining = False
+        self._slot_handles: Dict[int, RequestHandle] = {}
+        # counters (engine thread writes, stats() reads — GIL-atomic)
+        self.completed = 0
+        self.tokens_out = 0
+        self._occupancy_sum = 0.0
+        self._steps = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        from tpudist import telemetry
+        from tpudist.runtime import preemption
+
+        telemetry.ensure_started()
+        if self._install_signal:
+            # SIGTERM → drain: the same preemption flag the training loop
+            # checkpoints on.  Off the main thread install degrades to a
+            # warned no-op (preemption.py's contract) — close() still
+            # drains explicitly.
+            self._installed_preemption = preemption.install()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpudist-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def submit(self, prompt, *, max_new: Optional[int] = None,
+               temperature: float = 0.0, deadline_s: Optional[float] = None,
+               seed: Optional[int] = None,
+               on_token: Optional[Callable[[int, int], None]] = None,
+               ) -> RequestHandle:
+        """Thread-safe ingestion; raises :class:`AdmissionError` on
+        backpressure/budget rejection (reason stamped into telemetry)."""
+        from tpudist import telemetry
+
+        try:
+            return self.scheduler.submit(
+                prompt, max_new=max_new, temperature=temperature,
+                deadline_s=deadline_s, seed=seed, on_token=on_token)
+        except AdmissionError as e:
+            telemetry.event("serve_rejected", reason=e.reason)
+            raise
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, finish everything admitted, stop the loop.
+        Returns True once the engine thread exited (or never ran).
+
+        With no live engine thread — server never started, or its loop
+        already died — queued requests can never produce tokens: they
+        finish with reason ``"shutdown"`` instead of hanging their
+        waiters forever."""
+        self._stop.set()
+        t = self._thread
+        ok = True
+        if t is not None:
+            t.join(timeout)
+            ok = not t.is_alive()
+        if ok:
+            # After a graceful drain both are empty — this only bites on
+            # the never-started / dead-loop paths.
+            self.scheduler.refuse_new("draining")
+            self._abort_outstanding()
+        return ok
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown (drain) + handler restore."""
+        ok = self.drain(timeout)
+        if self._installed_preemption:
+            from tpudist.runtime import preemption
+
+            preemption.reset()
+            self._installed_preemption = False
+        return ok
+
+    def stats(self) -> dict:
+        return {
+            "completed": self.completed,
+            "rejected": self.scheduler.rejected,
+            "tokens_out": self.tokens_out,
+            "pending": self.scheduler.pending(),
+            "active": self.engine.num_active,
+            "occupancy_mean": (self._occupancy_sum / self._steps
+                               if self._steps else 0.0),
+            "compile_counts": self.engine.compile_counts(),
+        }
+
+    # -- the engine loop ----------------------------------------------------
+
+    def _should_drain(self) -> bool:
+        if self._stop.is_set():
+            return True
+        from tpudist.runtime import preemption
+
+        return preemption.requested()
+
+    def _abort_outstanding(self) -> None:
+        """Finish every request that can no longer be served (reason
+        ``"shutdown"``) — the hard-stop twin of the graceful drain."""
+        for slot in list(self._slot_handles):
+            h = self._slot_handles.pop(slot)
+            h._finish("shutdown")
+            self._note_finished(h)
+        for h in self.scheduler.take(1 << 30):
+            if not h.done:
+                h._finish("shutdown")
+            self._note_finished(h)
+
+    def _loop(self) -> None:
+        from tpudist import telemetry
+
+        try:
+            self._run_loop()
+        except BaseException as e:
+            # The loop must not die silently: a device error (OOM, a
+            # budget-guard RuntimeError) would otherwise strand every
+            # in-flight and queued handle in wait() forever while
+            # submit() keeps admitting doomed work.
+            telemetry.event("serve_loop_error", error=repr(e))
+            raise  # threading excepthook still reports the traceback
+        finally:
+            self.scheduler.refuse_new("draining")
+            self._abort_outstanding()
+
+    def _run_loop(self) -> None:
+        from tpudist import telemetry
+
+        eng, sched = self.engine, self.scheduler
+        while True:
+            if not self._draining and self._should_drain():
+                self._draining = True
+                sched.refuse_new("draining")
+                telemetry.event("serve_drain", pending=sched.pending(),
+                                active=eng.num_active)
+            now = time.monotonic()
+            # deadline enforcement: in-slot AND queued (the queue check
+            # must not wait for a slot to free — all lanes can be busy
+            # for far longer than a queued request's deadline)
+            for slot, h in list(self._slot_handles.items()):
+                if h._expired(now):
+                    self._finish_slot(slot, "deadline")
+            for h in sched.expire_queued(now):
+                self._note_finished(h)
+            # FIFO-with-budget admission into free lanes, batched prefill
+            free = eng.free_slots()
+            if free:
+                batch = sched.take(len(free), now)
+                alive = []
+                for h in batch:
+                    if h.done:  # finished in-queue (deadline expired)
+                        self._note_finished(h)
+                    else:
+                        alive.append(h)
+                if alive:
+                    items, t0 = [], time.monotonic()
+                    for h, slot in zip(alive, free):
+                        h.slot = slot
+                        h.t_admitted = t0
+                        items.append((slot, h.request.prompt,
+                                      h.request.temperature, h.request.seed))
+                        self._slot_handles[slot] = h
+                    with telemetry.span("prefill", n=len(items)):
+                        firsts = eng.insert_batch(items)
+                    for h in alive:
+                        h._deliver(firsts[h.slot])
+                        self.tokens_out += 1
+                        if len(h.tokens) >= h.request.max_new:
+                            self._finish_slot(h.slot, "length")
+            # one batched decode iteration
+            if eng.num_active:
+                occ = eng.occupancy
+                with telemetry.span("decode_step", occupancy=occ,
+                                    active=eng.num_active):
+                    toks = eng.step()
+                self._occupancy_sum += occ
+                self._steps += 1
+                for slot, tok in toks.items():
+                    h = self._slot_handles[slot]
+                    h._deliver(tok)
+                    self.tokens_out += 1
+                    if len(h.tokens) >= h.request.max_new:
+                        self._finish_slot(slot, "length")
+            elif self._draining and sched.pending() == 0:
+                break
+            else:
+                sched.wait_for_work(_IDLE_WAIT_S)
+
+    def _finish_slot(self, slot: int, reason: str) -> None:
+        h = self._slot_handles.pop(slot)
+        self.engine.evict(slot)
+        h._finish(reason)
+        self._note_finished(h)
+
+    def _note_finished(self, h: RequestHandle) -> None:
+        from tpudist import telemetry
+
+        self.completed += 1
+        telemetry.event(
+            "request_finished", id=h.id, reason=h.finish_reason,
+            prompt_len=int(len(h.request.prompt)), tokens_out=len(h.tokens),
+            ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s)
+
+
+def serve_forever(module, params, config: Optional[ServeConfig] = None,
+                  ) -> InferenceServer:
+    """Start a server and return it (the embedding entry — the CLI demo
+    in ``__main__`` owns its own loop)."""
+    return InferenceServer(module, params, config).start()

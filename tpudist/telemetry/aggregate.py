@@ -53,6 +53,13 @@ COMPONENT_OF = {
     "ckpt_wait": "ckpt",
     "host_collective": "comm",
     "init": "init",
+    # serving (tpudist.serve): device work of the engine loop — prefill
+    # teacher-forcing and batched decode iterations are the serving
+    # analog of a train step.  The first decode_step/prefill dispatch
+    # blocks on XLA compilation like any first dispatch; the serving
+    # section's TTFT percentiles surface that separately.
+    "prefill": "step",
+    "decode_step": "step",
 }
 
 #: Every component of the breakdown, in report order.  The accounted ones
@@ -64,7 +71,7 @@ COMPONENTS = ("step", "compile", "data", "ckpt", "comm", "init", "other",
 #: Event names surfaced in the report's event log (joined across ranks and
 #: generations on the wall-clock axis).
 _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
-                    "prefetch_stats")
+                    "prefetch_stats", "serve_drain", "serve_loop_error")
 
 
 def find_telemetry_dir(run_dir: "str | Path") -> Path:
@@ -182,6 +189,66 @@ def _step_stats(records: List[dict], num_ranks: int = 1) -> dict:
     }
 
 
+def _serving_summary(records: List[dict]) -> Optional[dict]:
+    """Serving-goodput section from the serve subsystem's records:
+    per-request ``request_finished`` events (TTFT/TPOT/queue-wait
+    percentiles, finish-reason counts) plus the ``decode_step`` spans'
+    occupancy gauge (duration-weighted — a long low-occupancy stretch
+    must weigh what it cost).  ``None`` when the run never served."""
+    fins = [r for r in records if r.get("kind") == "event"
+            and r.get("name") == "request_finished"]
+    rejects = sum(1 for r in records if r.get("kind") == "event"
+                  and r.get("name") == "serve_rejected")
+    occ_w, occ_dur, occ_max, decode_s, prefill_s = 0.0, 0.0, 0.0, 0.0, 0.0
+    serve_spans = 0
+    for r in records:
+        if r.get("kind") != "span":
+            continue
+        if r.get("name") == "decode_step":
+            serve_spans += 1
+            dur = float(r.get("dur", 0.0))
+            decode_s += dur
+            occ = r.get("occupancy")
+            if isinstance(occ, (int, float)):
+                occ_w += float(occ) * dur
+                occ_dur += dur
+                occ_max = max(occ_max, float(occ))
+        elif r.get("name") == "prefill":
+            serve_spans += 1
+            prefill_s += float(r.get("dur", 0.0))
+    if not fins and not serve_spans and not rejects:
+        return None
+
+    def _pcts(key):
+        vals = sorted(float(r[key]) for r in fins
+                      if isinstance(r.get(key), (int, float)))
+        if not vals:
+            return None
+        return {"p50_s": round(_percentile(vals, 50), 6),
+                "p95_s": round(_percentile(vals, 95), 6),
+                "max_s": round(vals[-1], 6)}
+
+    reasons: Dict[str, int] = {}
+    for r in fins:
+        reasons[str(r.get("reason"))] = reasons.get(str(r.get("reason")), 0) + 1
+    tokens_out = sum(int(r.get("tokens_out", 0)) for r in fins)
+    busy = decode_s + prefill_s
+    return {
+        "requests_finished": len(fins),
+        "requests_rejected": rejects,
+        "finish_reasons": reasons,
+        "tokens_out": tokens_out,
+        "decode_s": round(decode_s, 6),
+        "prefill_s": round(prefill_s, 6),
+        "tokens_per_s_busy": round(tokens_out / busy, 3) if busy > 0 else None,
+        "ttft": _pcts("ttft_s"),
+        "tpot": _pcts("tpot_s"),
+        "queue_wait": _pcts("queue_wait_s"),
+        "occupancy_mean": round(occ_w / occ_dur, 4) if occ_dur > 0 else None,
+        "occupancy_max": round(occ_max, 4) if occ_dur > 0 else None,
+    }
+
+
 def aggregate_run(run_dir: "str | Path") -> dict:
     """Merge a run's telemetry into the report dict (see module doc)."""
     records = load_records(run_dir)
@@ -259,6 +326,9 @@ def aggregate_run(run_dir: "str | Path") -> dict:
         "stages": {k: round(v, 6) for k, v in sorted(stages.items())},
         "events": events,
     }
+    serving = _serving_summary(records)
+    if serving is not None:
+        report["serving"] = serving
     return report
 
 
@@ -301,6 +371,29 @@ def render_markdown(report: dict) -> str:
         lines.append(
             f"| {p['rank']} | {p['generations']} | {p['wall_s']:.3f} | "
             + " | ".join(f"{p[c]:.3f}" for c in COMPONENTS) + " |")
+    if report.get("serving"):
+        sv = report["serving"]
+        lines += ["", "## Serving", ""]
+        lines.append(
+            f"- requests: {sv['requests_finished']} finished "
+            f"({sv['finish_reasons']}), {sv['requests_rejected']} rejected")
+        lines.append(
+            f"- tokens out: {sv['tokens_out']} — decode {sv['decode_s']:.3f} s"
+            f" + prefill {sv['prefill_s']:.3f} s"
+            + (f" → {sv['tokens_per_s_busy']:.1f} tok/s busy"
+               if sv["tokens_per_s_busy"] else ""))
+        for label, key in (("TTFT", "ttft"), ("TPOT", "tpot"),
+                           ("queue wait", "queue_wait")):
+            v = sv.get(key)
+            if v:
+                lines.append(
+                    f"- {label}: p50 {v['p50_s'] * 1e3:.1f} ms, "
+                    f"p95 {v['p95_s'] * 1e3:.1f} ms, "
+                    f"max {v['max_s'] * 1e3:.1f} ms")
+        if sv.get("occupancy_mean") is not None:
+            lines.append(
+                f"- batch occupancy: mean {sv['occupancy_mean']:.2f}, "
+                f"max {sv['occupancy_max']:.2f}")
     if report.get("stages"):
         lines += ["", "## Host stages (StageTimer)", ""]
         for k, v in report["stages"].items():

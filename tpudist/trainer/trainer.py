@@ -389,6 +389,18 @@ class Trainer:
 
         ts = token_sharding(mesh)
         batches = len(loader) if hasattr(loader, "__len__") else None
+        if batches is None and start_iteration:
+            # Fast-forwarding start_iteration batches through a loader with
+            # no __len__ cannot recover the epoch boundary: the skip loop
+            # would silently exhaust a shorter iterator (dying later with a
+            # misleading "yielded no batches") and epoch-seeded shuffling
+            # would replay epoch-0 data.  Fail at the resume site instead.
+            raise ValueError(
+                f"resume at iteration {start_iteration} requires a sized "
+                "loader: the LM loop derives the epoch boundary from "
+                "len(loader), which this loader does not provide — wrap it "
+                "with a __len__ (e.g. a list or tpudist.data loader) or "
+                "restart without resume")
         epoch = start_iteration // batches if batches else 0
         skip = start_iteration - epoch * (batches or 0)
         iteration = start_iteration

@@ -90,6 +90,12 @@ ENV_VARS = {
     "TPUDIST_INIT_RETRIES": "jax.distributed.initialize retry budget",
     "TPUDIST_INIT_BACKOFF_S": "initialize retry base backoff seconds",
     "TPUDIST_FAULT": "chaos fault-injection grammar (runtime.faults)",
+    # serving (tpudist.serve — ServeConfig.from_env)
+    "TPUDIST_SERVE_SLOTS": "continuous-batching KV-cache slot count",
+    "TPUDIST_SERVE_QUEUE": "serving request-queue bound (backpressure)",
+    "TPUDIST_SERVE_MAX_NEW": "default per-request output-token budget",
+    "TPUDIST_SERVE_PREFILL_PAD": "prefill pad length (max admissible prompt)",
+    "TPUDIST_SERVE_DEADLINE_S": "default per-request deadline seconds (<=0 off)",
     # telemetry & goodput
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
