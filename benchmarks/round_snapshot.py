@@ -59,9 +59,10 @@ def detect_round() -> int:
     return current_round()
 
 
-def run_lines(cmd: list[str], timeout: int) -> list[dict]:
+def run_lines(cmd: list[str], timeout: int,
+              env: dict | None = None) -> list[dict]:
     proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=timeout, cwd=REPO)
+                          timeout=timeout, cwd=REPO, env=env)
     if proc.returncode != 0:
         raise RuntimeError(
             f"{cmd[:2]} failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
@@ -113,6 +114,28 @@ def main(argv=None) -> None:
         out = REPO / f"{label}_r{rnd:02d}.json"
         out.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
         print(f"{out.name}: {json.dumps(rows[-1])}")
+
+    # Serving joins the round scoreboard: serve_bench writes its own
+    # artifact (rate rungs + block-size sweep + overhead split); smoke
+    # scale here — real numbers come from hardware rounds.  A serving
+    # failure must not void the completed SCALING/PARITY snapshots.
+    import os
+
+    serve_out = REPO / f"BENCH_SERVE_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "serve_bench.py"),
+             "--smoke", "--out", str(serve_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        # surface the last MEASUREMENT row, not the trailing
+        # {"wrote": ...} status line serve_bench prints after it
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{serve_out.name}: {json.dumps(data[-1])}")
+    except Exception as e:
+        serve_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{serve_out.name}: error {e!r}")
 
 
 if __name__ == "__main__":

@@ -10,13 +10,22 @@ the paper-facing serving questions need:
   rate rung, including the saturation rung where offered >> capacity);
 - **latency percentiles** — TTFT (submit → first token, queue wait
   included) and TPOT (steady decode interval) at p50/p95;
+- **the dispatch-overhead split** — wall TPOT vs device-busy TPOT per
+  rung (``tpot_busy_s`` = decode dispatch+sync seconds / tokens), plus
+  dispatches-per-token and host-sync-per-token, from the engine's
+  decode counters: the quantities the fused ``decode_block`` hot path
+  exists to shrink;
+- **the block-size sweep** — one burst rung per
+  ``TPUDIST_SERVE_DECODE_BLOCK`` value (default 1/4/8/16), isolating
+  how token-block fusion moves throughput and overhead;
 - **batch occupancy** — the utilization gauge continuous batching exists
   to raise (sequential serving pins it at 1/num_slots);
 - **backpressure** — rejected counts once the bounded queue overflows.
 
 One warmup request absorbs XLA compilation before any timed rung, so
 rows measure the steady engine, not the first dispatch.  Artifact:
-``BENCH_SERVE_r{NN}.json`` (round-frozen like every other harness), with
+``BENCH_SERVE_r{NN}.json`` (round-frozen like every other harness — and
+snapshotted into the round scoreboard by ``round_snapshot.py``), with
 the run's merged telemetry serving section embedded for cross-checking.
 ``--smoke`` shrinks everything to a CPU-CI scale (seconds, asserted by
 ``tests/test_benchmarks.py``).
@@ -74,6 +83,7 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
             if rate_rps < 1e6:
                 time.sleep(float(rng.exponential(1.0 / rate_rps)))
 
+    d0 = server.engine.decode_stats()
     t0 = time.monotonic()
     loader = threading.Thread(target=submit_all, daemon=True)
     loader.start()
@@ -81,10 +91,20 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
     for h in handles:
         h.wait()
     wall = time.monotonic() - t0
+    d1 = server.engine.decode_stats()
 
     ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
     tpots = [h.tpot_s for h in handles if h.tpot_s is not None]
     tokens = sum(len(h.tokens) for h in handles)
+    # the dispatch-overhead split: wall TPOT (the caller's experience)
+    # vs device-busy TPOT (decode dispatch + the blocking token fetch,
+    # per emitted token) — the gap is host/scheduler overhead the fused
+    # decode block amortizes
+    blocks = d1["blocks"] - d0["blocks"]
+    dtok = d1["tokens"] - d0["tokens"]
+    busy = ((d1["dispatch_s"] - d0["dispatch_s"])
+            + (d1["sync_s"] - d0["sync_s"]))
+    sync = d1["sync_s"] - d0["sync_s"]
     return {
         "offered_rps": rate_rps if rate_rps < 1e6 else "burst",
         "n_requests": n_requests,
@@ -98,6 +118,11 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
         "ttft_s_p95": round(_pct(ttfts, 95), 6) if ttfts else None,
         "tpot_s_p50": round(_pct(tpots, 50), 6) if tpots else None,
         "tpot_s_p95": round(_pct(tpots, 95), 6) if tpots else None,
+        "decode_blocks": blocks,
+        "decode_tokens": dtok,
+        "dispatches_per_token": round(blocks / dtok, 4) if dtok else None,
+        "tpot_busy_s": round(busy / dtok, 6) if dtok else None,
+        "host_sync_s_per_token": round(sync / dtok, 6) if dtok else None,
         "mean_tokens_per_request":
             round(statistics.mean([len(h.tokens) for h in handles]), 1)
             if handles else None,
@@ -122,6 +147,12 @@ def main(argv=None) -> int:
     p.add_argument("--max-len", type=int, default=None)
     p.add_argument("--prompt-lens", default=None, help="min:max")
     p.add_argument("--max-news", default=None, help="min:max")
+    p.add_argument("--block", type=int, default=None,
+                   help="decode block size K for the offered-load rungs "
+                        "(default 8)")
+    p.add_argument("--blocks", default=None,
+                   help="decode block sizes for the sweep (comma list; "
+                        "smoke default 1,4 — full default 1,4,8,16)")
     p.add_argument("--seed", type=int, default=0)
     try:
         from benchmarks._round import current_round
@@ -147,6 +178,9 @@ def main(argv=None) -> int:
     rates = [(1e9 if r == "burst" else float(r)) for r in
              (args.rates or ("8,burst" if smoke else "1,4,16,burst")
               ).split(",")]
+    block = args.block or 8
+    blocks = [int(b) for b in
+              (args.blocks or ("1,4" if smoke else "1,4,8,16")).split(",")]
 
     import tempfile
 
@@ -163,17 +197,33 @@ def main(argv=None) -> int:
         jax.random.PRNGKey(args.seed), seq_len=16, vocab=args.vocab,
         d_model=d_model, n_layers=n_layers, n_heads=max(2, d_model // 64),
         d_ff=4 * d_model, max_len=max_len)
-    server = InferenceServer(
-        module, params,
-        ServeConfig(num_slots=slots, queue_limit=queue,
-                    prefill_pad=plens[1], max_new=mnews[1]),
-        install_signal_handler=False)
-    server.start()
 
-    # warmup: absorb the prefill/insert/decode compiles before timing
-    warm = server.submit(np.zeros(plens[0], np.int32), max_new=2)
-    warm.wait()
+    # the pad is a chunk size, not an admission bound: capping it below
+    # the longest prompt makes the full regime exercise chunked prefill
+    pad = plens[1] if smoke else min(plens[1], 32)
 
+    def make_server(decode_block):
+        srv = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=slots, queue_limit=queue,
+                        prefill_pad=pad, max_new=mnews[1],
+                        decode_block=decode_block),
+            install_signal_handler=False)
+        srv.start()
+        # warmup: absorb the insert/prefill/decode compiles before any
+        # timed rung — the longest prompt (chunked prefill, if the pad
+        # splits it), then one request per power-of-two block bucket so
+        # every K variant the engine can pick compiles here
+        srv.submit(np.zeros(plens[1], np.int32), max_new=2).wait()
+        b = 1
+        while b <= decode_block:
+            # sequential: alone in the batch, a request with b remaining
+            # decodes exactly one K=b block
+            srv.submit(np.zeros(plens[0], np.int32), max_new=b + 1).wait()
+            b *= 2
+        return srv
+
+    server = make_server(block)
     rows = []
     for i, rate in enumerate(rates):
         row = run_rate(server, rate_rps=rate, n_requests=requests,
@@ -183,9 +233,23 @@ def main(argv=None) -> int:
             server.stats()["occupancy_mean"], 4)
         rows.append(row)
         print(json.dumps(row), flush=True)
-
     stats = server.stats()
     server.close()
+
+    # block-size sweep: same offered burst through a fresh engine per K,
+    # isolating what token-block fusion does to throughput and overhead
+    sweep = []
+    for b in blocks:
+        srv = make_server(b)
+        row = run_rate(srv, rate_rps=1e9, n_requests=requests,
+                       vocab=args.vocab, prompt_lens=plens, max_news=mnews,
+                       seed=args.seed)
+        entry = {"decode_block": b, **row,
+                 "compile_counts": srv.stats()["compile_counts"]}
+        srv.close()
+        sweep.append(entry)
+        print(json.dumps(entry), flush=True)
+
     report = telemetry.finish() or {}
     artifact = {
         "regime": ("cpu-smoke" if smoke else
@@ -194,9 +258,11 @@ def main(argv=None) -> int:
             "slots": slots, "queue": queue, "requests_per_rung": requests,
             "d_model": d_model, "n_layers": n_layers, "vocab": args.vocab,
             "max_len": max_len, "prompt_lens": list(plens),
-            "max_news": list(mnews),
+            "max_news": list(mnews), "decode_block": block,
+            "blocks_sweep": blocks,
         },
         "rows": rows,
+        "block_sweep": sweep,
         "server_stats": stats,
         "serving_report": report.get("serving"),
     }

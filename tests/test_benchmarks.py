@@ -115,13 +115,15 @@ class TestBands:
 class TestServeBench:
     def test_smoke_writes_artifact_with_required_columns(self, tmp_path):
         """CI-smoke acceptance: the load generator runs on CPU and the
-        artifact carries TTFT/TPOT percentiles, throughput-vs-offered-load
-        rows, occupancy, and the merged telemetry serving section."""
+        artifact carries TTFT/TPOT percentiles, the dispatch-overhead
+        split (wall vs device-busy TPOT), the decode-block sweep,
+        throughput-vs-offered-load rows, occupancy, and the merged
+        telemetry serving section."""
         from benchmarks.serve_bench import main
 
         out = tmp_path / "BENCH_SERVE.json"
         rc = main(["--smoke", "--out", str(out), "--requests", "4",
-                   "--rates", "burst"])
+                   "--rates", "burst", "--blocks", "1,4"])
         assert rc == 0
         import json as _json
 
@@ -131,14 +133,35 @@ class TestServeBench:
         assert row["offered_rps"] == "burst"
         assert row["completed"] == 4 and row["tokens_out"] > 0
         for col in ("achieved_tokens_per_s", "ttft_s_p50", "ttft_s_p95",
-                    "tpot_s_p50", "tpot_s_p95", "occupancy_mean_cum"):
+                    "tpot_s_p50", "tpot_s_p95", "occupancy_mean_cum",
+                    # the overhead split: wall TPOT vs device-busy TPOT
+                    "tpot_busy_s", "dispatches_per_token",
+                    "host_sync_s_per_token", "decode_blocks",
+                    "decode_tokens"):
             assert row[col] is not None, col
-        # continuous batching's whole point: nothing recompiled under load
+        # block decode amortizes dispatch: strictly fewer dispatches
+        # than decoded tokens at the default block size
+        assert row["dispatches_per_token"] < 1.0
+        # continuous batching's whole point: request churn never
+        # recompiles; decode_block's cache is the bounded bucket set
         cc = rec["server_stats"]["compile_counts"]
-        assert all(v in (1, -1) for v in cc.values()), cc
+        assert cc["insert_batch"] in (1, -1)
+        assert cc["evict"] in (1, -1)
+        assert cc["prefill_extend"] in (0, 1, -1)  # smoke prompts fit one chunk
+        assert cc["decode_block"] == -1 or 1 <= cc["decode_block"] <= 4
+        # the block-size sweep isolates fusion: K=1 is the per-iteration
+        # dispatch regime (tokens/dispatch = batch occupancy, at most
+        # num_slots=2 in smoke), K=4 fuses a further ~4x on top
+        sweep = {e["decode_block"]: e for e in rec["block_sweep"]}
+        assert set(sweep) == {1, 4}
+        assert sweep[1]["dispatches_per_token"] >= 1.0 / 2
+        assert (sweep[4]["dispatches_per_token"]
+                < sweep[1]["dispatches_per_token"])
+        assert sweep[4]["decode_blocks"] < sweep[1]["decode_blocks"]
         sv = rec["serving_report"]
         assert sv and sv["requests_finished"] >= 5  # warmup + 4
         assert sv["occupancy_mean"] is not None
+        assert sv["decode_tokens"] > 0 and sv["tokens_per_dispatch"] >= 1.0
 
 
 class TestLossParity:

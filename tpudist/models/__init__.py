@@ -7,6 +7,7 @@ from tpudist.models.transformer import (  # noqa: F401
 )
 from tpudist.models.generate import (  # noqa: F401
     SlotDecode,
+    SlotState,
     decode_logits,
     generate,
     make_decode_step,

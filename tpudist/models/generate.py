@@ -192,49 +192,96 @@ def generate(
     )(prompt, rng)
 
 
+class SlotState(NamedTuple):
+    """Per-slot decode state, resident ON DEVICE for the life of the
+    engine (:mod:`tpudist.serve`).  Before this existed the engine
+    re-uploaded five host arrays per decode step; now the host keeps
+    shadow cursors for admission/budget decisions only, and the device
+    round-trip per decode *block* is one token-block fetch.
+
+    All leaves carry a leading ``[num_slots]`` axis:
+
+    - ``last_tok [S] int32`` — the token the next decode step consumes
+      (fed back IN-GRAPH inside ``decode_block``);
+    - ``active [S] bool`` — lane is decoding (prefill-in-progress lanes
+      are occupied on the host but inactive here);
+    - ``pos [S] int32`` — filled cache positions (mirrors the cache's own
+      cursor; kept for introspection/debug dumps);
+    - ``counts [S] int32`` — tokens emitted so far, which is also the
+      per-request sampling-stream index (``fold_in(key, count)``);
+    - ``temps [S] f32`` / ``keys [S, 2] uint32`` — per-request sampling
+      config (keys are derived in-graph from integer seeds at insert).
+    """
+
+    last_tok: jax.Array
+    active: jax.Array
+    pos: jax.Array
+    counts: jax.Array
+    temps: jax.Array
+    keys: jax.Array
+
+
 class SlotDecode(NamedTuple):
     """The compiled primitives of the continuous-batching serving engine
     (:mod:`tpudist.serve`): ``num_slots`` independent KV-cache lanes, each
     a batch-1 decode cache with its OWN position cursor (the single-batch
     decode step vmapped over a leading slot axis — per-slot cursors, masks,
-    and RoPE offsets fall out of the vmap for free).
+    and RoPE offsets fall out of the vmap for free), plus a persistent
+    on-device :class:`SlotState` threaded (and donated) through every
+    primitive.
 
-    Every callable is jitted once with fixed shapes, so requests of any
+    Every callable is jitted with fixed shapes, so requests of any
     prompt/output length join and leave a running batch with ZERO
-    recompilation — the SPMD fixed-shape discipline, applied to serving:
+    recompilation — the SPMD fixed-shape discipline, applied to serving.
+    ``decode_block`` is the one exception by design: ``K`` is static, so
+    each distinct block size is one compile (the engine buckets K to
+    powers of two, bounding the cache at ``log2(max_block)+1`` entries):
 
-    - ``init_slots()`` → all-zeros slot cache (leading ``[num_slots]``
-      axis on every leaf, scalar cursors become ``[num_slots]`` vectors);
-    - ``prefill(prompts [S, pad], plens [S])`` → ``(caches, last_logits)``:
-      teacher-force up to ``S`` prompts at once through the cached forward
-      (a masked fixed-length scan: steps at ``i >= plen`` keep the old
-      cache, so any ``plen <= prefill_pad`` shares one program); returns
-      per-sequence caches (cursor at ``plen``) and the logits after the
-      LAST prompt token — the distribution the first generated token is
-      drawn from, exactly as :func:`generate` does it;
-    - ``insert_from(slot_cache, batch_cache, i, slot)`` → slot cache with
-      prefill lane ``i`` scattered into ``slot`` (indices traced: one
-      compile serves every (i, slot) pair);
-    - ``evict(slot_cache, slot)`` → that lane zeroed (a freed slot must
-      not leak a tenant's K/V into the next request's garbage window);
-    - ``decode_step(cache, toks, active, keys, temps, counts)`` →
-      ``(cache, next_toks)``: ONE compiled step over all slots — inactive
-      lanes compute too (fixed shape) but their cache writes are undone by
-      the ``active`` select, so they neither advance nor corrupt;
+    - ``init_state()`` / ``init_slots()`` → all-zeros state / slot cache;
+    - ``insert_batch(state, cache, prompts [S, pad], clens [S], dsts [S],
+      seeds [S], temps [S], last [S])`` → ``(state, cache, firsts [S])``:
+      ONE dispatch that teacher-forces up to ``S`` prompt chunks through
+      the cached forward (a masked fixed-length scan: steps at
+      ``i >= clen`` keep the old cache, so any ``clen <= prefill_pad``
+      shares one program), derives each lane's threefry key from its
+      integer seed IN-GRAPH, scatters lane ``j`` into slot ``dsts[j]``
+      (``dsts[j] == num_slots`` marks an unused lane — the out-of-bounds
+      scatter drops it), and where ``last[j]`` samples the first generated
+      token from the post-chunk logits and arms the slot for decode.
+      Lanes with ``last[j] == False`` hold a partial prompt: their slot
+      stays inactive until ``prefill_extend`` feeds the remaining chunks;
+    - ``prefill_extend(state, cache, slot, chunk [pad], clen, is_last)``
+      → ``(state, cache, first)``: append one prompt chunk at slot's
+      running cache offset (chunked prefill — prompts longer than the
+      pad are admitted and teacher-forced ``pad`` tokens per call, so a
+      long prompt stalls in-flight decode by at most one chunk per engine
+      iteration).  On ``is_last`` the first generated token is sampled
+      from the final chunk's last logits and the slot activates;
+    - ``decode_block(state, cache, K)`` → ``(state, cache, toks [K, S])``:
+      ``K`` decode steps fused into one dispatch via ``lax.scan`` with
+      in-graph token feedback — K×num_slots tokens for one dispatch and
+      one D2H fetch.  Inactive lanes compute too (fixed shape) but their
+      cache writes are undone by the ``active`` select and their
+      ``last_tok``/``counts`` hold still, so they neither advance nor
+      corrupt;
+    - ``evict(state, cache, slot)`` → that lane zeroed in both cache and
+      state (a freed slot must not leak a tenant's K/V into the next
+      request's garbage window);
     - ``sample(logits, keys, temps, counts)`` → per-slot token draw:
       greedy argmax where ``temps <= 0``, else categorical at that slot's
       temperature from ``fold_in(key, count)`` — a deterministic
       per-request stream independent of which slot/batch neighbors the
-      request decoded beside.
+      request decoded beside, and independent of the block size K.
     """
 
     num_slots: int
     prefill_pad: int
+    init_state: Callable
     init_slots: Callable
-    prefill: Callable
-    insert_from: Callable
+    insert_batch: Callable
+    prefill_extend: Callable
+    decode_block: Callable
     evict: Callable
-    decode_step: Callable
     sample: Callable
 
 
@@ -266,68 +313,131 @@ def make_slot_decode(module, params, num_slots: int,
     vocab = module.vocab
     vstep = jax.vmap(step, in_axes=(0, 0))
 
+    def init_state():
+        s = num_slots
+        return SlotState(
+            last_tok=jnp.zeros(s, jnp.int32),
+            active=jnp.zeros(s, bool),
+            pos=jnp.zeros(s, jnp.int32),
+            counts=jnp.zeros(s, jnp.int32),
+            temps=jnp.zeros(s, jnp.float32),
+            keys=jnp.zeros((s, 2), jnp.uint32))
+
     def init_slots():
         one = init_cache(1)
         return jax.tree.map(
             lambda a: jnp.zeros((num_slots,) + a.shape, a.dtype), one)
 
-    @jax.jit
-    def prefill(prompts, plens):
-        def one_seq(prompt, plen):
-            cache = init_cache(1)
+    def _force_chunk(cache, chunk, clen):
+        """Teacher-force ``chunk[:clen]`` through a batch-1 cache (masked
+        fixed-length scan: steps at ``i >= clen`` keep the old cache, so
+        every ``clen <= prefill_pad`` shares one program).  Returns the
+        advanced cache and the logits after the LAST live token."""
 
-            def body(carry, i):
-                cache, last = carry
-                tok = lax.dynamic_index_in_dim(prompt, i, keepdims=False)
-                nc, logits = step(cache, tok[None, None])
-                live = i < plen
-                cache = jax.tree.map(
-                    lambda n, o: jnp.where(live, n, o), nc, cache)
-                last = jnp.where(i == plen - 1, logits[0], last)
-                return (cache, last), None
+        def body(carry, i):
+            cache, last = carry
+            tok = lax.dynamic_index_in_dim(chunk, i, keepdims=False)
+            nc, logits = step(cache, tok[None, None])
+            live = i < clen
+            cache = jax.tree.map(
+                lambda n, o: jnp.where(live, n, o), nc, cache)
+            last = jnp.where(i == clen - 1, logits[0], last)
+            return (cache, last), None
 
-            (cache, last), _ = lax.scan(
-                body, (cache, jnp.zeros((vocab,), jnp.float32)),
-                jnp.arange(prefill_pad))
-            return cache, last
+        return lax.scan(body, (cache, jnp.zeros((vocab,), jnp.float32)),
+                        jnp.arange(prefill_pad))[0]
 
-        return jax.vmap(one_seq)(prompts, plens)
-
-    # The slot cache is donated in every primitive that threads it: the
-    # engine always overwrites its cache with the result, and without
+    # The slot state AND cache are donated in every primitive that threads
+    # them: the engine always overwrites both with the result, and without
     # donation each iteration would copy the whole [num_slots × layers ×
     # max_len] K/V arena into fresh buffers — doubling peak cache memory
-    # and paying a full-arena memcpy per decode step.
-    @partial(jax.jit, donate_argnums=0)
-    def insert_from(slot_cache, batch_cache, i, slot):
-        return jax.tree.map(
-            lambda full, b: lax.dynamic_update_index_in_dim(
-                full, lax.dynamic_index_in_dim(b, i, 0, keepdims=False),
-                slot, 0),
-            slot_cache, batch_cache)
+    # and paying a full-arena memcpy per decode block.
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def insert_batch(state, cache, prompts, clens, dsts, seeds, temps, last):
+        lanes, last_logits = jax.vmap(
+            lambda p, n: _force_chunk(init_cache(1), p, n))(prompts, clens)
+        keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
+        firsts = _slot_sample(last_logits, keys, temps,
+                              jnp.zeros(num_slots, jnp.int32))
+        # Scatter lane j into slot dsts[j].  Unused lanes carry the
+        # sentinel dst num_slots: out-of-bounds scatter indices are
+        # DROPPED (jax's default scatter mode), so one fixed-shape
+        # program serves every admission-batch size.
+        cache = jax.tree.map(
+            lambda full, b: full.at[dsts].set(b), cache, lanes)
+        state = SlotState(
+            last_tok=state.last_tok.at[dsts].set(jnp.where(last, firsts, 0)),
+            active=state.active.at[dsts].set(last),
+            pos=state.pos.at[dsts].set(clens),
+            counts=state.counts.at[dsts].set(last.astype(jnp.int32)),
+            temps=state.temps.at[dsts].set(temps),
+            keys=state.keys.at[dsts].set(keys))
+        return state, cache, firsts
 
-    @partial(jax.jit, donate_argnums=0)
-    def evict(slot_cache, slot):
-        return jax.tree.map(
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def prefill_extend(state, cache, slot, chunk, clen, is_last):
+        lane = jax.tree.map(
+            lambda full: lax.dynamic_index_in_dim(
+                full, slot, 0, keepdims=False), cache)
+        lane, last_logits = _force_chunk(lane, chunk, clen)
+        cache = jax.tree.map(
+            lambda full, l: lax.dynamic_update_index_in_dim(full, l, slot, 0),
+            cache, lane)
+        first = _slot_sample(
+            last_logits[None], state.keys[slot][None],
+            state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
+        state = state._replace(
+            pos=state.pos.at[slot].add(clen),
+            active=state.active.at[slot].set(is_last),
+            last_tok=state.last_tok.at[slot].set(
+                jnp.where(is_last, first, 0)),
+            counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
+        return state, cache, first
+
+    @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+    def decode_block(state, cache, k):
+        def body(carry, _):
+            state, cache = carry
+            nc, logits = vstep(cache, state.last_tok[:, None, None])
+
+            def sel(n, o):
+                m = state.active.reshape((-1,) + (1,) * (n.ndim - 1))
+                return jnp.where(m, n, o)
+
+            cache = jax.tree.map(sel, nc, cache)
+            toks = _slot_sample(logits[:, 0], state.keys, state.temps,
+                                state.counts)
+            toks = jnp.where(state.active, toks,
+                             state.last_tok).astype(jnp.int32)
+            inc = state.active.astype(jnp.int32)
+            state = state._replace(last_tok=toks, counts=state.counts + inc,
+                                   pos=state.pos + inc)
+            return (state, cache), toks
+
+        (state, cache), toks = lax.scan(body, (state, cache), None, length=k)
+        return state, cache, toks
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def evict(state, cache, slot):
+        cache = jax.tree.map(
             lambda full: lax.dynamic_update_index_in_dim(
                 full, jnp.zeros(full.shape[1:], full.dtype), slot, 0),
-            slot_cache)
-
-    @partial(jax.jit, donate_argnums=0)
-    def decode_step(slot_cache, toks, active, keys, temps, counts):
-        new_cache, logits = vstep(slot_cache, toks[:, None, None])
-
-        def sel(n, o):
-            m = active.reshape((-1,) + (1,) * (n.ndim - 1))
-            return jnp.where(m, n, o)
-
-        cache = jax.tree.map(sel, new_cache, slot_cache)
-        return cache, _slot_sample(logits[:, 0], keys, temps, counts)
+            cache)
+        zero = jnp.zeros((), jnp.int32)
+        state = SlotState(
+            last_tok=state.last_tok.at[slot].set(zero),
+            active=state.active.at[slot].set(False),
+            pos=state.pos.at[slot].set(zero),
+            counts=state.counts.at[slot].set(zero),
+            temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
+            keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
+        return state, cache
 
     return SlotDecode(
-        num_slots=num_slots, prefill_pad=prefill_pad, init_slots=init_slots,
-        prefill=prefill, insert_from=insert_from, evict=evict,
-        decode_step=decode_step, sample=jax.jit(_slot_sample))
+        num_slots=num_slots, prefill_pad=prefill_pad, init_state=init_state,
+        init_slots=init_slots, insert_batch=insert_batch,
+        prefill_extend=prefill_extend, decode_block=decode_block,
+        evict=evict, sample=jax.jit(_slot_sample))
 
 
 def decode_logits(module, params, tokens: jax.Array) -> jax.Array:

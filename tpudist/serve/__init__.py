@@ -5,7 +5,10 @@ subsystem).
 Three layers, device-to-host:
 
 - :mod:`tpudist.serve.engine` — ``SlotEngine``: fixed-shape slot lanes
-  over one compiled decode step (zero recompilation as requests churn);
+  with on-device per-slot state, fused multi-token decode blocks (one
+  dispatch + one host sync per K tokens), and chunked prefill (prompts
+  past the pad admit and append chunk by chunk) — zero recompilation as
+  requests churn;
 - :mod:`tpudist.serve.scheduler` — bounded FIFO with admission control,
   deadline enforcement, reject-with-reason backpressure;
 - :mod:`tpudist.serve.server` — ``InferenceServer``: threaded ingestion,

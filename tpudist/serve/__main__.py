@@ -30,6 +30,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="prompt-length ceiling; each request draws from "
                         "[1, prompt-len]")
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--decode-block", type=int, default=8,
+                   help="max fused decode tokens per device dispatch (K)")
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--vocab", type=int, default=64)
@@ -54,13 +56,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         d_model=args.d_model, n_layers=args.n_layers,
         n_heads=max(2, args.d_model // 32), d_ff=4 * args.d_model,
         max_len=args.max_len)
-    # prompts are drawn up to the pad the engine will admit — the demo
-    # must not generate requests its own server rejects as too long
-    prefill_pad = min(args.prompt_len, args.max_len // 2)
+    # chunked prefill admits prompts up to max_len - max_new; the pad is
+    # just the chunk size — half the prompt ceiling, so the demo's longer
+    # prompts actually exercise the chunked-prefill path
+    prefill_pad = max(1, min(args.prompt_len // 2, args.max_len // 2))
     server = InferenceServer(
         module, params,
         ServeConfig(num_slots=args.slots, queue_limit=args.queue,
-                    max_new=args.max_new, prefill_pad=prefill_pad))
+                    max_new=args.max_new, prefill_pad=prefill_pad,
+                    decode_block=args.decode_block))
     server.start()
 
     import time
@@ -69,8 +73,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rng = np.random.default_rng(args.seed)
     handles = []
+    # prompts range past the pad (chunked prefill) but stay admissible
+    # under the budget rule plen + max_new <= max_len
+    plen_cap = max(1, min(args.prompt_len, args.max_len - args.max_new))
     for i in range(args.requests):
-        plen = int(rng.integers(1, prefill_pad + 1))
+        plen = int(rng.integers(1, plen_cap + 1))
         max_new = int(rng.integers(2, args.max_new + 1))
         prompt = rng.integers(0, args.vocab, size=plen).astype(np.int32)
         stop_burst = False

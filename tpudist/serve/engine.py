@@ -1,50 +1,83 @@
 """Slot-based continuous-batching decode engine.
 
 The training side of the repo compiles ONE program and feeds it
-fixed-shape batches; this module applies the same discipline to serving.
-The engine owns ``num_slots`` independent KV-cache lanes (the vmapped
-slot-decode primitives of :func:`tpudist.models.make_slot_decode`) and a
-small set of host-side cursors; every device interaction is one of four
-compiled programs — ``prefill``, ``insert_from``, ``evict``,
-``decode_step`` — whose shapes never depend on a request, so concurrent
-requests with arbitrary prompt/output lengths join and leave a running
-batch with zero recompilation (iteration-level / continuous batching,
-arXiv:2509.07003's consistent-tensor-programming regime applied to
-inference).
+fixed-shape batches; this module applies the same discipline to serving,
+and amortizes host work over token *blocks* instead of tokens.  The
+engine owns ``num_slots`` independent KV-cache lanes plus a persistent
+ON-DEVICE :class:`tpudist.models.SlotState` (the slot-decode primitives
+of :func:`tpudist.models.make_slot_decode`); every device interaction is
+one of four compiled programs — ``insert_batch``, ``prefill_extend``,
+``decode_block``, ``evict`` — whose shapes never depend on a request, so
+concurrent requests with arbitrary prompt/output lengths join and leave
+a running batch with zero recompilation (iteration-level / continuous
+batching, arXiv:2509.07003's consistent-tensor-programming regime
+applied to inference).  ``decode_block`` alone compiles once per
+power-of-two block size K (a handful of cache entries, pinned by test).
 
-Division of labor: the engine is the DEVICE half — slots, caches,
-cursors, token emission.  Queueing, admission, deadlines, and threads
-live in :mod:`tpudist.serve.scheduler` / :mod:`tpudist.serve.server`;
-the engine is single-threaded by contract (exactly one caller drives
-``insert_batch``/``step``/``evict``).
+Hot-path accounting, per engine iteration:
+
+- admission: ONE ``insert_batch`` dispatch prefills and scatters a whole
+  admission batch (prompt chunks, seeds, temperatures uploaded once);
+- chunked prefill: one ``prefill_extend`` dispatch per prefilling slot
+  appends a ``prefill_pad``-sized prompt chunk at the slot's running
+  offset — prompts up to ``max_len - max_new`` are admissible, and a
+  long prompt stalls in-flight decode by at most one chunk per
+  iteration;
+- decode: ONE ``decode_block`` dispatch produces ``K×num_slots`` tokens
+  with in-graph token feedback, then ONE D2H fetch of the block.  The
+  host picks ``K = min(block, min remaining budget over active slots)``
+  from its shadow cursors (bucketed down to a power of two), so a block
+  never overshoots a length budget; early stops (EOS) are truncated
+  post-hoc by the caller, wasting at most K - 1 speculated tokens.
+
+Division of labor: the engine is the DEVICE half — slots, caches, the
+on-device state, token emission.  The host keeps *shadow* cursors
+(occupied/decoding flags, pos/counts/budget) strictly for admission and
+block-size decisions; device state is the truth the tokens come from.
+Queueing, admission policy, deadlines, and threads live in
+:mod:`tpudist.serve.scheduler` / :mod:`tpudist.serve.server`; the engine
+is single-threaded by contract (exactly one caller drives
+``start_batch``/``advance_prefill``/``decode_block``/``evict``).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from tpudist.models.generate import make_slot_decode
 
+#: ``start_batch`` item: (slot, prompt_1d_int32, temperature, seed, max_new).
+InsertItem = Tuple[int, np.ndarray, float, int, int]
+
+
+def _pow2_floor(k: int) -> int:
+    """Largest power of two ``<= k`` — the block-size bucketing rule that
+    bounds ``decode_block``'s jit cache at ``log2(max_block) + 1``."""
+    return 1 << (max(1, k).bit_length() - 1)
+
 
 class SlotEngine:
-    """``num_slots`` KV-cache lanes + host cursors over one compiled step.
+    """``num_slots`` KV-cache lanes + host shadow cursors over the
+    compiled slot-decode programs.
 
-    Per slot the engine tracks (host-side numpy — the device round-trip
-    per iteration is the emitted-token fetch, nothing else):
+    Per slot the host shadows (numpy — admission/budget decisions only;
+    the authoritative state lives on device):
 
-    - ``active[s]`` — lane occupied;
-    - ``last_tok[s]`` — the token the next decode step consumes;
-    - ``pos[s]`` — filled cache positions (``plen`` after prefill, +1 per
-      decode step); the lane's budget guard is ``pos < max_len``;
-    - ``counts[s]`` — tokens emitted so far (also the per-request sampling
-      stream index, see ``SlotDecode.sample``);
-    - ``temps[s]`` / ``keys[s]`` — per-request sampling config.
+    - ``occupied[s]`` — lane reserved (prefilling OR decoding);
+    - ``decoding[s]`` — lane actively decoding (device ``active``);
+    - ``pos[s]`` — filled cache positions; the budget guard is
+      ``pos + K <= max_len``;
+    - ``counts[s]`` — tokens emitted so far;
+    - ``budget[s]`` — the request's ``max_new`` (feeds the block-size
+      pick ``K = min(block, min(budget - counts))``).
     """
 
     def __init__(self, module, params, *, num_slots: int = 4,
-                 prefill_pad: Optional[int] = None):
+                 prefill_pad: Optional[int] = None,
+                 decode_block: Optional[int] = None):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         self.module = module
@@ -52,50 +85,83 @@ class SlotEngine:
         self.fns = make_slot_decode(module, params, num_slots, prefill_pad)
         self.num_slots = num_slots
         self.prefill_pad = prefill_pad
+        self.block = max(1, int(decode_block if decode_block else 8))
+        self.state = self.fns.init_state()
         self.cache = self.fns.init_slots()
-        self.active = np.zeros(num_slots, bool)
-        self.last_tok = np.zeros(num_slots, np.int32)
+        self.occupied = np.zeros(num_slots, bool)
+        self.decoding = np.zeros(num_slots, bool)
         self.pos = np.zeros(num_slots, np.int32)
         self.counts = np.zeros(num_slots, np.int32)
-        self.temps = np.zeros(num_slots, np.float32)
-        self.keys = np.zeros((num_slots, 2), np.uint32)
+        self.budget = np.zeros(num_slots, np.int32)
+        #: slot → (full prompt, next chunk offset) for prompts longer
+        #: than one prefill chunk (the host-side half of chunked prefill)
+        self._prefill_rest: Dict[int, Tuple[np.ndarray, int]] = {}
+        # decode hot-path counters (the bench's dispatch/sync overhead
+        # split reads these through ``decode_stats``)
+        self.n_decode_blocks = 0
+        self.n_decode_tokens = 0
+        self.t_decode_dispatch_s = 0.0
+        self.t_decode_sync_s = 0.0
 
     # -- inspection ---------------------------------------------------------
 
     def free_slots(self) -> List[int]:
-        return [s for s in range(self.num_slots) if not self.active[s]]
+        return [s for s in range(self.num_slots) if not self.occupied[s]]
+
+    def prefilling_slots(self) -> List[int]:
+        """Slots holding a partially-prefilled prompt (occupied, not yet
+        decoding)."""
+        return sorted(self._prefill_rest)
 
     @property
     def num_active(self) -> int:
-        return int(self.active.sum())
+        """Decoding lanes (the device-busy count decode blocks run over)."""
+        return int(self.decoding.sum())
+
+    @property
+    def num_occupied(self) -> int:
+        return int(self.occupied.sum())
 
     @property
     def occupancy(self) -> float:
-        """Busy fraction of the batch — the gauge the telemetry report
-        aggregates (an engine decoding one request at 8 slots wastes 7/8
-        of every step's HBM sweep)."""
+        """Busy fraction of the decode batch — the gauge the telemetry
+        report aggregates (an engine decoding one request at 8 slots
+        wastes 7/8 of every block's HBM sweep)."""
         return self.num_active / self.num_slots
 
     def compile_counts(self) -> Dict[str, int]:
         """Jit-cache sizes of the compiled primitives — the "no
-        recompilation under load" oracle the slow-lane test pins down."""
+        recompilation under load" oracle the slow-lane test pins down
+        (``decode_block`` alone grows one entry per power-of-two block
+        bucket actually used)."""
         out = {}
-        for name in ("prefill", "insert_from", "evict", "decode_step"):
+        for name in ("insert_batch", "prefill_extend", "decode_block",
+                     "evict"):
             fn = getattr(self.fns, name)
             size = getattr(fn, "_cache_size", None)
             out[name] = int(size()) if callable(size) else -1
         return out
 
+    def decode_stats(self) -> Dict[str, float]:
+        """Cumulative decode hot-path counters: blocks dispatched, tokens
+        emitted, host time spent dispatching vs blocked on the D2H token
+        fetch — the wall-TPOT vs device-busy-TPOT split in serve_bench."""
+        return {
+            "blocks": self.n_decode_blocks,
+            "tokens": self.n_decode_tokens,
+            "dispatch_s": self.t_decode_dispatch_s,
+            "sync_s": self.t_decode_sync_s,
+        }
+
     # -- lifecycle of a request -------------------------------------------
 
     def check_budget(self, prompt_len: int, max_new: int) -> Optional[str]:
         """``None`` if a request fits, else the rejection reason — the ONE
-        budget rule admission control and the engine agree on."""
+        budget rule admission control and the engine agree on.  Chunked
+        prefill admits any prompt up to ``max_len - max_new`` (the
+        prefill pad is a chunk size, not an admission bound)."""
         if prompt_len < 1:
             return "empty_prompt"
-        if prompt_len > self.prefill_pad:
-            return (f"prompt_too_long: {prompt_len} > prefill_pad "
-                    f"{self.prefill_pad}")
         if max_new < 1:
             return "max_new_must_be_positive"
         if prompt_len + max_new > self.max_len:
@@ -103,96 +169,165 @@ class SlotEngine:
                     f"{max_new} > max_len {self.max_len}")
         return None
 
-    def insert_batch(
-        self,
-        items: Sequence[Tuple[int, np.ndarray, float, int]],
-    ) -> Dict[int, int]:
-        """Prefill up to ``num_slots`` requests in ONE compiled call and
-        scatter each into its slot.  ``items``: ``(slot, prompt_1d_int32,
-        temperature, seed)`` per request.  Returns ``slot → first
-        generated token`` (drawn from the post-prompt logits, so a
-        ``max_new == 1`` request is complete without any decode step)."""
+    def start_batch(self, items: Sequence[InsertItem]
+                    ) -> Dict[int, Optional[int]]:
+        """Admit up to ``num_slots`` requests in ONE compiled dispatch:
+        each request's FIRST prompt chunk is prefilled and scattered into
+        its slot (the multi-slot scatter — no per-item insert loop).
+        Returns ``slot → first generated token`` for requests whose whole
+        prompt fit the first chunk (drawn from the post-prompt logits, so
+        a ``max_new == 1`` request is complete without any decode), and
+        ``slot → None`` for longer prompts, which continue through
+        ``advance_prefill`` chunk by chunk."""
         if not items:
             return {}
         if len(items) > self.num_slots:
             raise ValueError(
-                f"insert_batch of {len(items)} > num_slots {self.num_slots}")
+                f"start_batch of {len(items)} > num_slots {self.num_slots}")
         import jax.numpy as jnp
 
-        prompts = np.zeros((self.num_slots, self.prefill_pad), np.int32)
-        plens = np.zeros(self.num_slots, np.int32)
-        keys = np.zeros((self.num_slots, 2), np.uint32)
+        pad = self.prefill_pad
+        prompts = np.zeros((self.num_slots, pad), np.int32)
+        clens = np.zeros(self.num_slots, np.int32)
+        # dst == num_slots marks an unused lane (out-of-bounds scatter
+        # indices are dropped in the compiled program)
+        dsts = np.full(self.num_slots, self.num_slots, np.int32)
+        seeds = np.zeros(self.num_slots, np.int32)
         temps = np.zeros(self.num_slots, np.float32)
-        for j, (slot, prompt, temperature, seed) in enumerate(items):
-            if self.active[slot]:
+        last = np.zeros(self.num_slots, bool)
+        # validate the WHOLE batch before touching any state — a bad item
+        # must not leak half-reserved slots
+        norm = []
+        taken = set()
+        for slot, prompt, temperature, seed, max_new in items:
+            if self.occupied[slot] or slot in taken:
                 raise ValueError(f"slot {slot} is occupied")
+            taken.add(slot)
             prompt = np.asarray(prompt, np.int32).reshape(-1)
-            reason = self.check_budget(len(prompt), 1)
+            reason = self.check_budget(len(prompt), max_new)
             if reason is not None:
                 raise ValueError(reason)
-            prompts[j, : len(prompt)] = prompt
-            plens[j] = len(prompt)
-            keys[j] = _seed_key(seed)
+            norm.append((int(slot), prompt, temperature, seed, int(max_new)))
+        for j, (slot, prompt, temperature, seed, max_new) in enumerate(norm):
+            clen = min(len(prompt), pad)
+            prompts[j, :clen] = prompt[:clen]
+            clens[j] = clen
+            dsts[j] = slot
+            # int32 wrap keeps huge seeds admissible (the stream just
+            # derives from the wrapped value)
+            seeds[j] = np.uint32(seed & 0xFFFFFFFF).astype(np.int32)
             temps[j] = temperature
-        caches, last_logits = self.fns.prefill(
-            jnp.asarray(prompts), jnp.asarray(plens))
-        firsts = np.asarray(self.fns.sample(
-            last_logits, jnp.asarray(keys), jnp.asarray(temps),
-            jnp.zeros(self.num_slots, jnp.int32)))
-        out: Dict[int, int] = {}
-        for j, (slot, prompt, temperature, seed) in enumerate(items):
-            self.cache = self.fns.insert_from(self.cache, caches, j, slot)
-            self.active[slot] = True
-            self.last_tok[slot] = firsts[j]
-            self.pos[slot] = plens[j]
-            self.counts[slot] = 1
-            self.temps[slot] = temperature
-            self.keys[slot] = keys[j]
-            out[int(slot)] = int(firsts[j])
+            last[j] = len(prompt) <= pad
+        self.state, self.cache, firsts = self.fns.insert_batch(
+            self.state, self.cache, jnp.asarray(prompts), jnp.asarray(clens),
+            jnp.asarray(dsts), jnp.asarray(seeds), jnp.asarray(temps),
+            jnp.asarray(last))
+        firsts_h = np.asarray(firsts) if last.any() else None
+        out: Dict[int, Optional[int]] = {}
+        for j, (slot, prompt, temperature, seed, max_new) in enumerate(norm):
+            self.occupied[slot] = True
+            self.budget[slot] = max_new
+            self.pos[slot] = clens[j]
+            if last[j]:
+                self.decoding[slot] = True
+                self.counts[slot] = 1
+                out[slot] = int(firsts_h[j])
+            else:
+                self.counts[slot] = 0
+                self._prefill_rest[slot] = (prompt, pad)
+                out[slot] = None
         return out
 
-    def step(self) -> Dict[int, int]:
-        """One batched decode iteration over every active slot: consume
-        each slot's ``last_tok``, emit the next token.  Returns ``slot →
-        token`` for the active slots (callers stream/stop per request)."""
-        if not self.active.any():
+    def advance_prefill(self) -> Dict[int, int]:
+        """Feed ONE prompt chunk to every prefilling slot (one compiled
+        ``prefill_extend`` dispatch each, appended at the slot's running
+        cache offset).  Returns ``slot → first generated token`` for the
+        slots whose prompt just completed (they switch to decoding)."""
+        if not self._prefill_rest:
             return {}
-        if (self.pos[self.active] >= self.max_len).any():
+        import jax.numpy as jnp
+
+        pad = self.prefill_pad
+        done: List[Tuple[int, object]] = []
+        for slot in sorted(self._prefill_rest):
+            prompt, off = self._prefill_rest[slot]
+            clen = min(pad, len(prompt) - off)
+            chunk = np.zeros(pad, np.int32)
+            chunk[:clen] = prompt[off:off + clen]
+            is_last = off + clen >= len(prompt)
+            self.state, self.cache, first = self.fns.prefill_extend(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
+                jnp.asarray(is_last))
+            self.pos[slot] += clen
+            if is_last:
+                del self._prefill_rest[slot]
+                self.decoding[slot] = True
+                self.counts[slot] = 1
+                done.append((slot, first))
+            else:
+                self._prefill_rest[slot] = (prompt, off + clen)
+        return {int(s): int(f) for s, f in done}
+
+    def decode_block(self, max_k: Optional[int] = None
+                     ) -> Tuple[Optional[dict], Dict[int, List[int]]]:
+        """One fused decode block over every decoding slot: ``K`` steps in
+        one dispatch (in-graph token feedback), one D2H fetch of the
+        ``K×num_slots`` token block.  ``K = min(block, min remaining
+        budget)`` bucketed to a power of two, so no slot can overshoot
+        its length budget.  Returns ``(info, slot → K tokens)`` where
+        ``info`` carries the dispatch/sync attribution (``None`` when no
+        slot is decoding)."""
+        if not self.decoding.any():
+            return None, {}
+        dec = np.nonzero(self.decoding)[0]
+        remaining = self.budget[dec] - self.counts[dec]
+        if (remaining < 1).any():
+            raise RuntimeError(
+                "decoding slot with exhausted budget — the caller must "
+                "evict finished slots before the next block")
+        if (self.pos[dec] >= self.max_len).any():
             # admission's budget rule makes this unreachable; a loud error
             # beats silently attending over a recycled cache ring.
             raise RuntimeError("active slot at max_len — admission budget "
                                "violated")
-        import jax.numpy as jnp
+        cap = self.block if max_k is None else max(1, int(max_k))
+        k = _pow2_floor(min(cap, int(remaining.min())))
+        t0 = time.perf_counter()
+        self.state, self.cache, blocks = self.fns.decode_block(
+            self.state, self.cache, k)
+        t1 = time.perf_counter()
+        arr = np.asarray(blocks)  # ONE host sync for K×num_slots tokens
+        t2 = time.perf_counter()
+        self.n_decode_blocks += 1
+        self.n_decode_tokens += k * len(dec)
+        self.t_decode_dispatch_s += t1 - t0
+        self.t_decode_sync_s += t2 - t1
+        self.counts[dec] += k
+        self.pos[dec] += k
+        out = {int(s): [int(t) for t in arr[:, s]] for s in dec}
+        info = {"k": k, "tokens": k * len(dec),
+                "dispatch_s": t1 - t0, "sync_s": t2 - t1}
+        return info, out
 
-        self.cache, toks = self.fns.decode_step(
-            self.cache, jnp.asarray(self.last_tok), jnp.asarray(self.active),
-            jnp.asarray(self.keys), jnp.asarray(self.temps),
-            jnp.asarray(self.counts))
-        toks = np.asarray(toks)
-        out = {int(s): int(toks[s]) for s in np.nonzero(self.active)[0]}
-        act = self.active
-        self.last_tok[act] = toks[act]
-        self.pos[act] += 1
-        self.counts[act] += 1
-        return out
+    def step(self) -> Dict[int, int]:
+        """One single-token decode iteration (a K=1 block) — the
+        per-token path ``decode_block`` amortizes; kept for tests and
+        K=1 comparisons.  Returns ``slot → token`` for decoding slots."""
+        _, toks = self.decode_block(max_k=1)
+        return {s: t[0] for s, t in toks.items()}
 
     def evict(self, slot: int) -> None:
-        """Free a lane: zero its cache (no K/V leakage into the next
-        tenant's garbage window) and reset the host cursors."""
+        """Free a lane: zero its cache and device state (no K/V leakage
+        into the next tenant's garbage window), reset the host shadows,
+        drop any pending prefill chunks."""
         import jax.numpy as jnp
 
-        self.cache = self.fns.evict(self.cache, jnp.asarray(slot, jnp.int32))
-        self.active[slot] = False
-        self.last_tok[slot] = 0
+        self.state, self.cache = self.fns.evict(
+            self.state, self.cache, jnp.asarray(slot, jnp.int32))
+        self.occupied[slot] = False
+        self.decoding[slot] = False
         self.pos[slot] = 0
         self.counts[slot] = 0
-        self.temps[slot] = 0.0
-        self.keys[slot] = 0
-
-
-def _seed_key(seed: int) -> np.ndarray:
-    """A raw ``uint32[2]`` threefry key from an int seed — fetched to host
-    once per request so the engine can pass all slots' keys as one array."""
-    import jax
-
-    return np.asarray(jax.random.PRNGKey(seed), np.uint32)
+        self.budget[slot] = 0
+        self._prefill_rest.pop(slot, None)

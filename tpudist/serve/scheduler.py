@@ -5,8 +5,9 @@ decides WHAT goes into them.  Responsibilities, in the order a request
 meets them:
 
 - **admission control** — a request is checked against the engine's
-  budget rule (prompt fits the prefill pad, prompt + max_new fits the
-  KV cache) and the queue bound AT SUBMIT TIME, synchronously: the
+  budget rule (prompt + max_new fits the KV cache; prompts longer than
+  one prefill chunk are admitted and prefilled chunk by chunk) and the
+  queue bound AT SUBMIT TIME, synchronously: the
   caller gets an :class:`AdmissionError` with a machine-readable
   ``reason`` instead of a request that can never complete
   (reject-with-reason backpressure — a bounded queue is the only thing
@@ -37,15 +38,16 @@ from typing import Callable, List, Optional
 import numpy as np
 
 #: finish reasons a handle can carry (``finish_reason`` is always one of
-#: these once ``done`` is set): completed its token budget, missed its
-#: deadline, or was cut off by a non-graceful server stop.
-FINISH_REASONS = ("length", "deadline", "shutdown")
+#: these once ``done`` is set): completed its token budget, emitted its
+#: stop token, missed its deadline, or was cut off by a non-graceful
+#: server stop.
+FINISH_REASONS = ("length", "eos", "deadline", "shutdown")
 
 
 class AdmissionError(RuntimeError):
     """A request the scheduler refused; ``reason`` is machine-readable
-    (``queue_full``, ``draining``, ``prompt_too_long: ...``,
-    ``budget_exceeded: ...``, ``empty_prompt``)."""
+    (``queue_full``, ``draining``, ``budget_exceeded: ...``,
+    ``empty_prompt``)."""
 
     def __init__(self, reason: str):
         super().__init__(f"request rejected: {reason}")
@@ -62,6 +64,7 @@ class Request:
     temperature: float = 0.0  # 0 = greedy (the token-equivalence mode)
     deadline_s: Optional[float] = None  # relative to submit; None = none
     seed: int = 0  # per-request sampling stream (temperature > 0)
+    eos_id: Optional[int] = None  # stop token: finish "eos" on emission
     on_token: Optional[Callable[[int, int], None]] = None  # (token, index)
 
 
@@ -166,7 +169,7 @@ class Scheduler:
 
     def submit(self, prompt, *, max_new: Optional[int] = None,
                temperature: float = 0.0, deadline_s: Optional[float] = None,
-               seed: Optional[int] = None,
+               seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                ) -> RequestHandle:
         """Admit a request or raise :class:`AdmissionError` (backpressure
@@ -185,6 +188,7 @@ class Scheduler:
             temperature=float(temperature),
             deadline_s=deadline,
             seed=0 if seed is None else int(seed),
+            eos_id=None if eos_id is None else int(eos_id),
             on_token=on_token,
         )
         with self._lock:

@@ -5,26 +5,38 @@ Wiring (one picture)::
     submit() threads ──> Scheduler (bounded FIFO, admission)      host
                               │ take(free_slots)
                               ▼
-    engine thread ───> SlotEngine.insert_batch / step / evict     device
-                              │ tokens
+    engine thread ───> SlotEngine.start_batch / advance_prefill   device
+                       / decode_block / evict
+                              │ token blocks
                               ▼
                        RequestHandle streaming callbacks, done events
 
 One background thread drives the engine (the device programs are
 serialized anyway — a thread per request would only add contention);
-any number of caller threads submit.  SIGTERM reuses the training
-stack's preemption flag (:mod:`tpudist.runtime.preemption`): the loop
-checks it every iteration and, once set, stops admitting (new submits
-reject with ``"draining"``), finishes everything already admitted —
-queued AND in-slot — then exits.  The same drain runs on
-:meth:`InferenceServer.close`, so a deploy rollover never cuts a
-response mid-stream.
+any number of caller threads submit.  Each loop iteration admits into
+free slots (one fused prefill+scatter dispatch), feeds one prompt chunk
+to every still-prefilling slot (chunked prefill — a long prompt stalls
+decode by at most one chunk per iteration), then runs ONE fused decode
+block (``K`` tokens per slot per dispatch, ``K`` picked from the host
+shadow budgets).  Tokens stream per request as each block lands; a
+request's ``eos_id`` truncates its block post-hoc (finish reason
+``"eos"``).  Deadlines are enforced between blocks, so a request can
+overshoot its deadline by at most one block.
 
-Telemetry (the PR-2 subsystem) brackets the two device programs —
-``prefill`` and ``decode_step`` spans, the latter tagged with the batch
-occupancy gauge — and stamps a ``request_finished`` event per request
-carrying TTFT/TPOT/queue-wait, which the aggregator folds into the
-run report's serving section (:mod:`tpudist.telemetry.aggregate`).
+SIGTERM reuses the training stack's preemption flag
+(:mod:`tpudist.runtime.preemption`): the loop checks it every iteration
+and, once set, stops admitting (new submits reject with ``"draining"``),
+finishes everything already admitted — queued AND in-slot — then exits.
+The same drain runs on :meth:`InferenceServer.close`, so a deploy
+rollover never cuts a response mid-stream.
+
+Telemetry (the PR-2 subsystem) brackets the device programs —
+``prefill`` spans (admission batches and chunk feeds) and
+``decode_block`` spans tagged with the batch occupancy gauge, the block
+size ``k``, tokens emitted, and the dispatch-vs-host-sync attribution —
+and stamps a ``request_finished`` event per request carrying
+TTFT/TPOT/queue-wait, which the aggregator folds into the run report's
+serving section (:mod:`tpudist.telemetry.aggregate`).
 """
 
 from __future__ import annotations
@@ -50,8 +62,9 @@ class ServeConfig:
     num_slots: int = 4
     queue_limit: int = 64
     max_new: int = 64  # default per-request token budget
-    prefill_pad: Optional[int] = None  # None: min(max_len, 64)
+    prefill_pad: Optional[int] = None  # chunk size; None: min(max_len, 64)
     deadline_s: Optional[float] = None  # default per-request deadline
+    decode_block: int = 8  # max fused decode tokens per dispatch (K)
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -63,6 +76,7 @@ class ServeConfig:
             max_new=env_int("TPUDIST_SERVE_MAX_NEW", 64) or 64,
             prefill_pad=env_int("TPUDIST_SERVE_PREFILL_PAD", None),
             deadline_s=env_positive_float("TPUDIST_SERVE_DEADLINE_S", None),
+            decode_block=env_int("TPUDIST_SERVE_DECODE_BLOCK", 8) or 8,
         )
 
 
@@ -83,7 +97,8 @@ class InferenceServer:
         self.config = config or ServeConfig.from_env()
         self.engine = SlotEngine(
             module, params, num_slots=self.config.num_slots,
-            prefill_pad=self.config.prefill_pad)
+            prefill_pad=self.config.prefill_pad,
+            decode_block=self.config.decode_block)
         self.scheduler = Scheduler(
             queue_limit=self.config.queue_limit,
             check_budget=self.engine.check_budget,
@@ -123,7 +138,7 @@ class InferenceServer:
 
     def submit(self, prompt, *, max_new: Optional[int] = None,
                temperature: float = 0.0, deadline_s: Optional[float] = None,
-               seed: Optional[int] = None,
+               seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                ) -> RequestHandle:
         """Thread-safe ingestion; raises :class:`AdmissionError` on
@@ -133,7 +148,8 @@ class InferenceServer:
         try:
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
-                deadline_s=deadline_s, seed=seed, on_token=on_token)
+                deadline_s=deadline_s, seed=seed, eos_id=eos_id,
+                on_token=on_token)
         except AdmissionError as e:
             telemetry.event("serve_rejected", reason=e.reason)
             raise
@@ -176,9 +192,11 @@ class InferenceServer:
             "tokens_out": self.tokens_out,
             "pending": self.scheduler.pending(),
             "active": self.engine.num_active,
+            "prefilling": len(self.engine.prefilling_slots()),
             "occupancy_mean": (self._occupancy_sum / self._steps
                                if self._steps else 0.0),
             "compile_counts": self.engine.compile_counts(),
+            "decode": self.engine.decode_stats(),
         }
 
     # -- the engine loop ----------------------------------------------------
@@ -231,13 +249,15 @@ class InferenceServer:
             now = time.monotonic()
             # deadline enforcement: in-slot AND queued (the queue check
             # must not wait for a slot to free — all lanes can be busy
-            # for far longer than a queued request's deadline)
+            # for far longer than a queued request's deadline).  A block
+            # is atomic, so mid-decode expiry lands between blocks.
             for slot, h in list(self._slot_handles.items()):
                 if h._expired(now):
                     self._finish_slot(slot, "deadline")
             for h in sched.expire_queued(now):
                 self._note_finished(h)
-            # FIFO-with-budget admission into free lanes, batched prefill
+            # FIFO-with-budget admission into free lanes: ONE fused
+            # prefill+scatter dispatch for the whole admission batch
             free = eng.free_slots()
             if free:
                 batch = sched.take(len(free), now)
@@ -253,33 +273,63 @@ class InferenceServer:
                         h.slot = slot
                         h.t_admitted = t0
                         items.append((slot, h.request.prompt,
-                                      h.request.temperature, h.request.seed))
+                                      h.request.temperature, h.request.seed,
+                                      h.request.max_new))
                         self._slot_handles[slot] = h
                     with telemetry.span("prefill", n=len(items)):
-                        firsts = eng.insert_batch(items)
-                    for h in alive:
-                        h._deliver(firsts[h.slot])
-                        self.tokens_out += 1
-                        if len(h.tokens) >= h.request.max_new:
-                            self._finish_slot(h.slot, "length")
-            # one batched decode iteration
+                        firsts = eng.start_batch(items)
+                    for slot, tok in firsts.items():
+                        if tok is not None:
+                            self._deliver_block(slot, [tok])
+            # chunked prefill: one prompt chunk per prefilling slot per
+            # iteration — long prompts never stall decode for more than
+            # one chunk's worth of device time
+            if eng.prefilling_slots():
+                with telemetry.span("prefill",
+                                    chunks=len(eng.prefilling_slots())):
+                    done = eng.advance_prefill()
+                for slot, tok in done.items():
+                    self._deliver_block(slot, [tok])
+            # one fused decode block over every decoding lane
             if eng.num_active:
                 occ = eng.occupancy
-                with telemetry.span("decode_step", occupancy=occ,
-                                    active=eng.num_active):
-                    toks = eng.step()
+                active = eng.num_active
+                tele = telemetry.active()
+                t0 = time.monotonic()
+                info, blocks = eng.decode_block()
+                if tele is not None and info is not None:
+                    tele.record_span(
+                        "decode_block", t0, time.monotonic() - t0,
+                        {"occupancy": occ, "active": active, "k": info["k"],
+                         "tokens": info["tokens"],
+                         "dispatch_s": round(info["dispatch_s"], 9),
+                         "sync_s": round(info["sync_s"], 9)})
                 self._occupancy_sum += occ
                 self._steps += 1
-                for slot, tok in toks.items():
-                    h = self._slot_handles[slot]
-                    h._deliver(tok)
-                    self.tokens_out += 1
-                    if len(h.tokens) >= h.request.max_new:
-                        self._finish_slot(slot, "length")
+                for slot, toks in blocks.items():
+                    self._deliver_block(slot, toks)
+            elif eng.prefilling_slots():
+                pass  # prefill work continues next iteration
             elif self._draining and sched.pending() == 0:
                 break
             else:
                 sched.wait_for_work(_IDLE_WAIT_S)
+
+    def _deliver_block(self, slot: int, toks) -> None:
+        """Stream a token block to the slot's request, truncating
+        post-hoc at its stop token or length budget (the device block is
+        speculative past either — bounded by the block size)."""
+        h = self._slot_handles[slot]
+        eos = h.request.eos_id
+        for tok in toks:
+            h._deliver(tok)
+            self.tokens_out += 1
+            if eos is not None and tok == eos:
+                self._finish_slot(slot, "eos")
+                return
+            if len(h.tokens) >= h.request.max_new:
+                self._finish_slot(slot, "length")
+                return
 
     def _finish_slot(self, slot: int, reason: str) -> None:
         h = self._slot_handles.pop(slot)

@@ -54,12 +54,14 @@ COMPONENT_OF = {
     "host_collective": "comm",
     "init": "init",
     # serving (tpudist.serve): device work of the engine loop — prefill
-    # teacher-forcing and batched decode iterations are the serving
-    # analog of a train step.  The first decode_step/prefill dispatch
-    # blocks on XLA compilation like any first dispatch; the serving
-    # section's TTFT percentiles surface that separately.
+    # teacher-forcing and fused decode blocks are the serving analog of
+    # a train step.  The first decode_block/prefill dispatch blocks on
+    # XLA compilation like any first dispatch; the serving section's
+    # TTFT percentiles surface that separately.  decode_step is the
+    # pre-block name, still recognized so old streams aggregate.
     "prefill": "step",
     "decode_step": "step",
+    "decode_block": "step",
 }
 
 #: Every component of the breakdown, in report order.  The accounted ones
@@ -192,22 +194,30 @@ def _step_stats(records: List[dict], num_ranks: int = 1) -> dict:
 def _serving_summary(records: List[dict]) -> Optional[dict]:
     """Serving-goodput section from the serve subsystem's records:
     per-request ``request_finished`` events (TTFT/TPOT/queue-wait
-    percentiles, finish-reason counts) plus the ``decode_step`` spans'
+    percentiles, finish-reason counts) plus the ``decode_block`` spans'
     occupancy gauge (duration-weighted — a long low-occupancy stretch
-    must weigh what it cost).  ``None`` when the run never served."""
+    must weigh what it cost) and their dispatch/host-sync attribution
+    (the per-token overhead split — ``decode_step`` is the pre-block
+    span name, still folded in).  ``None`` when the run never served."""
     fins = [r for r in records if r.get("kind") == "event"
             and r.get("name") == "request_finished"]
     rejects = sum(1 for r in records if r.get("kind") == "event"
                   and r.get("name") == "serve_rejected")
     occ_w, occ_dur, occ_max, decode_s, prefill_s = 0.0, 0.0, 0.0, 0.0, 0.0
     serve_spans = 0
+    decode_blocks, decode_tokens = 0, 0
+    dispatch_s, sync_s = 0.0, 0.0
     for r in records:
         if r.get("kind") != "span":
             continue
-        if r.get("name") == "decode_step":
+        if r.get("name") in ("decode_block", "decode_step"):
             serve_spans += 1
+            decode_blocks += 1
             dur = float(r.get("dur", 0.0))
             decode_s += dur
+            decode_tokens += int(r.get("tokens", 0) or 0)
+            dispatch_s += float(r.get("dispatch_s", 0.0) or 0.0)
+            sync_s += float(r.get("sync_s", 0.0) or 0.0)
             occ = r.get("occupancy")
             if isinstance(occ, (int, float)):
                 occ_w += float(occ) * dur
@@ -240,6 +250,12 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         "tokens_out": tokens_out,
         "decode_s": round(decode_s, 6),
         "prefill_s": round(prefill_s, 6),
+        "decode_blocks": decode_blocks,
+        "decode_tokens": decode_tokens,
+        "tokens_per_dispatch": (round(decode_tokens / decode_blocks, 3)
+                                if decode_blocks else None),
+        "dispatch_s": round(dispatch_s, 6),
+        "host_sync_s": round(sync_s, 6),
         "tokens_per_s_busy": round(tokens_out / busy, 3) if busy > 0 else None,
         "ttft": _pcts("ttft_s"),
         "tpot": _pcts("tpot_s"),
@@ -382,6 +398,11 @@ def render_markdown(report: dict) -> str:
             f" + prefill {sv['prefill_s']:.3f} s"
             + (f" → {sv['tokens_per_s_busy']:.1f} tok/s busy"
                if sv["tokens_per_s_busy"] else ""))
+        if sv.get("decode_blocks"):
+            lines.append(
+                f"- decode dispatch overhead: {sv['decode_blocks']} blocks, "
+                f"{sv['tokens_per_dispatch']} tok/dispatch, host sync "
+                f"{sv['host_sync_s']:.3f} s of {sv['decode_s']:.3f} s decode")
         for label, key in (("TTFT", "ttft"), ("TPOT", "tpot"),
                            ("queue wait", "queue_wait")):
             v = sv.get(key)

@@ -94,8 +94,9 @@ ENV_VARS = {
     "TPUDIST_SERVE_SLOTS": "continuous-batching KV-cache slot count",
     "TPUDIST_SERVE_QUEUE": "serving request-queue bound (backpressure)",
     "TPUDIST_SERVE_MAX_NEW": "default per-request output-token budget",
-    "TPUDIST_SERVE_PREFILL_PAD": "prefill pad length (max admissible prompt)",
+    "TPUDIST_SERVE_PREFILL_PAD": "prefill chunk length (pad per compiled chunk)",
     "TPUDIST_SERVE_DEADLINE_S": "default per-request deadline seconds (<=0 off)",
+    "TPUDIST_SERVE_DECODE_BLOCK": "max fused decode tokens per dispatch (K)",
     # telemetry & goodput
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
