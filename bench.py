@@ -721,14 +721,14 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sections", default="all",
                     help="comma list of toy,fused,dense,mfu,mfu_scanned,"
-                         "decode,long "
+                         "decode,long,dh128 "
                          "(default: all).  Targeted on-chip reruns merge "
                          "into the existing BENCH_EXTENDED.json instead of "
                          "clobbering other sections' evidence.")
     cli = ap.parse_args()
     want = {s.strip() for s in cli.sections.split(",") if s.strip()}
     known = {"all", "toy", "fused", "dense", "mfu", "mfu_scanned",
-             "decode", "long"}
+             "decode", "long", "dh128"}
     if not want or want - known:
         # A typo'd section must not produce a success-looking empty run
         # (the shepherd would record the step as terminally complete).
@@ -770,7 +770,7 @@ def main() -> None:
     # The gate certifies the flash kernels; any section that can route
     # through them needs it (dense/MFU at seq 2048 included).
     need_gate = any(sec(s) for s in ("fused", "dense", "mfu",
-                                     "mfu_scanned", "long"))
+                                     "mfu_scanned", "long", "dh128"))
     if jax.devices()[0].platform == "tpu" and need_gate:
         # Correctness gate BEFORE any timing: a kernel MISMATCH must kill
         # the run (nonzero exit), never record a number.  A gate TIMEOUT is
@@ -876,6 +876,32 @@ def main() -> None:
     # long_context fp32 wedged at 600s and the d1024 row never executed).
     # (Dense/MFU still route seq 2048 through the flash kernel when the
     # gate certified it — the gate-timeout branch above reroutes them.)
+    def same_window_pair(key, fp32_key, bf16_key, field="step_ms",
+                         invert=False):
+        """Pair two rows measured back-to-back in THIS invocation (one
+        tunnel window), so BENCH_EXTENDED never invites a cross-window
+        fp32-vs-bf16 wall comparison (r5 verdict Weak #3: the decode
+        artifact showed bf16 1.7x 'slower' purely from window drift).
+        When only one side was measured now, the pair is explicitly
+        voided rather than silently stale."""
+        if fp32_key in measured_now and bf16_key in measured_now:
+            a, b = results[fp32_key], results[bf16_key]
+            va, vb = a.get(field), b.get(field)
+            if isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
+                    and va and vb:
+                speed = (vb / va) if invert else (va / vb)
+                results[key] = {
+                    "metric": key, "unit": a.get("unit"),
+                    f"{field}_fp32": va, f"{field}_bf16": vb,
+                    "bf16_speedup": round(speed, 3),
+                    "note": "fp32/bf16 measured back-to-back in one "
+                            "session — the only wall pair safe to compare",
+                }
+                return
+        results[key] = {
+            "error": "not a same-window pair: both precisions were not "
+                     "measured in this invocation"}
+
     for precision in ("fp32", "bf16"):
         if not sec("dense"):
             break
@@ -884,6 +910,38 @@ def main() -> None:
             lambda p=precision: bench_lm(
                 name=f"dense_{p}", batch=8, seq_len=2048, d_model=512,
                 n_layers=4, n_heads=8, d_ff=2048, precision=p))
+    if sec("dense"):
+        same_window_pair("lm_dense_same_window_pair",
+                         "lm_dense_fp32", "lm_dense_bf16")
+        ext_path.write_text(json.dumps(results, indent=2) + "\n")
+
+    # d_head-128 twin rungs (r5 verdict next #1): same model FLOPs as
+    # the dense d512 and long-context rows, but 128-deep heads — the
+    # falsification experiment for the round-5 "d_head-64 structural
+    # ceiling" claim.  If the MFU jumps toward the computed composite
+    # ceiling (~44%/~42%), the ceiling story becomes a measurement; if
+    # not, the sink hunt reopens with a named suspect eliminated.
+    if sec("dh128"):
+        run_section(
+            "lm_dense_bf16_dh128",
+            lambda: bench_lm(
+                name="dense_bf16_dh128", batch=8, seq_len=2048,
+                d_model=512, n_layers=4, n_heads=4, d_ff=2048,
+                precision="bf16"))
+        if gate_ok:
+            # long-context twin routes through the flash kernel on TPU;
+            # only timed when the numerics gate certified the kernels
+            run_section(
+                "lm_long_context_bf16_dh128",
+                lambda: bench_lm(
+                    name="long_context_bf16_dh128", batch=4, seq_len=8192,
+                    d_model=256, n_layers=4, n_heads=2, d_ff=1024,
+                    precision="bf16"))
+        else:
+            results["lm_long_context_bf16_dh128"] = {
+                "error": "skipped: numerics gate wedged, kernels "
+                         "uncertified"}
+        ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
     if jax.devices()[0].platform == "tpu" and sec("dense"):
         # Dispatch-tax A/B: the scanned LM step (K steps/dispatch) vs the
@@ -950,6 +1008,11 @@ def main() -> None:
         # decode is HBM-bound, so this is the one-line 2x ceiling lever
         run_section("lm_decode_bf16",
                     lambda: bench_decode(precision="bf16"))
+        # decode throughput: HIGHER is better, so the speedup inverts
+        same_window_pair("lm_decode_same_window_pair",
+                         "lm_decode", "lm_decode_bf16",
+                         field="value", invert=True)
+        ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
     # Long-context LM config (BASELINE.md's measured row): flash-attention
     # regime, attention-dominated — tracks the kernel round over round.

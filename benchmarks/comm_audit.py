@@ -54,7 +54,18 @@ def _force_cpu_mesh(n: int = 8) -> None:
         backend_up = True
     if not backend_up:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", max(n, 8))
+        try:
+            jax.config.update("jax_num_cpu_devices", max(n, 8))
+        except AttributeError:
+            # older jax spells the knob via XLA_FLAGS only
+            import os
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags +
+                    f" --xla_force_host_platform_device_count={max(n, 8)}"
+                ).strip()
     if len(jax.devices()) < n:
         raise RuntimeError(
             f"need {n} devices, have {len(jax.devices())} "
@@ -193,9 +204,9 @@ def regime_dp_model_split(devices):
     return step, (states, x, y), info
 
 
-def _lm_regime(mesh, *, attention_fn=None, moe_fn=None, n_layers=1,
-               n_experts=0, seq_len=64, batch=8, state_sharding_fn=None,
-               aux=False, seed=0):
+def _lm_regime(mesh, *, attention_fn=None, moe_fn=None, mlp_fn=None,
+               n_layers=1, n_experts=0, seq_len=64, batch=8,
+               state_sharding_fn=None, aux=False, seed=0):
     import jax
     import optax
 
@@ -205,8 +216,9 @@ def _lm_regime(mesh, *, attention_fn=None, moe_fn=None, n_layers=1,
 
     module, params = create_transformer(
         jax.random.PRNGKey(0), seq_len=seq_len, attention_fn=attention_fn,
-        moe_fn=moe_fn, vocab=32, d_model=32, n_layers=n_layers, n_heads=2,
-        d_ff=64, max_len=seq_len, n_experts=n_experts,
+        moe_fn=moe_fn, mlp_fn=mlp_fn, vocab=32, d_model=32,
+        n_layers=n_layers, n_heads=2, d_ff=64, max_len=seq_len,
+        n_experts=n_experts,
     )
     tx = optax.adam(1e-3)
     state = init_lm_state(params, tx)
@@ -446,6 +458,152 @@ def _pp_regime(devices, schedule):
     }
 
 
+def _tp_mlp_regime(devices, overlap):
+    """(8,) model axis: the explicit TP MLP (column→row pair), fwd+bwd.
+
+    ``overlap=None`` audits the default psum body — ONE exposed
+    all-reduce of the output.  ``overlap='ring'/'bidir'`` audits the
+    collective-matmul body: the wire traffic must have moved whole into
+    OVERLAP_SCOPE-tagged ppermute chunks (pipelined against the chunk
+    matmuls), with no monolithic all-gather/all-reduce left.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpudist.parallel import init_mlp_params, mlp_param_sharding
+    from tpudist.parallel.overlap import compat_shard_map
+    from tpudist.parallel.tensor_parallel import (tp_mlp_overlap_shard,
+                                                  tp_mlp_shard)
+    from tpudist.runtime.mesh import AXIS_MODEL
+
+    n = 8
+    batch, d, f = 64, 32, 128
+    mesh = Mesh(np.asarray(devices), axis_names=(AXIS_MODEL,))
+    params = init_mlp_params(jax.random.PRNGKey(0), d, f)
+    gparams = jax.device_put(params, mlp_param_sharding(mesh, params))
+    param_specs = {"w1": P(None, AXIS_MODEL), "b1": P(AXIS_MODEL),
+                   "w2": P(AXIS_MODEL, None), "b2": P()}
+    if overlap is None:
+        body = functools.partial(tp_mlp_shard, axis_name=AXIS_MODEL)
+        x_spec = P(None, None)
+    else:
+        body = functools.partial(tp_mlp_overlap_shard, axis_name=AXIS_MODEL,
+                                 mode=overlap)
+        x_spec = P(AXIS_MODEL, None)
+
+    def shard_loss(p, x):
+        def local_loss(pp):
+            out = body(pp, x)
+            loss = jnp.sum(out * out)
+            if overlap is not None:
+                # batch rows are sharded here; the default body's loss is
+                # already replicated (post-psum output)
+                loss = lax.psum(loss, AXIS_MODEL)
+            return loss
+
+        return jax.value_and_grad(local_loss)(p)
+
+    sharded = compat_shard_map(
+        shard_loss, mesh=mesh, in_specs=(param_specs, x_spec),
+        out_specs=(P(), param_specs))
+    step = jax.jit(sharded)
+    x = jax.device_put(
+        jnp.asarray(np.random.default_rng(1).standard_normal((batch, d)),
+                    jnp.float32),
+        NamedSharding(mesh, x_spec))
+    info = {
+        "mesh": {"model": n},
+        "overlap": overlap or "off",
+        "out_bytes": batch * d * 4,
+        # one pipelined chunk: a [batch/n, d] row block (x hops in the
+        # gather ring, accumulator hops in the reduce-scatter ring,
+        # cotangents retrace both — all the same chunk shape)
+        "chunk_bytes": (batch // n) * d * 4,
+        "ring": n,
+    }
+    return step, (gparams, x), info
+
+
+def regime_tp_mlp(devices):
+    return _tp_mlp_regime(devices, None)
+
+
+def regime_tp_mlp_overlap_ring(devices):
+    return _tp_mlp_regime(devices, "ring")
+
+
+def regime_tp_mlp_overlap_bidir(devices):
+    return _tp_mlp_regime(devices, "bidir")
+
+
+def _fsdp_overlap_regime(devices, mode):
+    """(8,) ZeRO-3 LM with the overlapped FFN compute: the FFN kernels
+    stream into the ppermute pipeline SHARDED — the partitioner's
+    monolithic pre-matmul all-gather of wi/wo must be gone, its bytes
+    moved into OVERLAP_SCOPE-tagged chunk permutes."""
+    from jax.sharding import Mesh
+
+    from tpudist.parallel import fsdp_sharding
+    from tpudist.runtime.mesh import AXIS_DATA
+    from tpudist.train import fsdp_overlap_mlp_fn
+
+    mesh = Mesh(np.asarray(devices), axis_names=(AXIS_DATA,))
+    min_size = 64
+    n = 8
+    d_model, d_ff, n_layers = 32, 64, 1
+
+    holder = {}
+
+    def shard_fn(mesh, state):
+        sh = fsdp_sharding(mesh, state, min_size=min_size)
+        holder["sharding"] = sh
+        holder["state"] = state
+        return sh
+
+    mlp_fn = fsdp_overlap_mlp_fn(mesh, overlap=mode)
+    step, args, info = _lm_regime(mesh, seq_len=16, batch=8,
+                                  state_sharding_fn=shard_fn,
+                                  mlp_fn=mlp_fn)
+    import jax as _jax
+    from jax.sharding import NamedSharding
+
+    sharded_b = repl_b = 0
+    for leaf, sh in zip(
+        _jax.tree.leaves(holder["state"].params),
+        _jax.tree.leaves(holder["sharding"].params,
+                         is_leaf=lambda x: isinstance(x, NamedSharding)),
+    ):
+        b = int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+        if all(a is None for a in tuple(sh.spec)):
+            repl_b += b
+        else:
+            sharded_b += b
+    ffn_kernel_bytes = d_model * d_ff * 4  # each of wi / wo, per layer
+    info.update({
+        "mesh": {"data": n},
+        "overlap": mode,
+        "sharded_param_bytes": sharded_b,
+        "replicated_param_bytes": repl_b,
+        "n_layers": n_layers,
+        "ffn_kernel_bytes": ffn_kernel_bytes,
+        "ffn_shard_bytes": ffn_kernel_bytes // n,
+        "ring": n,
+    })
+    return step, args, info
+
+
+def regime_fsdp_overlap_ring(devices):
+    return _fsdp_overlap_regime(devices, "ring")
+
+
+def regime_fsdp_overlap_bidir(devices):
+    return _fsdp_overlap_regime(devices, "bidir")
+
+
 def regime_dp_pp_gpipe(devices):
     return _pp_regime(devices, "gpipe")
 
@@ -471,6 +629,15 @@ REGIMES = {
     "dp_pp_gpipe": regime_dp_pp_gpipe,
     "dp_pp_1f1b": regime_dp_pp_1f1b,
     "dp_pp_interleaved": regime_dp_pp_interleaved,
+    # collective-matmul overlap family (tpudist/parallel/overlap.py):
+    # the default TP psum body vs the ppermute-pipelined twins, and the
+    # FSDP LM step with the FFN gathers moved into the pipeline.  fsdp
+    # MUST precede fsdp_overlap_* (their checks compare against it).
+    "tp_mlp": regime_tp_mlp,
+    "tp_mlp_overlap_ring": regime_tp_mlp_overlap_ring,
+    "tp_mlp_overlap_bidir": regime_tp_mlp_overlap_bidir,
+    "fsdp_overlap_ring": regime_fsdp_overlap_ring,
+    "fsdp_overlap_bidir": regime_fsdp_overlap_bidir,
 }
 
 
@@ -655,6 +822,94 @@ def check_fsdp(prof, info):
     ]
 
 
+def check_tp_mlp(prof, info, split):
+    ar = prof.get("all-reduce", {"count": 0, "bytes_total": 0})
+    # The psum body: the output all-reduce is the regime's whole wire
+    # story, and it is EXPOSED — the matmul that feeds it must finish
+    # first, nothing runs under it.  (The backward may add small
+    # bias-grad reduces; the floor is the fwd output psum.)
+    return [
+        _c("output psum present (>= out bytes, all exposed)", True,
+           ar["bytes_total"] >= info["out_bytes"]
+           and split["overlapped_bytes"] == 0),
+        _c("no ppermute pipeline in the default body", True,
+           "collective-permute" not in prof),
+        _c("no all-gather", True, "all-gather" not in prof),
+    ]
+
+
+def check_tp_mlp_overlap(prof, info, split):
+    cp = prof.get("collective-permute",
+                  {"count": 0, "bytes_total": 0, "instructions": []})
+    ar = prof.get("all-reduce", {"count": 0, "bytes_total": 0,
+                                 "instructions": []})
+    chunk = info["chunk_bytes"]
+    n = info["ring"]
+    # Fwd floor: the input gather ring (n-1 chunk hops) + the
+    # reduce-scatter ring (n-1 chunk hops); the backward retraces both.
+    floor = 2 * (n - 1) * chunk
+    # Remaining all-reduces must be bookkeeping-sized (the scalar loss
+    # psum and bias-grad reductions), never the [batch, d] output.
+    big_ar = [i for i in ar["instructions"] if i["bytes"] >= info["out_bytes"]]
+    return [
+        _c("monolithic output psum GONE (no out-sized all-reduce)", 0,
+           len(big_ar)),
+        _c("no monolithic all-gather", True, "all-gather" not in prof),
+        _c("wire moved into ppermute chunks (>= 2(n-1) chunk bytes)",
+           {"floor": floor}, cp["bytes_total"],
+           ok=cp["bytes_total"] >= floor),
+        _c("every permute is overlap-pipeline-tagged", True,
+           cp["count"] > 0 and all(i["overlapped"]
+                                   for i in cp["instructions"])),
+        _c("exposed bytes are bookkeeping only (< 1 chunk)", True,
+           split["exposed_bytes"] < chunk),
+        _c("no loop-resident collectives (chains unrolled)", 0,
+           cp.get("count_in_loop", 0)),
+    ]
+
+
+def check_fsdp_overlap(prof, info, split, dense_prof):
+    ag = prof.get("all-gather", {"count": 0, "bytes_total": 0,
+                                 "instructions": []})
+    cp = prof.get("collective-permute",
+                  {"count": 0, "bytes_total": 0, "instructions": []})
+    kb = info["ffn_kernel_bytes"]
+    shard = info["ffn_shard_bytes"]
+    n = info["ring"]
+    layers = info["n_layers"]
+    # Per layer: wi column ring (n-1 shard hops) + wo contraction ring
+    # (n-1 shard hops) in forward; backward retraces both.
+    floor = layers * 2 * (n - 1) * shard
+    dense_ag = dense_prof.get("all-gather", {"bytes_total": 0})
+    # The layout-only fsdp regime gathers every sharded param once
+    # (its check asserts equality); here the two FFN kernels per layer
+    # must be OUT of the gather budget — they stream sharded into the
+    # ppermute pipeline instead.
+    budget = info["sharded_param_bytes"] - layers * 2 * kb
+    ffn_gathers = [i for i in ag["instructions"]
+                   if "/wi/" in i["op_name"] or "/wo/" in i["op_name"]
+                   or i["bytes"] == kb]
+    return [
+        _c("no all-gather of an FFN kernel (by provenance or size)", 0,
+           len(ffn_gathers)),
+        _c("all-gather bytes fit the non-FFN budget",
+           {"budget": budget}, ag["bytes_total"],
+           ok=ag["bytes_total"] <= budget),
+        _c("FFN wire moved into ppermute chunks (>= 2·layers·(n-1) shards)",
+           {"floor": floor}, cp["bytes_total"],
+           ok=cp["bytes_total"] >= floor),
+        _c("every permute is overlap-pipeline-tagged", True,
+           cp["count"] > 0 and all(i["overlapped"]
+                                   for i in cp["instructions"])),
+        _c("strictly fewer gathered bytes than layout-only fsdp",
+           {"fsdp": dense_ag["bytes_total"]}, ag["bytes_total"],
+           ok=(dense_ag["bytes_total"] == 0
+               or ag["bytes_total"] < dense_ag["bytes_total"])),
+        _c("overlapped bytes dominate the permute traffic", True,
+           split["overlapped_bytes"] >= cp["bytes_total"]),
+    ]
+
+
 def check_pp(prof, info):
     cp = prof.get("collective-permute",
                   {"count": 0, "count_in_loop": 0, "instructions": []})
@@ -690,7 +945,7 @@ def main(argv=None) -> int:
     _force_cpu_mesh(8)
     import jax
 
-    from tpudist.utils.hlo_audit import profile
+    from tpudist.utils.hlo_audit import overlap_split, profile
 
     devices = jax.devices()[:8]
     wanted = set(args.only.split(",")) if args.only else None
@@ -701,12 +956,24 @@ def main(argv=None) -> int:
         if wanted and name not in wanted:
             continue
         print(f"[comm-audit] lowering {name} ...", flush=True)
-        step, ex_args, info = builder(devices)
-        ops = collect_ops(step, ex_args, info)
+        try:
+            step, ex_args, info = builder(devices)
+            ops = collect_ops(step, ex_args, info)
+        except Exception as e:  # noqa: BLE001
+            # A regime that cannot BUILD on this box (e.g. a jax API the
+            # installed version lacks) is a failed row, not a crashed
+            # artifact: later regimes still audit and the file still
+            # lands (the scaling_multiproc error-row convention).
+            results[name] = {"error": repr(e), "ok": False}
+            n_fail += 1
+            print(f"[comm-audit] {name}: ERROR {e!r}", flush=True)
+            continue
         prof = profile(ops)
         profiles[name] = prof
+        split = overlap_split(ops)
         row = {"mesh": info.get("mesh"), "info": {
-            k: v for k, v in info.items() if k != "mesh"}, "profile": prof}
+            k: v for k, v in info.items() if k != "mesh"},
+            "overlap_split": split, "profile": prof}
         if not args.measure_only:
             if name == "dp":
                 checks = check_dp(prof, info)
@@ -727,6 +994,13 @@ def main(argv=None) -> int:
                 checks = check_fsdp(prof, info)
             elif name == "dp_zero1":
                 checks = check_zero1(prof, info)
+            elif name == "tp_mlp":
+                checks = check_tp_mlp(prof, info, split)
+            elif name.startswith("tp_mlp_overlap"):
+                checks = check_tp_mlp_overlap(prof, info, split)
+            elif name.startswith("fsdp_overlap"):
+                checks = check_fsdp_overlap(prof, info, split,
+                                            profiles.get("fsdp", {}))
             else:
                 checks = check_pp(prof, info)
             row["checks"] = checks
@@ -737,10 +1011,15 @@ def main(argv=None) -> int:
             status = "measured"
         results[name] = row
         kinds = {k: (v["count"], v["bytes_total"]) for k, v in prof.items()}
-        print(f"[comm-audit] {name}: {status}  {kinds}", flush=True)
+        print(f"[comm-audit] {name}: {status}  "
+              f"exposed={split['exposed_bytes']} "
+              f"overlapped={split['overlapped_bytes']}  {kinds}", flush=True)
 
-    out = {"n_devices": 8, "platform": "cpu-virtual", "regimes": results,
+    out = {"n_devices": 8, "platform": "cpu-virtual",
+           "jax_version": jax.__version__, "regimes": results,
            "failed": n_fail}
+    if wanted:
+        out["only"] = sorted(wanted)
     Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
     print(json.dumps({"regimes": len(results), "failed": n_fail,
                       "out": args.out}))

@@ -31,6 +31,17 @@ Per-rung overhead split (slowest-rank times, per iteration):
   metric_ms  host-fabric (gloo) scalar loss all-reduce (demo.py:84's
              second-fabric analog)
 
+Null-step calibration.  Before each width's real rung, a calibration
+rung runs barrier + host scalar all-reduce ONLY — no compute, no
+loader, no jax step — pricing the coordination floor of this rig
+(loopback-TCP handshakes + scheduler wake-ups).  The real rung's
+in-step collective estimate is then reported twice: raw
+(``collective_ms_per_step_est``) and with the same-width floor
+subtracted (``collective_ms_per_step_cal``), so the framework is
+charged for gradient data movement, never for handshake latency any
+null step at that width would also pay (``--skip-null`` drops the
+calibration rungs and the calibrated column).
+
 Writes the detailed artifact to ``SCALING_MULTIPROC_r{NN}.json`` (NN =
 the round being built).  Per-rung progress goes to STDERR as each rung
 finishes; STDOUT carries only the final enriched rows (with the
@@ -80,6 +91,34 @@ BATCH = int(os.environ["SCALE_BATCH_PER_PROC"])
 
 ctx = bootstrap.initialize()
 n = ctx.num_processes
+
+if os.environ.get("SCALE_NULL") == "1":
+    # Null-step calibration rung: coordination floor only — barrier +
+    # host scalar all-reduce, no compute, no loader, no jax step.  What
+    # this prices is the fixed per-handshake cost of crossing process
+    # boundaries on THIS rig (gloo over loopback TCP plus scheduler
+    # wake-ups when procs > cores); the real rungs subtract it from
+    # their collective term so the reported number is data movement +
+    # framework work, not the handshake floor every rung pays anyway.
+    collectives.barrier("scale_null_warm")
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        collectives.barrier("scale_null")
+        collectives.host_allreduce_sum(np.float64(1.0))
+    t_null = time.perf_counter() - t0
+    out = {
+        "rank": ctx.process_id,
+        "n_procs": n,
+        "iters": ITERS,
+        "null_ms": t_null / ITERS * 1e3,
+    }
+    path = os.path.join(os.environ["SCALE_OUT"],
+                        f"rank{ctx.process_id}.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+    bootstrap.shutdown()
+    raise SystemExit(0)
+
 mesh = data_parallel_mesh()
 
 kx, ky = jax.random.split(jax.random.PRNGKey(0))
@@ -170,7 +209,8 @@ bootstrap.shutdown()
 """
 
 
-def run_rung(n_procs: int, *, iters: int, batch_per_proc: int) -> dict:
+def run_rung(n_procs: int, *, iters: int, batch_per_proc: int,
+             null: bool = False) -> dict:
     from tpudist.launch.run import main as tpurun_main
 
     saved_env = dict(os.environ)
@@ -190,6 +230,8 @@ def run_rung(n_procs: int, *, iters: int, batch_per_proc: int) -> dict:
             os.environ["SCALE_OUT"] = str(out_dir)
             os.environ["SCALE_ITERS"] = str(iters)
             os.environ["SCALE_BATCH_PER_PROC"] = str(batch_per_proc)
+            if null:
+                os.environ["SCALE_NULL"] = "1"
             os.environ["PYTHONPATH"] = (
                 str(REPO) + os.pathsep + saved_env["PYTHONPATH"]
                 if "PYTHONPATH" in saved_env else str(REPO))
@@ -214,6 +256,15 @@ def run_rung(n_procs: int, *, iters: int, batch_per_proc: int) -> dict:
                 "error": f"expected {n_procs} rank records, "
                          f"found {len(recs)}"}
     # slowest rank bounds the job — that IS the distributed cost
+    if null:
+        worst_null = max(r["null_ms"] for r in recs)
+        return {
+            "regime": "multiprocess-cpu-null",
+            "n_procs": n_procs,
+            "iters": iters,
+            "null_ms": round(worst_null, 3),
+            "rendezvous_plus_run_wall_s": round(wall, 1),
+        }
     worst = {k: max(r[k] for r in recs)
              for k in ("step_ms", "loader_ms", "e2e_ms", "metric_ms")}
     agg = n_procs * batch_per_proc / (worst["e2e_ms"] / 1e3)
@@ -237,6 +288,9 @@ def main(argv=None) -> int:
     p.add_argument("--n-procs", default="1,2,4")
     p.add_argument("--iters", type=int, default=64)
     p.add_argument("--batch-per-proc", type=int, default=256)
+    p.add_argument("--skip-null", action="store_true",
+                   help="drop the null-step calibration rungs (the "
+                        "calibrated collective column is then absent)")
     # Detailed artifact (columns doc + interpretation).  The round
     # snapshot merges this harness's rung LINES into SCALING_r{NN}.json
     # next to the virtual-cpu regime (benchmarks/round_snapshot.py).
@@ -251,8 +305,25 @@ def main(argv=None) -> int:
 
     cores = os.cpu_count() or 1
     rungs = []
+    calibration = []
+    null_ms_by_n: dict[int, float] = {}
     for n in [int(x) for x in args.n_procs.split(",")]:
+        if not args.skip_null:
+            # Null-step calibration FIRST at each width: barrier + host
+            # scalar all-reduce only, no compute — the coordination
+            # floor every rung at this width pays regardless of the
+            # framework.  A failed calibration is an error row, never a
+            # dead harness: the real rung still runs, its calibrated
+            # column is just absent.
+            c = run_rung(n, iters=args.iters,
+                         batch_per_proc=args.batch_per_proc, null=True)
+            calibration.append(c)
+            if "error" not in c:
+                null_ms_by_n[n] = c["null_ms"]
+            print(json.dumps(c), file=sys.stderr, flush=True)
         r = run_rung(n, iters=args.iters, batch_per_proc=args.batch_per_proc)
+        if "error" not in r and n in null_ms_by_n:
+            r["null_coordination_ms"] = null_ms_by_n[n]
         rungs.append(r)
         # progress to stderr; stdout carries only the FINAL enriched rows
         # (round_snapshot merges stdout lines into SCALING_r{NN}.json,
@@ -283,6 +354,14 @@ def main(argv=None) -> int:
             # collective
             r["collective_ms_per_step_est"] = round(
                 max(r["step_ms"] - ideal_factor * base["step_ms"], 0.0), 3)
+            if n in null_ms_by_n:
+                # calibrated: the null-step coordination floor (barrier
+                # + host all-reduce at the SAME width, measured this
+                # session) subtracted — what remains is gradient-bytes
+                # movement + framework work, not handshake latency.
+                r["collective_ms_per_step_cal"] = round(
+                    max(r["collective_ms_per_step_est"]
+                        - null_ms_by_n[n], 0.0), 3)
     out = {
         "regime": "multiprocess-cpu",
         "host_cores": cores,
@@ -301,6 +380,13 @@ def main(argv=None) -> int:
             "collective_ms_per_step_est": "step_ms beyond the "
                 "contention-ideal step — the in-step cross-process "
                 "gradient reduce on this rig",
+            "null_coordination_ms": "null-step calibration at the same "
+                "width: barrier + host scalar all-reduce per iteration, "
+                "no compute — the coordination floor of this rig",
+            "collective_ms_per_step_cal": "collective_ms_per_step_est "
+                "minus the same-width null_coordination_ms (floored at "
+                "0) — gradient data movement + framework work with the "
+                "handshake floor removed",
         },
         "interpretation": (
             "On this rig cross-process collectives ride gloo over "
@@ -314,6 +400,7 @@ def main(argv=None) -> int:
             "(COMM_AUDIT: exactly one combined grad all-reduce per step)."
         ),
         "rungs": rungs,
+        "calibration": calibration,
     }
     Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
     for r in rungs:

@@ -154,6 +154,11 @@ _SLOW_PATTERNS = (
     "test_regime[dp_ep_moe]",
     "test_regime[fsdp]",
     "test_regime[dp_pp",
+    # overlap-family transformer lowers (the small tp_mlp regimes and
+    # the overlap numerics/knob tests stay default)
+    "test_regime[fsdp_overlap",
+    # unrolled-ring compile-count pinning (repeated jitted steps)
+    "TestOverlapCompilePinning",
     # pipeline-demo e2e convergence runs (quick twins in default:
     # TestShardParity loss/grad parity, the 2-stage 1F1B smoke)
     "test_demo_pipeline[1f1b-1]",

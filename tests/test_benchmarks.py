@@ -41,6 +41,18 @@ class TestScalingMultiproc:
             assert "metric_ms" in r and "loader_ms" in r
         assert rungs[1]["contention_corrected_efficiency"] == 1.0
         assert 0 < rungs[2]["contention_corrected_efficiency"] <= 1.5
+        # null-step calibration: one rung per width, slowest-rank floor,
+        # and the calibrated collective column = est minus the floor
+        cal = {c["n_procs"]: c for c in rec["calibration"]}
+        assert set(cal) == {1, 2}
+        for c in cal.values():
+            assert c["regime"] == "multiprocess-cpu-null"
+            assert c["null_ms"] >= 0
+        for r in rungs.values():
+            assert r["null_coordination_ms"] == cal[r["n_procs"]]["null_ms"]
+            assert r["collective_ms_per_step_cal"] <= \
+                r["collective_ms_per_step_est"]
+            assert r["collective_ms_per_step_cal"] >= 0
 
 
 class TestBands:

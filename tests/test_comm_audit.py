@@ -22,6 +22,7 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "benchmarks"))
 
 from tpudist.utils.hlo_audit import (  # noqa: E402
+    overlap_split,
     parse_collectives,
     profile,
     ring_allreduce_wire_bytes,
@@ -110,12 +111,52 @@ ENTRY %e (p: f32[8]) -> f32[8] {
         # ring all-reduce: reduce-scatter + all-gather passes
         assert ring_allreduce_wire_bytes(800, 8) == 1400  # 2·7/8·800
 
+    def test_async_pair_with_compute_between_is_overlapped(self):
+        hlo = """
+ENTRY %main (p0: f32[16]) -> f32[16] {
+  %p0 = f32[16]{0} parameter(0)
+  %ar-start = (f32[16]{0}, f32[16]{0}) all-reduce-start(f32[16]{0} %p0), channel_id=2
+  %m = f32[16]{0} multiply(%p0, %p0)
+  ROOT %ar-done = f32[16]{0} all-reduce-done(%ar-start)
+}
+"""
+        (op,) = parse_collectives(hlo)
+        assert op.overlapped
+
+    def test_async_pair_with_only_bookkeeping_is_exposed(self):
+        hlo = """
+ENTRY %main (p0: f32[16]) -> f32[32] {
+  %p0 = f32[16]{0} parameter(0)
+  %ag-start = (f32[16]{0}, f32[32]{0}) all-gather-start(f32[16]{0} %p0), channel_id=2
+  %b = f32[16]{0} bitcast(%p0)
+  %t = (f32[16]{0}) tuple(%b)
+  ROOT %ag-done = f32[32]{0} all-gather-done(%ag-start)
+}
+"""
+        (op,) = parse_collectives(hlo)
+        assert not op.overlapped
+
+    def test_overlap_scope_tag_marks_pipeline_permutes(self):
+        hlo = """
+ENTRY %main (p0: f32[4]) -> f32[4] {
+  %cp = f32[4]{0} collective-permute(%p0), source_target_pairs={{0,1}}, metadata={op_name="jit(f)/transpose(jvp(tpudist_overlap))/ppermute"}
+  ROOT %cp2 = f32[4]{0} collective-permute(%cp), source_target_pairs={{0,1}}, metadata={op_name="jit(f)/shard_map/ppermute"}
+}
+"""
+        tagged, plain = parse_collectives(hlo)
+        assert tagged.overlapped and not plain.overlapped
+        split = overlap_split([tagged, plain])
+        assert split["overlapped_bytes"] == 16
+        assert split["exposed_bytes"] == 16
+        assert split["by_kind"]["collective-permute"]["overlapped_count"] == 1
+
 
 # Regime audits — each lowers a real jitted train step and runs the
 # analytic checks.  The cache is session-scoped so repeat audits (the
 # window regime's dense comparison, the wire-bytes test) don't re-lower.
 _PROFILES: dict = {}
 _INFOS: dict = {}
+_SPLITS: dict = {}
 
 
 def _audit(name):
@@ -128,9 +169,11 @@ def _audit(name):
 
     devices = jax.devices()[:8]
     step, args, info = ca.REGIMES[name](devices)
-    prof = profile(ca.collect_ops(step, args, info))
+    ops = ca.collect_ops(step, args, info)
+    prof = profile(ops)
     _PROFILES[name] = prof
     _INFOS[name] = info
+    _SPLITS[name] = overlap_split(ops)
     return prof, info
 
 
@@ -157,6 +200,15 @@ def _checks_for(name, prof, info):
         return ca.check_fsdp(prof, info)
     if name == "dp_zero1":
         return ca.check_zero1(prof, info)
+    if name == "tp_mlp":
+        return ca.check_tp_mlp(prof, info, _SPLITS[name])
+    if name.startswith("tp_mlp_overlap"):
+        return ca.check_tp_mlp_overlap(prof, info, _SPLITS[name])
+    if name.startswith("fsdp_overlap"):
+        if "fsdp" not in _PROFILES:
+            _audit("fsdp")
+        return ca.check_fsdp_overlap(prof, info, _SPLITS[name],
+                                     _PROFILES["fsdp"])
     return ca.check_pp(prof, info)
 
 
@@ -173,6 +225,13 @@ REGIME_NAMES = (
     "dp_pp_gpipe",
     "dp_pp_1f1b",
     "dp_pp_interleaved",
+    # collective-matmul overlap family (slow lane: the fsdp_overlap
+    # transformer lowers; the small tp_mlp regimes stay default)
+    "tp_mlp",
+    "tp_mlp_overlap_ring",
+    "tp_mlp_overlap_bidir",
+    "fsdp_overlap_ring",
+    "fsdp_overlap_bidir",
 )
 
 

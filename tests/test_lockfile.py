@@ -1,0 +1,76 @@
+"""Dependency-reproducibility gate: constraints-lock.txt.
+
+constraints.txt pins only the 8 DIRECT deps; the lock pins the full
+transitive install closure.  These tests keep the three files from
+drifting apart: a direct dep added to pyproject.toml without a lock
+entry, or a constraints.txt bump that forgets the lock, fails the suite
+— the same contract the env-knob inventory enforces for TPUDIST_*
+(tests/test_env_inventory.py).
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_PIN_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9._-]*)==(\S+)$")
+
+
+def _canon(name: str) -> str:
+    """PEP 503 name normalization (pyyaml == PyYAML == py-yaml... etc.)."""
+    return re.sub(r"[-_.]+", "-", name).lower()
+
+
+def _parse_pins(path: Path) -> dict:
+    pins = {}
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PIN_RE.match(line)
+        assert m, f"{path.name}: not an exact name==version pin: {line!r}"
+        pins[_canon(m.group(1))] = m.group(2)
+    return pins
+
+
+def _pyproject_direct_deps() -> set:
+    """Direct deps from pyproject.toml: [project.dependencies] plus the
+    dev extra (what `pip install -e '.[dev]'` — the environment the lock
+    freezes — resolves)."""
+    raw = (ROOT / "pyproject.toml").read_bytes()
+    try:
+        import tomllib
+    except ImportError:  # py3.10: stdlib tomllib landed in 3.11
+        try:
+            import tomli as tomllib
+        except ImportError:
+            pytest.skip("no TOML parser available (py<3.11, no tomli)")
+    proj = tomllib.loads(raw.decode())["project"]
+    reqs = list(proj["dependencies"])
+    reqs += proj.get("optional-dependencies", {}).get("dev", [])
+    return {_canon(re.split(r"[ ;\[<>=!~(]", r.strip())[0]) for r in reqs}
+
+
+def test_every_direct_dep_is_locked():
+    lock = _parse_pins(ROOT / "constraints-lock.txt")
+    missing = _pyproject_direct_deps() - set(lock)
+    assert not missing, (
+        f"direct deps declared in pyproject.toml but absent from "
+        f"constraints-lock.txt: {sorted(missing)} — regenerate the lock "
+        f"(header of constraints-lock.txt)")
+
+
+def test_lock_agrees_with_constraints_and_extends_them():
+    """The 8-pin file and the lock must name the same versions for the
+    deps both cover, and the lock must actually be the BIGGER closure —
+    a lock that merely restates constraints.txt pins nothing transitive."""
+    cons = _parse_pins(ROOT / "constraints.txt")
+    lock = _parse_pins(ROOT / "constraints-lock.txt")
+    missing = set(cons) - set(lock)
+    assert not missing, f"constraints.txt pins absent from lock: {missing}"
+    drift = {n: (cons[n], lock[n]) for n in cons if cons[n] != lock[n]}
+    assert not drift, f"version drift constraints.txt vs lock: {drift}"
+    assert len(lock) > len(cons), (
+        "lock holds no transitive pins beyond constraints.txt")

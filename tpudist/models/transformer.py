@@ -189,6 +189,23 @@ class MoEFFN(nn.Module):
         return y.reshape(b, s, d)
 
 
+class _Kernel(nn.Module):
+    """Declares a Dense-compatible ``kernel`` param WITHOUT the matmul —
+    the injection seam for externally-computed linear layers (the
+    overlapped FSDP MLP).  Named like the ``nn.Dense`` it replaces, the
+    param path (``block_i/wi/kernel``) and init (lecun_normal, same rng
+    fold — flax folds by path) are IDENTICAL to the dense twin, so
+    checkpoints, sharding rules, and parity tests see one param tree
+    regardless of which execution path runs."""
+
+    shape: tuple
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          self.shape)
+
+
 class Block(nn.Module):
     d_model: int
     n_heads: int
@@ -196,6 +213,17 @@ class Block(nn.Module):
     attention_fn: AttentionFn
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE FFN with that many experts
     moe_fn: Optional[Callable] = None
+    # Pluggable dense-FFN execution (the attention_fn pattern applied to
+    # the MLP): ``mlp_fn(params, x) -> y`` with
+    # ``params = {"wi": [d, ff], "wo": [ff, d]}`` kernels (cast to the
+    # compute dtype) and ``x: [b, s, d]`` the post-LN activations;
+    # the residual add stays here.  Param tree is identical to the
+    # built-in wi/wo Dense pair (see _Kernel), so the two paths are
+    # checkpoint/sharding-compatible and parity-testable.  Used by the
+    # overlapped FSDP layer compute
+    # (tpudist.parallel.fsdp.overlap_fsdp_mlp).  Mutually exclusive
+    # with the MoE FFN.
+    mlp_fn: Optional[Callable] = None
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay f32 masters
     rope: bool = False  # rotary q/k position encoding (no learned pos table)
     # Grouped-query attention: project K/V at this many heads (must divide
@@ -269,8 +297,21 @@ class Block(nn.Module):
 
         h = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
         if self.n_experts > 0:
+            if self.mlp_fn is not None:
+                raise ValueError(
+                    "mlp_fn replaces the dense FFN; it cannot compose "
+                    "with the MoE FFN (n_experts > 0)")
             return x + MoEFFN(self.d_model, self.d_ff, self.n_experts,
                               self.moe_fn, dtype=self.dtype, name="moe")(h)
+        if self.mlp_fn is not None:
+            wi = _Kernel((self.d_model, self.d_ff), name="wi")()
+            wo = _Kernel((self.d_ff, self.d_model), name="wo")()
+            # Same mixed-precision contract as the Dense twins: f32
+            # master kernels cast to the compute dtype at apply.
+            y = self.mlp_fn(
+                {"wi": wi.astype(self.dtype), "wo": wo.astype(self.dtype)},
+                h.astype(self.dtype))
+            return x + y
         h = nn.Dense(self.d_ff, use_bias=False, name="wi",
                      dtype=self.dtype)(h)
         h = nn.gelu(h)
@@ -332,6 +373,9 @@ class TransformerLM(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     n_experts: int = 0  # >0: MoE FFN in every block (expert parallelism)
     moe_fn: Optional[Callable] = None
+    # Pluggable dense-FFN execution in every block (see Block.mlp_fn) —
+    # e.g. the overlapped FSDP MLP (parallel/fsdp.py overlap_fsdp_mlp).
+    mlp_fn: Optional[Callable] = None
     # Compute dtype.  bf16 = mixed precision: f32 master params (flax
     # param_dtype default) cast to bf16 at apply, matmuls at bf16 MXU
     # throughput, f32 LayerNorm/softmax/loss — grads land f32 for the
@@ -441,6 +485,7 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, attn,
                 n_experts=self.n_experts, moe_fn=self.moe_fn,
+                mlp_fn=self.mlp_fn,
                 dtype=self.dtype, rope=self.rope,
                 n_kv_heads=self.n_kv_heads, decode=self.decode,
                 max_len=self.max_len, sliding_window=self.sliding_window,
@@ -506,7 +551,12 @@ def create_transformer(
     size-1 dummy batch (not divisible by the mesh's data axis).
     """
     module = TransformerLM(attention_fn=attention_fn, **kwargs)
-    init_kwargs = {k: v for k, v in kwargs.items() if k != "moe_fn"}
+    # Init always runs the dense/unsharded twins: moe_fn would demand a
+    # mesh-divisible dummy batch, mlp_fn a mesh at init time — and
+    # neither changes parameter shapes or paths (_Kernel mirrors the
+    # Dense pair exactly), so params are identical either way.
+    init_kwargs = {k: v for k, v in kwargs.items()
+                   if k not in ("moe_fn", "mlp_fn")}
     init_module = TransformerLM(attention_fn=None, **init_kwargs)
     params = init_module.init(rng, jnp.zeros((1, seq_len), jnp.int32))
     return module, params

@@ -35,7 +35,16 @@ from tpudist.parallel.tensor_parallel import (  # noqa: F401
     make_tp_mlp,
     mlp_param_sharding,
     row_spec,
+    tp_mlp_overlap_shard,
     tp_mlp_shard,
+)
+from tpudist.parallel.overlap import (  # noqa: F401
+    OVERLAP_MODES,
+    OVERLAP_SCOPE,
+    ag_matmul,
+    compat_shard_map,
+    matmul_rs,
+    overlap_mode,
 )
 from tpudist.parallel.pipeline import (  # noqa: F401
     make_pipeline,
@@ -60,6 +69,7 @@ from tpudist.parallel.moe import MoEStats, make_moe, moe_shard  # noqa: F401
 from tpudist.parallel.fsdp import (  # noqa: F401
     fsdp_sharding,
     merge_shardings,
+    overlap_fsdp_mlp,
     state_bytes_per_device,
     zero1_sharding,
 )

@@ -108,6 +108,78 @@ def zero1_sharding(
     return ModelState(params=repl, opt_state=opt)
 
 
+def overlap_fsdp_mlp(
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_DATA,
+    overlap: str | None = None,
+    activation=None,
+):
+    """Overlapped FSDP layer compute for the transformer MLP — the
+    explicit twin of the layout-only path.
+
+    Under :func:`fsdp_sharding` the FFN kernels land ``wi: [d, ff/n]``
+    (column shard — ``ff`` is the largest dim) and ``wo: [ff/n, d]``
+    (row shard), and the XLA partitioner inserts a monolithic all-gather
+    of each before the matmul that consumes it — exposed wire time.
+    This builder returns an ``mlp_fn(params, x) -> y`` for
+    :class:`tpudist.models.transformer.Block`'s injection seam (the
+    ``attention_fn`` pattern: the closure carries its own ``shard_map``)
+    that consumes the SHARDED kernels directly and pipelines the gather
+    into the matmuls chunk-by-chunk over ``lax.ppermute``
+    (:mod:`tpudist.parallel.overlap`): the ``wi`` column gather
+    assembles output columns (bit-exact), the ``wo`` contraction gather
+    accumulates partial products (documented reassociation bound).  No
+    all-gather of either kernel appears in the lowered HLO — the audit
+    (``benchmarks/comm_audit.py`` ``fsdp_overlap_*`` regimes) asserts
+    it structurally.
+
+    ``params``: ``{"wi": [d, ff], "wo": [ff, d]}`` global kernels;
+    ``x: [batch, seq, d]`` with batch sharded over ``axis_name``.
+    Returns ``None`` when the resolved mode is off, so call sites can
+    pass the result straight to ``create_transformer(mlp_fn=...)`` and
+    keep the byte-identical dense path by default.
+
+    ``activation`` defaults to the Block's ``gelu``.
+    """
+    from tpudist.parallel.overlap import (ag_matmul, compat_shard_map,
+                                          overlap_mode)
+
+    mode = overlap_mode(overlap)
+    if mode == "off":
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    act = activation if activation is not None else jax.nn.gelu
+
+    def body(params, x):
+        b_loc, s, d = x.shape
+        t = x.reshape(b_loc * s, d)
+        h = ag_matmul(t, params["wi"], axis_name=axis_name, mode=mode,
+                      gather="rhs")
+        h = act(h)
+        y = ag_matmul(h, params["wo"], axis_name=axis_name, mode=mode,
+                      gather="contract")
+        return y.reshape(b_loc, s, d).astype(x.dtype)
+
+    param_specs = {"wi": P(None, axis_name), "wo": P(axis_name, None)}
+    sharded = compat_shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P(axis_name, None, None)),
+        out_specs=P(axis_name, None, None),
+    )
+
+    def mlp_fn(params, x):
+        return sharded(params, x)
+
+    # Introspection tags (mirrors attention_fn's .window/.supports_gqa
+    # convention): which pipeline this closure runs, for guards/tests.
+    mlp_fn.overlap = mode
+    mlp_fn.axis_name = axis_name
+    return mlp_fn
+
+
 def merge_shardings(primary, fallback):
     """Leaf-wise composition: use ``primary``'s spec unless it is fully
     replicated, else ``fallback``'s — e.g. TP specs where they exist, FSDP

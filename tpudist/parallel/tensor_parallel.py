@@ -76,29 +76,93 @@ def tp_mlp_shard(
     return out + params["b2"]
 
 
+def tp_mlp_overlap_shard(
+    params: dict,
+    x: jax.Array,
+    *,
+    axis_name: str = AXIS_MODEL,
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+    mode: str = "ring",
+) -> jax.Array:
+    """Shard-local collective-matmul MLP body (call inside ``shard_map``)
+    — the overlapped twin of :func:`tp_mlp_shard`.
+
+    Same weight shards (``w1`` column, ``w2`` row), but ``x: [batch/n, d]``
+    arrives BATCH-SHARDED over the model axis and no monolithic
+    collective ever runs: the input gather is pipelined into the first
+    matmul (:func:`tpudist.parallel.overlap.ag_matmul`, chunk transfers
+    overlapping chunk matmuls) and the row-parallel reduction is a
+    pipelined reduce-scatter fused into the second matmul
+    (:func:`tpudist.parallel.overlap.matmul_rs`) — so the output comes
+    back batch-sharded too, and the big exposed ``psum`` of the default
+    body becomes overlapped ppermute wire.  Global values match the
+    default body within the reassociation bound documented in
+    :mod:`tpudist.parallel.overlap` (the gather half is bit-exact; the
+    reduce-scatter reassociates the n-way partial sum).
+    """
+    from tpudist.parallel.overlap import ag_matmul, matmul_rs
+
+    h = ag_matmul(x, params["w1"], axis_name=axis_name, mode=mode,
+                  gather="lhs")
+    h = activation(h + params["b1"])
+    out = matmul_rs(h, params["w2"], axis_name=axis_name, mode=mode)
+    return out + params["b2"]
+
+
 def make_tp_mlp(
     mesh: Mesh,
     *,
     axis_name: str = AXIS_MODEL,
     batch_axis: str | None = None,
     activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+    overlap: str | None = None,
 ):
     """Jitted global-view TP MLP: weights arrive globally shaped, sharded per
-    :func:`mlp_param_sharding`; ``x`` is replicated over the model axis."""
-    body = functools.partial(tp_mlp_shard, axis_name=axis_name,
-                             activation=activation)
+    :func:`mlp_param_sharding`; ``x`` is replicated over the model axis.
+
+    ``overlap`` selects the collective-matmul pipeline
+    (``tpudist.parallel.overlap``): ``None`` defers to the
+    ``TPUDIST_OVERLAP`` env knob (default off), ``"off"`` forces the
+    psum body, ``"ring"``/``"bidir"`` run :func:`tp_mlp_overlap_shard` —
+    batch sharded over the model axis internally, all wire traffic in
+    ppermute chunks pipelined against the matmuls, no monolithic
+    collective.  Global output VALUES match the default body (gather
+    half bit-exact, reduce half within the documented reassociation
+    bound); the output lands batch-sharded over ``axis_name`` instead of
+    replicated.  The overlapped body needs ``batch_axis=None`` (the
+    model axis carries the batch pipeline) and a batch divisible by the
+    axis size.
+    """
+    from tpudist.parallel.overlap import compat_shard_map, overlap_mode
+
+    mode = overlap_mode(overlap)
     param_specs = {
         "w1": column_spec(axis_name),
         "b1": P(axis_name),
         "w2": row_spec(axis_name),
         "b2": P(),
     }
-    sharded = jax.shard_map(
+    if mode != "off":
+        if batch_axis is not None:
+            raise ValueError(
+                "overlapped TP MLP pipelines the batch over the model "
+                "axis; batch_axis must be None")
+        body = functools.partial(tp_mlp_overlap_shard, axis_name=axis_name,
+                                 activation=activation, mode=mode)
+        sharded = compat_shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, P(axis_name, None)),
+            out_specs=P(axis_name, None),
+        )
+        return jax.jit(sharded)
+    body = functools.partial(tp_mlp_shard, axis_name=axis_name,
+                             activation=activation)
+    sharded = compat_shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, P(batch_axis, None)),
         out_specs=P(batch_axis, None),
-        check_vma=False,  # psum output is replicated; skip rep-check noise
     )
     return jax.jit(sharded)
 

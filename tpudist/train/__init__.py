@@ -8,6 +8,7 @@ from tpudist.train.step import (  # noqa: F401
 from tpudist.train.loop import TrainLoopConfig, run_training  # noqa: F401
 from tpudist.train.lm import (  # noqa: F401
     chunk_token_sharding,
+    fsdp_overlap_mlp_fn,
     init_lm_state,
     make_lm_eval_step,
     make_lm_train_step,

@@ -278,6 +278,39 @@ def make_lm_train_step(
     )
 
 
+def fsdp_overlap_mlp_fn(mesh: Mesh, *, axis_name: str = AXIS_DATA,
+                        overlap: str | None = None):
+    """Knob-driven overlapped FSDP layer compute for the LM train step.
+
+    The FSDP path (``state_sharding=fsdp_sharding(mesh, state)``) is a
+    pure layout: the SPMD partitioner all-gathers each FFN kernel whole
+    BEFORE the matmul that consumes it — exposed wire time on the
+    critical path.  This helper resolves the ``TPUDIST_OVERLAP`` knob
+    (``off``/``ring``/``bidir``; ``overlap`` overrides) and returns the
+    pipelined ppermute MLP closure for ``create_transformer(mlp_fn=...)``
+    — or ``None`` when off, keeping the byte-identical default.  Wiring::
+
+        mlp_fn = fsdp_overlap_mlp_fn(mesh)              # knob decides
+        module, params = create_transformer(rng, mlp_fn=mlp_fn, ...)
+        state = init_lm_state(params, tx)
+        sharding = fsdp_sharding(mesh, state)
+        step = make_lm_train_step(module.apply, tx, mesh,
+                                  state_sharding=sharding)
+
+    The step function itself needs no change: the closure carries its
+    own ``shard_map`` whose in-specs MATCH the FSDP layout of the FFN
+    kernels, so they stream into the ring sharded — no monolithic
+    all-gather is ever emitted for them (``benchmarks/comm_audit.py``'s
+    ``fsdp_overlap_*`` regimes assert it from optimized HLO).  Numerics:
+    the column gather is bit-exact; the contraction gather reassociates
+    (bound documented in :mod:`tpudist.parallel.overlap`; tests pin the
+    end-to-end step drift).
+    """
+    from tpudist.parallel.fsdp import overlap_fsdp_mlp
+
+    return overlap_fsdp_mlp(mesh, axis_name=axis_name, overlap=overlap)
+
+
 def chunk_token_sharding(mesh: Mesh) -> NamedSharding:
     """``[K, batch, seq]`` token windows: iteration axis replicated, the
     rest sharded like :func:`token_sharding`."""

@@ -101,6 +101,9 @@ ENV_VARS = {
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
     "TPUDIST_TELEMETRY_RING": "in-memory telemetry ring size (records)",
+    # parallel execution strategy
+    "TPUDIST_OVERLAP":
+        "collective-matmul overlap mode: off|ring|bidir (default off)",
     # caches / tuned constants
     "TPUDIST_COMPILATION_CACHE": "persistent XLA compile cache dir (off = disable)",
     "TPUDIST_CACHE": "native data-loader build cache base dir",

@@ -76,6 +76,12 @@ def shape_bytes(shape_str: str) -> int:
     return total
 
 
+#: jax named_scope tag that marks a collective as part of a hand-built
+#: overlap pipeline (tpudist.parallel.overlap emits every ppermute hop
+#: under it; jvp/transpose ops inherit the scope in their op_name).
+OVERLAP_SCOPE = "tpudist_overlap"
+
+
 @dataclasses.dataclass
 class CollectiveOp:
     """One collective instruction in the optimized HLO."""
@@ -88,6 +94,11 @@ class CollectiveOp:
     groups: str          # replica_groups= / source_target_pairs= text, if any
     shape: str           # the payload shape text
     op_name: str = ""    # jax op_name metadata (trace provenance)
+    # Exposed-vs-overlapped classification (see classify_overlap):
+    # True when the wire time is structurally hidden under compute —
+    # an async start/done pair with substantive instructions between
+    # the halves, or a ppermute-pipeline hop (OVERLAP_SCOPE-tagged).
+    overlapped: bool = False
 
 
 # instruction line:   %name = SHAPE opcode(OPERANDS), attr=..., ...
@@ -111,20 +122,49 @@ _GROUPS_RE = re.compile(
 )
 
 
+#: Opcodes that do no real work — async (start, done) pairs separated
+#: only by these are NOT overlapped (nothing runs under the transfer).
+_BOOKKEEPING_OPS = frozenset((
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "copy", "after-all", "partition-id", "replica-id",
+))
+
+# candidate operand tokens inside an instruction's (...) argument list —
+# matched against the pending-start table (dtype/shape tokens like
+# ``f32`` can never collide with instruction names registered there)
+_OPERAND_TOKEN_RE = re.compile(r"[\w.-]+")
+
+
 def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
     """Extract every collective instruction from HLO text, tagging each
     with whether it executes inside a ``while`` loop (a ``lax.scan`` /
-    ``while_loop`` body).
+    ``while_loop`` body) and whether it is structurally OVERLAPPED with
+    compute.
 
     Loop residence is decided two ways, OR-ed: the jax ``op_name``
     provenance metadata contains a ``/while/`` frame (robust across XLA's
     computation outlining), or the instruction's computation is reachable
     from a ``while`` instruction's body in the call graph.
+
+    Overlap is decided two ways, OR-ed (see :func:`overlap_split`):
+
+    - async form: a ``*-start`` whose matching ``*-done`` has at least
+      one substantive instruction (not in ``_BOOKKEEPING_OPS``) between
+      the halves — XLA committed real work under the transfer;
+    - pipeline form: the ``op_name`` provenance carries the
+      :data:`OVERLAP_SCOPE` tag — a hand-built ppermute-pipeline hop
+      (``tpudist.parallel.overlap`` emits every hop under that scope;
+      jvp/transpose ops inherit it), whose chunk transfer runs against
+      the neighboring chunk's matmul by construction.
     """
     ops: List[CollectiveOp] = []
     current_comp = "<module>"
     while_bodies: List[str] = []
     calls: Dict[str, List[str]] = {}
+    # async pairing state, per enclosing computation: instruction name of
+    # a pending -start -> (its CollectiveOp, substantive-op count at start)
+    pending: Dict[str, tuple] = {}
+    substantive = 0
 
     for line in hlo_text.splitlines():
         stripped = line.strip()
@@ -132,11 +172,23 @@ def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
         if m and not stripped.startswith(("//", "#")) and "=" not in \
                 stripped.split("(")[0]:
             current_comp = m.group(1)
+            pending.clear()
+            substantive = 0
             continue
         im = _INSTR_RE.match(line)
         if not im:
             continue
         name, shape, opcode = im.groups()
+        if opcode.endswith("-done"):
+            for tok in _OPERAND_TOKEN_RE.findall(line[im.end():]):
+                started = pending.pop(tok, None)
+                if started is not None:
+                    op0, count0 = started
+                    op0.overlapped = substantive > count0
+                    break
+        elif not opcode.endswith("-start") and \
+                opcode not in _BOOKKEEPING_OPS:
+            substantive += 1
         # Call-graph edges for loop-reachability.
         for cm in _CALLED_RE.finditer(line):
             calls.setdefault(current_comp, []).append(cm.group(1))
@@ -165,18 +217,19 @@ def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
             nbytes = shape_bytes(shape)
         gm = _GROUPS_RE.search(line)
         om = _OPNAME_RE.search(line)
-        ops.append(
-            CollectiveOp(
-                kind=base,
-                name=name,
-                bytes=nbytes,
-                computation=current_comp,
-                in_loop=False,  # resolved below
-                groups=gm.group(0) if gm else "",
-                shape=shape,
-                op_name=om.group(1) if om else "",
-            )
+        op = CollectiveOp(
+            kind=base,
+            name=name,
+            bytes=nbytes,
+            computation=current_comp,
+            in_loop=False,  # resolved below
+            groups=gm.group(0) if gm else "",
+            shape=shape,
+            op_name=om.group(1) if om else "",
         )
+        if opcode.endswith("-start"):
+            pending[name] = (op, substantive)
+        ops.append(op)
 
     # Transitive closure: computations reachable from any while body are
     # loop-resident (a scan body may call fusions/conditionals that hold
@@ -191,6 +244,8 @@ def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
         frontier.extend(calls.get(c, []))
     for op in ops:
         op.in_loop = (op.computation in looped) or ("/while/" in op.op_name)
+        if OVERLAP_SCOPE in op.op_name:
+            op.overlapped = True
     return ops
 
 
@@ -233,8 +288,35 @@ def profile(ops: Sequence[CollectiveOp]) -> Dict[str, dict]:
             row["bytes_in_loop"] += op.bytes
         row["instructions"].append(
             {"name": op.name, "bytes": op.bytes, "in_loop": op.in_loop,
-             "shape": op.shape, "op_name": op.op_name}
+             "overlapped": op.overlapped, "shape": op.shape,
+             "op_name": op.op_name}
         )
+    return out
+
+
+def overlap_split(ops: Sequence[CollectiveOp]) -> Dict[str, object]:
+    """Exposed-vs-overlapped accounting over a collective list.
+
+    *Overlapped* = structurally proven hidden under compute (async
+    start/done with substantive instructions between the halves, or an
+    :data:`OVERLAP_SCOPE`-tagged ppermute-pipeline hop — see
+    :func:`parse_collectives`).  Everything else is *exposed*: wire time
+    the step serializes on.  This is deliberately conservative — a sync
+    collective the TPU scheduler happens to hide still counts exposed,
+    so a drop in ``exposed_bytes`` between regimes is real structure,
+    not scheduler luck.  Returns totals plus a per-kind breakdown.
+    """
+    out = {"exposed_bytes": 0, "overlapped_bytes": 0,
+           "exposed_count": 0, "overlapped_count": 0,
+           "by_kind": {}}
+    for op in ops:
+        kind = out["by_kind"].setdefault(
+            op.kind, {"exposed_bytes": 0, "overlapped_bytes": 0,
+                      "exposed_count": 0, "overlapped_count": 0})
+        side = "overlapped" if op.overlapped else "exposed"
+        for row in (out, kind):
+            row[f"{side}_bytes"] += op.bytes
+            row[f"{side}_count"] += 1
     return out
 
 
