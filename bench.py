@@ -628,6 +628,35 @@ def numerics_gate(interpret: bool = False, quick: bool = False) -> dict:
     return report
 
 
+def same_window_pair(results: dict, measured_now, key: str, fp32_key: str,
+                     bf16_key: str, field: str = "step_ms",
+                     invert: bool = False) -> None:
+    """Pair two rows measured back-to-back in THIS invocation (one
+    tunnel window), so BENCH_EXTENDED never invites a cross-window
+    fp32-vs-bf16 wall comparison (r5 verdict Weak #3: the decode
+    artifact showed bf16 1.7x 'slower' purely from window drift).
+    When only one side was measured now, the pair is explicitly
+    voided rather than silently stale.  Module-level (not a main()
+    closure) so the voiding/pairing rules are unit-testable."""
+    if fp32_key in measured_now and bf16_key in measured_now:
+        a, b = results[fp32_key], results[bf16_key]
+        va, vb = a.get(field), b.get(field)
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
+                and va and vb:
+            speed = (vb / va) if invert else (va / vb)
+            results[key] = {
+                "metric": key, "unit": a.get("unit"),
+                f"{field}_fp32": va, f"{field}_bf16": vb,
+                "bf16_speedup": round(speed, 3),
+                "note": "fp32/bf16 measured back-to-back in one "
+                        "session — the only wall pair safe to compare",
+            }
+            return
+    results[key] = {
+        "error": "not a same-window pair: both precisions were not "
+                 "measured in this invocation"}
+
+
 def _with_watchdog(fn, timeout_s: float, label: str):
     """Run ``fn()`` in a daemon thread with a wall-clock bound.
 
@@ -876,31 +905,9 @@ def main() -> None:
     # long_context fp32 wedged at 600s and the d1024 row never executed).
     # (Dense/MFU still route seq 2048 through the flash kernel when the
     # gate certified it — the gate-timeout branch above reroutes them.)
-    def same_window_pair(key, fp32_key, bf16_key, field="step_ms",
-                         invert=False):
-        """Pair two rows measured back-to-back in THIS invocation (one
-        tunnel window), so BENCH_EXTENDED never invites a cross-window
-        fp32-vs-bf16 wall comparison (r5 verdict Weak #3: the decode
-        artifact showed bf16 1.7x 'slower' purely from window drift).
-        When only one side was measured now, the pair is explicitly
-        voided rather than silently stale."""
-        if fp32_key in measured_now and bf16_key in measured_now:
-            a, b = results[fp32_key], results[bf16_key]
-            va, vb = a.get(field), b.get(field)
-            if isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
-                    and va and vb:
-                speed = (vb / va) if invert else (va / vb)
-                results[key] = {
-                    "metric": key, "unit": a.get("unit"),
-                    f"{field}_fp32": va, f"{field}_bf16": vb,
-                    "bf16_speedup": round(speed, 3),
-                    "note": "fp32/bf16 measured back-to-back in one "
-                            "session — the only wall pair safe to compare",
-                }
-                return
-        results[key] = {
-            "error": "not a same-window pair: both precisions were not "
-                     "measured in this invocation"}
+    def pair(key, fp32_key, bf16_key, **kw):
+        same_window_pair(results, measured_now, key, fp32_key, bf16_key,
+                         **kw)
 
     for precision in ("fp32", "bf16"):
         if not sec("dense"):
@@ -911,8 +918,8 @@ def main() -> None:
                 name=f"dense_{p}", batch=8, seq_len=2048, d_model=512,
                 n_layers=4, n_heads=8, d_ff=2048, precision=p))
     if sec("dense"):
-        same_window_pair("lm_dense_same_window_pair",
-                         "lm_dense_fp32", "lm_dense_bf16")
+        pair("lm_dense_same_window_pair",
+             "lm_dense_fp32", "lm_dense_bf16")
         ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
     # d_head-128 twin rungs (r5 verdict next #1): same model FLOPs as
@@ -1009,9 +1016,9 @@ def main() -> None:
         run_section("lm_decode_bf16",
                     lambda: bench_decode(precision="bf16"))
         # decode throughput: HIGHER is better, so the speedup inverts
-        same_window_pair("lm_decode_same_window_pair",
-                         "lm_decode", "lm_decode_bf16",
-                         field="value", invert=True)
+        pair("lm_decode_same_window_pair",
+             "lm_decode", "lm_decode_bf16",
+             field="value", invert=True)
         ext_path.write_text(json.dumps(results, indent=2) + "\n")
 
     # Long-context LM config (BASELINE.md's measured row): flash-attention
@@ -1031,6 +1038,12 @@ def main() -> None:
                 name=f"long_context_{p}", batch=4, seq_len=8192,
                 d_model=256, n_layers=4, n_heads=4, d_ff=1024,
                 precision=p))
+    if sec("long"):
+        # the remaining fp32/bf16 family without a same-window pair —
+        # the flash-path rows drift across tunnel windows at least as
+        # much as the dense ones did (r5 verdict Weak #3)
+        pair("lm_long_context_same_window_pair",
+             "lm_long_context_fp32", "lm_long_context_bf16")
 
     ext_path.write_text(json.dumps(results, indent=2) + "\n")
 

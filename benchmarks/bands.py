@@ -19,6 +19,17 @@ Each invocation APPENDS a session to ``BANDS_r{NN}.json`` (NN = the
 round being built, ``benchmarks/_round.py``) and re-pools all sessions
 per row (median + [min, max] over every sample) — a later healthy
 tunnel window adds evidence instead of overwriting it.
+
+Cross-round carry-forward (VERDICT #8: each round used to restart its
+bands from zero samples, so early-round rows were narrated off 3-sample
+bands while 9 perfectly valid samples sat in the previous round's
+artifact): sessions from the prior round's artifact are imported into
+the new round IF their ``code_hash`` — a digest of the measured code
+paths (bench.py, models/ops/train/flops) — matches the current tree, so
+a kernel or step-function change quietly invalidates old samples
+instead of polluting the pool.  Carried sessions keep a ``carried_from``
+marker and every pooled row lists per-session provenance, so a reader
+can always tell which samples are fresh and which rode in.
 """
 
 from __future__ import annotations
@@ -33,6 +44,55 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 import bench  # noqa: E402
+
+
+def measurement_code_hash() -> str:
+    """Digest of the code that produces band samples: a change anywhere
+    in the measured paths (harness, model/kernel code, the train step,
+    the FLOPs accounting) invalidates prior-round samples for pooling.
+    Deliberately coarse — a one-line comment edit also rotates the hash;
+    false invalidation costs a few re-measured samples, false REUSE
+    costs a silently wrong band."""
+    import hashlib
+
+    h = hashlib.sha256()
+    files = [REPO / "bench.py", REPO / "tpudist" / "utils" / "flops.py"]
+    for sub in ("models", "ops", "train"):
+        files += sorted((REPO / "tpudist" / sub).glob("*.py"))
+    for f in files:
+        if f.exists():
+            h.update(f.name.encode())
+            h.update(f.read_bytes())
+    return h.hexdigest()[:12]
+
+
+def carry_forward(artifact: dict, prior_path: Path, code_hash: str) -> dict:
+    """Import the prior round's sessions whose ``code_hash`` matches the
+    current tree (module doc).  Already-carried sessions keep their
+    ORIGINAL provenance marker, so a chain of unchanged rounds stays
+    attributed to the round that measured it.  Returns a summary dict
+    (stored in the artifact so exclusions are visible, not silent)."""
+    info = {"from": prior_path.name, "carried": 0, "excluded_stale": 0}
+    try:
+        prior = json.loads(prior_path.read_text())
+        sessions = prior["sessions"]
+    except Exception as e:
+        info["error"] = f"unreadable prior artifact: {e!r}"
+        return info
+    have = {(s.get("carried_from"), s.get("label"))
+            for s in artifact["sessions"]}
+    for s in sessions:
+        if s.get("code_hash") != code_hash:
+            # stale code version (or a pre-carry-forward artifact with
+            # no hash at all): its samples measured different code
+            info["excluded_stale"] += 1
+            continue
+        origin = s.get("carried_from") or prior_path.name
+        if (origin, s.get("label")) in have:
+            continue  # re-invocation: already carried
+        artifact["sessions"].append({**s, "carried_from": origin})
+        info["carried"] += 1
+    return info
 
 
 def _band(values):
@@ -85,12 +145,21 @@ def pool(sessions) -> dict:
                 continue
             slot = merged.setdefault(
                 name, {"statistic": row.get("statistic"),
-                       "config": row.get("config"), "samples": {}})
+                       "config": row.get("config"), "samples": {},
+                       "provenance": []})
+            # per-row provenance: which session contributed, and whether
+            # its samples were measured THIS round or carried forward
+            prov = {"session": s.get("label"),
+                    "carried_from": s.get("carried_from"),
+                    "device_kind": s.get("device_kind")}
+            if prov not in slot["provenance"]:
+                slot["provenance"].append(prov)
             for key, vals in row.items():
                 if key.endswith("_runs"):
                     slot["samples"].setdefault(key[:-5], []).extend(vals)
     pooled = {
         name: {"statistic": slot["statistic"], "config": slot["config"],
+               "provenance": slot["provenance"],
                **{k: _band(v) for k, v in slot["samples"].items()}}
         for name, slot in merged.items()
     }
@@ -145,6 +214,10 @@ def main(argv=None) -> int:
                                         "decode_bf16")
     p.add_argument("--session", default=None,
                    help="label for this session (default: seq number)")
+    p.add_argument("--carry-from", default="auto",
+                   help="prior-round BANDS artifact to import matching-"
+                        "code sessions from ('auto': BANDS_r{NN-1}; "
+                        "'none': disable)")
     args = p.parse_args(argv)
     want = set(args.configs.split(","))
 
@@ -165,6 +238,19 @@ def main(argv=None) -> int:
     else:
         artifact = {"sessions": [], "pooled": {}}
 
+    code_hash = measurement_code_hash()
+    artifact["code_hash"] = code_hash
+    if args.carry_from != "none":
+        prior_path = (REPO / f"BANDS_r{current_round() - 1:02d}.json"
+                      if args.carry_from == "auto"
+                      else Path(args.carry_from))
+        if (prior_path.exists()
+                and prior_path.resolve() != out_path.resolve()):
+            artifact["carry_forward"] = carry_forward(
+                artifact, prior_path, code_hash)
+            print(json.dumps({"carry_forward":
+                              artifact["carry_forward"]}), flush=True)
+
     def write_artifact():
         # atomic: a kill mid-write must not truncate the accumulated file
         tmp = out_path.with_suffix(".tmp")
@@ -173,9 +259,10 @@ def main(argv=None) -> int:
 
     import jax
 
-    session = {"label": args.session or f"s{len(artifact['sessions']) + 1}",
+    fresh = [s for s in artifact["sessions"] if not s.get("carried_from")]
+    session = {"label": args.session or f"s{len(fresh) + 1}",
                "device_kind": jax.devices()[0].device_kind,
-               "repeats": args.repeats, "rows": {}}
+               "repeats": args.repeats, "code_hash": code_hash, "rows": {}}
     artifact["sessions"].append(session)
 
     def run(name, fn):
@@ -243,6 +330,9 @@ def main(argv=None) -> int:
 
     run("decode", decode)
     run("decode_bf16", lambda: decode(precision="bf16"))
+    # re-pool unconditionally: carried-forward sessions must reach the
+    # pooled bands even when this invocation ran zero configs
+    artifact["pooled"] = pool(artifact["sessions"])
     write_artifact()  # even a zero-row session leaves a valid artifact
     return 0
 

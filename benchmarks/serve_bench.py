@@ -20,7 +20,15 @@ the paper-facing serving questions need:
   how token-block fusion moves throughput and overhead;
 - **batch occupancy** — the utilization gauge continuous batching exists
   to raise (sequential serving pins it at 1/num_slots);
-- **backpressure** — rejected counts once the bounded queue overflows.
+- **backpressure** — rejected counts once the bounded queue overflows;
+- **the paged-KV capacity rung** — dense arena at S slots vs paged block
+  pool at 4S slots holding the SAME pool bytes, under high-churn
+  mixed-length load: the decoupling of slot count from ``max_len`` is
+  the whole point of the paged cache (CPU smoke proxies "equal HBM
+  bytes-resident" as equal block-pool bytes);
+- **the int8-KV sweep** — native vs int8 KV storage at the same
+  geometry/load: resident bytes-per-position ratio and throughput, the
+  bytes/token lever for bandwidth-bound decode.
 
 One warmup request absorbs XLA compilation before any timed rung, so
 rows measure the steady engine, not the first dispatch.  Artifact:
@@ -126,6 +134,8 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
         "mean_tokens_per_request":
             round(statistics.mean([len(h.tokens) for h in handles]), 1)
             if handles else None,
+        # KV residency accounting (paged: block pool; dense: the arena)
+        "kv": server.stats()["kv"],
     }
 
 
@@ -153,6 +163,21 @@ def main(argv=None) -> int:
     p.add_argument("--blocks", default=None,
                    help="decode block sizes for the sweep (comma list; "
                         "smoke default 1,4 — full default 1,4,8,16)")
+    p.add_argument("--paged", action="store_true",
+                   help="run the offered-load rungs and block sweep on a "
+                        "paged-KV server (block pool + block tables)")
+    p.add_argument("--kv-dtype", choices=("native", "int8"), default="native",
+                   help="KV storage dtype for --paged rungs (int8 = "
+                        "quantized blocks with per-block scales)")
+    p.add_argument("--kv-block", type=int, default=None,
+                   help="tokens per KV block (default 4 smoke / 16 full; "
+                        "must divide max_len)")
+    p.add_argument("--kv-blocks", type=int, default=None,
+                   help="pool size in blocks (default: dense-equivalent "
+                        "bytes for the configured slot count)")
+    p.add_argument("--prefix-cache", type=int, default=None,
+                   help="shared-prefix LRU cache bound in blocks "
+                        "(default: pool size / 4 when paged)")
     p.add_argument("--seed", type=int, default=0)
     try:
         from benchmarks._round import current_round
@@ -181,6 +206,7 @@ def main(argv=None) -> int:
     block = args.block or 8
     blocks = [int(b) for b in
               (args.blocks or ("1,4" if smoke else "1,4,8,16")).split(",")]
+    kv_block = args.kv_block or (4 if smoke else 16)
 
     import tempfile
 
@@ -202,12 +228,23 @@ def main(argv=None) -> int:
     # the longest prompt makes the full regime exercise chunked prefill
     pad = plens[1] if smoke else min(plens[1], 32)
 
-    def make_server(decode_block):
+    def make_server(decode_block, *, n_slots=None, paged=False,
+                    kv_blocks=None, kv_int8=False, prefix_cache=None,
+                    queue_limit=None):
+        n_slots = n_slots or slots
+        if paged and prefix_cache is None:
+            prefix_cache = args.prefix_cache
+            if prefix_cache is None:
+                pool = kv_blocks or n_slots * (max_len // kv_block)
+                prefix_cache = pool // 4
         srv = InferenceServer(
             module, params,
-            ServeConfig(num_slots=slots, queue_limit=queue,
+            ServeConfig(num_slots=n_slots, queue_limit=queue_limit or queue,
                         prefill_pad=pad, max_new=mnews[1],
-                        decode_block=decode_block),
+                        decode_block=decode_block,
+                        paged=paged, kv_block=kv_block, kv_blocks=kv_blocks,
+                        kv_int8=kv_int8,
+                        prefix_cache_blocks=prefix_cache or 0),
             install_signal_handler=False)
         srv.start()
         # warmup: absorb the insert/prefill/decode compiles before any
@@ -223,7 +260,9 @@ def main(argv=None) -> int:
             b *= 2
         return srv
 
-    server = make_server(block)
+    main_paged = dict(paged=args.paged, kv_blocks=args.kv_blocks,
+                      kv_int8=args.kv_dtype == "int8")
+    server = make_server(block, **main_paged)
     rows = []
     for i, rate in enumerate(rates):
         row = run_rate(server, rate_rps=rate, n_requests=requests,
@@ -240,7 +279,7 @@ def main(argv=None) -> int:
     # isolating what token-block fusion does to throughput and overhead
     sweep = []
     for b in blocks:
-        srv = make_server(b)
+        srv = make_server(b, **main_paged)
         row = run_rate(srv, rate_rps=1e9, n_requests=requests,
                        vocab=args.vocab, prompt_lens=plens, max_news=mnews,
                        seed=args.seed)
@@ -250,7 +289,71 @@ def main(argv=None) -> int:
         sweep.append(entry)
         print(json.dumps(entry), flush=True)
 
+    # The embedded serving report must describe the CONFIGURED regime:
+    # finish (and merge) the main stream NOW, before the always-on
+    # capacity and dtype sweeps — their servers run other regimes (the
+    # dtype sweep's int8 arm starts last and its serve_kv_config would
+    # win), which would leave the artifact quoting a composite no run
+    # produced.  The sweeps stream into a side directory whose report is
+    # discarded; their rows embed their own kv/stats snapshots.
     report = telemetry.finish() or {}
+    telemetry.start(Path(tele_dir) / "sweeps")
+
+    # -- paged-KV capacity rung: the tentpole's headline comparison --------
+    # Dense arena at S slots vs paged pool at 4S slots holding the SAME
+    # bytes (pool = S dense arenas' worth of blocks), both under a
+    # high-churn mixed-length burst (3x the rung's request count so slots
+    # churn through admissions).  The dense arm CANNOT hold more than S
+    # concurrent sequences at this byte budget; the paged arm packs by
+    # actual footprint — peak_occupied_slots is the measured claim.
+    cap_requests = requests * 3
+    dense_equiv_blocks = slots * (max_len // kv_block)
+    capacity = {}
+    for arm, kw in (
+            ("dense", dict(n_slots=slots)),
+            ("paged_4x", dict(n_slots=4 * slots, paged=True,
+                              kv_blocks=dense_equiv_blocks,
+                              prefix_cache=0))):
+        srv = make_server(block, queue_limit=max(queue, cap_requests),
+                          **kw)
+        row = run_rate(srv, rate_rps=1e9, n_requests=cap_requests,
+                       vocab=args.vocab, prompt_lens=plens, max_news=mnews,
+                       seed=args.seed + 17)
+        capacity[arm] = {"slots": kw["n_slots"], **row}
+        srv.close()
+        print(json.dumps({f"capacity_{arm}": capacity[arm]}), flush=True)
+    capacity["slots_ratio"] = (capacity["paged_4x"]["slots"]
+                               / capacity["dense"]["slots"])
+    capacity["pool_bytes_dense"] = capacity["dense"]["kv"]["pool_bytes"]
+    capacity["pool_bytes_paged"] = capacity["paged_4x"]["kv"]["pool_bytes"]
+    capacity["equal_pool_bytes"] = (capacity["pool_bytes_dense"]
+                                    == capacity["pool_bytes_paged"])
+    capacity["peak_concurrent_dense"] = \
+        capacity["dense"]["kv"]["peak_occupied_slots"]
+    capacity["peak_concurrent_paged"] = \
+        capacity["paged_4x"]["kv"]["peak_occupied_slots"]
+
+    # -- int8-KV sweep: bytes/position and throughput, native vs int8 ------
+    kv_sweep = []
+    for dtype in ("native", "int8"):
+        srv = make_server(block, paged=True, kv_int8=dtype == "int8",
+                          prefix_cache=0)
+        row = run_rate(srv, rate_rps=1e9, n_requests=requests,
+                       vocab=args.vocab, prompt_lens=plens, max_news=mnews,
+                       seed=args.seed)
+        kv_sweep.append({"kv_dtype": dtype, **row})
+        srv.close()
+        print(json.dumps({f"kv_{dtype}": kv_sweep[-1]["kv"]}), flush=True)
+    ratio = (kv_sweep[0]["kv"]["bytes_per_pos"]
+             / kv_sweep[1]["kv"]["bytes_per_pos"])
+    kv_dtype_sweep = {"rows": kv_sweep,
+                      "bytes_per_pos_native": kv_sweep[0]["kv"][
+                          "bytes_per_pos"],
+                      "bytes_per_pos_int8": kv_sweep[1]["kv"][
+                          "bytes_per_pos"],
+                      "native_over_int8_bytes": round(ratio, 3)}
+
+    telemetry.finish(write_report=False)
     artifact = {
         "regime": ("cpu-smoke" if smoke else
                    jax.devices()[0].device_kind),
@@ -260,9 +363,13 @@ def main(argv=None) -> int:
             "max_len": max_len, "prompt_lens": list(plens),
             "max_news": list(mnews), "decode_block": block,
             "blocks_sweep": blocks,
+            "paged": args.paged, "kv_dtype": args.kv_dtype,
+            "kv_block": kv_block,
         },
         "rows": rows,
         "block_sweep": sweep,
+        "paged_capacity": capacity,
+        "kv_dtype_sweep": kv_dtype_sweep,
         "server_stats": stats,
         "serving_report": report.get("serving"),
     }

@@ -85,6 +85,24 @@ class TestDecodeConsistency:
         with pytest.raises(ValueError, match="max_len"):
             generate(module, params, _tokens(1, 30), max_new=10)
 
+    def test_eager_decode_step_raises_cache_full(self):
+        """The silent-KV-overflow fix: an EAGER decode step asked to
+        write past ``max_len`` raises CacheFullError instead of clamping
+        the write onto the last position and attending over garbage
+        (the docs used to shrug this off as 'silently misbehaves')."""
+        from tpudist.models.generate import CacheFullError, make_decode_step
+
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, **{**CFG, "max_len": 8})
+        init_cache, step = make_decode_step(module, params)
+        cache = init_cache(1)
+        tok = _tokens(1, 1)
+        for _ in range(8):  # fills positions 0..7 — the whole cache
+            cache, logits = step(cache, tok)
+        assert logits.shape == (1, CFG["vocab"])
+        with pytest.raises(CacheFullError, match="max_len"):
+            step(cache, tok)
+
 
 class TestGeneration:
     def _train_chain(self, devices, rope, iters=250):

@@ -24,14 +24,40 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpudist.models.paged import PagedKV, PagedKVConfig, _Paged, strip_kv
+
+
+class CacheFullError(RuntimeError):
+    """A decode step was asked to write past ``module.max_len`` — the KV
+    cache is full.  Raised by the eager :func:`make_decode_step` path
+    (inside a traced program the cursor is a tracer and the caller owns
+    the budget: ``generate``/``decode_logits`` pre-validate, the serving
+    engine finishes the slot with reason ``"cache_full"``)."""
+
+
+def _cache_cursor(cache):
+    """The decode cache's write cursor (any per-layer ``idx`` leaf), or
+    ``None`` when the pytree carries no recognizable cursor."""
+    if not isinstance(cache, dict):
+        return None
+    for val in cache.values():
+        if isinstance(val, dict) and "idx" in val:
+            return val["idx"]
+    return None
+
 
 def make_decode_step(module, params):
     """Return ``(init_cache, step)``: ``init_cache(batch)`` builds a fresh
     all-zeros KV cache, ``step(cache, tok[b,1]) -> (cache, logits[b,vocab])``
     is the compiled single-token forward.
 
-    The cache covers ``module.max_len`` positions; exceeding it silently
-    attends over garbage — ``generate``/``decode_logits`` guard the budget.
+    The cache covers ``module.max_len`` positions.  An EAGER call that
+    would write past the end raises :class:`CacheFullError` instead of
+    silently clamping the write onto the last position and attending
+    over garbage; inside a traced program the cursor is a tracer, so
+    the caller owns the budget (``generate``/``decode_logits`` validate
+    up front, the serving engine finishes overflowing slots with reason
+    ``"cache_full"``).
     """
     # The sharded MoE closure (if any) cannot split a single decode token
     # over its batch axis; the dense reference is numerically identical
@@ -39,6 +65,13 @@ def make_decode_step(module, params):
     dec = module.clone(decode=True, moe_fn=None)
 
     def step(cache, tok):
+        cur = _cache_cursor(cache)
+        if cur is not None and not isinstance(cur, jax.core.Tracer):
+            if int(jnp.max(cur)) + tok.shape[-1] > module.max_len:
+                raise CacheFullError(
+                    f"KV cache full: cursor {int(jnp.max(cur))} + "
+                    f"{tok.shape[-1]} token(s) exceeds max_len "
+                    f"{module.max_len}")
         logits, mut = dec.apply(
             {"params": params["params"], "cache": cache},
             tok, mutable=["cache"],
@@ -272,6 +305,24 @@ class SlotDecode(NamedTuple):
       temperature from ``fold_in(key, count)`` — a deterministic
       per-request stream independent of which slot/batch neighbors the
       request decoded beside, and independent of the block size K.
+
+    **Paged mode** (``make_slot_decode(..., paged=PagedKVConfig(...))``,
+    see :mod:`tpudist.models.paged`): the cache argument threaded
+    through every primitive becomes a :class:`~tpudist.models.paged.
+    PagedKV` (block pool + per-slot block table) and the programs do
+    the gather/scatter indirection in-graph — same four fixed-shape
+    programs, still zero recompilation under churn.  Three signatures
+    widen to carry the host allocator's decisions as DATA (never as
+    shapes): ``insert_batch`` prepends ``tables [S, M]`` (each lane's
+    block-table row — shared prefix blocks first, freshly allocated
+    ones after) and ``poss [S]`` (each lane's starting cursor = its
+    reused prefix length, block-aligned); ``evict`` appends ``free_ids
+    [M]`` (physical blocks whose refcount hit zero, sentinel-padded —
+    shared blocks outlive any one tenant).  ``paged`` holds the
+    geometry/accounting helper; ``peek_logits(state, cache) ->
+    [S, vocab]`` reads every lane's next-token logits WITHOUT advancing
+    state or cache (the int8-accuracy oracle; compiled separately, not
+    one of the four hot programs).
     """
 
     num_slots: int
@@ -283,6 +334,8 @@ class SlotDecode(NamedTuple):
     decode_block: Callable
     evict: Callable
     sample: Callable
+    peek_logits: Optional[Callable] = None
+    paged: Optional["_Paged"] = None
 
 
 def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
@@ -299,10 +352,13 @@ def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
     return jnp.where(temps > 0.0, sampled, greedy)
 
 
-def make_slot_decode(module, params, num_slots: int,
-                     prefill_pad: int) -> SlotDecode:
+def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
+                     paged: Optional[PagedKVConfig] = None) -> SlotDecode:
     """Build the slot-decode primitive set over ``module``/``params`` —
-    see :class:`SlotDecode` for the contract of each callable."""
+    see :class:`SlotDecode` for the contract of each callable.  With
+    ``paged`` set, the cache is a block pool + block tables instead of
+    dense per-slot arenas (:mod:`tpudist.models.paged`); the unquantized
+    paged path is byte-identical to the dense one (tests pin it)."""
     if num_slots < 1:
         raise ValueError(f"num_slots must be >= 1, got {num_slots}")
     if not 1 <= prefill_pad <= module.max_len:
@@ -346,6 +402,123 @@ def make_slot_decode(module, params, num_slots: int,
 
         return lax.scan(body, (cache, jnp.zeros((vocab,), jnp.float32)),
                         jnp.arange(prefill_pad))[0]
+
+    def _decode_scan(state, cache, k):
+        """The K-step fused decode body shared by the dense and paged
+        ``decode_block`` programs: in-graph token feedback, inactive
+        lanes' cache writes undone by the ``active`` select."""
+
+        def body(carry, _):
+            state, cache = carry
+            nc, logits = vstep(cache, state.last_tok[:, None, None])
+
+            def sel(n, o):
+                m = state.active.reshape((-1,) + (1,) * (n.ndim - 1))
+                return jnp.where(m, n, o)
+
+            cache = jax.tree.map(sel, nc, cache)
+            toks = _slot_sample(logits[:, 0], state.keys, state.temps,
+                                state.counts)
+            toks = jnp.where(state.active, toks,
+                             state.last_tok).astype(jnp.int32)
+            inc = state.active.astype(jnp.int32)
+            state = state._replace(last_tok=toks, counts=state.counts + inc,
+                                   pos=state.pos + inc)
+            return (state, cache), toks
+
+        return lax.scan(body, (state, cache), None, length=k)
+
+    if paged is not None:
+        pg = _Paged(init_cache(1), num_slots, paged)
+        meta_template = strip_kv(pg.template)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
+                               dsts, seeds, temps, last):
+            # Each lane teacher-forces its first NON-SHARED chunk on top
+            # of a dense view gathered through its (host-built) table
+            # row: a reused prefix's K/V is already in the pool, so the
+            # lane's cursor starts at poss[j] — prefilled once, mapped
+            # into every slot that shares it.
+            def lane(row, pos0, p, n):
+                meta1 = jax.tree.map(
+                    lambda t: jnp.asarray(pos0, t.dtype), meta_template)
+                return _force_chunk(pg.lane_cache(pkv, row, meta1), p, n)
+
+            lanes, last_logits = jax.vmap(lane)(tables, poss, prompts, clens)
+            keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
+            firsts = _slot_sample(last_logits, keys, temps,
+                                  jnp.zeros(num_slots, jnp.int32))
+            pkv = pg.commit_lanes(pkv, lanes, tables, dsts, poss,
+                                  prefill_pad)
+            state = SlotState(
+                last_tok=state.last_tok.at[dsts].set(
+                    jnp.where(last, firsts, 0)),
+                active=state.active.at[dsts].set(last),
+                pos=state.pos.at[dsts].set(poss + clens),
+                counts=state.counts.at[dsts].set(last.astype(jnp.int32)),
+                temps=state.temps.at[dsts].set(temps),
+                keys=state.keys.at[dsts].set(keys))
+            return state, pkv, firsts
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def prefill_extend_paged(state, pkv, slot, chunk, clen, is_last):
+            row = pkv.table[slot]
+            meta1 = jax.tree.map(lambda full: full[slot], pkv.meta)
+            pos0 = _cache_cursor(meta1)
+            cache, last_logits = _force_chunk(
+                pg.lane_cache(pkv, row, meta1), chunk, clen)
+            pkv = pg.commit_lanes(
+                pkv, jax.tree.map(lambda a: a[None], cache),
+                row[None], jnp.reshape(slot, (1,)), jnp.reshape(pos0, (1,)),
+                prefill_pad)
+            first = _slot_sample(
+                last_logits[None], state.keys[slot][None],
+                state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
+            state = state._replace(
+                pos=state.pos.at[slot].add(clen),
+                active=state.active.at[slot].set(is_last),
+                last_tok=state.last_tok.at[slot].set(
+                    jnp.where(is_last, first, 0)),
+                counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
+            return state, pkv, first
+
+        @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+        def decode_block_paged(state, pkv, k):
+            pos0 = _cache_cursor(pkv.meta)
+            mask = state.active
+            (state, cache), toks = _decode_scan(
+                state, pg.slot_cache(pkv), k)
+            pkv = pg.commit_slots(pkv, cache, pos0, k, mask)
+            return state, pkv, toks
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def evict_paged(state, pkv, slot, free_ids):
+            pkv = pg.release(pkv, slot, free_ids)
+            zero = jnp.zeros((), jnp.int32)
+            state = SlotState(
+                last_tok=state.last_tok.at[slot].set(zero),
+                active=state.active.at[slot].set(False),
+                pos=state.pos.at[slot].set(zero),
+                counts=state.counts.at[slot].set(zero),
+                temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
+                keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
+            return state, pkv
+
+        @jax.jit
+        def peek_logits_paged(state, pkv):
+            _, logits = vstep(pg.slot_cache(pkv),
+                              state.last_tok[:, None, None])
+            return logits[:, 0]
+
+        return SlotDecode(
+            num_slots=num_slots, prefill_pad=prefill_pad,
+            init_state=init_state, init_slots=pg.init,
+            insert_batch=insert_batch_paged,
+            prefill_extend=prefill_extend_paged,
+            decode_block=decode_block_paged, evict=evict_paged,
+            sample=jax.jit(_slot_sample), peek_logits=peek_logits_paged,
+            paged=pg)
 
     # The slot state AND cache are donated in every primitive that threads
     # them: the engine always overwrites both with the result, and without
@@ -396,25 +569,7 @@ def make_slot_decode(module, params, num_slots: int,
 
     @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
     def decode_block(state, cache, k):
-        def body(carry, _):
-            state, cache = carry
-            nc, logits = vstep(cache, state.last_tok[:, None, None])
-
-            def sel(n, o):
-                m = state.active.reshape((-1,) + (1,) * (n.ndim - 1))
-                return jnp.where(m, n, o)
-
-            cache = jax.tree.map(sel, nc, cache)
-            toks = _slot_sample(logits[:, 0], state.keys, state.temps,
-                                state.counts)
-            toks = jnp.where(state.active, toks,
-                             state.last_tok).astype(jnp.int32)
-            inc = state.active.astype(jnp.int32)
-            state = state._replace(last_tok=toks, counts=state.counts + inc,
-                                   pos=state.pos + inc)
-            return (state, cache), toks
-
-        (state, cache), toks = lax.scan(body, (state, cache), None, length=k)
+        (state, cache), toks = _decode_scan(state, cache, k)
         return state, cache, toks
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -433,11 +588,16 @@ def make_slot_decode(module, params, num_slots: int,
             keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
         return state, cache
 
+    @jax.jit
+    def peek_logits(state, cache):
+        _, logits = vstep(cache, state.last_tok[:, None, None])
+        return logits[:, 0]
+
     return SlotDecode(
         num_slots=num_slots, prefill_pad=prefill_pad, init_state=init_state,
         init_slots=init_slots, insert_batch=insert_batch,
         prefill_extend=prefill_extend, decode_block=decode_block,
-        evict=evict, sample=jax.jit(_slot_sample))
+        evict=evict, sample=jax.jit(_slot_sample), peek_logits=peek_logits)
 
 
 def decode_logits(module, params, tokens: jax.Array) -> jax.Array:

@@ -14,6 +14,12 @@ Three layers, device-to-host:
 - :mod:`tpudist.serve.server` — ``InferenceServer``: threaded ingestion,
   streaming token callbacks, SIGTERM graceful drain, telemetry.
 
+``ServeConfig(paged=True)`` swaps the dense per-slot arenas for a paged
+KV cache — block pool + per-slot block tables
+(:mod:`tpudist.models.paged`), host-side block accounting with
+shared-prefix reuse and refcounts (:mod:`tpudist.serve.paged_alloc`),
+optional int8 KV storage — decoupling slot count from ``max_len``.
+
 ``python -m tpudist.serve`` runs a self-contained CPU demo.
 """
 

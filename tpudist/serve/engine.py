@@ -48,8 +48,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tpudist.models.generate import make_slot_decode
+from tpudist.models.paged import PagedKVConfig
+from tpudist.serve.paged_alloc import BlockAllocator
 
-#: ``start_batch`` item: (slot, prompt_1d_int32, temperature, seed, max_new).
+#: ``start_batch`` item: (slot, prompt_1d_int32, temperature, seed, max_new)
+#: plus an optional 6th element — the prompt's prefix hash chain
+#: (:func:`tpudist.serve.paged_alloc.hash_chain`, stamped at submit by the
+#: scheduler) enabling shared-prefix block reuse on the paged engine.
 InsertItem = Tuple[int, np.ndarray, float, int, int]
 
 
@@ -77,12 +82,37 @@ class SlotEngine:
 
     def __init__(self, module, params, *, num_slots: int = 4,
                  prefill_pad: Optional[int] = None,
-                 decode_block: Optional[int] = None):
+                 decode_block: Optional[int] = None,
+                 paged: bool = False, kv_block: int = 16,
+                 kv_blocks: Optional[int] = None, kv_int8: bool = False,
+                 prefix_cache_blocks: int = 0):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         self.module = module
         self.max_len = int(module.max_len)
-        self.fns = make_slot_decode(module, params, num_slots, prefill_pad)
+        self.alloc: Optional[BlockAllocator] = None
+        if paged:
+            kv_block = min(int(kv_block), self.max_len)
+            if self.max_len % kv_block:
+                raise ValueError(
+                    f"kv_block {kv_block} must divide max_len {self.max_len}")
+            if kv_blocks is None:
+                # dense-equivalent capacity: the pool holds exactly what
+                # the dense arena pinned; the win is raising num_slots at
+                # this same byte budget
+                kv_blocks = num_slots * (self.max_len // kv_block)
+            self.paged_cfg: Optional[PagedKVConfig] = PagedKVConfig(
+                num_blocks=int(kv_blocks), block_size=kv_block,
+                quantized=bool(kv_int8))
+            self.fns = make_slot_decode(module, params, num_slots,
+                                        prefill_pad, paged=self.paged_cfg)
+            self.alloc = BlockAllocator(
+                self.paged_cfg.num_blocks, kv_block, self.max_len,
+                prefix_cache_blocks=prefix_cache_blocks)
+        else:
+            self.paged_cfg = None
+            self.fns = make_slot_decode(module, params, num_slots,
+                                        prefill_pad)
         self.num_slots = num_slots
         self.prefill_pad = prefill_pad
         self.block = max(1, int(decode_block if decode_block else 8))
@@ -96,12 +126,25 @@ class SlotEngine:
         #: slot → (full prompt, next chunk offset) for prompts longer
         #: than one prefill chunk (the host-side half of chunked prefill)
         self._prefill_rest: Dict[int, Tuple[np.ndarray, int]] = {}
+        #: high-water mark of concurrently occupied lanes — the paged
+        #: capacity claim (N× slots at equal pool bytes) is only real if
+        #: the lanes actually fill under load; serve_bench records this
+        self.peak_occupied = 0
         # decode hot-path counters (the bench's dispatch/sync overhead
         # split reads these through ``decode_stats``)
         self.n_decode_blocks = 0
         self.n_decode_tokens = 0
         self.t_decode_dispatch_s = 0.0
         self.t_decode_sync_s = 0.0
+        # per-decode-block telemetry gauges must not rebuild the full
+        # kv_stats() dict on the hot path: precompute the constants
+        if self.fns.paged is not None:
+            self._block_bytes = self.fns.paged.block_bytes
+            self._dense_resident_bytes = 0
+        else:
+            self._block_bytes = 0
+            self._dense_resident_bytes = int(
+                num_slots * self.max_len * self._bytes_per_pos())
 
     # -- inspection ---------------------------------------------------------
 
@@ -153,6 +196,68 @@ class SlotEngine:
             "sync_s": self.t_decode_sync_s,
         }
 
+    def _bytes_per_pos(self) -> float:
+        """Resident KV bytes per cached position.  Paged: pool bytes /
+        pool positions (int8 + scales when quantized).  Dense: summed
+        K+V row bytes over the slot cache's layers."""
+        if self.fns.paged is not None:
+            return self.fns.paged.bytes_per_pos
+        total = 0
+        for val in self.cache.values():
+            if isinstance(val, dict) and "k" in val and "v" in val:
+                # leaf [num_slots, 1, n_kv, max_len, dh]
+                _, _, n_kv, _, dh = val["k"].shape
+                total += 2 * n_kv * dh * val["k"].dtype.itemsize
+        return float(total)
+
+    def kv_stats(self) -> Dict[str, object]:
+        """KV residency accounting — the serving report's capacity
+        story.  ``bytes_resident`` is what actually pins HBM: the whole
+        arena for the dense engine (every slot owns ``max_len`` positions
+        whether it uses them or not), tenant-or-cache-held blocks for the
+        paged engine.  ``bytes_per_pos`` is the bytes-per-token lever the
+        int8 path halves-or-better."""
+        bpp = self._bytes_per_pos()
+        if self.alloc is None:
+            total = self.num_slots * self.max_len * bpp
+            return {
+                "paged": False, "quantized": False,
+                "block_size": None, "blocks_total": None,
+                "blocks_in_use": None, "blocks_free": None,
+                "cached_blocks": None, "block_occupancy": None,
+                "pool_bytes": int(total),
+                "bytes_resident": int(total),  # dense pins it all
+                "bytes_per_pos": bpp,
+                "peak_occupied_slots": self.peak_occupied,
+            }
+        pg, al = self.fns.paged, self.alloc
+        return {
+            "paged": True, "quantized": self.paged_cfg.quantized,
+            "block_size": self.paged_cfg.block_size,
+            "blocks_total": al.num_blocks,
+            "blocks_in_use": al.blocks_in_use,
+            "blocks_free": al.free_blocks,
+            "cached_blocks": al.cached_blocks,
+            "block_occupancy": al.blocks_in_use / al.num_blocks,
+            "pool_bytes": pg.pool_bytes,
+            "bytes_resident": al.blocks_in_use * pg.block_bytes,
+            "bytes_per_pos": bpp,
+            "peak_occupied_slots": self.peak_occupied,
+            "prefix_hit_blocks": al.prefix_hit_blocks,
+            "prefix_miss_blocks": al.prefix_miss_blocks,
+            "prefix_hit_tokens": al.prefix_hit_tokens,
+        }
+
+    def kv_gauges(self) -> Tuple[Optional[float], int]:
+        """The two per-decode-block telemetry gauges ``(block_occupancy,
+        bytes_resident)`` — cheap enough for the decode hot loop (two
+        counter reads; :meth:`kv_stats` builds the full dict and walks
+        the cache pytree, which has no place per dispatch)."""
+        if self.alloc is None:
+            return None, self._dense_resident_bytes
+        return (self.alloc.blocks_in_use / self.alloc.num_blocks,
+                self.alloc.blocks_in_use * self._block_bytes)
+
     # -- lifecycle of a request -------------------------------------------
 
     def check_budget(self, prompt_len: int, max_new: int) -> Optional[str]:
@@ -167,7 +272,63 @@ class SlotEngine:
         if prompt_len + max_new > self.max_len:
             return (f"budget_exceeded: prompt {prompt_len} + max_new "
                     f"{max_new} > max_len {self.max_len}")
+        if self.alloc is not None:
+            need = self.alloc.blocks_needed(prompt_len, max_new)
+            if need > self.alloc.num_blocks:
+                # can NEVER be admitted: the whole-footprint reservation
+                # exceeds the pool even when it is empty (transient
+                # exhaustion is not a reject — the request queues and
+                # admission waits for blocks to free)
+                return (f"kv_exhausted: footprint {need} blocks > pool "
+                        f"{self.alloc.num_blocks}")
         return None
+
+    def cache_full_slots(self) -> List[int]:
+        """Decoding slots whose KV cursor reached ``max_len`` with
+        budget still unspent — decoding on would clamp writes onto the
+        last position and attend over garbage (the silent-overflow
+        failure :class:`tpudist.models.generate.CacheFullError` exists
+        for).  Admission's budget rule makes this empty in healthy runs;
+        the server finishes any hit with reason ``"cache_full"`` instead
+        of letting ``decode_block`` corrupt or crash the loop."""
+        return [int(s) for s in np.nonzero(
+            self.decoding & (self.pos >= self.max_len)
+            & (self.counts < self.budget))[0]]
+
+    def can_admit_kv(self, prompt_len: int, max_new: int,
+                     prefix_hashes: Sequence[str] = (), *,
+                     reserve: int = 0) -> bool:
+        """Would the block pool cover this request RIGHT NOW (reused
+        prefix blocks discounted), on top of ``reserve`` blocks already
+        promised to same-batch admissions?  The server's take-from-queue
+        gate on the paged engine; always True on the dense engine, where
+        a free slot IS the whole admission budget."""
+        if self.alloc is None:
+            return True
+        return self.alloc.can_admit(prompt_len, max_new, prefix_hashes,
+                                    reserve=reserve)
+
+    def kv_admission_probe(self, prompt_len: int, max_new: int,
+                           prefix_hashes: Sequence[str] = (), *,
+                           reserve: int = 0, protect: Sequence[int] = ()):
+        """Multi-take admission probe: ``(fresh_blocks, reused_ids)`` if
+        the pool covers this request on top of ``reserve`` fresh blocks
+        and the ``protect``-pinned reuses already promised to earlier
+        same-batch candidates, else ``None``.  Trivially admits on the
+        dense engine (``(0, [])``)."""
+        if self.alloc is None:
+            return 0, []
+        return self.alloc.probe(prompt_len, max_new, prefix_hashes,
+                                reserve=reserve, protect=protect)
+
+    def kv_footprint(self, prompt_len: int, max_new: int,
+                     prefix_hashes: Sequence[str] = ()) -> int:
+        """Fresh blocks this request would reserve right now (0 on the
+        dense engine) — what the server adds to its same-batch reserve
+        after each gate pass."""
+        if self.alloc is None:
+            return 0
+        return self.alloc.footprint(prompt_len, max_new, prefix_hashes)
 
     def start_batch(self, items: Sequence[InsertItem]
                     ) -> Dict[int, Optional[int]]:
@@ -178,7 +339,15 @@ class SlotEngine:
         prompt fit the first chunk (drawn from the post-prompt logits, so
         a ``max_new == 1`` request is complete without any decode), and
         ``slot → None`` for longer prompts, which continue through
-        ``advance_prefill`` chunk by chunk."""
+        ``advance_prefill`` chunk by chunk.
+
+        Paged engine: each item's whole block footprint is reserved here
+        (the allocator's admission-only policy), its prompt's cached
+        prefix blocks are mapped in instead of re-prefilled (the chunk
+        walk starts at the reused length), and the host-built block-table
+        rows ride into the compiled program as data.  Items may carry a
+        6th element — the prompt's prefix hash chain; without it a
+        request simply never shares."""
         if not items:
             return {}
         if len(items) > self.num_slots:
@@ -199,7 +368,9 @@ class SlotEngine:
         # must not leak half-reserved slots
         norm = []
         taken = set()
-        for slot, prompt, temperature, seed, max_new in items:
+        for item in items:
+            slot, prompt, temperature, seed, max_new = item[:5]
+            hashes = tuple(item[5]) if len(item) > 5 else ()
             if self.occupied[slot] or slot in taken:
                 raise ValueError(f"slot {slot} is occupied")
             taken.add(slot)
@@ -207,35 +378,78 @@ class SlotEngine:
             reason = self.check_budget(len(prompt), max_new)
             if reason is not None:
                 raise ValueError(reason)
-            norm.append((int(slot), prompt, temperature, seed, int(max_new)))
-        for j, (slot, prompt, temperature, seed, max_new) in enumerate(norm):
-            clen = min(len(prompt), pad)
-            prompts[j, :clen] = prompt[:clen]
+            norm.append((int(slot), prompt, temperature, seed, int(max_new),
+                         hashes))
+        reused_len = np.zeros(self.num_slots, np.int32)
+        if self.alloc is not None:
+            M = self.max_len // self.paged_cfg.block_size
+            tables = np.full((self.num_slots, M), self.paged_cfg.num_blocks,
+                             np.int32)
+            admitted = []
+            # pin every item's currently-reusable chain for the WHOLE
+            # batch: an earlier admission's LRU eviction must not take a
+            # block a later (gate-approved) item is about to reuse
+            protect: List[int] = []
+            for slot, prompt, _, _, max_new, hashes in norm:
+                protect.extend(
+                    self.alloc.reusable_blocks(len(prompt), hashes))
+            try:
+                for j, (slot, prompt, _, _, max_new, hashes) in \
+                        enumerate(norm):
+                    row, reused = self.alloc.admit(
+                        slot, len(prompt), max_new, hashes,
+                        protect=protect)
+                    admitted.append(slot)
+                    tables[j, :len(row)] = row
+                    reused_len[j] = reused
+            except RuntimeError:
+                # a half-admitted batch must not leak reservations; the
+                # caller gates on can_admit_kv, so this is the defense
+                for slot in admitted:
+                    self.alloc.release(slot)
+                raise
+        for j, (slot, prompt, temperature, seed, max_new, _) in \
+                enumerate(norm):
+            rest = len(prompt) - int(reused_len[j])
+            clen = min(rest, pad)
+            prompts[j, :clen] = prompt[reused_len[j]:reused_len[j] + clen]
             clens[j] = clen
             dsts[j] = slot
             # int32 wrap keeps huge seeds admissible (the stream just
             # derives from the wrapped value)
             seeds[j] = np.uint32(seed & 0xFFFFFFFF).astype(np.int32)
             temps[j] = temperature
-            last[j] = len(prompt) <= pad
-        self.state, self.cache, firsts = self.fns.insert_batch(
-            self.state, self.cache, jnp.asarray(prompts), jnp.asarray(clens),
-            jnp.asarray(dsts), jnp.asarray(seeds), jnp.asarray(temps),
-            jnp.asarray(last))
+            last[j] = rest <= pad
+        if self.alloc is not None:
+            self.state, self.cache, firsts = self.fns.insert_batch(
+                self.state, self.cache, jnp.asarray(tables),
+                jnp.asarray(reused_len), jnp.asarray(prompts),
+                jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
+                jnp.asarray(temps), jnp.asarray(last))
+        else:
+            self.state, self.cache, firsts = self.fns.insert_batch(
+                self.state, self.cache, jnp.asarray(prompts),
+                jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
+                jnp.asarray(temps), jnp.asarray(last))
         firsts_h = np.asarray(firsts) if last.any() else None
         out: Dict[int, Optional[int]] = {}
-        for j, (slot, prompt, temperature, seed, max_new) in enumerate(norm):
+        for j, (slot, prompt, temperature, seed, max_new, _) in \
+                enumerate(norm):
             self.occupied[slot] = True
             self.budget[slot] = max_new
-            self.pos[slot] = clens[j]
+            self.pos[slot] = reused_len[j] + clens[j]
+            if self.alloc is not None:
+                self.alloc.note_progress(slot, int(self.pos[slot]))
             if last[j]:
                 self.decoding[slot] = True
                 self.counts[slot] = 1
                 out[slot] = int(firsts_h[j])
             else:
                 self.counts[slot] = 0
-                self._prefill_rest[slot] = (prompt, pad)
+                self._prefill_rest[slot] = (
+                    prompt, int(reused_len[j]) + clens[j])
                 out[slot] = None
+        self.peak_occupied = max(self.peak_occupied, self.num_occupied)
         return out
 
     def advance_prefill(self) -> Dict[int, int]:
@@ -260,6 +474,10 @@ class SlotEngine:
                 jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
                 jnp.asarray(is_last))
             self.pos[slot] += clen
+            if self.alloc is not None:
+                # prompt blocks now fully written become shareable
+                # prefix-cache entries (LRU-bounded)
+                self.alloc.note_progress(slot, int(self.pos[slot]))
             if is_last:
                 del self._prefill_rest[slot]
                 self.decoding[slot] = True
@@ -292,7 +510,14 @@ class SlotEngine:
             raise RuntimeError("active slot at max_len — admission budget "
                                "violated")
         cap = self.block if max_k is None else max(1, int(max_k))
-        k = _pow2_floor(min(cap, int(remaining.min())))
+        # K is also capped by cache headroom: for correctly-admitted
+        # requests headroom >= remaining always (prompt + max_new <=
+        # max_len), but if the budget rule was bypassed this stops the
+        # block EXACTLY at the cache edge — no write ever clamps onto
+        # max_len-1 — and the server then finishes the slot
+        # "cache_full" (cache_full_slots) instead of decoding garbage.
+        headroom = int((self.max_len - self.pos[dec]).min())
+        k = _pow2_floor(min(cap, int(remaining.min()), headroom))
         t0 = time.perf_counter()
         self.state, self.cache, blocks = self.fns.decode_block(
             self.state, self.cache, k)
@@ -306,8 +531,16 @@ class SlotEngine:
         self.counts[dec] += k
         self.pos[dec] += k
         out = {int(s): [int(t) for t in arr[:, s]] for s in dec}
+        # KV bytes the block's attention streamed: step s of a lane whose
+        # pre-block cursor was p0 attends over p0 + s positions, so the
+        # block reads Σ_lanes (k·p0 + k(k+1)/2) positions × bytes/pos —
+        # the decode bytes/token lever the int8 path halves-or-better.
+        pos0_sum = int((self.pos[dec].astype(np.int64) - k).sum())
+        kv_read = (k * pos0_sum + len(dec) * k * (k + 1) // 2) \
+            * self._bytes_per_pos()
         info = {"k": k, "tokens": k * len(dec),
-                "dispatch_s": t1 - t0, "sync_s": t2 - t1}
+                "dispatch_s": t1 - t0, "sync_s": t2 - t1,
+                "kv_read_bytes": int(kv_read)}
         return info, out
 
     def step(self) -> Dict[int, int]:
@@ -320,11 +553,24 @@ class SlotEngine:
     def evict(self, slot: int) -> None:
         """Free a lane: zero its cache and device state (no K/V leakage
         into the next tenant's garbage window), reset the host shadows,
-        drop any pending prefill chunks."""
+        drop any pending prefill chunks.  Paged: the slot's tenancy is
+        released on the host; only blocks whose refcount hit zero AND
+        that no prefix-cache entry pins are zeroed on device and
+        returned to the free list — a shared prefix block outlives any
+        one tenant."""
         import jax.numpy as jnp
 
-        self.state, self.cache = self.fns.evict(
-            self.state, self.cache, jnp.asarray(slot, jnp.int32))
+        if self.alloc is not None:
+            freed = self.alloc.release(slot)
+            M = self.max_len // self.paged_cfg.block_size
+            free_ids = np.full(M, self.paged_cfg.num_blocks, np.int32)
+            free_ids[:len(freed)] = freed
+            self.state, self.cache = self.fns.evict(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(free_ids))
+        else:
+            self.state, self.cache = self.fns.evict(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32))
         self.occupied[slot] = False
         self.decoding[slot] = False
         self.pos[slot] = 0

@@ -39,15 +39,18 @@ import numpy as np
 
 #: finish reasons a handle can carry (``finish_reason`` is always one of
 #: these once ``done`` is set): completed its token budget, emitted its
-#: stop token, missed its deadline, or was cut off by a non-graceful
-#: server stop.
-FINISH_REASONS = ("length", "eos", "deadline", "shutdown")
+#: stop token, missed its deadline, was cut off by a non-graceful server
+#: stop, or hit a full KV cache with budget unspent (``cache_full`` —
+#: the loud ending the silent-overflow fix installed; admission's budget
+#: rule makes it unreachable unless that rule is bypassed).
+FINISH_REASONS = ("length", "eos", "deadline", "shutdown", "cache_full")
 
 
 class AdmissionError(RuntimeError):
     """A request the scheduler refused; ``reason`` is machine-readable
     (``queue_full``, ``draining``, ``budget_exceeded: ...``,
-    ``empty_prompt``)."""
+    ``empty_prompt``, ``kv_exhausted: ...`` — a paged-KV footprint no
+    empty pool could ever hold)."""
 
     def __init__(self, reason: str):
         super().__init__(f"request rejected: {reason}")
@@ -66,6 +69,9 @@ class Request:
     seed: int = 0  # per-request sampling stream (temperature > 0)
     eos_id: Optional[int] = None  # stop token: finish "eos" on emission
     on_token: Optional[Callable[[int, int], None]] = None  # (token, index)
+    #: prompt prefix hash chain, stamped ONCE at submit (paged engine:
+    #: shared-prefix block reuse keys on it; admission never re-hashes)
+    prefix_hashes: tuple = ()
 
 
 class RequestHandle:
@@ -151,13 +157,18 @@ class Scheduler:
     def __init__(self, *, queue_limit: int,
                  check_budget: Callable[[int, int], Optional[str]],
                  default_max_new: int = 64,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 prefix_hasher: Optional[Callable] = None):
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         self.queue_limit = queue_limit
         self.check_budget = check_budget
         self.default_max_new = default_max_new
         self.default_deadline_s = default_deadline_s
+        #: prompt → prefix hash chain, run once per submit (the paged
+        #: server passes ``paged_alloc.hash_chain`` at its block size;
+        #: None stamps an empty chain — no sharing, no hashing cost)
+        self.prefix_hasher = prefix_hasher
         self._q: "collections.deque[RequestHandle]" = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -182,14 +193,30 @@ class Scheduler:
             deadline = self.default_deadline_s
         else:
             deadline = float(deadline_s) if deadline_s > 0 else None
+        resolved_max_new = (self.default_max_new if max_new is None
+                            else int(max_new))
+        # hashed OUTSIDE the lock (O(plen) work must not serialize
+        # concurrent submitters behind one long prompt), and only when
+        # an advisory peek says the request stands a chance — a rejected
+        # submit must not pay O(plen) hashing it will throw away.  The
+        # peek is racy by design: if the queue drains between here and
+        # the lock, the request admits with an empty chain and simply
+        # doesn't share (prefix reuse is opportunistic).
+        hashes: tuple = ()
+        if (self.prefix_hasher is not None
+                and self._refuse_reason is None
+                and len(self._q) < self.queue_limit
+                and self.check_budget(len(prompt), resolved_max_new) is None):
+            hashes = tuple(self.prefix_hasher(prompt))
         req = Request(
             prompt=prompt,
-            max_new=self.default_max_new if max_new is None else int(max_new),
+            max_new=resolved_max_new,
             temperature=float(temperature),
             deadline_s=deadline,
             seed=0 if seed is None else int(seed),
             eos_id=None if eos_id is None else int(eos_id),
             on_token=on_token,
+            prefix_hashes=hashes,
         )
         with self._lock:
             reason = self._refuse_reason
@@ -208,12 +235,19 @@ class Scheduler:
 
     # -- engine side --------------------------------------------------------
 
-    def take(self, k: int, now: Optional[float] = None
+    def take(self, k: int, now: Optional[float] = None,
+             admit: Optional[Callable[[RequestHandle], bool]] = None
              ) -> List[RequestHandle]:
         """Pop up to ``k`` admissible requests (FIFO).  Requests whose
         deadline already expired in the queue finish as ``"deadline"`` on
         the spot; they are returned too (already ``done``) so the caller
-        can account for them, but they do not consume an admission slot."""
+        can account for them, but they do not consume an admission slot.
+
+        ``admit``: an extra per-request gate (the paged engine's
+        free-block budget).  The FIRST refusal stops the take and the
+        request stays at the queue head — deliberate head-of-line
+        blocking, because skipping past it would starve large-footprint
+        requests forever under steady small-request load."""
         if k <= 0:
             return []
         now = time.monotonic() if now is None else now
@@ -224,8 +258,12 @@ class Scheduler:
                 h = self._q.popleft()
                 if h._expired(now):
                     h._finish("deadline")
-                else:
-                    alive += 1
+                    out.append(h)
+                    continue
+                if admit is not None and not admit(h):
+                    self._q.appendleft(h)  # stays the FIFO head
+                    break
+                alive += 1
                 out.append(h)
         return out
 

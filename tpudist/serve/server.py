@@ -65,10 +65,20 @@ class ServeConfig:
     prefill_pad: Optional[int] = None  # chunk size; None: min(max_len, 64)
     deadline_s: Optional[float] = None  # default per-request deadline
     decode_block: int = 8  # max fused decode tokens per dispatch (K)
+    # -- paged KV cache (tpudist/models/paged.py) --------------------------
+    paged: bool = False  # block pool + block tables instead of dense arenas
+    kv_block: int = 16  # tokens per KV block (must divide max_len)
+    # pool size in blocks; None = dense-equivalent bytes (num_slots ×
+    # max_len / kv_block) — raise num_slots at fixed kv_blocks for the
+    # capacity win
+    kv_blocks: Optional[int] = None
+    kv_int8: bool = False  # int8 KV storage + per-block scales
+    prefix_cache_blocks: int = 0  # shared-prefix LRU cache bound (blocks)
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
-        from tpudist.utils.envutil import env_int, env_positive_float
+        from tpudist.utils.envutil import (env_flag, env_int,
+                                           env_positive_float)
 
         return cls(
             num_slots=env_int("TPUDIST_SERVE_SLOTS", 4) or 4,
@@ -77,6 +87,12 @@ class ServeConfig:
             prefill_pad=env_int("TPUDIST_SERVE_PREFILL_PAD", None),
             deadline_s=env_positive_float("TPUDIST_SERVE_DEADLINE_S", None),
             decode_block=env_int("TPUDIST_SERVE_DECODE_BLOCK", 8) or 8,
+            paged=env_flag("TPUDIST_SERVE_PAGED", False),
+            kv_block=env_int("TPUDIST_SERVE_KV_BLOCK", 16) or 16,
+            kv_blocks=env_int("TPUDIST_SERVE_KV_BLOCKS", None),
+            kv_int8=env_flag("TPUDIST_SERVE_KV_INT8", False),
+            prefix_cache_blocks=env_int(
+                "TPUDIST_SERVE_PREFIX_CACHE", 0) or 0,
         )
 
 
@@ -98,12 +114,22 @@ class InferenceServer:
         self.engine = SlotEngine(
             module, params, num_slots=self.config.num_slots,
             prefill_pad=self.config.prefill_pad,
-            decode_block=self.config.decode_block)
+            decode_block=self.config.decode_block,
+            paged=self.config.paged, kv_block=self.config.kv_block,
+            kv_blocks=self.config.kv_blocks, kv_int8=self.config.kv_int8,
+            prefix_cache_blocks=self.config.prefix_cache_blocks)
+        hasher = None
+        if self.config.paged and self.config.prefix_cache_blocks > 0:
+            from tpudist.serve.paged_alloc import hash_chain
+
+            bs = self.engine.paged_cfg.block_size
+            hasher = lambda prompt: hash_chain(prompt, bs)  # noqa: E731
         self.scheduler = Scheduler(
             queue_limit=self.config.queue_limit,
             check_budget=self.engine.check_budget,
             default_max_new=self.config.max_new,
-            default_deadline_s=self.config.deadline_s)
+            default_deadline_s=self.config.deadline_s,
+            prefix_hasher=hasher)
         self._install_signal = install_signal_handler
         self._installed_preemption = False
         self._thread: Optional[threading.Thread] = None
@@ -125,6 +151,15 @@ class InferenceServer:
         from tpudist.runtime import preemption
 
         telemetry.ensure_started()
+        # one config-stamp event: the static KV geometry the aggregator
+        # pairs with the per-block occupancy gauges (block size, pool
+        # bytes, bytes/pos — the denominator side of the capacity story)
+        kv = self.engine.kv_stats()
+        telemetry.event(
+            "serve_kv_config", paged=kv["paged"], quantized=kv["quantized"],
+            block_size=kv["block_size"], blocks_total=kv["blocks_total"],
+            pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
+            num_slots=self.engine.num_slots, max_len=self.engine.max_len)
         if self._install_signal:
             # SIGTERM → drain: the same preemption flag the training loop
             # checkpoints on.  Off the main thread install degrades to a
@@ -197,6 +232,7 @@ class InferenceServer:
                                if self._steps else 0.0),
             "compile_counts": self.engine.compile_counts(),
             "decode": self.engine.decode_stats(),
+            "kv": self.engine.kv_stats(),
         }
 
     # -- the engine loop ----------------------------------------------------
@@ -254,13 +290,44 @@ class InferenceServer:
             for slot, h in list(self._slot_handles.items()):
                 if h._expired(now):
                     self._finish_slot(slot, "deadline")
+            # a decoding slot whose cache filled with budget unspent can
+            # only mean the admission budget rule was bypassed — finish
+            # it LOUDLY (reason "cache_full") instead of letting the next
+            # decode block clamp writes onto max_len-1 and attend over
+            # garbage, or crash the loop for every other tenant
+            for slot in eng.cache_full_slots():
+                if slot in self._slot_handles:
+                    self._finish_slot(slot, "cache_full")
             for h in sched.expire_queued(now):
                 self._note_finished(h)
             # FIFO-with-budget admission into free lanes: ONE fused
-            # prefill+scatter dispatch for the whole admission batch
+            # prefill+scatter dispatch for the whole admission batch.
+            # The paged engine adds a second gate: the queue head is
+            # taken only while its whole block footprint fits the pool
+            # (reused prefix blocks discounted).
             free = eng.free_slots()
             if free:
-                batch = sched.take(len(free), now)
+                # the gate runs once per queued candidate within ONE
+                # take; `reserved` carries the fresh blocks already
+                # promised to earlier candidates of this same batch and
+                # `pinned` the cached blocks they will reuse (counted
+                # evictable by a naive peek, pinned the moment they
+                # land) — the free list only learns about either at
+                # start_batch
+                reserved, pinned = [0], []
+
+                def _gate(h):
+                    req = h.request
+                    got = eng.kv_admission_probe(
+                        len(req.prompt), req.max_new, req.prefix_hashes,
+                        reserve=reserved[0], protect=pinned)
+                    if got is None:
+                        return False
+                    reserved[0] += got[0]
+                    pinned.extend(got[1])
+                    return True
+
+                batch = sched.take(len(free), now, admit=_gate)
                 alive = []
                 for h in batch:
                     if h.done:  # finished in-queue (deadline expired)
@@ -274,7 +341,8 @@ class InferenceServer:
                         h.t_admitted = t0
                         items.append((slot, h.request.prompt,
                                       h.request.temperature, h.request.seed,
-                                      h.request.max_new))
+                                      h.request.max_new,
+                                      h.request.prefix_hashes))
                         self._slot_handles[slot] = h
                     with telemetry.span("prefill", n=len(items)):
                         firsts = eng.start_batch(items)
@@ -298,12 +366,19 @@ class InferenceServer:
                 t0 = time.monotonic()
                 info, blocks = eng.decode_block()
                 if tele is not None and info is not None:
+                    kv_occ, kv_resident = eng.kv_gauges()
                     tele.record_span(
                         "decode_block", t0, time.monotonic() - t0,
                         {"occupancy": occ, "active": active, "k": info["k"],
                          "tokens": info["tokens"],
                          "dispatch_s": round(info["dispatch_s"], 9),
-                         "sync_s": round(info["sync_s"], 9)})
+                         "sync_s": round(info["sync_s"], 9),
+                         # the KV capacity/bandwidth gauges: pool block
+                         # occupancy (None on dense), resident bytes,
+                         # and the bytes this block's attention streamed
+                         "kv_block_occupancy": kv_occ,
+                         "kv_bytes_resident": kv_resident,
+                         "kv_read_bytes": info["kv_read_bytes"]})
                 self._occupancy_sum += occ
                 self._steps += 1
                 for slot, toks in blocks.items():

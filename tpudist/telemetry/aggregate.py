@@ -207,7 +207,17 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     serve_spans = 0
     decode_blocks, decode_tokens = 0, 0
     dispatch_s, sync_s = 0.0, 0.0
+    # KV residency gauges (paged-cache PR): block occupancy duration-
+    # weighted like the batch occupancy, peak resident bytes, and the
+    # attention's streamed bytes (→ decode bytes/token)
+    kv_occ_w, kv_occ_dur, kv_occ_max = 0.0, 0.0, 0.0
+    kv_resident_peak, kv_read_bytes = 0, 0
+    kv_config = None
     for r in records:
+        if (r.get("kind") == "event"
+                and r.get("name") == "serve_kv_config"):
+            kv_config = r  # last one wins (restart/regeneration)
+            continue
         if r.get("kind") != "span":
             continue
         if r.get("name") in ("decode_block", "decode_step"):
@@ -223,6 +233,15 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
                 occ_w += float(occ) * dur
                 occ_dur += dur
                 occ_max = max(occ_max, float(occ))
+            kocc = r.get("kv_block_occupancy")
+            if isinstance(kocc, (int, float)):
+                kv_occ_w += float(kocc) * dur
+                kv_occ_dur += dur
+                kv_occ_max = max(kv_occ_max, float(kocc))
+            if isinstance(r.get("kv_bytes_resident"), (int, float)):
+                kv_resident_peak = max(kv_resident_peak,
+                                       int(r["kv_bytes_resident"]))
+            kv_read_bytes += int(r.get("kv_read_bytes", 0) or 0)
         elif r.get("name") == "prefill":
             serve_spans += 1
             prefill_s += float(r.get("dur", 0.0))
@@ -243,6 +262,30 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         reasons[str(r.get("reason"))] = reasons.get(str(r.get("reason")), 0) + 1
     tokens_out = sum(int(r.get("tokens_out", 0)) for r in fins)
     busy = decode_s + prefill_s
+    kv: Optional[dict] = None
+    if kv_config is not None or kv_occ_dur > 0 or kv_read_bytes:
+        kv = {
+            # static geometry from the serve_kv_config stamp
+            **({"paged": kv_config.get("paged"),
+                "quantized": kv_config.get("quantized"),
+                "block_size": kv_config.get("block_size"),
+                "blocks_total": kv_config.get("blocks_total"),
+                "pool_bytes": kv_config.get("pool_bytes"),
+                "bytes_per_pos": kv_config.get("bytes_per_pos")}
+               if kv_config is not None else {}),
+            # measured residency/bandwidth gauges
+            "block_occupancy_mean": (round(kv_occ_w / kv_occ_dur, 4)
+                                     if kv_occ_dur > 0 else None),
+            "block_occupancy_max": (round(kv_occ_max, 4)
+                                    if kv_occ_dur > 0 else None),
+            "bytes_resident_peak": kv_resident_peak or None,
+            "read_bytes": kv_read_bytes or None,
+            # decode bytes/token: what the attention streamed per
+            # emitted token — the int8 path halves-or-betters this
+            "read_bytes_per_token": (round(kv_read_bytes / decode_tokens, 1)
+                                     if decode_tokens and kv_read_bytes
+                                     else None),
+        }
     return {
         "requests_finished": len(fins),
         "requests_rejected": rejects,
@@ -262,6 +305,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         "queue_wait": _pcts("queue_wait_s"),
         "occupancy_mean": round(occ_w / occ_dur, 4) if occ_dur > 0 else None,
         "occupancy_max": round(occ_max, 4) if occ_dur > 0 else None,
+        **({"kv": kv} if kv is not None else {}),
     }
 
 
@@ -415,6 +459,27 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"- batch occupancy: mean {sv['occupancy_mean']:.2f}, "
                 f"max {sv['occupancy_max']:.2f}")
+        if sv.get("kv"):
+            kv = sv["kv"]
+            bits = []
+            if kv.get("paged"):
+                bits.append(
+                    f"paged ({kv.get('blocks_total')} × "
+                    f"{kv.get('block_size')}-token blocks"
+                    + (", int8" if kv.get("quantized") else "") + ")")
+            elif kv.get("paged") is False:
+                bits.append("dense arena")
+            if kv.get("block_occupancy_mean") is not None:
+                bits.append(f"block occupancy mean "
+                            f"{kv['block_occupancy_mean']:.2f} / max "
+                            f"{kv['block_occupancy_max']:.2f}")
+            if kv.get("bytes_resident_peak"):
+                bits.append(f"peak resident "
+                            f"{kv['bytes_resident_peak']:,} B")
+            if kv.get("read_bytes_per_token"):
+                bits.append(f"decode streams "
+                            f"{kv['read_bytes_per_token']:,.0f} B/token")
+            lines.append("- KV cache: " + "; ".join(bits))
     if report.get("stages"):
         lines += ["", "## Host stages (StageTimer)", ""]
         for k, v in report["stages"].items():

@@ -97,6 +97,11 @@ ENV_VARS = {
     "TPUDIST_SERVE_PREFILL_PAD": "prefill chunk length (pad per compiled chunk)",
     "TPUDIST_SERVE_DEADLINE_S": "default per-request deadline seconds (<=0 off)",
     "TPUDIST_SERVE_DECODE_BLOCK": "max fused decode tokens per dispatch (K)",
+    "TPUDIST_SERVE_PAGED": "paged KV cache: block pool + per-slot tables",
+    "TPUDIST_SERVE_KV_BLOCK": "tokens per KV block (must divide max_len)",
+    "TPUDIST_SERVE_KV_BLOCKS": "KV pool size in blocks (default: dense-equivalent)",
+    "TPUDIST_SERVE_KV_INT8": "int8 KV storage with per-block dequant scales",
+    "TPUDIST_SERVE_PREFIX_CACHE": "shared-prefix LRU cache bound in blocks (0 off)",
     # telemetry & goodput
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
