@@ -308,6 +308,14 @@ def main(argv=None) -> int:
     calibration = []
     null_ms_by_n: dict[int, float] = {}
     for n in [int(x) for x in args.n_procs.split(",")]:
+        # The gate (VERDICT Weak #4): a rung with n_procs > host_cores
+        # measures the host scheduler time-slicing collective partners,
+        # not the framework — it still runs (its row is the honest
+        # upper bound the interpretation note describes) but carries
+        # the "scheduler-bound" label so no reader quotes it as a
+        # scaling number, and the summary excludes it from the
+        # efficiency claim.
+        scheduler_bound = n > cores
         if not args.skip_null:
             # Null-step calibration FIRST at each width: barrier + host
             # scalar all-reduce only, no compute — the coordination
@@ -317,6 +325,8 @@ def main(argv=None) -> int:
             # column is just absent.
             c = run_rung(n, iters=args.iters,
                          batch_per_proc=args.batch_per_proc, null=True)
+            if scheduler_bound and "error" not in c:
+                c["label"] = "scheduler-bound"
             calibration.append(c)
             if "error" not in c:
                 null_ms_by_n[n] = c["null_ms"]
@@ -324,6 +334,9 @@ def main(argv=None) -> int:
         r = run_rung(n, iters=args.iters, batch_per_proc=args.batch_per_proc)
         if "error" not in r and n in null_ms_by_n:
             r["null_coordination_ms"] = null_ms_by_n[n]
+        if scheduler_bound and "error" not in r:
+            r["scheduler_bound"] = True
+            r["label"] = "scheduler-bound"
         rungs.append(r)
         # progress to stderr; stdout carries only the FINAL enriched rows
         # (round_snapshot merges stdout lines into SCALING_r{NN}.json,
@@ -387,6 +400,10 @@ def main(argv=None) -> int:
                 "minus the same-width null_coordination_ms (floored at "
                 "0) — gradient data movement + framework work with the "
                 "handshake floor removed",
+            "label": "'scheduler-bound' on rungs with n_procs > "
+                "host_cores: the host scheduler time-slices collective "
+                "partners, so the row is an upper bound on boundary "
+                "cost, never a scaling claim (the summary excludes it)",
         },
         "interpretation": (
             "On this rig cross-process collectives ride gloo over "
@@ -405,11 +422,15 @@ def main(argv=None) -> int:
     Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
     for r in rungs:
         print(json.dumps(r), flush=True)
+    in_gate = [r for r in ok if not r.get("scheduler_bound")]
     print(json.dumps({"summary": "multiproc_scaling",
                       "host_cores": cores,
                       "rungs": [(r["n_procs"],
                                  r.get("contention_corrected_efficiency"))
-                                for r in ok]}), flush=True)
+                                for r in in_gate],
+                      "scheduler_bound_rungs": [
+                          r["n_procs"] for r in ok
+                          if r.get("scheduler_bound")]}), flush=True)
     return 0 if ok and len(ok) == len(rungs) else 1
 
 

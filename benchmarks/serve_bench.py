@@ -28,7 +28,21 @@ the paper-facing serving questions need:
   bytes-resident" as equal block-pool bytes);
 - **the int8-KV sweep** — native vs int8 KV storage at the same
   geometry/load: resident bytes-per-position ratio and throughput, the
-  bytes/token lever for bandwidth-bound decode.
+  bytes/token lever for bandwidth-bound decode;
+- **sharded serving** (``--mesh DxM`` [+ ``--tp-overlap``]) — every
+  in-process rung serves SPMD over a serving mesh
+  (``tpudist/serve/spmd.py``); the artifact records the mesh geometry
+  and the sharded-param accounting;
+- **disaggregated serving** (``--disagg``) — rungs serve through the
+  prefill/decode coordinator (``tpudist/serve/disagg.py``): per-rung
+  handoff counts/bytes/wait percentiles, and the embedded serving
+  report splits TTFT (prefill pool) from TPOT (decode pool);
+- **the multi-process serve rung** (``--multiproc N``) — N
+  tpurun-launched workers, each a disaggregated server SPMD over its
+  own ``--devices-per-proc``-emulated mesh with SERIALIZED KV handoff
+  (the cross-process transfer), merged per-pool serving report
+  embedded.  ``round_snapshot.py`` freezes this rung into the round's
+  ``BENCH_SERVE`` artifact.
 
 One warmup request absorbs XLA compilation before any timed rung, so
 rows measure the steady engine, not the first dispatch.  Artifact:
@@ -64,6 +78,54 @@ def _pct(vals, q):
     return _percentile(sorted(vals), q)
 
 
+def _ensure_devices(n: int) -> None:
+    """Emulate ``n`` CPU devices when the backend is not yet up (the
+    comm_audit trick) — standalone ``--mesh``/``--disagg`` runs need
+    them; under pytest the conftest's 8-device mesh is already live."""
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        backend_up = _xb.backends_are_initialized()
+    except Exception:
+        backend_up = True
+    if not backend_up:
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", max(n, 2))
+        except AttributeError:
+            import os
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count"
+                            f"={max(n, 2)}").strip()
+
+
+def _server_decode_stats(server) -> dict:
+    """Cumulative decode counters for either server shape (the disagg
+    coordinator sums its decode pool)."""
+    if hasattr(server, "decode_pool"):
+        return server.stats()["decode_pool"]["decode"]
+    return server.engine.decode_stats()
+
+
+def _server_kv(server) -> dict:
+    if hasattr(server, "decode_pool"):
+        return server.stats()["decode_pool"]["kv"]
+    return server.stats()["kv"]
+
+
+def _server_compile_counts(server) -> dict:
+    if hasattr(server, "decode_pool"):
+        st = server.stats()
+        return {"prefill_pool": st["prefill_pool"]["compile_counts"],
+                "decode_pool": st["decode_pool"]["compile_counts"]}
+    return server.stats()["compile_counts"]
+
+
 def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
              prompt_lens, max_news, seed: int) -> dict:
     """One offered-load rung: open-loop Poisson arrivals at ``rate_rps``
@@ -91,7 +153,8 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
             if rate_rps < 1e6:
                 time.sleep(float(rng.exponential(1.0 / rate_rps)))
 
-    d0 = server.engine.decode_stats()
+    d0 = _server_decode_stats(server)
+    h0 = _server_handoff_stats(server)
     t0 = time.monotonic()
     loader = threading.Thread(target=submit_all, daemon=True)
     loader.start()
@@ -99,7 +162,8 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
     for h in handles:
         h.wait()
     wall = time.monotonic() - t0
-    d1 = server.engine.decode_stats()
+    d1 = _server_decode_stats(server)
+    h1 = _server_handoff_stats(server)
 
     ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
     tpots = [h.tpot_s for h in handles if h.tpot_s is not None]
@@ -135,7 +199,190 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
             round(statistics.mean([len(h.tokens) for h in handles]), 1)
             if handles else None,
         # KV residency accounting (paged: block pool; dense: the arena)
-        "kv": server.stats()["kv"],
+        "kv": _server_kv(server),
+        # disaggregated serving only: the prefill→decode handoff story
+        # (None columns on the single-pool server)
+        **_handoff_cols(h0, h1, handles),
+    }
+
+
+def _server_handoff_stats(server):
+    if not hasattr(server, "decode_pool"):
+        return None
+    st = server.stats()
+    return {"handoffs": st["handoffs"], "bytes": st["handoff_bytes"]}
+
+
+def _handoff_cols(h0, h1, handles) -> dict:
+    if h0 is None or h1 is None:
+        return {}
+    waits = [h.handoff_wait_s for h in handles
+             if h.handoff_wait_s is not None]
+    # deltas, like the decode counters: the row must count THIS rung's
+    # handoffs, not the server's cumulative total (warmup included)
+    return {
+        "handoffs": h1["handoffs"] - h0["handoffs"],
+        "handoff_bytes": h1["bytes"] - h0["bytes"],
+        "handoff_wait_s_p50": round(_pct(waits, 50), 6) if waits else None,
+        "handoff_wait_s_p95": round(_pct(waits, 95), 6) if waits else None,
+    }
+
+
+#: Worker body of the multi-process serve rung: one disaggregated
+#: server per process, SPMD over that process's emulated device mesh,
+#: KV handoff serialized (the cross-process transfer stand-in), traffic
+#: seeded per rank.  Launched via the tpurun agent exactly like a real
+#: multi-host serving job; telemetry streams into a shared dir whose
+#: merged serving report (per-pool TTFT/TPOT split) embeds in the
+#: artifact row.
+_SERVE_WORKER = """
+import json, os, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# device count per process comes from tpurun --devices-per-proc
+
+import numpy as np
+import jax
+
+from tpudist import telemetry
+from tpudist.models import create_transformer
+from tpudist.serve import DisaggServer, ServeConfig
+
+rank = int(os.environ.get("TPUDIST_PROCESS_ID", "0"))
+requests = int(os.environ["SERVE_REQUESTS"])
+mesh = os.environ.get("SERVE_MESH", "") or None
+vocab = 64
+telemetry.start(os.environ["SERVE_TELE"])
+module, params = create_transformer(
+    jax.random.PRNGKey(0), seq_len=16, vocab=vocab, d_model=32,
+    n_layers=2, n_heads=2, d_ff=128, max_len=64)
+cfg = ServeConfig(num_slots=2, queue_limit=max(64, requests), max_new=8,
+                  prefill_pad=8, decode_block=4, disagg=True,
+                  handoff="serial", mesh=mesh,
+                  tp_overlap=os.environ.get("SERVE_TP_OVERLAP") or None)
+srv = DisaggServer(module, params, cfg,
+                   install_signal_handler=False).start()
+# absorb compiles: insert/export/import once, plus every power-of-two
+# decode bucket the engine can pick at block size 4
+for b in (1, 2, 4):
+    srv.submit(np.zeros(4, np.int32), max_new=b + 1).wait()
+rng = np.random.default_rng(rank)
+t0 = time.monotonic()
+hs = []
+for i in range(requests):
+    plen, mn = int(rng.integers(2, 9)), int(rng.integers(2, 9))
+    hs.append(srv.submit(rng.integers(0, vocab, size=plen).astype(np.int32),
+                         max_new=mn, seed=i))
+for h in hs:
+    assert h.wait(300), "request timed out"
+wall = time.monotonic() - t0
+st = srv.stats()
+srv.close()
+telemetry.finish(write_report=False)
+
+
+def pct(vals, q):
+    return (vals[min(len(vals) - 1, int(round(q / 100 * (len(vals) - 1))))]
+            if vals else None)
+
+
+ttfts = sorted(h.ttft_s for h in hs if h.ttft_s is not None)
+tpots = sorted(h.tpot_s for h in hs if h.tpot_s is not None)
+toks = sum(len(h.tokens) for h in hs)
+out = {"rank": rank, "n_devices": len(jax.devices()),
+       "completed": len(hs), "tokens_out": toks,
+       "wall_s": round(wall, 3),
+       "tokens_per_s": round(toks / wall, 1) if wall > 0 else None,
+       "ttft_s_p50": pct(ttfts, 50), "ttft_s_p95": pct(ttfts, 95),
+       "tpot_s_p50": pct(tpots, 50), "tpot_s_p95": pct(tpots, 95),
+       "handoffs": st["handoffs"], "handoff_bytes": st["handoff_bytes"],
+       "spmd": st["spmd"]}
+with open(os.path.join(os.environ["SERVE_OUT"],
+                       f"rank{rank}.json"), "w") as f:
+    json.dump(out, f)
+"""
+
+
+def run_multiproc_serve(*, n_procs: int, devices_per_proc: int,
+                        requests: int, mesh: str = "",
+                        tp_overlap: str = "") -> dict:
+    """The tpurun-launched multi-process serve rung: ``n_procs``
+    disaggregated serving workers, each SPMD over its own
+    ``devices_per_proc``-device emulated mesh, serialized KV handoff.
+    Returns the artifact row (error-row convention on failure — a dead
+    rung must not void the in-process measurements)."""
+    import os
+    import tempfile
+    import textwrap
+    import time as _time
+
+    from tpudist.launch.run import main as tpurun_main
+    from tpudist.telemetry.aggregate import aggregate_run
+
+    saved_env = dict(os.environ)
+    with tempfile.TemporaryDirectory() as td:
+        worker = Path(td) / "serve_worker.py"
+        worker.write_text(textwrap.dedent(_SERVE_WORKER))
+        out_dir = Path(td) / "out"
+        out_dir.mkdir()
+        tele_dir = Path(td) / "tele"
+        try:
+            for var in list(os.environ):
+                if var.startswith(("TPUDIST_", "SLURM_", "OMPI_")) or var in (
+                        "RANK", "WORLD_SIZE", "MASTER_ADDR", "NODE_RANK"):
+                    os.environ.pop(var, None)
+            os.environ["SERVE_OUT"] = str(out_dir)
+            os.environ["SERVE_TELE"] = str(tele_dir)
+            os.environ["SERVE_REQUESTS"] = str(requests)
+            os.environ["SERVE_MESH"] = mesh or ""
+            os.environ["SERVE_TP_OVERLAP"] = tp_overlap or ""
+            os.environ["PYTHONPATH"] = (
+                str(REPO) + os.pathsep + saved_env["PYTHONPATH"]
+                if "PYTHONPATH" in saved_env else str(REPO))
+            t0 = _time.perf_counter()
+            rc = tpurun_main([
+                "--nprocs", str(n_procs), "--max-restarts", "0",
+                "--devices-per-proc", str(devices_per_proc),
+                "--tmpdir", str(Path(td) / "scratch"),
+                "--", sys.executable, str(worker),
+            ])
+            wall = _time.perf_counter() - t0
+        finally:
+            os.environ.clear()
+            os.environ.update(saved_env)
+        if rc != 0:
+            return {"regime": "multiprocess-serve", "n_procs": n_procs,
+                    "error": f"tpurun rc={rc}"}
+        recs = [json.load(open(f))
+                for f in sorted(out_dir.glob("rank*.json"))]
+        if len(recs) != n_procs:
+            return {"regime": "multiprocess-serve", "n_procs": n_procs,
+                    "error": f"expected {n_procs} rank records, "
+                             f"found {len(recs)}"}
+        report = aggregate_run(tele_dir)
+    agg = sum(r["tokens_per_s"] or 0 for r in recs)
+    return {
+        "regime": "multiprocess-serve",
+        "n_procs": n_procs,
+        "devices_per_proc": devices_per_proc,
+        "mesh_per_proc": mesh or None,
+        "handoff": "serial",
+        "requests_per_proc": requests,
+        "agg_tokens_per_s": round(agg, 1),
+        # the slowest worker bounds the fleet's tail latency
+        "ttft_s_p95_worst": max((r["ttft_s_p95"] for r in recs
+                                 if r["ttft_s_p95"] is not None),
+                                default=None),
+        "tpot_s_p95_worst": max((r["tpot_s_p95"] for r in recs
+                                 if r["tpot_s_p95"] is not None),
+                                default=None),
+        "handoffs_total": sum(r["handoffs"] for r in recs),
+        "handoff_bytes_total": sum(r["handoff_bytes"] for r in recs),
+        "launch_plus_run_wall_s": round(wall, 1),
+        "ranks": recs,
+        # the merged cross-rank serving report: TTFT under the prefill
+        # pool, TPOT under the decode pool, handoff waits in between
+        "serving_report": report.get("serving"),
     }
 
 
@@ -178,6 +425,37 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-cache", type=int, default=None,
                    help="shared-prefix LRU cache bound in blocks "
                         "(default: pool size / 4 when paged)")
+    p.add_argument("--mesh", default=None,
+                   help="SPMD serving mesh 'DxM' (data x model) for every "
+                        "in-process rung — params/KV shard, programs don't "
+                        "change (tpudist/serve/spmd.py)")
+    p.add_argument("--tp-overlap", choices=("off", "ring", "bidir"),
+                   default=None,
+                   help="route the TP decode matmuls through the "
+                        "ppermute-pipelined collective matmul "
+                        "(ag_matmul) — gathers hide under compute")
+    p.add_argument("--disagg", action="store_true",
+                   help="serve the in-process rungs through the "
+                        "prefill/decode-disaggregated coordinator "
+                        "(separate pools + KV handoff)")
+    p.add_argument("--handoff", choices=("device", "serial"),
+                   default="serial",
+                   help="--disagg KV transfer mode (serial = the "
+                        "multi-process byte-transfer stand-in)")
+    p.add_argument("--prefill-slots", type=int, default=None,
+                   help="--disagg slots per prefill worker")
+    p.add_argument("--multiproc", type=int, default=0,
+                   help="ALSO run a true multi-process serve rung: N "
+                        "tpurun-launched workers, each a disaggregated "
+                        "server SPMD over its own emulated mesh, KV "
+                        "handoff serialized (0 = skip)")
+    p.add_argument("--devices-per-proc", type=int, default=2,
+                   help="emulated devices per multiproc worker "
+                        "(tpurun --devices-per-proc)")
+    p.add_argument("--skip-sweeps", action="store_true",
+                   help="skip the always-on paged-capacity and kv-dtype "
+                        "sweeps (their sections record {'skipped': true}) "
+                        "— for the CI smokes of the mesh/disagg rungs")
     p.add_argument("--seed", type=int, default=0)
     try:
         from benchmarks._round import current_round
@@ -210,12 +488,16 @@ def main(argv=None) -> int:
 
     import tempfile
 
+    if args.mesh:
+        from tpudist.serve.spmd import ServeMeshConfig
+
+        _ensure_devices(ServeMeshConfig(shape=args.mesh).n_devices)
     import jax
     import numpy as np
 
     from tpudist import telemetry
     from tpudist.models import create_transformer
-    from tpudist.serve import InferenceServer, ServeConfig
+    from tpudist.serve import DisaggServer, InferenceServer, ServeConfig
 
     tele_dir = tempfile.mkdtemp(prefix="serve_bench_tele_")
     telemetry.start(tele_dir)
@@ -230,22 +512,26 @@ def main(argv=None) -> int:
 
     def make_server(decode_block, *, n_slots=None, paged=False,
                     kv_blocks=None, kv_int8=False, prefix_cache=None,
-                    queue_limit=None):
+                    queue_limit=None, disagg=None, mesh=None):
         n_slots = n_slots or slots
+        disagg = args.disagg if disagg is None else disagg
+        mesh = args.mesh if mesh is None else (mesh or None)
         if paged and prefix_cache is None:
             prefix_cache = args.prefix_cache
             if prefix_cache is None:
                 pool = kv_blocks or n_slots * (max_len // kv_block)
                 prefix_cache = pool // 4
-        srv = InferenceServer(
-            module, params,
-            ServeConfig(num_slots=n_slots, queue_limit=queue_limit or queue,
-                        prefill_pad=pad, max_new=mnews[1],
-                        decode_block=decode_block,
-                        paged=paged, kv_block=kv_block, kv_blocks=kv_blocks,
-                        kv_int8=kv_int8,
-                        prefix_cache_blocks=prefix_cache or 0),
-            install_signal_handler=False)
+        cfg = ServeConfig(num_slots=n_slots, queue_limit=queue_limit or queue,
+                          prefill_pad=pad, max_new=mnews[1],
+                          decode_block=decode_block,
+                          paged=paged, kv_block=kv_block, kv_blocks=kv_blocks,
+                          kv_int8=kv_int8,
+                          prefix_cache_blocks=prefix_cache or 0,
+                          mesh=mesh, tp_overlap=args.tp_overlap,
+                          disagg=disagg, handoff=args.handoff,
+                          prefill_slots=args.prefill_slots)
+        cls = DisaggServer if disagg else InferenceServer
+        srv = cls(module, params, cfg, install_signal_handler=False)
         srv.start()
         # warmup: absorb the insert/prefill/decode compiles before any
         # timed rung — the longest prompt (chunked prefill, if the pad
@@ -268,8 +554,8 @@ def main(argv=None) -> int:
         row = run_rate(server, rate_rps=rate, n_requests=requests,
                        vocab=args.vocab, prompt_lens=plens, max_news=mnews,
                        seed=args.seed + i)
-        row["occupancy_mean_cum"] = round(
-            server.stats()["occupancy_mean"], 4)
+        occ = server.stats().get("occupancy_mean")
+        row["occupancy_mean_cum"] = round(occ, 4) if occ is not None else None
         rows.append(row)
         print(json.dumps(row), flush=True)
     stats = server.stats()
@@ -284,7 +570,7 @@ def main(argv=None) -> int:
                        vocab=args.vocab, prompt_lens=plens, max_news=mnews,
                        seed=args.seed)
         entry = {"decode_block": b, **row,
-                 "compile_counts": srv.stats()["compile_counts"]}
+                 "compile_counts": _server_compile_counts(srv)}
         srv.close()
         sweep.append(entry)
         print(json.dumps(entry), flush=True)
@@ -299,61 +585,86 @@ def main(argv=None) -> int:
     report = telemetry.finish() or {}
     telemetry.start(Path(tele_dir) / "sweeps")
 
-    # -- paged-KV capacity rung: the tentpole's headline comparison --------
-    # Dense arena at S slots vs paged pool at 4S slots holding the SAME
-    # bytes (pool = S dense arenas' worth of blocks), both under a
-    # high-churn mixed-length burst (3x the rung's request count so slots
-    # churn through admissions).  The dense arm CANNOT hold more than S
-    # concurrent sequences at this byte budget; the paged arm packs by
-    # actual footprint — peak_occupied_slots is the measured claim.
-    cap_requests = requests * 3
-    dense_equiv_blocks = slots * (max_len // kv_block)
-    capacity = {}
-    for arm, kw in (
-            ("dense", dict(n_slots=slots)),
-            ("paged_4x", dict(n_slots=4 * slots, paged=True,
-                              kv_blocks=dense_equiv_blocks,
-                              prefix_cache=0))):
-        srv = make_server(block, queue_limit=max(queue, cap_requests),
-                          **kw)
-        row = run_rate(srv, rate_rps=1e9, n_requests=cap_requests,
-                       vocab=args.vocab, prompt_lens=plens, max_news=mnews,
-                       seed=args.seed + 17)
-        capacity[arm] = {"slots": kw["n_slots"], **row}
-        srv.close()
-        print(json.dumps({f"capacity_{arm}": capacity[arm]}), flush=True)
-    capacity["slots_ratio"] = (capacity["paged_4x"]["slots"]
-                               / capacity["dense"]["slots"])
-    capacity["pool_bytes_dense"] = capacity["dense"]["kv"]["pool_bytes"]
-    capacity["pool_bytes_paged"] = capacity["paged_4x"]["kv"]["pool_bytes"]
-    capacity["equal_pool_bytes"] = (capacity["pool_bytes_dense"]
-                                    == capacity["pool_bytes_paged"])
-    capacity["peak_concurrent_dense"] = \
-        capacity["dense"]["kv"]["peak_occupied_slots"]
-    capacity["peak_concurrent_paged"] = \
-        capacity["paged_4x"]["kv"]["peak_occupied_slots"]
+    if args.skip_sweeps:
+        capacity = {"skipped": True}
+        kv_dtype_sweep = {"skipped": True}
+    else:
+        # -- paged-KV capacity rung: the tentpole's headline comparison --------
+        # Dense arena at S slots vs paged pool at 4S slots holding the SAME
+        # bytes (pool = S dense arenas' worth of blocks), both under a
+        # high-churn mixed-length burst (3x the rung's request count so slots
+        # churn through admissions).  The dense arm CANNOT hold more than S
+        # concurrent sequences at this byte budget; the paged arm packs by
+        # actual footprint — peak_occupied_slots is the measured claim.
+        cap_requests = requests * 3
+        dense_equiv_blocks = slots * (max_len // kv_block)
+        capacity = {}
+        for arm, kw in (
+                ("dense", dict(n_slots=slots)),
+                ("paged_4x", dict(n_slots=4 * slots, paged=True,
+                                  kv_blocks=dense_equiv_blocks,
+                                  prefix_cache=0))):
+            # single-pool single-device arms regardless of --mesh/--disagg:
+            # the capacity claim is a byte-budget comparison, continuous
+            # with the r07 artifact
+            srv = make_server(block, queue_limit=max(queue, cap_requests),
+                              disagg=False, mesh="", **kw)
+            row = run_rate(srv, rate_rps=1e9, n_requests=cap_requests,
+                           vocab=args.vocab, prompt_lens=plens, max_news=mnews,
+                           seed=args.seed + 17)
+            capacity[arm] = {"slots": kw["n_slots"], **row}
+            srv.close()
+            print(json.dumps({f"capacity_{arm}": capacity[arm]}), flush=True)
+        capacity["slots_ratio"] = (capacity["paged_4x"]["slots"]
+                                   / capacity["dense"]["slots"])
+        capacity["pool_bytes_dense"] = capacity["dense"]["kv"]["pool_bytes"]
+        capacity["pool_bytes_paged"] = capacity["paged_4x"]["kv"]["pool_bytes"]
+        capacity["equal_pool_bytes"] = (capacity["pool_bytes_dense"]
+                                        == capacity["pool_bytes_paged"])
+        capacity["peak_concurrent_dense"] = \
+            capacity["dense"]["kv"]["peak_occupied_slots"]
+        capacity["peak_concurrent_paged"] = \
+            capacity["paged_4x"]["kv"]["peak_occupied_slots"]
 
-    # -- int8-KV sweep: bytes/position and throughput, native vs int8 ------
-    kv_sweep = []
-    for dtype in ("native", "int8"):
-        srv = make_server(block, paged=True, kv_int8=dtype == "int8",
-                          prefix_cache=0)
-        row = run_rate(srv, rate_rps=1e9, n_requests=requests,
-                       vocab=args.vocab, prompt_lens=plens, max_news=mnews,
-                       seed=args.seed)
-        kv_sweep.append({"kv_dtype": dtype, **row})
-        srv.close()
-        print(json.dumps({f"kv_{dtype}": kv_sweep[-1]["kv"]}), flush=True)
-    ratio = (kv_sweep[0]["kv"]["bytes_per_pos"]
-             / kv_sweep[1]["kv"]["bytes_per_pos"])
-    kv_dtype_sweep = {"rows": kv_sweep,
-                      "bytes_per_pos_native": kv_sweep[0]["kv"][
-                          "bytes_per_pos"],
-                      "bytes_per_pos_int8": kv_sweep[1]["kv"][
-                          "bytes_per_pos"],
-                      "native_over_int8_bytes": round(ratio, 3)}
+        # -- int8-KV sweep: bytes/position and throughput, native vs int8 ------
+        kv_sweep = []
+        for dtype in ("native", "int8"):
+            srv = make_server(block, paged=True, kv_int8=dtype == "int8",
+                              prefix_cache=0, disagg=False, mesh="")
+            row = run_rate(srv, rate_rps=1e9, n_requests=requests,
+                           vocab=args.vocab, prompt_lens=plens, max_news=mnews,
+                           seed=args.seed)
+            kv_sweep.append({"kv_dtype": dtype, **row})
+            srv.close()
+            print(json.dumps({f"kv_{dtype}": kv_sweep[-1]["kv"]}), flush=True)
+        ratio = (kv_sweep[0]["kv"]["bytes_per_pos"]
+                 / kv_sweep[1]["kv"]["bytes_per_pos"])
+        kv_dtype_sweep = {"rows": kv_sweep,
+                          "bytes_per_pos_native": kv_sweep[0]["kv"][
+                              "bytes_per_pos"],
+                          "bytes_per_pos_int8": kv_sweep[1]["kv"][
+                              "bytes_per_pos"],
+                          "native_over_int8_bytes": round(ratio, 3)}
 
+    # finish the sweeps side-stream unconditionally — a still-armed
+    # session would cross-contaminate whatever this process serves next
     telemetry.finish(write_report=False)
+
+    # -- multi-process serve rung (tpurun-launched; --multiproc N) ---------
+    multiproc = None
+    if args.multiproc:
+        multiproc = run_multiproc_serve(
+            n_procs=args.multiproc,
+            devices_per_proc=args.devices_per_proc,
+            requests=max(4, requests // 2),
+            mesh=(args.mesh
+                  or (f"1x{args.devices_per_proc}"
+                      if args.devices_per_proc > 1 else "")),
+            tp_overlap=args.tp_overlap or "")
+        print(json.dumps({"multiproc_serve": {
+            k: v for k, v in multiproc.items()
+            if k not in ("ranks", "serving_report")}}), flush=True)
+
     artifact = {
         "regime": ("cpu-smoke" if smoke else
                    jax.devices()[0].device_kind),
@@ -365,11 +676,15 @@ def main(argv=None) -> int:
             "blocks_sweep": blocks,
             "paged": args.paged, "kv_dtype": args.kv_dtype,
             "kv_block": kv_block,
+            "mesh": args.mesh, "tp_overlap": args.tp_overlap,
+            "disagg": args.disagg,
+            "handoff": args.handoff if args.disagg else None,
         },
         "rows": rows,
         "block_sweep": sweep,
         "paged_capacity": capacity,
         "kv_dtype_sweep": kv_dtype_sweep,
+        **({"multiproc_serve": multiproc} if multiproc is not None else {}),
         "server_stats": stats,
         "serving_report": report.get("serving"),
     }
@@ -378,7 +693,10 @@ def main(argv=None) -> int:
     tmp.write_text(json.dumps(artifact, indent=2) + "\n")
     tmp.replace(out)
     print(json.dumps({"wrote": str(out),
-                      "compile_counts": stats["compile_counts"]}),
+                      "compile_counts": stats.get(
+                          "compile_counts",
+                          stats.get("decode_pool", {}).get(
+                              "compile_counts"))}),
           flush=True)
     return 0
 

@@ -97,6 +97,19 @@ _SLOW_PATTERNS = (
     # serving: sustained-load dynamics (late join / backpressure / drain
     # under load); the fast slot/scheduler/server cases stay default
     "TestServeUnderLoad",
+    # sharded-serving sweeps: full mesh-shape × engine-mode oracle
+    # matrix + disagg server e2e (the fast engine-level mesh/handoff
+    # oracles stay default in TestServeSpmd)
+    "TestServeMeshOracleSweep",
+    "TestDisaggServer",
+    # serve_bench mesh/disagg/multiproc smokes + the decode trace
+    # capture (each builds servers / spawns tpurun workers)
+    "TestServeBench::test_smoke_mesh_rung",
+    "TestServeBench::test_smoke_disagg_rung",
+    "TestServeBench::test_multiproc_serve_rung",
+    "TestServeBench::test_decode_profile_capture",
+    # TP-serving decode-path comm-audit lowers
+    "test_regime[serve_decode",
     # generation / checkpoint long chains
     "test_greedy_decodes_the_chain",
     "test_generate_with_filters_runs",

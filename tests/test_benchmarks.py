@@ -53,6 +53,19 @@ class TestScalingMultiproc:
             assert r["collective_ms_per_step_cal"] <= \
                 r["collective_ms_per_step_est"]
             assert r["collective_ms_per_step_cal"] >= 0
+        # the oversubscription gate (VERDICT Weak #4): any rung beyond
+        # the host's cores carries the scheduler-bound label — an upper
+        # bound, never a scaling claim; in-gate rungs carry none
+        import os as _os
+
+        cores = _os.cpu_count() or 1
+        for n, r in rungs.items():
+            if n > cores:
+                assert r.get("scheduler_bound") is True
+                assert r.get("label") == "scheduler-bound"
+            else:
+                assert "scheduler_bound" not in r and "label" not in r
+        assert "label" in rec["columns"]
 
 
 class TestBands:
@@ -310,6 +323,100 @@ class TestServeBench:
         assert kvs["native_over_int8_bytes"] >= 2.0
         assert kvs["rows"][1]["kv"]["quantized"] is True
         assert kvs["rows"][1]["completed"] == kvs["rows"][0]["completed"]
+
+    def test_smoke_mesh_rung(self, tmp_path):
+        """The --mesh rung (single-process emulated-device mode): the
+        offered-load rows serve off an SPMD 1x2 engine with the overlap
+        routing on, the artifact records the mesh + sharded-param
+        accounting, and the compile pins hold — mesh shapes change
+        shardings, never programs."""
+        from benchmarks.serve_bench import main
+
+        out = tmp_path / "BENCH_SERVE_MESH.json"
+        rc = main(["--smoke", "--out", str(out), "--requests", "3",
+                   "--rates", "burst", "--blocks", "1", "--skip-sweeps",
+                   "--mesh", "1x2", "--tp-overlap", "ring"])
+        assert rc == 0
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["config"]["mesh"] == "1x2"
+        (row,) = rec["rows"]
+        assert row["completed"] == 3 and row["tokens_out"] > 0
+        spmd = rec["server_stats"]["spmd"]
+        assert spmd["mesh"] == {"data": 1, "model": 2}
+        assert spmd["tp_overlap"] == "ring"
+        assert spmd["param_bytes_per_device"] < spmd["param_bytes_total"]
+        cc = rec["server_stats"]["compile_counts"]
+        assert cc["insert_batch"] in (1, -1)
+        assert cc["evict"] in (1, -1)
+
+    def test_smoke_disagg_rung(self, tmp_path):
+        """The --disagg rung (single-process mode): rows serve through
+        the prefill/decode-disaggregated coordinator with serialized KV
+        handoff, the handoff columns land, and the embedded serving
+        report carries the per-pool TTFT/TPOT split."""
+        from benchmarks.serve_bench import main
+
+        out = tmp_path / "BENCH_SERVE_DISAGG.json"
+        rc = main(["--smoke", "--out", str(out), "--requests", "3",
+                   "--rates", "burst", "--blocks", "1", "--skip-sweeps",
+                   "--disagg", "--handoff", "serial"])
+        assert rc == 0
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["config"]["disagg"] and rec["config"]["handoff"] == \
+            "serial"
+        (row,) = rec["rows"]
+        assert row["completed"] == 3 and row["tokens_out"] > 0
+        assert row["handoffs"] > 0 and row["handoff_bytes"] > 0
+        assert row["handoff_wait_s_p50"] is not None
+        cc = rec["server_stats"]["decode_pool"]["compile_counts"]
+        assert cc["import_lane"] in (1, -1)
+        # the embedded report splits the phases by pool
+        pools = rec["serving_report"]["pools"]
+        assert pools["handoffs"] > 0
+        assert pools["prefill"]["ttft"] is not None
+        assert pools["decode"]["tpot"] is not None
+
+    def test_multiproc_serve_rung(self):
+        """The tpurun-launched multi-process serve rung: 2 workers x
+        2 emulated devices each, disaggregated + serialized handoff,
+        merged per-pool serving report embedded."""
+        from benchmarks.serve_bench import run_multiproc_serve
+
+        row = run_multiproc_serve(n_procs=2, devices_per_proc=2,
+                                  requests=3, mesh="1x2")
+        assert "error" not in row, row
+        assert row["n_procs"] == 2 and len(row["ranks"]) == 2
+        assert row["agg_tokens_per_s"] > 0
+        assert row["handoffs_total"] > 0
+        for r in row["ranks"]:
+            assert r["n_devices"] == 2
+            assert r["spmd"]["mesh"] == {"data": 1, "model": 2}
+        sv = row["serving_report"]
+        assert sv and sv["pools"]["handoffs"] == row["handoffs_total"]
+        assert sv["pools"]["prefill"]["ttft"] is not None
+        assert sv["pools"]["decode"]["tpot"] is not None
+
+    def test_decode_profile_capture(self, tmp_path):
+        """--capture-decode: the bf16 decode loop traces and the per-op
+        table names the non-matmul residual (VERDICT Weak #2)."""
+        from benchmarks.profile_summary import main
+
+        out = tmp_path / "DECODE_PROFILE.json"
+        rc = main(["--capture-decode", "--decode-blocks", "2",
+                   "--out", str(out)])
+        assert rc == 0
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["config"]["dtype"] == "bf16"
+        assert rec["total_us"] > 0
+        assert rec["residual_pct"] is not None
+        assert rec["residual_groups"], "residual table must name groups"
+        assert abs(rec["matmul_pct"] + rec["residual_pct"] - 100.0) < 0.1
 
     def test_smoke_paged_int8_rungs_compile_pinned(self, tmp_path):
         """The --paged/--kv-dtype rungs: offered-load rows served off

@@ -209,6 +209,10 @@ def _checks_for(name, prof, info):
             _audit("fsdp")
         return ca.check_fsdp_overlap(prof, info, _SPLITS[name],
                                      _PROFILES["fsdp"])
+    if name == "serve_decode_tp":
+        return ca.check_serve_decode_tp(prof, info, _SPLITS[name])
+    if name.startswith("serve_decode_tp_"):
+        return ca.check_serve_decode_tp_overlap(prof, info, _SPLITS[name])
     return ca.check_pp(prof, info)
 
 
@@ -232,6 +236,11 @@ REGIME_NAMES = (
     "tp_mlp_overlap_bidir",
     "fsdp_overlap_ring",
     "fsdp_overlap_bidir",
+    # TP serving decode path (slow lane: transformer decode lowers) —
+    # layout-only baseline vs the ag_matmul-routed overlap variants
+    "serve_decode_tp",
+    "serve_decode_tp_ring",
+    "serve_decode_tp_bidir",
 )
 
 

@@ -65,6 +65,39 @@ class TestTpurun:
             assert rec["TPUDIST_LOCAL_WORLD_SIZE"] == "3"
             assert rec["TPUDIST_COORDINATOR"].startswith("127.0.0.1:")
 
+    def test_devices_per_proc_sets_xla_flag(self, tmp_path, monkeypatch):
+        """--devices-per-proc plants the host-platform device-count flag
+        in each worker's XLA_FLAGS (replacing any inherited one), so CPU
+        rungs can run per-process multi-device meshes; without the flag
+        the inherited env passes through untouched."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import json, os
+            out = os.path.join(os.environ["OUT_DIR"],
+                               "r" + os.environ["TPUDIST_PROCESS_ID"]
+                               + ".json")
+            json.dump({"xla": os.environ.get("XLA_FLAGS", "")},
+                      open(out, "w"))
+        """)
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        monkeypatch.setenv("OUT_DIR", str(out_dir))
+        # a stale inherited count must be REPLACED, not duplicated
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_foo=1 --xla_force_host_platform_device_count=3")
+        rc = tpurun_main(["--nprocs", "2", "--devices-per-proc", "4",
+                          "--tmpdir", str(tmp_path / "scratch"),
+                          "--", sys.executable, str(worker)])
+        assert rc == 0
+        recs = [json.load(open(f)) for f in sorted(out_dir.glob("r*.json"))]
+        assert len(recs) == 2
+        for rec in recs:
+            assert rec["xla"].count(
+                "xla_force_host_platform_device_count") == 1
+            assert "--xla_force_host_platform_device_count=4" in rec["xla"]
+            assert "--xla_foo=1" in rec["xla"]  # other flags preserved
+
     def test_node_rank_offsets_global_rank(self, tmp_path, monkeypatch):
         _clean_env(monkeypatch)
         worker = _write_worker(tmp_path, """

@@ -88,6 +88,68 @@ class TestPrimitives:
             P(AXIS_MODEL, None))
         np.testing.assert_allclose(f(x, w), x @ w, **REASSOC)
 
+    # -- decode-shaped variants (the serving TP path's input shapes) --------
+
+    @pytest.mark.parametrize("mode", ["ring", "bidir"])
+    @pytest.mark.parametrize("gather", ["rhs", "contract"])
+    def test_ag_matmul_leading_batch_dims(self, model_mesh, mode, gather):
+        """rhs/contract accept ``[..., m, k]`` inputs (the decode step's
+        ``[slots, 1, d]`` activations): flattened into the ring, leading
+        dims restored — values match the batched dense matmul."""
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((4, 1, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+        w_spec = P(None, AXIS_MODEL) if gather == "rhs" \
+            else P(AXIS_MODEL, None)
+        f = _sharded(
+            lambda xx, ww: ag_matmul(xx, ww, axis_name=AXIS_MODEL,
+                                     mode=mode, gather=gather),
+            model_mesh, (P(None, None, None), w_spec), P(None, None, None))
+        out = f(x, w)
+        assert out.shape == (4, 1, 32)
+        tol = REASSOC if gather == "contract" else EXACT
+        np.testing.assert_allclose(
+            out, jnp.einsum("bsk,kf->bsf", x, w), **tol)
+
+    def test_ag_matmul_lhs_rejects_leading_dims(self, model_mesh):
+        x = jnp.zeros((2, 4, 8), jnp.float32)
+        w = jnp.zeros((8, 16), jnp.float32)
+        f = _sharded(
+            lambda xx, ww: ag_matmul(xx, ww, axis_name=AXIS_MODEL,
+                                     gather="lhs"),
+            model_mesh, (P(None, None, None), P(None, None)),
+            P(None, None, None))
+        with pytest.raises(ValueError, match="2-D"):
+            f(x, w)
+
+    @pytest.mark.parametrize("mode", ["ring", "bidir"])
+    def test_matmul_rs_pad_rows(self, model_mesh, mode):
+        """pad_rows: a row count that does not divide the ring (decode
+        batches rarely do) zero-pads up, every device returns its chunk
+        of the padded rows, and the assembled result sliced back to m
+        matches the dense matmul.  Without the flag the same shape
+        raises."""
+        rng = np.random.default_rng(5)
+        m = 12  # 8-ring: pads to 16
+        x = jnp.asarray(rng.standard_normal((m, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+        f = _sharded(
+            lambda xx, ww: matmul_rs(xx, ww, axis_name=AXIS_MODEL,
+                                     mode=mode, pad_rows=True),
+            model_mesh, (P(None, AXIS_MODEL), P(AXIS_MODEL, None)),
+            P(AXIS_MODEL, None))
+        out = f(x, w)
+        assert out.shape[0] == 16  # the padded row count, chunk-assembled
+        np.testing.assert_allclose(out[:m], x @ w, **REASSOC)
+        np.testing.assert_allclose(out[m:], 0.0, atol=1e-6)
+        g = _sharded(
+            lambda xx, ww: matmul_rs(xx, ww, axis_name=AXIS_MODEL,
+                                     mode=mode),
+            model_mesh, (P(None, AXIS_MODEL), P(AXIS_MODEL, None)),
+            P(AXIS_MODEL, None))
+        with pytest.raises(ValueError, match="pad_rows"):
+            g(x, w)
+
     @pytest.mark.parametrize("mode", ["ring", "bidir"])
     def test_gradients_match_dense(self, model_mesh, mode):
         """Backward through the full gather→matmul→reduce-scatter chain:

@@ -1,0 +1,446 @@
+"""Sharded (SPMD) serving + prefill/decode disaggregation tests.
+
+The acceptance contract of the serving mesh (tpudist/serve/spmd.py) is
+the SAME one every serving change has had to meet: greedy output
+byte-identical to the single-device sequential ``generate()`` oracle —
+now at every tested mesh shape (1x2 pure-TP, 2x2 data×model), on the
+dense and the paged engine, with the ag_matmul overlap routing on and
+off; sampled output stream-identical to the unsharded engine; jit
+compile counts pinned flat under churn and late joins with the mesh
+enabled.  Disaggregation adds its own oracle: a prompt prefilled in the
+prefill pool must land in a decode-pool slot and CONTINUE
+byte-identically, through both the device and the serialized KV
+handoff.  Heavier sweeps run in the slow lane (conftest patterns)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist.models import create_transformer, generate
+from tpudist.serve import DisaggServer, ServeConfig, ServeMeshConfig, SlotEngine
+from tpudist.serve.disagg import deserialize_package, serialize_package
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+def _prompt(plen, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _reference(model, prompt, max_new):
+    module, params = model
+    import jax.numpy as jnp
+
+    out = generate(module, params, jnp.asarray(prompt)[None], max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+#: the dense suite's heterogeneous-churn request mix: more requests
+#: than slots (churn), a prompt longer than the pad (chunked prefill)
+REQUESTS = [
+    (_prompt(3, 0), 4),
+    (_prompt(5, 1), 6),
+    (_prompt(12, 2), 3),
+    (_prompt(6, 3), 5),
+]
+
+
+def _drive(model, requests, *, num_slots=2, prefill_pad=8, decode_block=8,
+           temperature=0.0, seed=0, **engine_kw):
+    """FIFO continuous-batching drive over a raw SlotEngine (the
+    test_serve oracle driver, mesh-capable via ``engine_kw``)."""
+    module, params = model
+    eng = SlotEngine(module, params, num_slots=num_slots,
+                     prefill_pad=prefill_pad, decode_block=decode_block,
+                     **engine_kw)
+    pending = list(enumerate(requests))
+    out = {rid: [] for rid, _ in pending}
+    slot_rid, slot_budget = {}, {}
+
+    def deliver(slot, toks):
+        rid = slot_rid[slot]
+        out[rid].extend(toks)
+        if len(out[rid]) >= slot_budget[slot]:
+            eng.evict(slot)
+            del slot_rid[slot], slot_budget[slot]
+
+    while pending or eng.num_occupied:
+        free = eng.free_slots()
+        items, reserved = [], 0
+        while free and pending:
+            rid, (prompt, max_new) = pending[0]
+            if not eng.can_admit_kv(len(prompt), max_new, reserve=reserved):
+                break
+            reserved += eng.kv_footprint(len(prompt), max_new)
+            pending.pop(0)
+            slot = free.pop(0)
+            slot_rid[slot], slot_budget[slot] = rid, max_new
+            items.append((slot, prompt, temperature, seed + rid, max_new))
+        for slot, tok in eng.start_batch(items).items():
+            if tok is not None:
+                deliver(slot, [tok])
+        for slot, tok in eng.advance_prefill().items():
+            deliver(slot, [tok])
+        if eng.num_active:
+            _, blocks = eng.decode_block()
+            for slot, toks in blocks.items():
+                deliver(slot, toks)
+    return out, eng
+
+
+class TestServeMeshConfig:
+    def test_shapes_parse(self):
+        assert ServeMeshConfig("2x2").dims == (2, 2)
+        assert ServeMeshConfig("4").dims == (1, 4)
+        assert ServeMeshConfig("1").dims == (1, 1)
+        assert not ServeMeshConfig("1x1").enabled
+        assert ServeMeshConfig("2x4").n_devices == 8
+
+    def test_bad_shapes_raise(self):
+        for bad in ("x", "2x2x2", "0x2", "two"):
+            with pytest.raises(ValueError, match="serve mesh shape"):
+                ServeMeshConfig(bad).dims
+
+    def test_too_many_devices_raises(self):
+        from tpudist.serve.spmd import build_serve_mesh
+
+        with pytest.raises(ValueError, match="needs"):
+            build_serve_mesh(ServeMeshConfig("4x4"))  # 16 > the test 8
+
+
+class TestServeSpmd:
+    """Fast mesh acceptance: pure-TP 1x2, overlap routing ON (the
+    structural-exactness path) — oracle byte-identity plus the layout
+    actually sharding."""
+
+    def test_mesh_oracle_greedy_1x2_overlap(self, model):
+        out, eng = _drive(model, REQUESTS,
+                          mesh=ServeMeshConfig("1x2", tp_overlap="ring"))
+        for rid, (prompt, max_new) in enumerate(REQUESTS):
+            assert out[rid] == _reference(model, prompt, max_new), rid
+        st = eng.spmd_stats()
+        assert st["mesh"] == {"data": 1, "model": 2}
+        assert st["tp_overlap"] == "ring"
+        # the HBM story is real: param bytes per device strictly below
+        # the replicated total
+        assert st["param_bytes_per_device"] < st["param_bytes_total"]
+        assert st["param_bytes_sharded"] > 0
+
+    def test_params_and_cache_actually_sharded(self, model):
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         mesh=ServeMeshConfig("1x2", tp_overlap="ring"))
+        # cache K/V arenas carry the model-axis sharding, and KEEP it
+        # after donated program iterations (the with_sharding_constraint
+        # in the programs makes the layout structural)
+        eng.start_batch([(0, _prompt(4, 7), 0.0, 0, 6)])
+        eng.decode_block()
+        leaf = eng.cache["block_0"]["k"]
+        spec = tuple(leaf.sharding.spec)
+        assert "model" in spec, spec
+        assert eng.num_active == 1
+
+    def test_disagg_handoff_serial_byte_identical(self, model):
+        """The tentpole's disaggregation oracle at engine level: prefill
+        in engine A, hand the KV off SERIALIZED (the multi-process
+        transfer stand-in), decode in engine B — byte-identical to the
+        sequential oracle."""
+        module, params = model
+        pre = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        dec = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        p, max_new = _prompt(5, 11), 6
+        toks = [pre.start_batch([(0, p, 0.0, 0, max_new)])[0]]
+        pkg = deserialize_package(serialize_package(pre.export_slot(0)))
+        pre.evict(0)
+        dec.import_slot(1, pkg)
+        while len(toks) < max_new:
+            _, blocks = dec.decode_block()
+            toks.extend(blocks[1])
+        assert toks[:max_new] == _reference(model, p, max_new)
+        # handoff programs are part of the pinned compile budget
+        assert pre.compile_counts()["export_lane"] == 1
+        assert dec.compile_counts()["import_lane"] == 1
+
+    def test_serialize_roundtrip_is_byte_preserving(self, model):
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        eng.start_batch([(0, _prompt(4, 3), 0.7, 9, 5)])
+        pkg = eng.export_slot(0)
+        rt = deserialize_package(serialize_package(pkg))
+        flat_a = jax.tree.leaves((pkg["lane"], pkg["state"]))
+        flat_b = jax.tree.leaves((rt["lane"], rt["state"]))
+        for a, b in zip(flat_a, flat_b):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert (rt["pos"], rt["counts"], rt["budget"]) == \
+            (pkg["pos"], pkg["counts"], pkg["budget"])
+
+    def test_serialize_roundtrip_bf16_lane(self):
+        """A bf16 model's KV lane survives the serialized handoff with
+        byte-identical continuation — dtypes round-trip by NAME (the
+        ml_dtypes struct codes degrade to raw void and would destroy
+        the lane)."""
+        import jax.numpy as jnp
+
+        module, params = create_transformer(
+            jax.random.PRNGKey(1), seq_len=16, dtype=jnp.bfloat16, **CFG)
+        pre = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        dec = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        ref = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        p, max_new = _prompt(5, 2), 6
+        toks = [pre.start_batch([(0, p, 0.0, 0, max_new)])[0]]
+        ref_toks = [ref.start_batch([(0, p, 0.0, 0, max_new)])[0]]
+        pkg = deserialize_package(serialize_package(pre.export_slot(0)))
+        assert str(pkg["lane"]["block_0"]["k"].dtype) == "bfloat16"
+        pre.evict(0)
+        dec.import_slot(0, pkg)
+        while len(toks) < max_new:
+            _, blocks = dec.decode_block()
+            toks.extend(blocks[0])
+        while len(ref_toks) < max_new:
+            _, blocks = ref.decode_block()
+            ref_toks.extend(blocks[0])
+        assert toks[:max_new] == ref_toks[:max_new]
+
+    def test_import_into_occupied_or_mismatched_raises(self, model):
+        module, params = model
+        a = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        b = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                       paged=True, kv_block=4)
+        a.start_batch([(0, _prompt(3, 0), 0.0, 0, 4),
+                       (1, _prompt(3, 1), 0.0, 0, 4)])
+        pkg = a.export_slot(0)
+        with pytest.raises(ValueError, match="occupied"):
+            a.import_slot(1, pkg)
+        with pytest.raises(ValueError, match="paged"):
+            b.import_slot(0, pkg)
+        with pytest.raises(ValueError, match="not decoding"):
+            SlotEngine(module, params, num_slots=1,
+                       prefill_pad=8).export_slot(0)
+
+
+class TestServeMeshOracleSweep:
+    """Slow lane: the full heterogeneous-churn oracle sweep across mesh
+    shapes × engine modes, sampled stream identity, and the compile-pin
+    contract under churn/late joins with the mesh enabled."""
+
+    @pytest.mark.parametrize("shape,overlap", [
+        ("1x2", "off"), ("2x2", "ring"), ("2x2", "off")])
+    def test_oracle_greedy_dense(self, model, shape, overlap):
+        out, _ = _drive(model, REQUESTS,
+                        mesh=ServeMeshConfig(shape, tp_overlap=overlap))
+        for rid, (prompt, max_new) in enumerate(REQUESTS):
+            assert out[rid] == _reference(model, prompt, max_new), \
+                (shape, overlap, rid)
+
+    @pytest.mark.parametrize("shape", ["1x2", "2x2"])
+    def test_oracle_greedy_paged(self, model, shape):
+        out, _ = _drive(model, REQUESTS,
+                        mesh=ServeMeshConfig(shape, tp_overlap="ring"),
+                        paged=True, kv_block=4)
+        for rid, (prompt, max_new) in enumerate(REQUESTS):
+            assert out[rid] == _reference(model, prompt, max_new), \
+                (shape, rid)
+
+    @pytest.mark.parametrize("k", [1, 4, 8])
+    def test_oracle_greedy_every_block_size_on_mesh(self, model, k):
+        """Byte-identity holds at every decode block size with the mesh
+        enabled (block fusion and sharding compose)."""
+        out, _ = _drive(model, REQUESTS, decode_block=k,
+                        mesh=ServeMeshConfig("2x2", tp_overlap="ring"))
+        for rid, (prompt, max_new) in enumerate(REQUESTS):
+            assert out[rid] == _reference(model, prompt, max_new), (k, rid)
+
+    def test_sampled_streams_match_unsharded(self, model):
+        """temperature > 0 on the mesh engine draws the SAME per-request
+        streams as the single-device engine: sampling is
+        ``fold_in(key, count)`` — topology-independent."""
+        ref, _ = _drive(model, REQUESTS, temperature=1.3, seed=40)
+        got, _ = _drive(model, REQUESTS, temperature=1.3, seed=40,
+                        mesh=ServeMeshConfig("2x2", tp_overlap="ring"))
+        assert got == ref
+
+    def test_compile_counts_flat_across_mesh_and_late_join(self, model):
+        """Churn + a late join recompile NOTHING with the mesh enabled,
+        and the pin values match the single-device engine exactly —
+        mesh shapes change shardings, never programs."""
+        pins = {}
+        for label, kw in (
+                ("none", {}),
+                ("1x2", dict(mesh=ServeMeshConfig("1x2",
+                                                  tp_overlap="ring"))),
+                ("2x2", dict(mesh=ServeMeshConfig("2x2",
+                                                  tp_overlap="ring")))):
+            out, eng = _drive(model, REQUESTS, **kw)
+            # late join: a fresh request after the churn completed
+            p, mn = _prompt(4, 99), 3
+            toks = [eng.start_batch([(0, p, 0.0, 0, mn)])[0]]
+            while len(toks) < mn:
+                _, blocks = eng.decode_block()
+                toks.extend(blocks[0])
+            eng.evict(0)
+            assert toks[:mn] == _reference(model, p, mn), label
+            cc = eng.compile_counts()
+            assert cc["insert_batch"] == 1, (label, cc)
+            assert cc["evict"] == 1, (label, cc)
+            assert cc["prefill_extend"] == 1, (label, cc)
+            pins[label] = cc
+        assert pins["1x2"] == pins["2x2"] == pins["none"]
+
+    def test_disagg_device_handoff_paged_byte_identical(self, model):
+        """Paged engines, device-mode handoff, int8 round trip: the
+        decode-pool continuation stays byte-identical (the int8
+        requantization on import reproduces the same q/scale)."""
+        module, params = model
+        for int8 in (False, True):
+            pre = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                             paged=True, kv_block=4, kv_int8=int8)
+            dec = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                             paged=True, kv_block=4, kv_int8=int8)
+            p, max_new = _prompt(9, 21), 5  # > pad: chunked prefill
+            firsts = pre.start_batch([(0, p, 0.0, 0, max_new)])
+            toks = []
+            if firsts[0] is not None:
+                toks.append(firsts[0])
+            while not toks:
+                done = pre.advance_prefill()
+                if 0 in done:
+                    toks.append(done[0])
+            pkg = pre.export_slot(0)
+            pre.evict(0)
+            dec.import_slot(0, pkg)
+            while len(toks) < max_new:
+                _, blocks = dec.decode_block()
+                toks.extend(blocks[0])
+            if int8:
+                # int8 decode has its own accuracy bound vs the f32
+                # oracle; the handoff contract is that the DECODE-POOL
+                # continuation equals decoding in the source engine.
+                ref_eng = SlotEngine(module, params, num_slots=2,
+                                     prefill_pad=8, paged=True, kv_block=4,
+                                     kv_int8=True)
+                ref_toks = []
+                f = ref_eng.start_batch([(0, p, 0.0, 0, max_new)])
+                if f[0] is not None:
+                    ref_toks.append(f[0])
+                while not ref_toks:
+                    d = ref_eng.advance_prefill()
+                    if 0 in d:
+                        ref_toks.append(d[0])
+                while len(ref_toks) < max_new:
+                    _, blocks = ref_eng.decode_block()
+                    ref_toks.extend(blocks[0])
+                assert toks[:max_new] == ref_toks[:max_new]
+            else:
+                assert toks[:max_new] == _reference(model, p, max_new)
+
+
+class TestDisaggServer:
+    """Coordinator end-to-end: the prefill-pool → decode-pool path with
+    byte-identical output, per-pool telemetry, and drain semantics."""
+
+    def test_disagg_server_oracle_and_pools_report(self, model, tmp_path):
+        from tpudist import telemetry
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        module, params = model
+        telemetry.start(tmp_path)
+        try:
+            cfg = ServeConfig(num_slots=2, prefill_slots=2,
+                              prefill_workers=1, decode_workers=1,
+                              disagg=True, handoff="serial",
+                              decode_block=4)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            hs = [srv.submit(p, max_new=mn, seed=i)
+                  for i, (p, mn) in enumerate(REQUESTS)]
+            for h in hs:
+                assert h.wait(120), "request timed out"
+            for h, (p, mn) in zip(hs, REQUESTS):
+                assert h.tokens == _reference(model, p, mn)
+                assert h.finish_reason == "length"
+            st = srv.stats()
+            # every multi-token request crossed pools exactly once
+            assert st["handoffs"] == len(REQUESTS)
+            assert st["handoff_bytes"] > 0
+            # the serialized transfer really serialized
+            waits = [h.handoff_wait_s for h in hs]
+            assert all(w is not None and w >= 0 for w in waits)
+            assert srv.close(timeout=30)
+        finally:
+            telemetry.finish(write_report=False)
+        report = aggregate_run(tmp_path)
+        sv = report["serving"]
+        pools = sv["pools"]
+        assert pools["handoffs"] == len(REQUESTS)
+        assert pools["prefill"]["spans"] > 0
+        assert pools["decode"]["spans"] > 0
+        assert pools["prefill"]["ttft"] is not None
+        assert pools["decode"]["tpot"] is not None
+        assert pools["handoff_wait"]["p50_s"] >= 0
+
+    def test_disagg_max_new_one_finishes_in_prefill_pool(self, model):
+        module, params = model
+        cfg = ServeConfig(num_slots=2, disagg=True, handoff="device")
+        srv = DisaggServer(module, params, cfg,
+                           install_signal_handler=False).start()
+        h = srv.submit(_prompt(3, 5), max_new=1)
+        assert h.wait(60)
+        assert h.tokens == _reference(model, _prompt(3, 5), 1)
+        assert srv.stats()["handoffs"] == 0  # never crossed pools
+        assert srv.close(timeout=30)
+
+    def test_disagg_drain_finishes_everything(self, model):
+        module, params = model
+        cfg = ServeConfig(num_slots=2, disagg=True, handoff="serial")
+        srv = DisaggServer(module, params, cfg,
+                           install_signal_handler=False).start()
+        hs = [srv.submit(_prompt(3 + i, i), max_new=4, seed=i)
+              for i in range(4)]
+        assert srv.close(timeout=120)
+        for h in hs:
+            assert h.done
+            # drained, not cut: everything admitted completed
+            assert h.finish_reason == "length", h.finish_reason
+
+    def test_disagg_multi_worker_pools(self, model):
+        """2 prefill + 2 decode workers: work spreads, output exact."""
+        module, params = model
+        cfg = ServeConfig(num_slots=2, prefill_slots=1,
+                          prefill_workers=2, decode_workers=2,
+                          disagg=True, handoff="device", decode_block=4)
+        srv = DisaggServer(module, params, cfg,
+                           install_signal_handler=False).start()
+        reqs = [(_prompt(3 + i % 3, 30 + i), 3 + i % 4) for i in range(6)]
+        hs = [srv.submit(p, max_new=mn, seed=i)
+              for i, (p, mn) in enumerate(reqs)]
+        for h in hs:
+            assert h.wait(180)
+        for h, (p, mn) in zip(hs, reqs):
+            assert h.tokens == _reference(model, p, mn)
+        st = srv.stats()
+        assert st["decode_pool"]["workers"] == 2
+        assert st["handoffs"] == sum(1 for _, mn in reqs if mn > 1)
+        assert srv.close(timeout=30)
+
+    def test_disagg_on_mesh(self, model):
+        """Disaggregation composes with the serving mesh: both pools
+        SPMD over 1x2, serialized handoff, byte-identical output."""
+        module, params = model
+        cfg = ServeConfig(num_slots=2, disagg=True, handoff="serial",
+                          mesh="1x2", tp_overlap="ring")
+        srv = DisaggServer(module, params, cfg,
+                           install_signal_handler=False).start()
+        hs = [srv.submit(p, max_new=mn, seed=i)
+              for i, (p, mn) in enumerate(REQUESTS[:2])]
+        for h in hs:
+            assert h.wait(180)
+        for h, (p, mn) in zip(hs, REQUESTS[:2]):
+            assert h.tokens == _reference(model, p, mn)
+        assert srv.stats()["spmd"]["mesh"] == {"data": 1, "model": 2}
+        assert srv.close(timeout=30)

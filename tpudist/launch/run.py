@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "the end-of-run goodput report lands (default: "
                         "$TPUDIST_TELEMETRY_DIR or <tmpdir>/telemetry; "
                         "TPUDIST_TELEMETRY=0 disables)")
+    p.add_argument("--devices-per-proc", type=int, default=None,
+                   help="emulated devices per worker (sets XLA's "
+                        "host-platform device-count flag in the worker "
+                        "env) — lets CPU smoke rungs and tests run "
+                        "per-process multi-device meshes, e.g. a sharded "
+                        "serve worker per process")
     p.add_argument("--no-python-check", action="store_true",
                    help="allow worker commands that do not start with 'python'")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -109,8 +115,21 @@ def _validate_cmd(cmd: List[str], allow_any: bool) -> List[str]:
 def _worker_env(base: Dict[str, str], *, coordinator: Optional[str], world: int,
                 rank: int, local_rank: int, nprocs: int, run_id: str,
                 restart_count: int, error_template: str, tmpdir: str,
-                telemetry_dir: Optional[str] = None) -> Dict[str, str]:
+                telemetry_dir: Optional[str] = None,
+                devices_per_proc: Optional[int] = None) -> Dict[str, str]:
     env = dict(base)
+    if devices_per_proc and devices_per_proc > 0:
+        # Per-process emulated multi-device mesh (CPU rigs): the XLA
+        # host-platform flag must be in the env BEFORE jax initializes
+        # its backends in the worker.  An existing device-count flag in
+        # the inherited XLA_FLAGS is replaced, not duplicated (last
+        # occurrence wins in XLA, but a stale first one is confusing in
+        # ps output and logs).
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={devices_per_proc}")
+        env["XLA_FLAGS"] = " ".join(flags)
     env.update({
         "TPUDIST_NUM_PROCESSES": str(world),
         "TPUDIST_PROCESS_ID": str(rank),
@@ -205,7 +224,8 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
                           rank=rank, local_rank=i, nprocs=args.nprocs,
                           run_id=run_id, restart_count=restart_count,
                           error_template=error_template, tmpdir=tmpdir,
-                          telemetry_dir=telemetry_dir)
+                          telemetry_dir=telemetry_dir,
+                          devices_per_proc=args.devices_per_proc)
         procs.append(subprocess.Popen(cmd, env=env))
     failed_rc = 0
     try:

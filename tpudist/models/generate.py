@@ -300,6 +300,19 @@ class SlotDecode(NamedTuple):
     - ``evict(state, cache, slot)`` → that lane zeroed in both cache and
       state (a freed slot must not leak a tenant's K/V into the next
       request's garbage window);
+    - ``export_lane(state, cache, slot)`` → ``(lane, lane_state)``: one
+      slot's KV lane plus its SlotState row — the export half of the
+      prefill→decode KV handoff (:mod:`tpudist.serve.disagg`).  Dense:
+      the lane is the slot's flax cache slice; paged: a dense
+      ``(k, v, meta)`` view gathered through the slot's block table
+      (int8 pools dequantize; the re-import re-quantizes bit-exactly);
+    - ``import_lane(state, cache, slot, [row,] lane, lane_state)`` →
+      install an exported lane into ``slot`` (paged takes the dest
+      allocator's fresh table ``row`` as data).  Greedy/sampled
+      continuation after import is byte-identical to decoding in the
+      source engine: the state row carries ``last_tok``/``counts``/
+      ``keys``, and the sampling stream is ``fold_in(key, count)`` —
+      independent of which engine or slot hosts the request;
     - ``sample(logits, keys, temps, counts)`` → per-slot token draw:
       greedy argmax where ``temps <= 0``, else categorical at that slot's
       temperature from ``fold_in(key, count)`` — a deterministic
@@ -336,6 +349,8 @@ class SlotDecode(NamedTuple):
     sample: Callable
     peek_logits: Optional[Callable] = None
     paged: Optional["_Paged"] = None
+    export_lane: Optional[Callable] = None
+    import_lane: Optional[Callable] = None
 
 
 def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
@@ -353,12 +368,23 @@ def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
 
 
 def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
-                     paged: Optional[PagedKVConfig] = None) -> SlotDecode:
+                     paged: Optional[PagedKVConfig] = None,
+                     cache_constraint: Optional[Callable] = None,
+                     state_constraint: Optional[Callable] = None
+                     ) -> SlotDecode:
     """Build the slot-decode primitive set over ``module``/``params`` —
     see :class:`SlotDecode` for the contract of each callable.  With
     ``paged`` set, the cache is a block pool + block tables instead of
     dense per-slot arenas (:mod:`tpudist.models.paged`); the unquantized
-    paged path is byte-identical to the dense one (tests pin it)."""
+    paged path is byte-identical to the dense one (tests pin it).
+
+    ``cache_constraint`` / ``state_constraint`` (SPMD serving,
+    :mod:`tpudist.serve.spmd`): ``tree -> tree`` callables applying
+    ``with_sharding_constraint`` to the cache / SlotState pytrees.  The
+    hot programs re-assert them on their outputs, making the mesh
+    layout STRUCTURAL — the engine's shardings cannot silently drift
+    (decay to replicated, or pick up a partitioner-invented split that
+    would recompile the next program) across donated iterations."""
     if num_slots < 1:
         raise ValueError(f"num_slots must be >= 1, got {num_slots}")
     if not 1 <= prefill_pad <= module.max_len:
@@ -368,6 +394,12 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     init_cache, step = make_decode_step(module, params)
     vocab = module.vocab
     vstep = jax.vmap(step, in_axes=(0, 0))
+
+    def _constrain(cache):
+        return cache if cache_constraint is None else cache_constraint(cache)
+
+    def _constrain_state(state):
+        return state if state_constraint is None else state_constraint(state)
 
     def init_state():
         s = num_slots
@@ -449,8 +481,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
             firsts = _slot_sample(last_logits, keys, temps,
                                   jnp.zeros(num_slots, jnp.int32))
-            pkv = pg.commit_lanes(pkv, lanes, tables, dsts, poss,
-                                  prefill_pad)
+            pkv = _constrain(pg.commit_lanes(pkv, lanes, tables, dsts, poss,
+                                             prefill_pad))
             state = SlotState(
                 last_tok=state.last_tok.at[dsts].set(
                     jnp.where(last, firsts, 0)),
@@ -459,7 +491,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 counts=state.counts.at[dsts].set(last.astype(jnp.int32)),
                 temps=state.temps.at[dsts].set(temps),
                 keys=state.keys.at[dsts].set(keys))
-            return state, pkv, firsts
+            return _constrain_state(state), pkv, firsts
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def prefill_extend_paged(state, pkv, slot, chunk, clen, is_last):
@@ -468,10 +500,10 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             pos0 = _cache_cursor(meta1)
             cache, last_logits = _force_chunk(
                 pg.lane_cache(pkv, row, meta1), chunk, clen)
-            pkv = pg.commit_lanes(
+            pkv = _constrain(pg.commit_lanes(
                 pkv, jax.tree.map(lambda a: a[None], cache),
                 row[None], jnp.reshape(slot, (1,)), jnp.reshape(pos0, (1,)),
-                prefill_pad)
+                prefill_pad))
             first = _slot_sample(
                 last_logits[None], state.keys[slot][None],
                 state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
@@ -481,7 +513,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 last_tok=state.last_tok.at[slot].set(
                     jnp.where(is_last, first, 0)),
                 counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
-            return state, pkv, first
+            return _constrain_state(state), pkv, first
 
         @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
         def decode_block_paged(state, pkv, k):
@@ -489,12 +521,12 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             mask = state.active
             (state, cache), toks = _decode_scan(
                 state, pg.slot_cache(pkv), k)
-            pkv = pg.commit_slots(pkv, cache, pos0, k, mask)
-            return state, pkv, toks
+            pkv = _constrain(pg.commit_slots(pkv, cache, pos0, k, mask))
+            return _constrain_state(state), pkv, toks
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def evict_paged(state, pkv, slot, free_ids):
-            pkv = pg.release(pkv, slot, free_ids)
+            pkv = _constrain(pg.release(pkv, slot, free_ids))
             zero = jnp.zeros((), jnp.int32)
             state = SlotState(
                 last_tok=state.last_tok.at[slot].set(zero),
@@ -503,13 +535,27 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 counts=state.counts.at[slot].set(zero),
                 temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
                 keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
-            return state, pkv
+            return _constrain_state(state), pkv
 
         @jax.jit
         def peek_logits_paged(state, pkv):
             _, logits = vstep(pg.slot_cache(pkv),
                               state.last_tok[:, None, None])
             return logits[:, 0]
+
+        @jax.jit
+        def export_lane_paged(state, pkv, slot):
+            ks, vs, meta1 = pg.extract_lane(pkv, slot)
+            lane_state = jax.tree.map(lambda a: a[slot], state)
+            return (ks, vs, meta1), lane_state
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def import_lane_paged(state, pkv, slot, row, lane, lane_state):
+            ks, vs, meta1 = lane
+            pkv = _constrain(pg.adopt_lane(pkv, slot, row, ks, vs, meta1))
+            state = jax.tree.map(lambda full, v: full.at[slot].set(v),
+                                 state, lane_state)
+            return _constrain_state(state), pkv
 
         return SlotDecode(
             num_slots=num_slots, prefill_pad=prefill_pad,
@@ -518,7 +564,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             prefill_extend=prefill_extend_paged,
             decode_block=decode_block_paged, evict=evict_paged,
             sample=jax.jit(_slot_sample), peek_logits=peek_logits_paged,
-            paged=pg)
+            paged=pg, export_lane=export_lane_paged,
+            import_lane=import_lane_paged)
 
     # The slot state AND cache are donated in every primitive that threads
     # them: the engine always overwrites both with the result, and without
@@ -536,8 +583,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         # sentinel dst num_slots: out-of-bounds scatter indices are
         # DROPPED (jax's default scatter mode), so one fixed-shape
         # program serves every admission-batch size.
-        cache = jax.tree.map(
-            lambda full, b: full.at[dsts].set(b), cache, lanes)
+        cache = _constrain(jax.tree.map(
+            lambda full, b: full.at[dsts].set(b), cache, lanes))
         state = SlotState(
             last_tok=state.last_tok.at[dsts].set(jnp.where(last, firsts, 0)),
             active=state.active.at[dsts].set(last),
@@ -545,7 +592,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             counts=state.counts.at[dsts].set(last.astype(jnp.int32)),
             temps=state.temps.at[dsts].set(temps),
             keys=state.keys.at[dsts].set(keys))
-        return state, cache, firsts
+        return _constrain_state(state), cache, firsts
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def prefill_extend(state, cache, slot, chunk, clen, is_last):
@@ -553,9 +600,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             lambda full: lax.dynamic_index_in_dim(
                 full, slot, 0, keepdims=False), cache)
         lane, last_logits = _force_chunk(lane, chunk, clen)
-        cache = jax.tree.map(
+        cache = _constrain(jax.tree.map(
             lambda full, l: lax.dynamic_update_index_in_dim(full, l, slot, 0),
-            cache, lane)
+            cache, lane))
         first = _slot_sample(
             last_logits[None], state.keys[slot][None],
             state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
@@ -565,19 +612,19 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             last_tok=state.last_tok.at[slot].set(
                 jnp.where(is_last, first, 0)),
             counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
-        return state, cache, first
+        return _constrain_state(state), cache, first
 
     @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
     def decode_block(state, cache, k):
         (state, cache), toks = _decode_scan(state, cache, k)
-        return state, cache, toks
+        return _constrain_state(state), _constrain(cache), toks
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def evict(state, cache, slot):
-        cache = jax.tree.map(
+        cache = _constrain(jax.tree.map(
             lambda full: lax.dynamic_update_index_in_dim(
                 full, jnp.zeros(full.shape[1:], full.dtype), slot, 0),
-            cache)
+            cache))
         zero = jnp.zeros((), jnp.int32)
         state = SlotState(
             last_tok=state.last_tok.at[slot].set(zero),
@@ -586,18 +633,36 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             counts=state.counts.at[slot].set(zero),
             temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
             keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
-        return state, cache
+        return _constrain_state(state), cache
 
     @jax.jit
     def peek_logits(state, cache):
         _, logits = vstep(cache, state.last_tok[:, None, None])
         return logits[:, 0]
 
+    @jax.jit
+    def export_lane(state, cache, slot):
+        lane = jax.tree.map(
+            lambda full: lax.dynamic_index_in_dim(
+                full, slot, 0, keepdims=False), cache)
+        lane_state = jax.tree.map(lambda a: a[slot], state)
+        return lane, lane_state
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def import_lane(state, cache, slot, lane, lane_state):
+        cache = _constrain(jax.tree.map(
+            lambda full, l: lax.dynamic_update_index_in_dim(full, l, slot, 0),
+            cache, lane))
+        state = jax.tree.map(lambda full, v: full.at[slot].set(v),
+                             state, lane_state)
+        return _constrain_state(state), cache
+
     return SlotDecode(
         num_slots=num_slots, prefill_pad=prefill_pad, init_state=init_state,
         init_slots=init_slots, insert_batch=insert_batch,
         prefill_extend=prefill_extend, decode_block=decode_block,
-        evict=evict, sample=jax.jit(_slot_sample), peek_logits=peek_logits)
+        evict=evict, sample=jax.jit(_slot_sample), peek_logits=peek_logits,
+        export_lane=export_lane, import_lane=import_lane)
 
 
 def decode_logits(module, params, tokens: jax.Array) -> jax.Array:

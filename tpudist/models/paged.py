@@ -304,6 +304,38 @@ class _Paged:
                             pkv.meta, strip_kv(cache))
         return pkv._replace(table=pkv.table.at[dsts].set(rows), meta=meta)
 
+    # -- KV handoff (prefill/decode disaggregation) -------------------------
+
+    def extract_lane(self, pkv: PagedKV, slot: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array, Any]:
+        """One slot's KV as a dense lane ``(k, v, meta1)`` with ``k``/``v``
+        ``[L, n_kv, max_len, dh]`` in the compute dtype — the export half
+        of the prefill→decode KV handoff.  Positions beyond the slot's
+        cursor carry clamped-gather garbage exactly like any dispatch's
+        dense view; the importing engine's attention mask excludes them
+        bit-identically.  int8 pools dequantize here and re-quantize on
+        :meth:`adopt_lane` — a bit-exact round trip (requantizing a
+        block whose values came from ``q * scale`` reproduces the same
+        ``q`` and ``scale``)."""
+        ks, vs = self._dense_kv(pkv, pkv.table[slot])
+        meta1 = jax.tree.map(lambda full: full[slot], pkv.meta)
+        return ks, vs, meta1
+
+    def adopt_lane(self, pkv: PagedKV, slot: jax.Array, row: jax.Array,
+                   ks: jax.Array, vs: jax.Array, meta1: Any) -> PagedKV:
+        """Import half of the KV handoff: scatter a dense lane into the
+        (host-allocated) table ``row [M]``'s blocks, install the row and
+        meta at ``slot``.  Sentinel row entries (footprints shorter than
+        ``M`` blocks) drop their scatter, so only the reserved blocks
+        are written."""
+        cache = jax.tree.map(lambda m: m[None], meta1)
+        for li, name in enumerate(self.layers):
+            cache[name] = dict(cache[name], k=ks[li][None, None],
+                               v=vs[li][None, None])
+        return self.commit_lanes(
+            pkv, cache, row[None], jnp.reshape(slot, (1,)),
+            jnp.zeros((1,), jnp.int32), self.max_len)
+
     # -- evict --------------------------------------------------------------
 
     def release(self, pkv: PagedKV, slot: jax.Array,

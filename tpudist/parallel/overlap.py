@@ -143,7 +143,14 @@ def ag_matmul(x: jax.Array, w: jax.Array, *, axis_name: str,
     Shard-local (call inside ``shard_map``).  ``x: [m, k]``,
     ``w: [k, f]`` are the LOCAL operands; what is sharded (and therefore
     what rides the ring, one chunk per hop, each hop overlapping the
-    previous chunk's matmul) depends on ``gather``:
+    previous chunk's matmul) depends on ``gather``.
+
+    **Decode-shaped inputs**: for ``gather="rhs"``/``"contract"``, ``x``
+    may carry leading batch dims (``[..., m, k]`` — the serving decode
+    step's ``[slots, 1, d]`` activations); they are flattened into the
+    row axis for the ring and restored on the output.  ``"lhs"`` stays
+    2-D only (its row axis IS the sharded global axis — flattening
+    batch dims into it would change which rows each rank owns).
 
     - ``"lhs"``      x is the local ROW shard of a ``[m*n, k]`` global;
                      returns ``allgather(x) @ w: [m*n, f]`` (bit-exact).
@@ -163,14 +170,26 @@ def ag_matmul(x: jax.Array, w: jax.Array, *, axis_name: str,
     if gather not in ("lhs", "rhs", "contract"):
         raise ValueError(
             f"gather must be 'lhs', 'rhs' or 'contract', got {gather!r}")
+    lead, m = x.shape[:-2], x.shape[-2]
+    if lead:
+        if gather == "lhs":
+            raise ValueError(
+                "gather='lhs' requires 2-D x (the row axis is the sharded "
+                f"global axis); got shape {x.shape} — flatten explicitly "
+                "or use gather='rhs'/'contract'")
+        x = x.reshape((-1, x.shape[-1]))
     n, idx = _axis_env(axis_name)
     if n == 1:
-        return x @ w
-    if gather == "lhs":
+        out = x @ w
+    elif gather == "lhs":
         return _ag_matmul_lhs(x, w, axis_name, n, idx, mode)
-    if gather == "rhs":
-        return _ag_matmul_rhs(x, w, axis_name, n, idx, mode)
-    return _ag_matmul_contract(x, w, axis_name, n, idx, mode)
+    elif gather == "rhs":
+        out = _ag_matmul_rhs(x, w, axis_name, n, idx, mode)
+    else:
+        out = _ag_matmul_contract(x, w, axis_name, n, idx, mode)
+    if lead:
+        out = out.reshape(lead + (m, out.shape[-1]))
+    return out
 
 
 def _ag_matmul_lhs(x, w, axis_name, n, idx, mode):
@@ -268,7 +287,7 @@ def _ag_matmul_contract(x, w, axis_name, n, idx, mode):
 
 
 def matmul_rs(x: jax.Array, w: jax.Array, *, axis_name: str,
-              mode: str = "ring") -> jax.Array:
+              mode: str = "ring", pad_rows: bool = False) -> jax.Array:
     """Matmul feeding a pipelined reduce-scatter ring:
     ``psum_scatter(x @ w, axis_name, scatter over rows)``.
 
@@ -285,7 +304,13 @@ def matmul_rs(x: jax.Array, w: jax.Array, *, axis_name: str,
     rotated per device) — documented f32 bound ``rtol <= 1e-5`` at the
     tested shapes.  ``mode="bidir"`` splits the f columns into halves
     riding opposite directions (same hop count, both link directions
-    busy).  ``m`` must divide by the ring size.
+    busy).  ``m`` must divide by the ring size — unless
+    ``pad_rows=True`` (the decode-shaped variant: serving batches are
+    ``num_slots`` rows, rarely a ring multiple), which zero-pads the
+    rows up to the next multiple; every device then returns its
+    ``ceil(m/n)``-row chunk of the PADDED result, and the caller slices
+    the assembled ``[pad_m, f]`` back to ``m`` rows after the
+    ``shard_map`` reassembles it.
     """
     _check_mode(mode)
     n, idx = _axis_env(axis_name)
@@ -293,7 +318,14 @@ def matmul_rs(x: jax.Array, w: jax.Array, *, axis_name: str,
         return x @ w
     m = x.shape[0]
     if m % n:
-        raise ValueError(f"matmul_rs needs rows {m} divisible by ring {n}")
+        if not pad_rows:
+            raise ValueError(
+                f"matmul_rs needs rows {m} divisible by ring {n} "
+                "(pass pad_rows=True for the padded decode-shaped variant)")
+        pad = (n - m % n) % n
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+        m = x.shape[0]
     mloc = m // n
 
     def xrows(chunk_idx):

@@ -20,10 +20,20 @@ KV cache — block pool + per-slot block tables
 shared-prefix reuse and refcounts (:mod:`tpudist.serve.paged_alloc`),
 optional int8 KV storage — decoupling slot count from ``max_len``.
 
+``ServeConfig(mesh="DxM")`` runs the same four compiled programs SPMD
+over a multi-chip mesh (:mod:`tpudist.serve.spmd`): params and KV
+storage get TP/slot shardings, the host logic is unchanged, greedy
+output stays byte-identical at every mesh shape.
+``ServeConfig(disagg=True)`` splits prefill and decode into separate
+worker pools with KV handoff between them
+(:mod:`tpudist.serve.disagg`).
+
 ``python -m tpudist.serve`` runs a self-contained CPU demo.
 """
 
+from tpudist.serve.disagg import DisaggServer  # noqa: F401
 from tpudist.serve.engine import SlotEngine  # noqa: F401
+from tpudist.serve.spmd import ServeMeshConfig  # noqa: F401
 from tpudist.serve.scheduler import (  # noqa: F401
     AdmissionError,
     Request,

@@ -1,0 +1,561 @@
+"""Prefill/decode disaggregation: separate worker pools with KV handoff.
+
+The single-pool server interleaves prompt chunks and decode blocks on
+ONE engine, so a burst of long prompts steals decode iterations from
+every in-flight stream (bounded to one chunk per iteration by chunked
+prefill, but still stolen).  This coordinator splits the two phases the
+way disaggregated serving systems do (DistServe/Splitwise lineage):
+
+- a **prefill pool** of workers that ONLY teacher-force prompts
+  (``start_batch`` + ``advance_prefill``; their slots never decode);
+- a **decode pool** of workers that ONLY run fused decode blocks;
+- a bounded **handoff queue** between them carrying each finished
+  prompt's KV package (:meth:`tpudist.serve.engine.SlotEngine.
+  export_slot`): the KV lane, the SlotState row, and the budget
+  shadows.  ``import_slot`` installs it in a free decode slot and the
+  request continues BYTE-IDENTICALLY — the sampling stream is
+  ``fold_in(key, count)``, indifferent to which engine or slot hosts
+  the request (the oracle tests pin greedy and sampled continuation).
+
+TTFT is now a prefill-pool number (token 0 is sampled from the final
+prompt logits, in the prefill worker) and TPOT a decode-pool number;
+the telemetry serving section splits them per pool, plus the
+coordinator's own ``handoff_wait`` gap.
+
+Transfer modes (``ServeConfig.handoff``): ``"device"`` passes the
+device arrays through (in-mesh handoff — on one host a reference copy,
+on a real mesh a device-to-device transfer scheduled by the runtime);
+``"serial"`` round-trips every leaf through host bytes
+(``serialize_package``/``deserialize_package``) — the stand-in for the
+multi-process CPU rig, where KV crosses a process boundary as a
+serialized block transfer.  Both modes are byte-preserving (int8 pools
+re-quantize bit-exactly on import; tests pin it).
+
+Thread contract mirrors :class:`tpudist.serve.server.InferenceServer`:
+one engine thread drives every engine in both pools (the device
+programs serialize anyway on one host), any number of threads submit,
+SIGTERM/``close()`` drain everything admitted.  If a pool worker dies
+(any engine-loop exception), the loop aborts every outstanding request
+with reason ``"shutdown"`` — the same no-stranded-waiters contract as
+the single-pool server; requests never hang on a dead pool.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpudist.serve.engine import SlotEngine
+from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
+
+_IDLE_WAIT_S = 0.01
+
+
+def _np_dtype(name: str):
+    """Resolve a dtype NAME back to a numpy dtype.  Names, not
+    ``dtype.str``: the struct codes of the ml_dtypes family degrade to
+    raw void ("<V2" for bfloat16), which would silently destroy a bf16
+    KV lane on the wire."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_package(pkg: dict) -> dict:
+    """Flatten a KV-handoff package to host bytes — what would ride the
+    wire between a prefill process and a decode process.  Keeps the
+    treedef (both ends share the engine geometry, so the structure is
+    common knowledge; a cross-host protocol would pin it by schema).
+    Byte-preserving for every lane dtype including bf16/int8 (tests pin
+    the round trip)."""
+    import jax
+    import numpy as np
+
+    flat, tree = jax.tree.flatten((pkg["lane"], pkg["state"]))
+    blob = []
+    for leaf in flat:
+        a = np.asarray(leaf)
+        blob.append((a.tobytes(), a.dtype.name, a.shape))
+    return {"paged": pkg["paged"], "pos": pkg["pos"],
+            "counts": pkg["counts"], "budget": pkg["budget"],
+            "blob": blob, "tree": tree,
+            "bytes": sum(len(b) for b, _, _ in blob)}
+
+
+def deserialize_package(ser: dict) -> dict:
+    """Inverse of :func:`serialize_package` (byte-preserving)."""
+    import jax
+    import numpy as np
+
+    flat = [np.frombuffer(b, dtype=_np_dtype(d)).reshape(s)
+            for b, d, s in ser["blob"]]
+    lane, state = jax.tree.unflatten(ser["tree"], flat)
+    return {"paged": ser["paged"], "pos": ser["pos"],
+            "counts": ser["counts"], "budget": ser["budget"],
+            "lane": lane, "state": state}
+
+
+class DisaggServer:
+    """Disaggregated continuous-batching server: prefill pool → KV
+    handoff → decode pool.  Config rides the same
+    :class:`tpudist.serve.server.ServeConfig` (``disagg=True`` selects
+    this class in :func:`tpudist.serve.server.serve_forever`)."""
+
+    def __init__(self, module, params, config=None, *,
+                 install_signal_handler: bool = True):
+        from tpudist.serve.server import ServeConfig
+
+        self.config = config or ServeConfig.from_env()
+        cfg = self.config
+        shared = dict(
+            prefill_pad=cfg.prefill_pad, paged=cfg.paged,
+            kv_block=cfg.kv_block, kv_blocks=cfg.kv_blocks,
+            kv_int8=cfg.kv_int8, mesh=cfg.mesh_config())
+        p_slots = cfg.prefill_slots or cfg.num_slots
+        # prefill workers keep the prefix cache (reuse saves prefill
+        # compute — that is this pool's whole job); decode workers get
+        # private blocks only (a handed-off lane never shares).
+        self.prefill_pool: List[SlotEngine] = [
+            SlotEngine(module, params, num_slots=p_slots, decode_block=1,
+                       prefix_cache_blocks=cfg.prefix_cache_blocks, **shared)
+            for _ in range(max(1, cfg.prefill_workers))]
+        self.decode_pool: List[SlotEngine] = [
+            SlotEngine(module, params, num_slots=cfg.num_slots,
+                       decode_block=cfg.decode_block,
+                       prefix_cache_blocks=0, **shared)
+            for _ in range(max(1, cfg.decode_workers))]
+        self.handoff_mode = cfg.handoff
+        if self.handoff_mode not in ("device", "serial"):
+            raise ValueError(
+                f"handoff must be 'device' or 'serial', got {cfg.handoff!r}")
+        #: bounded pending-handoff queue: (handle, package) — a full
+        #: queue stalls exports (the lane waits in its prefill slot),
+        #: which in turn backpressures admission via free prefill slots.
+        self._handoff: "collections.deque[Tuple[RequestHandle, dict]]" = \
+            collections.deque()
+        self.handoff_limit = max(1, cfg.handoff_queue)
+        pe, de = self.prefill_pool[0], self.decode_pool[0]
+
+        def check_budget(plen: int, max_new: int) -> Optional[str]:
+            return pe.check_budget(plen, max_new) \
+                or de.check_budget(plen, max_new)
+
+        hasher = None
+        if cfg.paged and cfg.prefix_cache_blocks > 0:
+            from tpudist.serve.paged_alloc import hash_chain
+
+            bs = pe.paged_cfg.block_size
+            hasher = lambda prompt: hash_chain(prompt, bs)  # noqa: E731
+        self.scheduler = Scheduler(
+            queue_limit=cfg.queue_limit, check_budget=check_budget,
+            default_max_new=cfg.max_new, default_deadline_s=cfg.deadline_s,
+            prefix_hasher=hasher)
+        self._install_signal = install_signal_handler
+        self._installed_preemption = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._draining = False
+        #: (pool, worker, slot) → handle; pool ∈ {"prefill", "decode"}
+        self._slot_handles: Dict[Tuple[str, int, int], RequestHandle] = {}
+        self.completed = 0
+        self.tokens_out = 0
+        self.handoffs = 0
+        self.handoff_bytes = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "DisaggServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        from tpudist import telemetry
+        from tpudist.runtime import preemption
+
+        telemetry.ensure_started()
+        telemetry.event(
+            "serve_disagg_config",
+            prefill_workers=len(self.prefill_pool),
+            decode_workers=len(self.decode_pool),
+            prefill_slots=self.prefill_pool[0].num_slots,
+            decode_slots=self.decode_pool[0].num_slots,
+            handoff=self.handoff_mode,
+            mesh=self.decode_pool[0].spmd_stats().get("mesh"))
+        if self._install_signal:
+            self._installed_preemption = preemption.install()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpudist-serve-disagg", daemon=True)
+        self._thread.start()
+        return self
+
+    def submit(self, prompt, *, max_new: Optional[int] = None,
+               temperature: float = 0.0, deadline_s: Optional[float] = None,
+               seed: Optional[int] = None, eos_id: Optional[int] = None,
+               on_token=None) -> RequestHandle:
+        from tpudist import telemetry
+
+        try:
+            return self.scheduler.submit(
+                prompt, max_new=max_new, temperature=temperature,
+                deadline_s=deadline_s, seed=seed, eos_id=eos_id,
+                on_token=on_token)
+        except AdmissionError as e:
+            telemetry.event("serve_rejected", reason=e.reason)
+            raise
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        self._stop.set()
+        t = self._thread
+        ok = True
+        if t is not None:
+            t.join(timeout)
+            ok = not t.is_alive()
+        if ok:
+            self.scheduler.refuse_new("draining")
+            self._abort_outstanding()
+        return ok
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        ok = self.drain(timeout)
+        if self._installed_preemption:
+            from tpudist.runtime import preemption
+
+            preemption.reset()
+            self._installed_preemption = False
+        return ok
+
+    def stats(self) -> dict:
+        dec = {"blocks": 0, "tokens": 0, "dispatch_s": 0.0, "sync_s": 0.0}
+        for eng in self.decode_pool:
+            for k, v in eng.decode_stats().items():
+                dec[k] += v
+        return {
+            "completed": self.completed,
+            "rejected": self.scheduler.rejected,
+            "tokens_out": self.tokens_out,
+            "pending": self.scheduler.pending(),
+            "handoffs": self.handoffs,
+            "handoff_bytes": self.handoff_bytes,
+            "handoff_queued": len(self._handoff),
+            "prefill_pool": {
+                "workers": len(self.prefill_pool),
+                "slots": self.prefill_pool[0].num_slots,
+                "occupied": sum(e.num_occupied for e in self.prefill_pool),
+                "compile_counts": self.prefill_pool[0].compile_counts(),
+            },
+            "decode_pool": {
+                "workers": len(self.decode_pool),
+                "slots": self.decode_pool[0].num_slots,
+                "active": sum(e.num_active for e in self.decode_pool),
+                "compile_counts": self.decode_pool[0].compile_counts(),
+                "decode": dec,
+                "kv": self.decode_pool[0].kv_stats(),
+            },
+            "spmd": self.decode_pool[0].spmd_stats(),
+        }
+
+    # -- the engine loop ----------------------------------------------------
+
+    def _should_drain(self) -> bool:
+        if self._stop.is_set():
+            return True
+        from tpudist.runtime import preemption
+
+        return preemption.requested()
+
+    def _abort_outstanding(self) -> None:
+        for key in list(self._slot_handles):
+            h = self._slot_handles.pop(key)
+            h._finish("shutdown")
+            self._note_finished(h)
+        while self._handoff:
+            h, _ = self._handoff.popleft()
+            h._finish("shutdown")
+            self._note_finished(h)
+        for h in self.scheduler.take(1 << 30):
+            if not h.done:
+                h._finish("shutdown")
+            self._note_finished(h)
+
+    def _loop(self) -> None:
+        from tpudist import telemetry
+
+        try:
+            self._run_loop()
+        except BaseException as e:
+            # a dying pool worker must not strand waiters (module doc)
+            telemetry.event("serve_loop_error", error=repr(e))
+            raise
+        finally:
+            self.scheduler.refuse_new("draining")
+            self._abort_outstanding()
+
+    def _outstanding(self) -> int:
+        return (self.scheduler.pending() + len(self._slot_handles)
+                + len(self._handoff))
+
+    def _run_loop(self) -> None:
+        from tpudist import telemetry
+
+        sched = self.scheduler
+        while True:
+            if not self._draining and self._should_drain():
+                self._draining = True
+                sched.refuse_new("draining")
+                telemetry.event("serve_drain", pending=sched.pending(),
+                                active=self._outstanding())
+            now = time.monotonic()
+            for key, h in list(self._slot_handles.items()):
+                if h._expired(now):
+                    self._finish_key(key, "deadline")
+            # deadline sweep over the handoff queue, order-preserving
+            kept = collections.deque()
+            while self._handoff:
+                h, pkg = self._handoff.popleft()
+                if h._expired(now):
+                    h._finish("deadline")
+                    self._note_finished(h)
+                else:
+                    kept.append((h, pkg))
+            self._handoff = kept
+            for h in sched.expire_queued(now):
+                self._note_finished(h)
+            did_work = False
+            did_work |= self._admit_prefill(now)
+            did_work |= self._advance_prefill()
+            did_work |= self._place_handoffs()
+            did_work |= self._decode()
+            if self._draining and self._outstanding() == 0:
+                break
+            if not did_work:
+                if sched.pending() or self._handoff:
+                    # gate-blocked (pool/slots full): nothing frees until
+                    # a later iteration — don't spin the engine thread
+                    time.sleep(_IDLE_WAIT_S)
+                else:
+                    sched.wait_for_work(_IDLE_WAIT_S)
+
+    # -- prefill pool -------------------------------------------------------
+
+    def _admit_prefill(self, now: float) -> bool:
+        from tpudist import telemetry
+
+        worked = False
+        for w, eng in enumerate(self.prefill_pool):
+            free = eng.free_slots()
+            if not free:
+                continue
+            reserved, pinned = [0], []
+
+            def _gate(h, _eng=eng, _reserved=reserved, _pinned=pinned):
+                req = h.request
+                got = _eng.kv_admission_probe(
+                    len(req.prompt), req.max_new, req.prefix_hashes,
+                    reserve=_reserved[0], protect=_pinned)
+                if got is None:
+                    return False
+                # the decode pool must eventually take it too; reject
+                # never — transient decode-pool pressure just queues the
+                # package (bounded by the handoff queue)
+                _reserved[0] += got[0]
+                _pinned.extend(got[1])
+                return True
+
+            batch = self.scheduler.take(len(free), now, admit=_gate)
+            alive = []
+            for h in batch:
+                if h.done:
+                    self._note_finished(h)
+                else:
+                    alive.append(h)
+            if not alive:
+                continue
+            worked = True
+            items, t0 = [], time.monotonic()
+            for h, slot in zip(alive, free):
+                h.slot = slot
+                h.t_admitted = t0
+                items.append((slot, h.request.prompt, h.request.temperature,
+                              h.request.seed, h.request.max_new,
+                              h.request.prefix_hashes))
+                self._slot_handles[("prefill", w, slot)] = h
+            with telemetry.span("prefill", n=len(items), pool="prefill",
+                                worker=w):
+                firsts = eng.start_batch(items)
+            for slot, tok in firsts.items():
+                if tok is not None:
+                    self._prefill_complete(w, slot, tok)
+        return worked
+
+    def _advance_prefill(self) -> bool:
+        from tpudist import telemetry
+
+        worked = False
+        for w, eng in enumerate(self.prefill_pool):
+            if not eng.prefilling_slots():
+                continue
+            worked = True
+            with telemetry.span("prefill",
+                                chunks=len(eng.prefilling_slots()),
+                                pool="prefill", worker=w):
+                done = eng.advance_prefill()
+            for slot, tok in done.items():
+                self._prefill_complete(w, slot, tok)
+        return worked
+
+    def _prefill_complete(self, w: int, slot: int, tok: int) -> None:
+        """A prompt finished in prefill worker ``w``: deliver token 0
+        (TTFT stamps here — in the prefill pool), then either finish
+        (budget of 1) or export the lane for the decode pool."""
+        key = ("prefill", w, slot)
+        h = self._slot_handles[key]
+        h.t_prefill_done = time.monotonic()
+        eos = h.request.eos_id
+        h._deliver(tok)
+        self.tokens_out += 1
+        eng = self.prefill_pool[w]
+        if (eos is not None and tok == eos) \
+                or len(h.tokens) >= h.request.max_new:
+            del self._slot_handles[key]
+            eng.evict(slot)
+            h._finish("eos" if eos is not None and tok == eos else "length")
+            self._note_finished(h)
+            return
+        if len(self._handoff) >= self.handoff_limit:
+            # queue full: the lane waits in its prefill slot; retried on
+            # a later iteration (the slot stays occupied → admission
+            # backpressure).  Mark it ready by leaving decoding=True.
+            return
+        self._export(w, slot, h)
+
+    def _export(self, w: int, slot: int, h: RequestHandle) -> None:
+        eng = self.prefill_pool[w]
+        pkg = eng.export_slot(slot)
+        if self.handoff_mode == "serial":
+            ser = serialize_package(pkg)
+            self.handoff_bytes += ser["bytes"]
+            pkg = ser
+        eng.evict(slot)
+        del self._slot_handles[("prefill", w, slot)]
+        self._handoff.append((h, pkg))
+        self.handoffs += 1
+
+    def _retry_stalled_exports(self) -> bool:
+        """Prefill slots whose export stalled on a full handoff queue
+        (decoding=True but still in the prefill pool) retry here."""
+        worked = False
+        for w, eng in enumerate(self.prefill_pool):
+            for slot in list(range(eng.num_slots)):
+                key = ("prefill", w, slot)
+                if (eng.decoding[slot] and key in self._slot_handles
+                        and len(self._handoff) < self.handoff_limit):
+                    self._export(w, slot, self._slot_handles[key])
+                    worked = True
+        return worked
+
+    # -- handoff → decode pool ---------------------------------------------
+
+    def _place_handoffs(self) -> bool:
+        from tpudist import telemetry
+
+        self._retry_stalled_exports()
+        worked = False
+        while self._handoff:
+            h, pkg = self._handoff[0]
+            placed = False
+            for w, eng in enumerate(self.decode_pool):
+                free = eng.free_slots()
+                # gate on the serialized dict directly (pos/budget/paged
+                # are top-level fields either way) — a full decode pool
+                # must not pay a full-lane deserialization per blocked
+                # loop iteration just to fail placement
+                if not free or not eng.can_import(pkg):
+                    continue
+                self._handoff.popleft()
+                raw = (deserialize_package(pkg)
+                       if self.handoff_mode == "serial" else pkg)
+                slot = free[0]
+                t0 = time.monotonic()
+                eng.import_slot(slot, raw)
+                h.t_decode_start = time.monotonic()
+                h.slot = slot
+                telemetry.event(
+                    "kv_handoff", worker=w, slot=slot,
+                    mode=self.handoff_mode,
+                    wait_s=round(h.handoff_wait_s or 0.0, 6),
+                    import_s=round(h.t_decode_start - t0, 6))
+                self._slot_handles[("decode", w, slot)] = h
+                placed = worked = True
+                break
+            if not placed:
+                break  # FIFO head-of-line: decode pool is full
+        return worked
+
+    # -- decode pool --------------------------------------------------------
+
+    def _decode(self) -> bool:
+        from tpudist import telemetry
+
+        worked = False
+        for w, eng in enumerate(self.decode_pool):
+            for slot in eng.cache_full_slots():
+                if ("decode", w, slot) in self._slot_handles:
+                    self._finish_key(("decode", w, slot), "cache_full")
+            if not eng.num_active:
+                continue
+            worked = True
+            occ = eng.occupancy
+            tele = telemetry.active()
+            t0 = time.monotonic()
+            info, blocks = eng.decode_block()
+            if tele is not None and info is not None:
+                kv_occ, kv_resident = eng.kv_gauges()
+                tele.record_span(
+                    "decode_block", t0, time.monotonic() - t0,
+                    {"occupancy": occ, "active": eng.num_active,
+                     "k": info["k"], "tokens": info["tokens"],
+                     "dispatch_s": round(info["dispatch_s"], 9),
+                     "sync_s": round(info["sync_s"], 9),
+                     "kv_block_occupancy": kv_occ,
+                     "kv_bytes_resident": kv_resident,
+                     "kv_read_bytes": info["kv_read_bytes"],
+                     "pool": "decode", "worker": w})
+            for slot, toks in blocks.items():
+                self._deliver_block(w, slot, toks)
+        return worked
+
+    def _deliver_block(self, w: int, slot: int, toks) -> None:
+        h = self._slot_handles[("decode", w, slot)]
+        eos = h.request.eos_id
+        for tok in toks:
+            h._deliver(tok)
+            self.tokens_out += 1
+            if eos is not None and tok == eos:
+                self._finish_key(("decode", w, slot), "eos")
+                return
+            if len(h.tokens) >= h.request.max_new:
+                self._finish_key(("decode", w, slot), "length")
+                return
+
+    def _finish_key(self, key, reason: str) -> None:
+        pool, w, slot = key
+        h = self._slot_handles.pop(key)
+        (self.prefill_pool if pool == "prefill"
+         else self.decode_pool)[w].evict(slot)
+        h._finish(reason)
+        self._note_finished(h)
+
+    def _note_finished(self, h: RequestHandle) -> None:
+        from tpudist import telemetry
+
+        self.completed += 1
+        telemetry.event(
+            "request_finished", id=h.id, reason=h.finish_reason,
+            prompt_len=int(len(h.request.prompt)), tokens_out=len(h.tokens),
+            ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s,
+            pool="disagg", handoff_wait_s=h.handoff_wait_s)

@@ -85,11 +85,69 @@ class SlotEngine:
                  decode_block: Optional[int] = None,
                  paged: bool = False, kv_block: int = 16,
                  kv_blocks: Optional[int] = None, kv_int8: bool = False,
-                 prefix_cache_blocks: int = 0):
+                 prefix_cache_blocks: int = 0,
+                 mesh=None):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         self.module = module
         self.max_len = int(module.max_len)
+        # -- SPMD serving mesh (tpudist.serve.spmd): params + KV storage
+        # get NamedShardings, SlotState/tables stay replicated, and the
+        # SAME four programs run partitioned — shardings change, code
+        # does not (the eager-SPMD consistency contract).
+        self.mesh = None
+        self.tp_overlap = "off"
+        self._mesh_cfg = None
+        cache_constraint = None
+        if mesh is not None:
+            from tpudist.serve import spmd
+
+            cfg = (mesh if isinstance(mesh, spmd.ServeMeshConfig)
+                   else spmd.ServeMeshConfig(shape=str(mesh)))
+            self._mesh_cfg = cfg
+            self.mesh = spmd.build_serve_mesh(cfg)
+        if self.mesh is not None:
+            from tpudist.serve import spmd
+
+            self.tp_overlap = spmd.resolve_serve_overlap(self._mesh_cfg)
+            overlap_on = self.tp_overlap != "off"
+            if overlap_on and getattr(module, "n_experts", 0) == 0:
+                mlp_fn = spmd.serve_overlap_mlp_fn(
+                    self.mesh, mode=self.tp_overlap)
+                if mlp_fn is not None:
+                    module = module.clone(mlp_fn=mlp_fn)
+                    self.module = module
+                else:
+                    overlap_on = False
+                    self.tp_overlap = "off"
+            elif overlap_on:
+                # MoE FFN owns the mlp seam; TP-shard the rest only
+                overlap_on = False
+                self.tp_overlap = "off"
+            self._param_sharding = spmd.serve_param_sharding(
+                self.mesh, params, overlap=overlap_on)
+            self._spmd_param_stats = spmd.sharded_param_bytes(
+                params, self._param_sharding)
+            import jax as _jax
+
+            params = _jax.device_put(params, self._param_sharding)
+
+            def cache_constraint(tree):
+                import jax as _j
+
+                spec = (spmd.serve_paged_sharding(self.mesh, tree)
+                        if hasattr(tree, "pool_k")
+                        else spmd.serve_cache_sharding(self.mesh, tree))
+                return _j.lax.with_sharding_constraint(tree, spec)
+
+            def state_constraint(tree):
+                import jax as _j
+
+                return _j.lax.with_sharding_constraint(
+                    tree, spmd.serve_state_sharding(self.mesh, tree))
+        else:
+            state_constraint = None
+        self._cache_constraint = cache_constraint
         self.alloc: Optional[BlockAllocator] = None
         if paged:
             kv_block = min(int(kv_block), self.max_len)
@@ -105,19 +163,38 @@ class SlotEngine:
                 num_blocks=int(kv_blocks), block_size=kv_block,
                 quantized=bool(kv_int8))
             self.fns = make_slot_decode(module, params, num_slots,
-                                        prefill_pad, paged=self.paged_cfg)
+                                        prefill_pad, paged=self.paged_cfg,
+                                        cache_constraint=cache_constraint,
+                                        state_constraint=state_constraint)
             self.alloc = BlockAllocator(
                 self.paged_cfg.num_blocks, kv_block, self.max_len,
                 prefix_cache_blocks=prefix_cache_blocks)
         else:
             self.paged_cfg = None
             self.fns = make_slot_decode(module, params, num_slots,
-                                        prefill_pad)
+                                        prefill_pad,
+                                        cache_constraint=cache_constraint,
+                                        state_constraint=state_constraint)
         self.num_slots = num_slots
         self.prefill_pad = prefill_pad
         self.block = max(1, int(decode_block if decode_block else 8))
         self.state = self.fns.init_state()
         self.cache = self.fns.init_slots()
+        if self.mesh is not None:
+            # place the fresh state/cache on their serving layout ONCE;
+            # the programs' output constraint keeps it there through
+            # every donated iteration
+            import jax as _jax
+
+            from tpudist.serve import spmd
+
+            self.state = _jax.device_put(
+                self.state, spmd.serve_state_sharding(self.mesh, self.state))
+            self.cache = _jax.device_put(
+                self.cache,
+                spmd.serve_paged_sharding(self.mesh, self.cache)
+                if self.alloc is not None
+                else spmd.serve_cache_sharding(self.mesh, self.cache))
         self.occupied = np.zeros(num_slots, bool)
         self.decoding = np.zeros(num_slots, bool)
         self.pos = np.zeros(num_slots, np.int32)
@@ -179,7 +256,7 @@ class SlotEngine:
         bucket actually used)."""
         out = {}
         for name in ("insert_batch", "prefill_extend", "decode_block",
-                     "evict"):
+                     "evict", "export_lane", "import_lane"):
             fn = getattr(self.fns, name)
             size = getattr(fn, "_cache_size", None)
             out[name] = int(size()) if callable(size) else -1
@@ -257,6 +334,84 @@ class SlotEngine:
             return None, self._dense_resident_bytes
         return (self.alloc.blocks_in_use / self.alloc.num_blocks,
                 self.alloc.blocks_in_use * self._block_bytes)
+
+    def spmd_stats(self) -> Dict[str, object]:
+        """The serving-mesh story for reports/tests: mesh geometry, the
+        TP-overlap routing mode, and where the param bytes live.  All
+        ``None``/trivial on a single-device engine."""
+        if self.mesh is None:
+            return {"mesh": None, "tp_overlap": "off"}
+        d, m = self._mesh_cfg.dims
+        return {"mesh": {"data": d, "model": m},
+                "n_devices": self._mesh_cfg.n_devices,
+                "tp_overlap": self.tp_overlap,
+                **self._spmd_param_stats}
+
+    # -- KV handoff (prefill/decode disaggregation) -------------------------
+
+    def export_slot(self, slot: int) -> Dict[str, object]:
+        """Package a DECODING slot for handoff to another engine
+        (:mod:`tpudist.serve.disagg`): its KV lane, its SlotState row
+        (``last_tok``/``counts``/``keys`` — the sampling stream
+        continues byte-identically wherever the lane lands), and the
+        host shadows the importing engine needs for budget accounting.
+        Does not evict — the caller evicts once the handoff is safe."""
+        if not self.decoding[slot]:
+            raise ValueError(
+                f"slot {slot} is not decoding (export happens after the "
+                "prompt completes and the first token is sampled)")
+        import jax.numpy as jnp
+
+        lane, lane_state = self.fns.export_lane(
+            self.state, self.cache, jnp.asarray(slot, jnp.int32))
+        return {"paged": self.alloc is not None,
+                "lane": lane, "state": lane_state,
+                "pos": int(self.pos[slot]),
+                "counts": int(self.counts[slot]),
+                "budget": int(self.budget[slot])}
+
+    def can_import(self, package: Dict[str, object]) -> bool:
+        """Would this engine's KV budget take the package right now
+        (a free slot is checked by the caller)?  Paged engines reserve
+        the remaining whole footprint; dense engines always fit."""
+        if self.alloc is None:
+            return True
+        return self.alloc.can_admit(int(package["pos"]),
+                                    int(package["budget"]), ())
+
+    def import_slot(self, slot: int, package: Dict[str, object]) -> None:
+        """Install an exported lane into free ``slot`` and arm it for
+        decode.  Paged: the remaining footprint is reserved on THIS
+        pool (fresh blocks — handed-off lanes never share prefix blocks
+        across pools; the prefill pool's prefix cache already saved the
+        recompute) and the lane scatters into the new row in-graph."""
+        if self.occupied[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        if bool(package["paged"]) != (self.alloc is not None):
+            raise ValueError("handoff package and engine disagree on "
+                             "paged mode — pools must share KV geometry")
+        import jax.numpy as jnp
+
+        pos, counts = int(package["pos"]), int(package["counts"])
+        budget = int(package["budget"])
+        if self.alloc is not None:
+            row, _ = self.alloc.admit(slot, pos, budget, ())
+            M = self.max_len // self.paged_cfg.block_size
+            full = np.full(M, self.paged_cfg.num_blocks, np.int32)
+            full[:len(row)] = row
+            self.state, self.cache = self.fns.import_lane(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(full), package["lane"], package["state"])
+        else:
+            self.state, self.cache = self.fns.import_lane(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32),
+                package["lane"], package["state"])
+        self.occupied[slot] = True
+        self.decoding[slot] = True
+        self.pos[slot] = pos
+        self.counts[slot] = counts
+        self.budget[slot] = budget
+        self.peak_occupied = max(self.peak_occupied, self.num_occupied)
 
     # -- lifecycle of a request -------------------------------------------
 

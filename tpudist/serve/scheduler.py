@@ -91,6 +91,12 @@ class RequestHandle:
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         self.slot: Optional[int] = None
+        #: disaggregated serving only (tpudist.serve.disagg): when the
+        #: prefill pool finished the prompt (and sampled token 0), and
+        #: when the KV landed in a decode-pool slot — the handoff-wait
+        #: gap between them is the disagg coordinator's own latency.
+        self.t_prefill_done: Optional[float] = None
+        self.t_decode_start: Optional[float] = None
 
     # -- caller side --------------------------------------------------------
 
@@ -123,6 +129,14 @@ class RequestHandle:
         if self.t_admitted is None:
             return None
         return self.t_admitted - self.t_submit
+
+    @property
+    def handoff_wait_s(self) -> Optional[float]:
+        """Prefill-done → decode-slot-installed gap (disaggregated
+        serving only; None on the single-pool path)."""
+        if self.t_prefill_done is None or self.t_decode_start is None:
+            return None
+        return self.t_decode_start - self.t_prefill_done
 
     # -- engine side (single engine thread) ---------------------------------
 

@@ -74,9 +74,31 @@ class ServeConfig:
     kv_blocks: Optional[int] = None
     kv_int8: bool = False  # int8 KV storage + per-block scales
     prefix_cache_blocks: int = 0  # shared-prefix LRU cache bound (blocks)
+    # -- SPMD serving mesh (tpudist/serve/spmd.py) -------------------------
+    # "DxM" (data × model) or "M"; "1" = single device.  Declarative on
+    # purpose (AMP-style): a planner searches this field, not the code.
+    mesh: Optional[str] = None
+    tp_overlap: Optional[str] = None  # off|ring|bidir; None = knob chain
+    # -- prefill/decode disaggregation (tpudist/serve/disagg.py) -----------
+    disagg: bool = False  # separate prefill + decode worker pools
+    prefill_workers: int = 1
+    decode_workers: int = 1
+    prefill_slots: Optional[int] = None  # per prefill worker; None: num_slots
+    handoff: str = "device"  # "device" (in-mesh) | "serial" (byte transfer)
+    handoff_queue: int = 8  # bounded pending-handoff packages
+
+    def mesh_config(self):
+        """The engine-facing mesh spec (None when unset/1-device)."""
+        if not self.mesh or self.mesh.strip() in ("", "1", "1x1"):
+            return None
+        from tpudist.serve.spmd import ServeMeshConfig
+
+        return ServeMeshConfig(shape=self.mesh, tp_overlap=self.tp_overlap)
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
+        import os
+
         from tpudist.utils.envutil import (env_flag, env_int,
                                            env_positive_float)
 
@@ -93,6 +115,16 @@ class ServeConfig:
             kv_int8=env_flag("TPUDIST_SERVE_KV_INT8", False),
             prefix_cache_blocks=env_int(
                 "TPUDIST_SERVE_PREFIX_CACHE", 0) or 0,
+            mesh=os.environ.get("TPUDIST_SERVE_MESH", "").strip() or None,
+            tp_overlap=os.environ.get(
+                "TPUDIST_SERVE_TP_OVERLAP", "").strip() or None,
+            disagg=env_flag("TPUDIST_SERVE_DISAGG", False),
+            prefill_workers=env_int("TPUDIST_SERVE_PREFILL_WORKERS", 1) or 1,
+            decode_workers=env_int("TPUDIST_SERVE_DECODE_WORKERS", 1) or 1,
+            prefill_slots=env_int("TPUDIST_SERVE_PREFILL_SLOTS", None),
+            handoff=os.environ.get(
+                "TPUDIST_SERVE_HANDOFF", "").strip() or "device",
+            handoff_queue=env_int("TPUDIST_SERVE_HANDOFF_QUEUE", 8) or 8,
         )
 
 
@@ -117,7 +149,8 @@ class InferenceServer:
             decode_block=self.config.decode_block,
             paged=self.config.paged, kv_block=self.config.kv_block,
             kv_blocks=self.config.kv_blocks, kv_int8=self.config.kv_int8,
-            prefix_cache_blocks=self.config.prefix_cache_blocks)
+            prefix_cache_blocks=self.config.prefix_cache_blocks,
+            mesh=self.config.mesh_config())
         hasher = None
         if self.config.paged and self.config.prefix_cache_blocks > 0:
             from tpudist.serve.paged_alloc import hash_chain
@@ -233,6 +266,7 @@ class InferenceServer:
             "compile_counts": self.engine.compile_counts(),
             "decode": self.engine.decode_stats(),
             "kv": self.engine.kv_stats(),
+            "spmd": self.engine.spmd_stats(),
         }
 
     # -- the engine loop ----------------------------------------------------
@@ -422,8 +456,15 @@ class InferenceServer:
             ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s)
 
 
-def serve_forever(module, params, config: Optional[ServeConfig] = None,
-                  ) -> InferenceServer:
+def serve_forever(module, params, config: Optional[ServeConfig] = None):
     """Start a server and return it (the embedding entry — the CLI demo
-    in ``__main__`` owns its own loop)."""
-    return InferenceServer(module, params, config).start()
+    in ``__main__`` owns its own loop).  ``config.disagg`` selects the
+    prefill/decode-disaggregated coordinator
+    (:class:`tpudist.serve.disagg.DisaggServer`) — same submit/close
+    surface, two engine pools with KV handoff behind it."""
+    cfg = config or ServeConfig.from_env()
+    if cfg.disagg:
+        from tpudist.serve.disagg import DisaggServer
+
+        return DisaggServer(module, params, cfg).start()
+    return InferenceServer(module, params, cfg).start()

@@ -73,7 +73,8 @@ COMPONENTS = ("step", "compile", "data", "ckpt", "comm", "init", "other",
 #: Event names surfaced in the report's event log (joined across ranks and
 #: generations on the wall-clock axis).
 _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
-                    "prefetch_stats", "serve_drain", "serve_loop_error")
+                    "prefetch_stats", "serve_drain", "serve_loop_error",
+                    "serve_disagg_config")
 
 
 def find_telemetry_dir(run_dir: "str | Path") -> Path:
@@ -213,13 +214,35 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     kv_occ_w, kv_occ_dur, kv_occ_max = 0.0, 0.0, 0.0
     kv_resident_peak, kv_read_bytes = 0, 0
     kv_config = None
+    # disaggregated serving (tpudist.serve.disagg): spans tagged with
+    # their pool; TTFT belongs to the prefill pool (token 0 is sampled
+    # there) and TPOT to the decode pool, with the coordinator's
+    # handoff-wait gap in between.
+    pool_s: Dict[str, float] = {}
+    pool_spans: Dict[str, int] = {}
+    handoffs = 0
+    handoff_import_s: List[float] = []
+    disagg_config = None
     for r in records:
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_kv_config"):
             kv_config = r  # last one wins (restart/regeneration)
             continue
+        if (r.get("kind") == "event"
+                and r.get("name") == "serve_disagg_config"):
+            disagg_config = r
+            continue
+        if r.get("kind") == "event" and r.get("name") == "kv_handoff":
+            handoffs += 1
+            if isinstance(r.get("import_s"), (int, float)):
+                handoff_import_s.append(float(r["import_s"]))
+            continue
         if r.get("kind") != "span":
             continue
+        pool = r.get("pool")
+        if isinstance(pool, str):
+            pool_s[pool] = pool_s.get(pool, 0.0) + float(r.get("dur", 0.0))
+            pool_spans[pool] = pool_spans.get(pool, 0) + 1
         if r.get("name") in ("decode_block", "decode_step"):
             serve_spans += 1
             decode_blocks += 1
@@ -286,6 +309,37 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
                                      if decode_tokens and kv_read_bytes
                                      else None),
         }
+    pools: Optional[dict] = None
+    if pool_s or disagg_config is not None or handoffs:
+        hwaits = sorted(float(r["handoff_wait_s"]) for r in fins
+                        if isinstance(r.get("handoff_wait_s"), (int, float)))
+        pools = {
+            **({"config": {k: v for k, v in disagg_config.items()
+                           if k not in ("kind", "name", "t", "dur",
+                                        "rank", "gen")}}
+               if disagg_config is not None else {}),
+            "prefill": {
+                "span_s": round(pool_s.get("prefill", 0.0), 6),
+                "spans": pool_spans.get("prefill", 0),
+                # token 0 is sampled in the prefill pool: TTFT is ITS
+                # latency number (queue wait included)
+                "ttft": _pcts("ttft_s"),
+            },
+            "decode": {
+                "span_s": round(pool_s.get("decode", 0.0), 6),
+                "spans": pool_spans.get("decode", 0),
+                "tpot": _pcts("tpot_s"),
+            },
+            "handoffs": handoffs,
+            "handoff_wait": ({
+                "p50_s": round(_percentile(hwaits, 50), 6),
+                "p95_s": round(_percentile(hwaits, 95), 6),
+                "max_s": round(hwaits[-1], 6)} if hwaits else None),
+            "handoff_import": ({
+                "p50_s": round(_percentile(sorted(handoff_import_s), 50), 6),
+                "max_s": round(max(handoff_import_s), 6)}
+                if handoff_import_s else None),
+        }
     return {
         "requests_finished": len(fins),
         "requests_rejected": rejects,
@@ -306,6 +360,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         "occupancy_mean": round(occ_w / occ_dur, 4) if occ_dur > 0 else None,
         "occupancy_max": round(occ_max, 4) if occ_dur > 0 else None,
         **({"kv": kv} if kv is not None else {}),
+        **({"pools": pools} if pools is not None else {}),
     }
 
 
@@ -459,6 +514,26 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"- batch occupancy: mean {sv['occupancy_mean']:.2f}, "
                 f"max {sv['occupancy_max']:.2f}")
+        if sv.get("pools"):
+            pp = sv["pools"]
+            bits = [f"prefill {pp['prefill']['span_s']:.3f} s "
+                    f"({pp['prefill']['spans']} spans)",
+                    f"decode {pp['decode']['span_s']:.3f} s "
+                    f"({pp['decode']['spans']} spans)",
+                    f"{pp['handoffs']} KV handoffs"]
+            hw = pp.get("handoff_wait")
+            if hw:
+                bits.append(f"handoff wait p50 {hw['p50_s'] * 1e3:.1f} ms / "
+                            f"p95 {hw['p95_s'] * 1e3:.1f} ms")
+            lines.append("- disaggregated pools: " + "; ".join(bits))
+            for label, pool, key in (("TTFT", "prefill", "ttft"),
+                                     ("TPOT", "decode", "tpot")):
+                v = pp[pool].get(key)
+                if v:
+                    lines.append(
+                        f"  - {pool}-pool {label}: p50 "
+                        f"{v['p50_s'] * 1e3:.1f} ms, "
+                        f"p95 {v['p95_s'] * 1e3:.1f} ms")
         if sv.get("kv"):
             kv = sv["kv"]
             bits = []

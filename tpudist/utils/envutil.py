@@ -102,6 +102,19 @@ ENV_VARS = {
     "TPUDIST_SERVE_KV_BLOCKS": "KV pool size in blocks (default: dense-equivalent)",
     "TPUDIST_SERVE_KV_INT8": "int8 KV storage with per-block dequant scales",
     "TPUDIST_SERVE_PREFIX_CACHE": "shared-prefix LRU cache bound in blocks (0 off)",
+    "TPUDIST_SERVE_MESH":
+        "serving mesh shape 'DxM' (data x model; '1' = single device)",
+    "TPUDIST_SERVE_TP_OVERLAP":
+        "TP decode collective-matmul routing: off|ring|bidir "
+        "(falls back to TPUDIST_OVERLAP)",
+    "TPUDIST_SERVE_DISAGG": "prefill/decode disaggregation (separate pools)",
+    "TPUDIST_SERVE_PREFILL_WORKERS": "prefill-pool worker count (disagg)",
+    "TPUDIST_SERVE_DECODE_WORKERS": "decode-pool worker count (disagg)",
+    "TPUDIST_SERVE_PREFILL_SLOTS":
+        "slots per prefill worker (disagg; default: the decode slot count)",
+    "TPUDIST_SERVE_HANDOFF":
+        "KV handoff transport: device (in-mesh) | serial (byte transfer)",
+    "TPUDIST_SERVE_HANDOFF_QUEUE": "bounded pending-KV-handoff queue length",
     # telemetry & goodput
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
