@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""The d_head-128 twin rungs (VERDICT r5 Weak #1 / top_next).
+
+Round 5 explained the weak dense-d512 and long-context MFU rungs with a
+*computed* composite ceiling plus a structural d_head-64 argument — the
+MXU contracts 128-deep, so 64-deep heads leave half of every attention
+contraction's systolic depth idle.  The falsification experiment is the
+SAME model FLOPs at MXU-native head depth: d512 at 4 heads × d_head 128
+(vs the rung's 8 × 64), and the long-context d256 class at 2 × 128 (vs
+4 × 64).  If MFU jumps toward the computed ~44%/~42% ceilings the claim
+becomes a measurement; if not, the sink hunt reopens with a named
+suspect eliminated.
+
+This harness runs BOTH twins of each pair in ONE process (the repo's
+same-window discipline — cross-window wall comparisons are what Weak #3
+was about), asserts the pairs are FLOP-identical before timing anything,
+and freezes ``DH128_TWIN_r{NN}.json`` with per-row regime labels.  The
+MFU claim itself is only settled by the on-chip run: a ``cpu`` regime
+row proves the harness and the FLOPs parity, and records the wall ratio
+for what a CPU is worth (the artifact says which it was — no CPU row
+ever masquerades as chip evidence).
+
+Usage:
+  python benchmarks/dh128_twin.py            # VERDICT geometry (on-chip)
+  python benchmarks/dh128_twin.py --smoke    # CPU-CI scale, mechanics only
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU-CI scale (mechanics + FLOPs parity; the MFU "
+                        "verdict needs the full on-chip run)")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--families", default=None,
+                   help="comma list of dense,long_context (default both; "
+                        "a CPU box can afford the dense pair at true "
+                        "geometry but not the 8k-seq long-context pair)")
+    try:
+        from benchmarks._round import current_round
+    except ImportError:
+        from _round import current_round
+
+    p.add_argument("--out", default=str(
+        REPO / f"DH128_TWIN_r{current_round():02d}.json"))
+    args = p.parse_args(argv)
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    import jax
+
+    from tpudist.utils import transformer_train_flops
+
+    steps = args.steps or (2 if args.smoke else 5)
+    if args.smoke:
+        # batch 8: divisible by any test-rig data mesh (the conftest's
+        # 8 virtual devices included)
+        pairs = [
+            ("dense", dict(batch=8, seq_len=256, d_model=128, d_ff=512),
+             dict(n_heads=4), dict(n_heads=2)),   # dh32 vs dh64 twins
+        ]
+    else:
+        # the VERDICT geometries: identical model FLOPs, head depth is
+        # the ONLY thing that moves
+        pairs = [
+            ("dense", dict(batch=8, seq_len=2048, d_model=512, d_ff=2048),
+             dict(n_heads=8), dict(n_heads=4)),   # dh64 vs dh128
+            ("long_context",
+             dict(batch=4, seq_len=8192, d_model=256, d_ff=1024),
+             dict(n_heads=4), dict(n_heads=2)),   # dh64 vs dh128
+        ]
+    if args.families:
+        want = {f.strip() for f in args.families.split(",")}
+        pairs = [p_ for p_ in pairs if p_[0] in want]
+    regime = jax.devices()[0].device_kind
+    rows = {}
+    for family, base, shallow, deep in pairs:
+        # FLOPs parity is structural (head count cancels out of the
+        # matmul accounting) — assert it anyway so a future config edit
+        # cannot silently break the twin-ness the comparison rests on
+        fl = [transformer_train_flops(
+            batch=base["batch"], seq_len=base["seq_len"],
+            d_model=base["d_model"], n_layers=4, d_ff=base["d_ff"],
+            vocab=256) for _ in (shallow, deep)]
+        assert fl[0] == fl[1], "twin rungs must be FLOP-identical"
+        for tag, heads in (("base", shallow), ("dh_twin", deep)):
+            dh = base["d_model"] // heads["n_heads"]
+            row = bench.bench_lm(
+                name=f"{family}_{tag}_dh{dh}", n_layers=4,
+                precision="bf16", steps=steps, **base, **heads)
+            row["regime"] = regime
+            row["d_head"] = dh
+            rows[f"{family}_{tag}"] = row
+            print(json.dumps({f"{family}_{tag}": {
+                "d_head": dh, "step_ms": row["step_ms"],
+                "mfu_pct_vs_bf16_peak": row["mfu_pct_vs_bf16_peak"]}}),
+                flush=True)
+        base_row, twin = rows[f"{family}_base"], rows[f"{family}_dh_twin"]
+        rows[f"{family}_twin_speedup"] = round(
+            base_row["step_ms"] / twin["step_ms"], 4)
+    artifact = {
+        "regime": regime,
+        "smoke": bool(args.smoke),
+        "verdict_claim": "d_head-64 leaves the MXU's 128-deep contraction "
+                         "half idle; the 128-deep twin at identical model "
+                         "FLOPs should recover the computed ceiling",
+        "note": ("cpu regime rows validate the harness and the FLOPs "
+                 "parity only — the MFU verdict requires the on-chip run"
+                 if regime == "cpu" or args.smoke else
+                 "on-chip twin measurement"),
+        **rows,
+    }
+    out = Path(args.out)
+    tmp = out.with_suffix(".tmp")
+    tmp.write_text(json.dumps(artifact, indent=2) + "\n")
+    tmp.replace(out)
+    print(json.dumps({"wrote": str(out)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

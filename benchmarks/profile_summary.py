@@ -24,7 +24,12 @@ bf16 fused-decode-block loop itself (``make_slot_decode`` →
 ``decode_block``, the same program the serving engine dispatches),
 emits the per-op table that NAMES that residual (fusions, layout
 copies, dynamic-slice cache surgery, …), and freezes it as
-``DECODE_PROFILE_r{NN}.json`` alongside the round artifacts.
+``DECODE_PROFILE_r{NN}.json`` alongside the round artifacts.  It also
+captures the SPECULATIVE path's three phases separately — the draft
+propose loop, the batched target-verify window, and the rollback
+(cursor-reset) program in isolation — so the artifact distinguishes
+draft, verify, and rollback time per op group (the rollback should
+profile as cursor arithmetic, ~free next to either forward).
 """
 
 from __future__ import annotations
@@ -200,24 +205,51 @@ def summarize(path: str | Path, top: int = 25) -> dict:
     }
 
 
+def _trace_phase(fn, blocks: int, top: int) -> dict:
+    """Trace ``blocks`` invocations of ``fn`` (a thunk advancing its own
+    state) into a throwaway dir and return the per-op summary."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    tdir = tempfile.mkdtemp(prefix="decode_profile_")
+    try:
+        with jax.profiler.trace(tdir):
+            out = None
+            for _ in range(blocks):
+                out = fn()
+            jax.block_until_ready(out)
+        return summarize(tdir, top=top)
+    finally:
+        # the raw XLA trace can be tens of MB; the artifact is the
+        # summarized table, not the trace
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
                            d_model: int = 64, n_layers: int = 2,
                            n_heads: int = 2, vocab: int = 128,
                            max_len: int = 128, slots: int = 4,
                            k: int = 8, blocks: int = 16,
-                           top: int = 25) -> dict:
+                           top: int = 25, spec: bool = True) -> dict:
     """Trace the bf16 fused decode loop and attribute its device time
     per op (module doc, ``--capture-decode``).  Returns the artifact
-    dict; writes it to ``out_path`` when given."""
-    import tempfile
+    dict; writes it to ``out_path`` when given.
 
+    ``spec``: also trace the speculative path's three phases separately
+    — the draft propose loop, the batched target-verify pass, and the
+    rollback (cursor-reset) program in isolation — so the residual
+    table distinguishes where a spec block's device time goes (the
+    rollback is cursor arithmetic and should profile as ~free; the
+    table proves it instead of asserting it)."""
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from tpudist.models import create_transformer
-    from tpudist.models.generate import make_slot_decode
+    from tpudist.models.generate import make_slot_decode, tied_draft
 
     compute = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     module, params = create_transformer(
@@ -239,19 +271,110 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
     # decode loop, not XLA compilation
     state, cache, toks = fns.decode_block(state, cache, k)
     jax.block_until_ready(toks)
-    import shutil
 
-    tdir = tempfile.mkdtemp(prefix="decode_profile_")
-    try:
-        with jax.profiler.trace(tdir):
-            for _ in range(blocks):
-                state, cache, toks = fns.decode_block(state, cache, k)
-            jax.block_until_ready(toks)
-        s = summarize(tdir, top=top)
-    finally:
-        # the raw XLA trace can be tens of MB; the artifact is the
-        # summarized table, not the trace
-        shutil.rmtree(tdir, ignore_errors=True)
+    carry = {"state": state, "cache": cache}
+
+    def plain_block():
+        carry["state"], carry["cache"], toks = fns.decode_block(
+            carry["state"], carry["cache"], k)
+        return toks
+
+    s = _trace_phase(plain_block, blocks, top)
+
+    spec_tables = None
+    if spec:
+        sfns = make_slot_decode(
+            module, params, slots, pad,
+            spec=tied_draft(module, params, max(1, n_layers // 2)))
+        sstate, scache = sfns.init_state(), sfns.init_slots()
+        dcache = sfns.init_draft()
+        sstate, scache, _ = sfns.insert_batch(
+            sstate, scache, jnp.asarray(prompts),
+            jnp.full(slots, pad, jnp.int32),
+            jnp.arange(slots, dtype=jnp.int32),
+            jnp.zeros(slots, jnp.int32), jnp.zeros(slots, jnp.float32),
+            jnp.ones(slots, bool))
+        dcache = sfns.draft_prefill(
+            dcache, jnp.asarray(prompts), jnp.full(slots, pad, jnp.int32),
+            jnp.arange(slots, dtype=jnp.int32))
+        sk = min(k, 4)
+        spec_on = jnp.ones(slots, bool)
+        rem = jnp.full(slots, max_len, jnp.int32)
+        # warmup every phase program outside the traces
+        dcache, drafts, dlogits = sfns.draft_propose(sstate, dcache, sk)
+        sstate, scache, dcache, packed = sfns.spec_verify(
+            sstate, scache, dcache, drafts, dlogits, spec_on, rem)
+        jax.block_until_ready(packed)
+
+        # draft phase: the propose loop alone (cursor advances sk+1 per
+        # call; the budget above keeps every call in bounds)
+        dc = {"d": dcache}
+
+        def draft_phase():
+            dc["d"], dr, _ = sfns.draft_propose(sstate, dc["d"], sk)
+            return dr
+
+        n_phase = min(blocks, max(2, (max_len - 2 * pad) // (sk + 1) - 2))
+        draft_table = _trace_phase(draft_phase, n_phase, top)
+
+        # verify phase: the batched target-verify (rollback included,
+        # as in production) re-verifying one proposal repeatedly
+        vc = {"s": sstate, "c": scache, "d": dc["d"]}
+
+        def verify_phase():
+            vc["s"], vc["c"], vc["d"], pk = sfns.spec_verify(
+                vc["s"], vc["c"], vc["d"], drafts, dlogits, spec_on, rem)
+            return pk
+
+        verify_table = _trace_phase(verify_phase, n_phase, top)
+
+        # rollback phase in isolation: the cursor-reset program alone
+        # (every non-K/V cache leaf overwritten with the clamped
+        # cursor, exactly what spec_verify's rollback does in-graph) —
+        # what rollback costs with no forward attached
+        def _roll(cache, cur):
+            out = {}
+            for key, val in cache.items():
+                if isinstance(val, dict) and "k" in val and "v" in val:
+                    out[key] = {k2: (v2 if k2 in ("k", "v")
+                                     else cur.astype(v2.dtype))
+                                for k2, v2 in val.items()}
+                else:
+                    out[key] = cur.astype(val.dtype)
+            return out
+
+        # donated like the real program — without donation XLA would
+        # copy the untouched K/V leaves and bill rollback for a full
+        # arena memcpy it never pays in production
+        roll = jax.jit(_roll, donate_argnums=0)
+        rb = {"c": vc["c"]}
+        cur = jnp.full(slots, pad, jnp.int32)
+
+        def rollback_phase():
+            rb["c"] = roll(rb["c"], cur)
+            return rb["c"]
+
+        rollback_table = _trace_phase(rollback_phase, blocks, top)
+
+        def _slice(table, keys=("total_us", "groups", "top_ops", "error")):
+            out = {kk: table.get(kk) for kk in keys if kk in table}
+            # on backends without a distinct device track (CPU smoke)
+            # the "other" bucket absorbs host/trace bookkeeping — the
+            # cross-phase comparison metric is attributed-op time
+            groups = table.get("groups") or {}
+            other = (groups.get("other") or {}).get("us", 0.0)
+            if table.get("total_us") is not None:
+                out["op_us_excl_other"] = round(
+                    table["total_us"] - other, 1)
+            return out
+
+        spec_tables = {
+            "draft_k": sk,
+            "draft": _slice(draft_table),
+            "verify": _slice(verify_table),
+            "rollback": _slice(rollback_table, ("total_us", "groups",
+                                                "error")),
+        }
     groups = s.get("groups", {})
     mxu = groups.get("matmul (MXU)", {"us": 0.0, "pct": 0.0})
     residual = {g: row for g, row in groups.items() if g != "matmul (MXU)"}
@@ -271,6 +394,7 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
         "residual_pct": round(100.0 - float(mxu.get("pct") or 0.0), 2),
         "residual_groups": dict(sorted(
             residual.items(), key=lambda kv: -kv[1]["us"])),
+        **({"spec": spec_tables} if spec_tables is not None else {}),
         **({"error": s["error"]} if "error" in s else {}),
     }
     if out_path is not None:

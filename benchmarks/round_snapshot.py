@@ -126,10 +126,12 @@ def main(argv=None) -> None:
         # --multiproc 2: the tpurun-launched multi-process serve rung
         # (2 disaggregated workers, each SPMD over a 2-device emulated
         # mesh, serialized KV handoff) freezes into the same artifact.
+        # --spec: the speculative-decode sweep (draft size x K vs the
+        # non-spec device-busy floor) joins the round scoreboard too.
         rows = run_lines(
             [sys.executable, str(REPO / "benchmarks" / "serve_bench.py"),
              "--smoke", "--multiproc", "2", "--devices-per-proc", "2",
-             "--out", str(serve_out)],
+             "--spec", "--out", str(serve_out)],
             timeout=900,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         # surface the last MEASUREMENT row, not the trailing

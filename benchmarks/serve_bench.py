@@ -42,7 +42,22 @@ the paper-facing serving questions need:
   own ``--devices-per-proc``-emulated mesh with SERIALIZED KV handoff
   (the cross-process transfer), merged per-pool serving report
   embedded.  ``round_snapshot.py`` freezes this rung into the round's
-  ``BENCH_SERVE`` artifact.
+  ``BENCH_SERVE`` artifact;
+- **the speculative-decode sweep** (``--spec`` [+ ``--draft-layers``
+  ``--draft-k`` ``--spec-distill``]) — the decode roofline said only
+  fewer-passes-per-token remained: rungs sweep draft size × drafted-K
+  over a REPEAT-PROMPT workload (a fixed pool of popular prompts — the
+  distribution a production draft is trained on), quoting
+  accepted-tokens-per-pass and wall-TPOT against the single-model
+  device-busy TPOT floor measured on a non-spec twin under the same
+  traffic.  Draft variants: weight-tied (the target's first N layers,
+  zero training — the out-of-the-box floor) and a distilled draft
+  (trained for ``--spec-distill`` steps on the pool's greedy streams —
+  what "load a trained draft" buys; random-weight targets have no
+  pre-existing trained pair, so the bench builds one the way
+  production does, from the serving distribution).  A mixed
+  spec/non-spec rung interleaves opted-out and sampled requests in the
+  same batch.
 
 One warmup request absorbs XLA compilation before any timed rung, so
 rows measure the steady engine, not the first dispatch.  Artifact:
@@ -127,9 +142,16 @@ def _server_compile_counts(server) -> dict:
 
 
 def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
-             prompt_lens, max_news, seed: int) -> dict:
+             prompt_lens, max_news, seed: int, prompt_pool=None,
+             submit_kw=None) -> dict:
     """One offered-load rung: open-loop Poisson arrivals at ``rate_rps``
-    (``inf``-like rates degenerate to a burst), wait for completion."""
+    (``inf``-like rates degenerate to a burst), wait for completion.
+
+    ``prompt_pool``: draw prompts round-robin from this fixed list
+    instead of random per-request (the repeat-traffic workload the spec
+    sweep speculates on).  ``submit_kw``: per-request extra submit
+    kwargs, a callable ``i -> dict`` (e.g. the mixed spec/non-spec
+    rung's alternating opt-out)."""
     import numpy as np
 
     from tpudist.serve import AdmissionError
@@ -141,11 +163,15 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
     def submit_all():
         nonlocal rejected
         for i in range(n_requests):
-            plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
             max_new = int(rng.integers(max_news[0], max_news[1] + 1))
-            prompt = rng.integers(0, vocab, size=plen).astype(np.int32)
+            if prompt_pool is not None:
+                prompt = prompt_pool[i % len(prompt_pool)]
+            else:
+                plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+                prompt = rng.integers(0, vocab, size=plen).astype(np.int32)
+            kw = submit_kw(i) if callable(submit_kw) else (submit_kw or {})
             try:
-                h = server.submit(prompt, max_new=max_new, seed=i)
+                h = server.submit(prompt, max_new=max_new, seed=i, **kw)
                 with lock:
                     handles.append(h)
             except AdmissionError:
@@ -154,6 +180,7 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
                 time.sleep(float(rng.exponential(1.0 / rate_rps)))
 
     d0 = _server_decode_stats(server)
+    s0 = _server_spec_stats(server)
     h0 = _server_handoff_stats(server)
     t0 = time.monotonic()
     loader = threading.Thread(target=submit_all, daemon=True)
@@ -163,6 +190,7 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
         h.wait()
     wall = time.monotonic() - t0
     d1 = _server_decode_stats(server)
+    s1 = _server_spec_stats(server)
     h1 = _server_handoff_stats(server)
 
     ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
@@ -174,6 +202,7 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
     # decode block amortizes
     blocks = d1["blocks"] - d0["blocks"]
     dtok = d1["tokens"] - d0["tokens"]
+    steps = d1.get("steps", 0) - d0.get("steps", 0)
     busy = ((d1["dispatch_s"] - d0["dispatch_s"])
             + (d1["sync_s"] - d0["sync_s"]))
     sync = d1["sync_s"] - d0["sync_s"]
@@ -192,14 +221,23 @@ def run_rate(server, *, rate_rps: float, n_requests: int, vocab: int,
         "tpot_s_p95": round(_pct(tpots, 95), 6) if tpots else None,
         "decode_blocks": blocks,
         "decode_tokens": dtok,
+        "decode_steps": steps,
         "dispatches_per_token": round(blocks / dtok, 4) if dtok else None,
         "tpot_busy_s": round(busy / dtok, 6) if dtok else None,
+        # device-busy time per sequential TARGET pass: for a non-spec
+        # engine this is the single-model latency floor (a request
+        # cannot decode faster than one full-model pass per token); a
+        # spec engine's verify pass emits K+1 tokens per lane per step,
+        # which is exactly how it gets underneath that floor
+        "busy_per_step_s": round(busy / steps, 6) if steps else None,
         "host_sync_s_per_token": round(sync / dtok, 6) if dtok else None,
         "mean_tokens_per_request":
             round(statistics.mean([len(h.tokens) for h in handles]), 1)
             if handles else None,
         # KV residency accounting (paged: block pool; dense: the arena)
         "kv": _server_kv(server),
+        # speculative decode only: per-rung acceptance deltas
+        **_spec_cols(s0, s1),
         # disaggregated serving only: the prefill→decode handoff story
         # (None columns on the single-pool server)
         **_handoff_cols(h0, h1, handles),
@@ -211,6 +249,38 @@ def _server_handoff_stats(server):
         return None
     st = server.stats()
     return {"handoffs": st["handoffs"], "bytes": st["handoff_bytes"]}
+
+
+def _server_spec_stats(server):
+    """Cumulative speculative-decode counters, or None on a non-spec
+    server (rows then omit the spec columns)."""
+    if hasattr(server, "decode_pool"):
+        st = server.stats()["decode_pool"]["spec"]
+    else:
+        st = server.stats()["spec"]
+    return st if st.get("enabled") else None
+
+
+def _spec_cols(s0, s1) -> dict:
+    if s0 is None or s1 is None:
+        return {}
+    blocks = s1["blocks"] - s0["blocks"]
+    lanes = s1["lane_passes"] - s0["lane_passes"]
+    tokens = s1["tokens"] - s0["tokens"]
+    accepted = s1["accepted"] - s0["accepted"]
+    drafted = s1["drafted"] - s0["drafted"]
+    return {
+        "spec_blocks": blocks,
+        "spec_tokens": tokens,
+        # emitted tokens PER LANE per verify pass (1.0 = no better than
+        # plain decode) — the fewer-target-passes-per-token headline,
+        # normalized so batch occupancy cannot masquerade as acceptance
+        "accepted_per_pass": round(tokens / lanes, 3) if lanes else None,
+        "acceptance_rate": round(accepted / drafted, 4) if drafted else None,
+        "spec_rollbacks": s1["rollbacks"] - s0["rollbacks"],
+        "spec_draft_s": round(s1["draft_s"] - s0["draft_s"], 6),
+        "spec_verify_s": round(s1["verify_s"] - s0["verify_s"], 6),
+    }
 
 
 def _handoff_cols(h0, h1, handles) -> dict:
@@ -225,6 +295,134 @@ def _handoff_cols(h0, h1, handles) -> dict:
         "handoff_bytes": h1["bytes"] - h0["bytes"],
         "handoff_wait_s_p50": round(_pct(waits, 50), 6) if waits else None,
         "handoff_wait_s_p95": round(_pct(waits, 95), 6) if waits else None,
+    }
+
+
+def _distill_draft(module, params, layers: int, prompt_pool, steps: int,
+                   max_new: int):
+    """Build a TRAINED draft the way production does: distill the
+    target's own greedy continuations of the serving prompt pool into a
+    shallow student (cross-entropy on next-token, the sequence-level
+    distillation objective).  Random-weight targets ship no pre-trained
+    draft pair, so the bench trains one from the serving distribution —
+    acceptance is a property of (draft, workload), and this rung
+    measures the workload a real deployment would train for.  Returns
+    ``(draft_module, draft_params, final_loss)``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpudist.models import make_generator, tied_draft
+    from tpudist.models.transformer import lm_loss_with_targets
+
+    draft_mod, _ = tied_draft(module, params, layers)
+    dp = draft_mod.init(jax.random.PRNGKey(11), jnp.zeros((1, 8), jnp.int32))
+    gen = make_generator(module, params, max_new)
+    T = max(len(p) for p in prompt_pool) + max_new
+    toks = np.zeros((len(prompt_pool), T), np.int32)
+    tgts = np.full((len(prompt_pool), T - 1), -1, np.int32)
+    for i, p in enumerate(prompt_pool):
+        out = np.asarray(gen(jnp.asarray(p)[None]))[0]
+        toks[i, :len(out)] = out
+        tgts[i, :len(out) - 1] = out[1:]
+    opt = optax.adam(3e-3)
+    ost = opt.init(dp)
+
+    @jax.jit
+    def train_step(dp, ost, toks, tgts):
+        def loss_fn(dp):
+            return lm_loss_with_targets(draft_mod.apply(dp, toks[:, :-1]),
+                                        tgts)
+
+        loss, g = jax.value_and_grad(loss_fn)(dp)
+        up, ost = opt.update(g, ost)
+        return optax.apply_updates(dp, up), ost, loss
+
+    tj, gj = jnp.asarray(toks), jnp.asarray(tgts)
+    loss = None
+    for _ in range(max(1, steps)):
+        dp, ost, loss = train_step(dp, ost, tj, gj)
+    return draft_mod, dp, float(loss)
+
+
+def run_spec_sweep(*, module, params, make_server, vocab, requests, plens,
+                   mnews, block, draft_layers, draft_ks, distill_steps,
+                   seed) -> dict:
+    """The speculative-decode section: a repeat-prompt workload (fixed
+    pool of popular prompts), a non-spec FLOOR server measured under the
+    same traffic, then one rung per (draft variant × drafted-K) quoting
+    accepted-tokens-per-pass and wall-TPOT vs the floor's device-busy
+    TPOT, plus a mixed spec/non-spec traffic rung."""
+    import numpy as np
+
+    prng = np.random.default_rng(seed + 31)
+    P = min(6, max(2, requests))
+    pool = [prng.integers(
+        0, vocab, size=int(prng.integers(plens[0], plens[1] + 1))
+    ).astype(np.int32) for _ in range(P)]
+
+    def rung(srv, submit_kw=None, n=None):
+        row = run_rate(srv, rate_rps=1e9, n_requests=n or requests,
+                       vocab=vocab, prompt_lens=plens, max_news=mnews,
+                       seed=seed + 41, prompt_pool=pool,
+                       submit_kw=submit_kw)
+        srv.close()
+        return row
+
+    floor_row = rung(make_server(block))
+    # THE floor: the non-spec engine's device-busy seconds per
+    # sequential decode step.  A single model cannot emit a request's
+    # tokens faster than one full forward per token no matter how it
+    # batches or fuses — speculative decoding is the only lever that
+    # goes below it, and only when wall-TPOT (host overhead included)
+    # lands under this device-only bound is the win unarguable.
+    floor_busy = floor_row["busy_per_step_s"]
+    variants = [("tied", int(L), int(L)) for L in draft_layers]
+    distilled = None
+    if distill_steps:
+        dm, dp, loss = _distill_draft(module, params, min(draft_layers),
+                                      pool, distill_steps, mnews[1])
+        distilled = (dm, dp)
+        variants.append(("distilled", min(draft_layers), distilled))
+    rows = []
+    for kind, layers, draft in variants:
+        for k in draft_ks:
+            row = rung(make_server(block, spec=draft, spec_k=int(k)))
+            wall = row.get("tpot_s_p50")
+            rows.append({
+                "draft": f"{kind}-{layers}", "draft_layers": layers,
+                "distilled": kind == "distilled", "k": int(k), **row,
+                "tpot_busy_floor_s": floor_busy,
+                # the acceptance criterion: spec wall-TPOT under the
+                # single-model device-busy floor (host overhead included
+                # on the spec side, excluded from the floor — a strict
+                # comparison)
+                "below_busy_floor": (wall is not None
+                                     and floor_busy is not None
+                                     and wall < floor_busy),
+            })
+            print(json.dumps({"spec_rung": {
+                k2: rows[-1][k2] for k2 in (
+                    "draft", "k", "accepted_per_pass", "acceptance_rate",
+                    "tpot_s_p50", "tpot_busy_floor_s",
+                    "below_busy_floor")}}), flush=True)
+    # mixed spec/non-spec traffic: half the requests opt out, a third
+    # run sampled — heterogeneous acceptance in one batch
+    best = distilled if distilled is not None else int(draft_layers[0])
+    mixed_row = rung(
+        make_server(block, spec=best, spec_k=int(draft_ks[-1])),
+        submit_kw=lambda i: {"spec": i % 2 == 0,
+                             "temperature": 0.8 if i % 3 == 0 else 0.0},
+        n=max(requests, 2 * P))
+    return {
+        "workload": {"pool_prompts": P, "repeat_traffic": True,
+                     "prompt_lens": [int(len(p)) for p in pool]},
+        "floor": {**floor_row, "tpot_busy_s": floor_busy},
+        "rows": rows,
+        "distill_steps": int(distill_steps or 0),
+        "mixed": mixed_row,
+        "any_below_busy_floor": any(r["below_busy_floor"] for r in rows),
     }
 
 
@@ -452,6 +650,22 @@ def main(argv=None) -> int:
     p.add_argument("--devices-per-proc", type=int, default=2,
                    help="emulated devices per multiproc worker "
                         "(tpurun --devices-per-proc)")
+    p.add_argument("--spec", action="store_true",
+                   help="ALSO run the speculative-decode sweep: draft "
+                        "size x drafted-K rungs on a repeat-prompt "
+                        "workload, accepted-tokens/pass and wall-TPOT vs "
+                        "the non-spec device-busy TPOT floor, plus a "
+                        "mixed spec/non-spec traffic rung")
+    p.add_argument("--draft-layers", default=None,
+                   help="tied-draft depths for the --spec sweep (comma "
+                        "list of target-layer counts; default 1)")
+    p.add_argument("--draft-k", default=None,
+                   help="drafted tokens per pass for the --spec sweep "
+                        "(comma list; smoke default 2,4 — full 2,4,8)")
+    p.add_argument("--spec-distill", type=int, default=None,
+                   help="distillation steps for the trained-draft rung "
+                        "(0 = tied drafts only; default 150 smoke / 200 "
+                        "full)")
     p.add_argument("--skip-sweeps", action="store_true",
                    help="skip the always-on paged-capacity and kv-dtype "
                         "sweeps (their sections record {'skipped': true}) "
@@ -512,7 +726,8 @@ def main(argv=None) -> int:
 
     def make_server(decode_block, *, n_slots=None, paged=False,
                     kv_blocks=None, kv_int8=False, prefix_cache=None,
-                    queue_limit=None, disagg=None, mesh=None):
+                    queue_limit=None, disagg=None, mesh=None,
+                    spec=None, spec_k=4):
         n_slots = n_slots or slots
         disagg = args.disagg if disagg is None else disagg
         mesh = args.mesh if mesh is None else (mesh or None)
@@ -521,6 +736,14 @@ def main(argv=None) -> int:
             if prefix_cache is None:
                 pool = kv_blocks or n_slots * (max_len // kv_block)
                 prefix_cache = pool // 4
+        # spec: None = off, an int = tied-draft depth, a (module,
+        # params) pair = a loaded (e.g. distilled) draft
+        spec_kw = {}
+        if spec is not None:
+            spec_kw = dict(
+                spec=True, spec_k=spec_k,
+                spec_draft_layers=spec if isinstance(spec, int) else 0,
+                spec_draft=None if isinstance(spec, int) else spec)
         cfg = ServeConfig(num_slots=n_slots, queue_limit=queue_limit or queue,
                           prefill_pad=pad, max_new=mnews[1],
                           decode_block=decode_block,
@@ -529,7 +752,7 @@ def main(argv=None) -> int:
                           prefix_cache_blocks=prefix_cache or 0,
                           mesh=mesh, tp_overlap=args.tp_overlap,
                           disagg=disagg, handoff=args.handoff,
-                          prefill_slots=args.prefill_slots)
+                          prefill_slots=args.prefill_slots, **spec_kw)
         cls = DisaggServer if disagg else InferenceServer
         srv = cls(module, params, cfg, install_signal_handler=False)
         srv.start()
@@ -544,10 +767,32 @@ def main(argv=None) -> int:
             # decodes exactly one K=b block
             srv.submit(np.zeros(plens[0], np.int32), max_new=b + 1).wait()
             b *= 2
+        if spec is not None:
+            # the spec bucket picker caps K at (max remaining - 1): a
+            # request with b + 2 tokens of budget compiles the K=b
+            # draft-propose/verify pair — every power-of-two bucket up
+            # to spec_k must compile HERE, not inside a timed rung
+            b = 1
+            while b <= spec_k:
+                srv.submit(np.zeros(plens[0], np.int32),
+                           max_new=b + 2).wait()
+                b *= 2
         return srv
 
+    spec_draft_layers = [int(x) for x in
+                         (args.draft_layers or "1").split(",")]
+    spec_draft_ks = [int(x) for x in
+                     (args.draft_k or ("2,4" if smoke else "2,4,8")
+                      ).split(",")]
     main_paged = dict(paged=args.paged, kv_blocks=args.kv_blocks,
                       kv_int8=args.kv_dtype == "int8")
+    if args.spec:
+        # --spec serves the MAIN rows speculatively too (tied draft at
+        # the sweep's first depth), so the offered-load rows and the
+        # embedded serving report carry the acceptance counters; the
+        # sweep section isolates draft variants against the floor
+        main_paged.update(spec=spec_draft_layers[0],
+                          spec_k=spec_draft_ks[-1])
     server = make_server(block, **main_paged)
     rows = []
     for i, rate in enumerate(rates):
@@ -563,9 +808,13 @@ def main(argv=None) -> int:
 
     # block-size sweep: same offered burst through a fresh engine per K,
     # isolating what token-block fusion does to throughput and overhead
+    # — always NON-speculative (the spec sweep isolates speculation; a
+    # spec engine's iteration shape doesn't vary with the plain block K)
     sweep = []
+    block_kw = {k: v for k, v in main_paged.items()
+                if k not in ("spec", "spec_k")}
     for b in blocks:
-        srv = make_server(b, **main_paged)
+        srv = make_server(b, **block_kw)
         row = run_rate(srv, rate_rps=1e9, n_requests=requests,
                        vocab=args.vocab, prompt_lens=plens, max_news=mnews,
                        seed=args.seed)
@@ -646,6 +895,19 @@ def main(argv=None) -> int:
                               "bytes_per_pos"],
                           "native_over_int8_bytes": round(ratio, 3)}
 
+    # -- speculative-decode sweep (--spec): draft size x K rungs vs the
+    # non-spec device-busy floor, on repeat-prompt traffic -----------------
+    spec_sweep = None
+    if args.spec:
+        distill = args.spec_distill
+        if distill is None:
+            distill = 150 if smoke else 200
+        spec_sweep = run_spec_sweep(
+            module=module, params=params, make_server=make_server,
+            vocab=args.vocab, requests=requests, plens=plens, mnews=mnews,
+            block=block, draft_layers=spec_draft_layers,
+            draft_ks=spec_draft_ks, distill_steps=distill, seed=args.seed)
+
     # finish the sweeps side-stream unconditionally — a still-armed
     # session would cross-contaminate whatever this process serves next
     telemetry.finish(write_report=False)
@@ -679,11 +941,13 @@ def main(argv=None) -> int:
             "mesh": args.mesh, "tp_overlap": args.tp_overlap,
             "disagg": args.disagg,
             "handoff": args.handoff if args.disagg else None,
+            "spec": args.spec,
         },
         "rows": rows,
         "block_sweep": sweep,
         "paged_capacity": capacity,
         "kv_dtype_sweep": kv_dtype_sweep,
+        **({"spec_sweep": spec_sweep} if spec_sweep is not None else {}),
         **({"multiproc_serve": multiproc} if multiproc is not None else {}),
         "server_stats": stats,
         "serving_report": report.get("serving"),

@@ -402,7 +402,9 @@ class TestServeBench:
 
     def test_decode_profile_capture(self, tmp_path):
         """--capture-decode: the bf16 decode loop traces and the per-op
-        table names the non-matmul residual (VERDICT Weak #2)."""
+        table names the non-matmul residual (VERDICT Weak #2), and the
+        speculative path's draft / verify / rollback phases are traced
+        separately."""
         from benchmarks.profile_summary import main
 
         out = tmp_path / "DECODE_PROFILE.json"
@@ -417,6 +419,79 @@ class TestServeBench:
         assert rec["residual_pct"] is not None
         assert rec["residual_groups"], "residual table must name groups"
         assert abs(rec["matmul_pct"] + rec["residual_pct"] - 100.0) < 0.1
+        sp = rec["spec"]
+        for phase in ("draft", "verify"):
+            assert sp[phase]["total_us"] > 0, phase
+            assert sp[phase]["groups"], phase
+        # rollback is cursor arithmetic: its attributed-op time is a
+        # sliver of either forward's
+        assert sp["rollback"]["op_us_excl_other"] < \
+            sp["verify"]["op_us_excl_other"]
+
+    def test_dh128_twin_smoke(self, tmp_path):
+        """The d_head twin harness (VERDICT Weak #1): both twins run in
+        one window, the FLOPs-parity assert holds, rows carry regime +
+        d_head labels (cpu rows are mechanics-only by construction)."""
+        from benchmarks.dh128_twin import main
+
+        out = tmp_path / "DH128.json"
+        rc = main(["--smoke", "--out", str(out)])
+        assert rc == 0
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["smoke"] and "FLOPs" in rec["note"]
+        assert rec["dense_base"]["d_head"] * 2 == \
+            rec["dense_dh_twin"]["d_head"]
+        assert rec["dense_base"]["model_flops_per_step"] == \
+            rec["dense_dh_twin"]["model_flops_per_step"]
+        assert rec["dense_twin_speedup"] > 0
+
+    def test_smoke_spec_sweep(self, tmp_path):
+        """The --spec sweep: tied + distilled draft rungs over repeat
+        traffic, accepted-tokens/pass and acceptance-rate columns, the
+        single-model device-busy floor quoted per rung, and the mixed
+        spec/non-spec traffic rung.  CPU-smoke asserts mechanics (the
+        distilled draft reaches high acceptance on its workload; the
+        below-floor claim is for the compute-dominated frozen artifact,
+        not this µs-scale model)."""
+        from benchmarks.serve_bench import main
+
+        out = tmp_path / "BENCH_SERVE_SPEC.json"
+        rc = main(["--smoke", "--out", str(out), "--requests", "4",
+                   "--rates", "burst", "--blocks", "1", "--skip-sweeps",
+                   "--spec", "--draft-layers", "1", "--draft-k", "2,4",
+                   "--spec-distill", "120"])
+        assert rc == 0
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["config"]["spec"]
+        sw = rec["spec_sweep"]
+        assert sw["workload"]["repeat_traffic"]
+        # the floor is the non-spec engine's device-busy seconds per
+        # sequential decode step
+        assert sw["floor"]["busy_per_step_s"] > 0
+        assert sw["floor"]["decode_steps"] > 0
+        rows = {(r["draft"], r["k"]): r for r in sw["rows"]}
+        assert set(rows) == {("tied-1", 2), ("tied-1", 4),
+                             ("distilled-1", 2), ("distilled-1", 4)}
+        for r in sw["rows"]:
+            assert r["spec_blocks"] > 0
+            assert r["accepted_per_pass"] is not None
+            assert r["acceptance_rate"] is not None
+            assert r["tpot_busy_floor_s"] == sw["floor"]["busy_per_step_s"]
+            assert r["spec_draft_s"] >= 0 and r["spec_verify_s"] > 0
+        # a draft distilled on the serving distribution accepts most of
+        # its proposals; the zero-training tied draft accepts fewer
+        assert (rows[("distilled-1", 4)]["acceptance_rate"]
+                > rows[("tied-1", 4)]["acceptance_rate"])
+        assert rows[("distilled-1", 4)]["acceptance_rate"] > 0.5
+        # full acceptance at K=4 emits ~5 tokens per lane per pass
+        assert rows[("distilled-1", 4)]["accepted_per_pass"] > 2.0
+        # mixed rung: opted-out + sampled requests complete in-batch
+        assert sw["mixed"]["completed"] > 0
+        assert sw["mixed"]["spec_blocks"] > 0
 
     def test_smoke_paged_int8_rungs_compile_pinned(self, tmp_path):
         """The --paged/--kv-dtype rungs: offered-load rows served off

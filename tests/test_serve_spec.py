@@ -1,0 +1,385 @@
+"""Speculative decoding (tpudist.serve, draft-propose / batched
+target-verify): the oracle sweep — greedy byte-identity vs sequential
+``generate()`` under heterogeneous-length churn across dense/paged ×
+K ∈ {2,4,8} × draft sizes, sampled stream-equivalence across cache
+layouts and mesh shapes, compile pins with spec enabled, the
+zero-acceptance worst case (an adversarial draft degrades to ≥ 1
+token/pass, never livelocks, never overdraws a budget), mixed
+spec/non-spec traffic in one batch, server/disagg e2e, and the
+telemetry speculation section."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist.models import create_transformer, generate, tied_draft
+from tpudist.serve import DisaggServer, InferenceServer, ServeConfig, SlotEngine
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+def _prompt(plen, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _reference(model, prompt, max_new):
+    module, params = model
+    import jax.numpy as jnp
+
+    out = generate(module, params, jnp.asarray(prompt)[None], max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+#: the dense suite's acceptance-oracle request mix (heterogeneous
+#: lengths incl. a prompt past the prefill chunk), with per-request
+#: spec opt flags — one lane opts out so every sweep also covers mixed
+#: spec/non-spec batches
+def _reqs():
+    return [
+        (_prompt(3, 0), 4, True),
+        (_prompt(5, 1), 6, False),
+        (_prompt(12, 2), 3, True),  # > prefill_pad 8: chunked prefill
+        (_prompt(6, 3), 5, True),
+    ]
+
+
+def _drive(model, requests, *, num_slots=2, prefill_pad=8,
+           temperature=0.0, seed=0, **engine_kw):
+    """Continuous-batching churn through a (spec) SlotEngine: FIFO
+    admission, chunked prefill, decode via ``decode_auto``.  Asserts
+    the in-graph budget clamp: no block ever delivers past a lane's
+    budget."""
+    module, params = model
+    eng = SlotEngine(module, params, num_slots=num_slots,
+                     prefill_pad=prefill_pad, **engine_kw)
+    pending = list(enumerate(requests))
+    out = {rid: [] for rid, _ in pending}
+    slot_rid, slot_budget = {}, {}
+
+    def deliver(slot, toks):
+        rid = slot_rid[slot]
+        out[rid].extend(toks)
+        assert len(out[rid]) <= slot_budget[slot], \
+            "block overdrew the request budget"
+        if len(out[rid]) >= slot_budget[slot]:
+            eng.evict(slot)
+            del slot_rid[slot], slot_budget[slot]
+
+    while pending or eng.num_occupied:
+        free, items = eng.free_slots(), []
+        while free and pending:
+            rid, (prompt, max_new, spec) = pending.pop(0)
+            slot = free.pop(0)
+            slot_rid[slot], slot_budget[slot] = rid, max_new
+            items.append((slot, prompt, temperature, seed, max_new, (),
+                          spec))
+        for slot, tok in eng.start_batch(items).items():
+            if tok is not None:
+                deliver(slot, [tok])
+        for slot, tok in eng.advance_prefill().items():
+            deliver(slot, [tok])
+        if eng.num_active:
+            _, blocks = eng.decode_auto()
+            for slot, toks in list(blocks.items()):
+                if slot in slot_rid:
+                    deliver(slot, toks)
+    return out, eng
+
+
+class TestSpecOracle:
+    @pytest.mark.parametrize("paged", [False, True])
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_greedy_byte_identity_sweep(self, model, k, paged):
+        """The acceptance contract: greedy spec output byte-identical to
+        the sequential oracle at every (K, paged/dense) combination,
+        heterogeneous churn included."""
+        kw = dict(paged=True, kv_block=4) if paged else {}
+        out, eng = _drive(model, _reqs(), spec_draft=1, spec_k=k, **kw)
+        for rid, (prompt, max_new, _) in enumerate(_reqs()):
+            assert out[rid] == _reference(model, prompt, max_new), \
+                (k, paged, rid)
+        assert eng.num_occupied == 0
+        # speculation actually ran and emitted more than one token per
+        # verify pass on aggregate (the tied draft accepts some)
+        st = eng.spec_stats()
+        assert st["blocks"] > 0 and st["tokens"] > st["blocks"]
+        if paged:
+            assert eng.alloc.free_blocks == eng.alloc.num_blocks
+
+    @pytest.mark.parametrize("layers", [1, 2])
+    def test_greedy_every_draft_size(self, model, layers):
+        """Draft depth moves acceptance, never output: the full tie
+        (layers == n_layers) accepts everything, the shallow tie less —
+        both byte-identical to the oracle."""
+        out, eng = _drive(model, _reqs(), spec_draft=layers, spec_k=4)
+        for rid, (prompt, max_new, _) in enumerate(_reqs()):
+            assert out[rid] == _reference(model, prompt, max_new), \
+                (layers, rid)
+        if layers == CFG["n_layers"]:
+            # the tied-identity draft IS the target: every verified
+            # draft accepted (the acceptance-ceiling calibration)
+            st = eng.spec_stats()
+            assert st["acceptance_rate"] == 1.0
+            assert st["rollbacks"] == 0
+
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_sampled_stream_equivalence_dense_vs_paged(self, model, k):
+        """Sampled spec streams are cache-layout-independent: every
+        acceptance test and residual draw sits on a fold_in substream of
+        the request key at that token's stream index, so the dense and
+        paged engines draw identical streams at every K."""
+        dense, _ = _drive(model, _reqs(), spec_draft=1, spec_k=k,
+                          temperature=1.3, seed=5)
+        paged, _ = _drive(model, _reqs(), spec_draft=1, spec_k=k,
+                          temperature=1.3, seed=5, paged=True, kv_block=4)
+        assert paged == dense, k
+        for toks in dense.values():
+            assert all(0 <= t < CFG["vocab"] for t in toks)
+
+    def test_spec_off_lane_matches_nonspec_engine_streams(self, model):
+        """A spec-opted-out lane rides the spec programs with acceptance
+        forced to zero and draws on the PLAIN fold_in(key, count)
+        stream — its sampled tokens are byte-identical to a
+        non-speculative engine's, even while its batch neighbors
+        speculate."""
+        spec_out, _ = _drive(model, _reqs(), spec_draft=1, spec_k=4,
+                             temperature=1.3, seed=5)
+        plain_out, _ = _drive(model, _reqs(), temperature=1.3, seed=5)
+        # request 1 is the opted-out lane (see _reqs)
+        assert spec_out[1] == plain_out[1]
+
+    def test_zero_acceptance_worst_case(self, model):
+        """The degradation bound: an adversarial draft (independently
+        random weights — its argmax is uncorrelated with the target's)
+        still emits >= 1 token per verify pass, the engine never
+        livelocks (pass count bounded by emitted tokens), budgets are
+        never overdrawn, and the output stays oracle-exact."""
+        module, params = model
+        wrong = create_transformer(jax.random.PRNGKey(99), seq_len=16,
+                                   **CFG)
+        out, eng = _drive(model, _reqs(), spec_draft=wrong, spec_k=4)
+        for rid, (prompt, max_new, _) in enumerate(_reqs()):
+            assert out[rid] == _reference(model, prompt, max_new), rid
+        st = eng.spec_stats()
+        assert st["blocks"] > 0
+        # >= 1 token per pass, per active lane: aggregate tokens cover
+        # every pass (each pass emits at least the correction token)
+        assert st["tokens"] >= st["blocks"]
+        assert st["acceptance_rate"] < 0.5  # uncorrelated draft
+        # total emitted exactly equals the sum of budgets — no overdraw,
+        # no livelock leftovers
+        assert sum(len(v) for v in out.values()) == \
+            sum(m for _, m, _ in _reqs())
+
+    def test_budget_edges(self, model):
+        """max_new == 1 finishes at insert; max_new == 2 exercises the
+        per-lane in-graph rem clamp alongside a long-budget neighbor."""
+        reqs = [(_prompt(3, 40), 1, True), (_prompt(4, 41), 2, True),
+                (_prompt(5, 42), 12, True)]
+        out, _ = _drive(model, reqs, spec_draft=1, spec_k=8)
+        for rid, (prompt, max_new, _) in enumerate(reqs):
+            assert out[rid] == _reference(model, prompt, max_new), rid
+
+
+class TestSpecCompilePins:
+    def test_compile_counts_pinned_under_churn(self, model):
+        """Churn never recompiles the spec programs: one compile each
+        for draft prefill/extend/evict, and draft_propose/spec_verify
+        bounded by the power-of-two K bucket set."""
+        out, eng = _drive(model, _reqs() * 2, spec_draft=1, spec_k=4)
+        cc = eng.compile_counts()
+        assert cc["insert_batch"] == 1
+        assert cc["prefill_extend"] == 1
+        assert cc["draft_prefill"] == 1
+        assert cc["draft_extend"] == 1
+        assert cc["draft_evict"] == 1
+        assert 1 <= cc["draft_propose"] <= 3  # buckets of spec_k=4
+        assert 1 <= cc["spec_verify"] <= 3
+        assert cc["spec_verify"] == cc["draft_propose"]
+
+    def test_compile_counts_flat_across_mesh_shapes(self, model, devices):
+        """Mesh shapes change shardings, never programs: the spec
+        engine's jit-cache sizes are identical at 1x1 and 1x2, and
+        greedy output stays byte-identical to the oracle on the mesh."""
+        outs, counts = {}, {}
+        for mesh in (None, "1x2"):
+            out, eng = _drive(model, _reqs(), spec_draft=1, spec_k=4,
+                              mesh=mesh)
+            outs[mesh], counts[mesh] = out, eng.compile_counts()
+        assert outs[None] == outs["1x2"]
+        for rid, (prompt, max_new, _) in enumerate(_reqs()):
+            assert outs["1x2"][rid] == _reference(model, prompt, max_new)
+        assert counts[None] == counts["1x2"]
+
+    def test_sampled_stream_equivalence_across_mesh(self, model, devices):
+        """Sampled spec streams are mesh-shape-independent too."""
+        a, _ = _drive(model, _reqs(), spec_draft=1, spec_k=2,
+                      temperature=1.3, seed=5)
+        b, _ = _drive(model, _reqs(), spec_draft=1, spec_k=2,
+                      temperature=1.3, seed=5, mesh="1x2")
+        assert a == b
+
+
+class TestSpecServer:
+    def _server(self, model, **cfg):
+        module, params = model
+        cfg.setdefault("num_slots", 2)
+        cfg.setdefault("queue_limit", 8)
+        cfg.setdefault("prefill_pad", 8)
+        cfg.setdefault("spec", True)
+        cfg.setdefault("spec_k", 4)
+        cfg.setdefault("spec_draft_layers", 1)
+        return InferenceServer(module, params, ServeConfig(**cfg),
+                               install_signal_handler=False)
+
+    def test_server_e2e_mixed_traffic(self, model):
+        server = self._server(model).start()
+        try:
+            reqs = [(_prompt(3, 20), 6, None), (_prompt(5, 21), 5, False),
+                    (_prompt(12, 22), 4, None), (_prompt(6, 23), 5, True)]
+            handles = [server.submit(p, max_new=m, spec=s)
+                       for p, m, s in reqs]
+            for h, (p, m, _) in zip(handles, reqs):
+                assert h.wait(120)
+                assert h.finish_reason == "length"
+                assert h.tokens == _reference(model, p, m)
+            st = server.stats()
+            assert st["spec"]["enabled"] and st["spec"]["blocks"] > 0
+            assert st["spec"]["accepted_per_pass"] is not None
+        finally:
+            assert server.close(30)
+
+    def test_server_eos_truncates_spec_block(self, model):
+        """A stop token mid-spec-block truncates post-hoc exactly like
+        the plain block path."""
+        p = _prompt(4, 31)
+        ref = _reference(model, p, 12)
+        eos = ref[len(ref) // 2]
+        cut = ref.index(eos)
+        assert cut + 1 < len(ref), "flaky fixture: eos is the last token"
+        server = self._server(model).start()
+        try:
+            h = server.submit(p, max_new=12, eos_id=eos)
+            assert h.wait(120)
+            assert h.finish_reason == "eos"
+            assert h.tokens == ref[:cut + 1]
+        finally:
+            assert server.close(30)
+
+    def test_paged_spec_server_with_prefix_cache(self, model):
+        """Spec × paged × prefix reuse: the draft pool shares the
+        target pool's block ids, so a reused prefix's draft KV is
+        already in place — streams stay byte-identical."""
+        module, params = model
+        server = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=2, queue_limit=8, prefill_pad=8,
+                        paged=True, kv_block=4, prefix_cache_blocks=8,
+                        spec=True, spec_k=4, spec_draft_layers=1),
+            install_signal_handler=False).start()
+        try:
+            sysp = _prompt(8, 90)
+            for i in range(3):
+                p = np.concatenate([sysp, _prompt(2 + i, 91 + i)])
+                h = server.submit(p, max_new=5)
+                assert h.wait(120)
+                assert h.tokens == _reference(model, p, 5), i
+            assert server.engine.alloc.prefix_hit_blocks >= 4
+        finally:
+            assert server.close(30)
+
+    def test_disagg_spec_decode_pool_cold_draft(self, model):
+        """Disaggregation with spec: the decode pool owns the draft,
+        handoff packages are unchanged, and an imported lane's COLD
+        draft context never moves output (only acceptance)."""
+        module, params = model
+        server = DisaggServer(
+            module, params,
+            ServeConfig(num_slots=2, queue_limit=8, prefill_pad=8,
+                        handoff="serial", spec=True, spec_k=4,
+                        spec_draft_layers=2),
+            install_signal_handler=False).start()
+        try:
+            reqs = [(_prompt(3, 60), 6), (_prompt(5, 61), 5),
+                    (_prompt(12, 62), 4)]
+            handles = [server.submit(p, max_new=m) for p, m in reqs]
+            for h, (p, m) in zip(handles, reqs):
+                assert h.wait(120)
+                assert h.tokens == _reference(model, p, m)
+            st = server.stats()
+            assert st["decode_pool"]["spec"]["blocks"] > 0
+            # prefill pool never drafts
+            assert not server.prefill_pool[0].spec
+        finally:
+            assert server.close(30)
+
+
+class TestSpecAggregation:
+    def _write(self, tmp_path, records):
+        lines = []
+        for r in records:
+            r = {"rank": 0, "gen": 0, "dur": 0.0, **r}
+            lines.append(json.dumps(r))
+        (tmp_path / "rank0_gen0.jsonl").write_text("\n".join(lines) + "\n")
+
+    def test_spec_section_percentiles_and_split(self, tmp_path):
+        from tpudist.telemetry.aggregate import aggregate_run, render_markdown
+
+        recs = [
+            {"kind": "span", "name": "spec_verify", "t": 0.1, "dur": 1.0,
+             "occupancy": 1.0, "active": 2, "k": 4, "tokens": 6,
+             "accepted": 4, "drafted": 8, "rollbacks": 1,
+             "dispatch_s": 0.8, "sync_s": 0.1, "draft_s": 0.3,
+             "verify_s": 0.5},
+            {"kind": "span", "name": "spec_verify", "t": 1.2, "dur": 1.0,
+             "occupancy": 1.0, "active": 2, "k": 4, "tokens": 10,
+             "accepted": 8, "drafted": 8, "rollbacks": 0,
+             "dispatch_s": 0.8, "sync_s": 0.1, "draft_s": 0.3,
+             "verify_s": 0.5},
+            {"kind": "event", "name": "request_finished", "t": 2.0,
+             "reason": "length", "tokens_out": 16, "ttft_s": 0.2,
+             "tpot_s": 0.01, "queue_wait_s": 0.05},
+        ]
+        self._write(tmp_path, recs)
+        report = aggregate_run(tmp_path)
+        sv = report["serving"]
+        sp = sv["spec"]
+        assert sp["blocks"] == 2 and sp["tokens"] == 16
+        assert sp["accepted"] == 12 and sp["drafted"] == 16
+        assert sp["acceptance_rate"] == pytest.approx(0.75)
+        assert sp["rollbacks"] == 1
+        # per-lane emitted per pass: 3.0 and 5.0
+        assert sp["accepted_per_pass"]["p50"] == pytest.approx(3.0)
+        assert sp["accepted_per_pass"]["p95"] == pytest.approx(5.0)
+        assert sp["draft_s"] == pytest.approx(0.6)
+        assert sp["verify_s"] == pytest.approx(1.0)
+        # spec blocks fold into the decode dispatch accounting too
+        assert sv["decode_blocks"] == 2 and sv["decode_tokens"] == 16
+        # spec_verify is step time in the goodput breakdown
+        assert report["goodput"]["step"]["s"] == pytest.approx(2.0)
+        md = render_markdown(report)
+        assert "speculative decode" in md
+
+    def test_old_streams_without_spec_events_aggregate_cleanly(
+            self, tmp_path):
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        self._write(tmp_path, [
+            {"kind": "span", "name": "decode_block", "t": 0.1, "dur": 1.0,
+             "occupancy": 0.5, "k": 4, "tokens": 4, "dispatch_s": 0.9,
+             "sync_s": 0.05},
+            {"kind": "event", "name": "request_finished", "t": 2.0,
+             "reason": "length", "tokens_out": 4, "ttft_s": 0.2,
+             "tpot_s": 0.01, "queue_wait_s": 0.05},
+        ])
+        sv = aggregate_run(tmp_path)["serving"]
+        assert "spec" not in sv
+        assert sv["decode_blocks"] == 1

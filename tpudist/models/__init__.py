@@ -11,7 +11,9 @@ from tpudist.models.generate import (  # noqa: F401
     decode_logits,
     generate,
     make_decode_step,
+    make_decode_window,
     make_generator,
     make_slot_decode,
     sample_logits,
+    tied_draft,
 )

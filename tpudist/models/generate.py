@@ -13,6 +13,12 @@ TPU-first:
   worth a second compilation).
 - works for both position encodings: learned tables read the cache's
   position counter; RoPE rotates each token at its absolute offset.
+- speculative decoding (:func:`make_slot_decode` ``spec=``): a draft
+  model proposes K tokens per slot, the target verifies the whole
+  window in ONE multi-token cached pass (:func:`make_decode_window`) —
+  the weights and KV arena stream once per K+1 candidates instead of
+  once per token, which is the fewer-passes-per-token lever left after
+  the decode path measured at 100.6% of its HBM roofline.
 """
 
 from __future__ import annotations
@@ -88,6 +94,53 @@ def make_decode_step(module, params):
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     return init_cache, step
+
+
+def make_decode_window(module, params):
+    """Return ``window(cache, toks [s]) -> (cache, logits [s, vocab])``:
+    the batch-1 multi-token decode pass — ``s`` tokens written at the
+    cache cursor and scored against the cache in ONE forward (the
+    speculative-decoding verify kernel).  Unlike a ``lax.scan`` of
+    single-token steps, the weights and the KV arena stream once per
+    window instead of once per token: at K drafted tokens the target
+    pays ~1/K of the sequential HBM sweeps per emitted token — the
+    fewer-passes-per-token lever the decode roofline measurement said
+    was the only one left (ROOFLINE_r05 / ROADMAP item 5).  Logit row
+    ``i`` is conditioned on the cache prefix plus ``toks[:i]`` — for a
+    verify window ``[last_tok, d_1..d_K]`` row ``i`` scores candidate
+    ``d_{i+1}`` exactly as a sequential decode step would."""
+    dec = module.clone(decode=True, moe_fn=None)
+
+    def window(cache, toks):
+        logits, mut = dec.apply(
+            {"params": params["params"], "cache": cache},
+            toks[None], mutable=["cache"],
+        )
+        return mut["cache"], logits[0].astype(jnp.float32)
+
+    return window
+
+
+def tied_draft(module, params, layers: int):
+    """Weight-tied shallow draft for speculative decoding: the target's
+    first ``layers`` blocks plus its embeddings, final LayerNorm, and
+    head — zero extra parameters, zero training (the LayerSkip-style
+    early-exit draft).  Returns ``(draft_module, draft_params)`` for
+    :func:`make_slot_decode`'s ``spec=``.  Draft quality only moves the
+    acceptance rate, never output correctness (the target verify is the
+    oracle); a trained/distilled draft can be loaded instead through the
+    same ``(module, params)`` seam — it must share the target's
+    ``vocab`` and ``max_len`` (cursor parity with the target cache)."""
+    n = int(getattr(module, "n_layers"))
+    if not 1 <= layers <= n:
+        raise ValueError(f"draft layers {layers} must be in [1, {n}]")
+    draft = module.clone(n_layers=layers, moe_fn=None, mlp_fn=None)
+    src = params["params"]
+    kept = {
+        k: v for k, v in src.items()
+        if not k.startswith("block_") or int(k.rsplit("_", 1)[1]) < layers
+    }
+    return draft, {"params": kept}
 
 
 def sample_logits(
@@ -243,7 +296,15 @@ class SlotState(NamedTuple):
     - ``counts [S] int32`` — tokens emitted so far, which is also the
       per-request sampling-stream index (``fold_in(key, count)``);
     - ``temps [S] f32`` / ``keys [S, 2] uint32`` — per-request sampling
-      config (keys are derived in-graph from integer seeds at insert).
+      config (keys are derived in-graph from integer seeds at insert);
+    - ``accepted [S] int32`` / ``drafted [S] int32`` — speculative-decode
+      acceptance bookkeeping (:func:`make_slot_decode` ``spec=``):
+      cumulative drafted tokens the target verify accepted / proposed for
+      this tenant.  Updated in-graph by ``spec_verify`` (the rollback
+      cursor itself is ``pos`` — the same leaf every path maintains), so
+      acceptance telemetry needs no extra device round trips and the
+      counters ride KV handoff with the rest of the row.  Zero on
+      non-speculative engines.
     """
 
     last_tok: jax.Array
@@ -252,6 +313,8 @@ class SlotState(NamedTuple):
     counts: jax.Array
     temps: jax.Array
     keys: jax.Array
+    accepted: jax.Array
+    drafted: jax.Array
 
 
 class SlotDecode(NamedTuple):
@@ -351,6 +414,56 @@ class SlotDecode(NamedTuple):
     paged: Optional["_Paged"] = None
     export_lane: Optional[Callable] = None
     import_lane: Optional[Callable] = None
+    # -- speculative decoding (make_slot_decode(spec=...)) -----------------
+    # The draft model's own slot cache rides beside the target cache
+    # through a parallel primitive set (the four core programs above are
+    # UNCHANGED — spec is additive, so non-spec engines keep their exact
+    # compile pins):
+    # - ``init_draft()`` → all-zeros draft slot cache (dense twin of
+    #   ``init_slots``; paged engines get a PagedKV over the DRAFT
+    #   template sharing the target pool's block ids — "its own smaller
+    #   block pool": same allocator decisions, draft-sized bytes);
+    # - ``draft_prefill(dcache, [tables, poss,] prompts, clens, dsts)``
+    #   → teacher-force each admission lane's prompt chunk through the
+    #   draft (the draft twin of ``insert_batch``'s cache half);
+    # - ``draft_extend(dcache, slot, chunk, clen)`` → one chunked-prefill
+    #   append (twin of ``prefill_extend``);
+    # - ``draft_evict(dcache, slot[, free_ids])`` → zero the lane (and
+    #   recycled pool blocks);
+    # - ``draft_arm(dcache, slot, [row,] pos)`` → cold-start a lane at
+    #   cursor ``pos`` after a KV handoff import (packages are unchanged
+    #   — the decode pool owns the draft, so an imported lane's draft
+    #   context starts empty and warms as the request decodes);
+    # - ``draft_track(state, dcache, toks [K, S])`` → teacher-force a
+    #   plain decode block's emitted tokens through the draft, keeping
+    #   draft and target cursors in lockstep across non-speculative
+    #   iterations (remaining-budget-1 fallbacks);
+    # - ``draft_propose(state, dcache, k)`` → ``(dcache, drafts [k, S],
+    #   dlogits [k, S, vocab])``: k draft decode steps with in-graph
+    #   token feedback (greedy argmax, or categorical on the per-request
+    #   ``fold_in`` substream), plus one extra step feeding the last
+    #   draft so an all-accepted verify leaves both cursors equal;
+    # - ``spec_verify(state, cache, dcache, drafts, dlogits, spec_on,
+    #   rem)`` → ``(state, cache, dcache, packed [S, k+2])``: the
+    #   batched target verify — ONE multi-token window pass scores
+    #   ``[last_tok, d_1..d_k]``, leading-prefix acceptance (greedy
+    #   token match, or the standard residual-distribution correction),
+    #   per-lane budget clamp (``rem``), bonus/correction token, and the
+    #   in-graph rollback (cursors back to ``pos0 + emitted``; rejected
+    #   KV beyond the cursor is masked garbage, the paged-gather
+    #   contract).  ``packed`` is ``[S, k+3]``: column 0 the per-lane
+    #   emitted count, column 1 the UNCLAMPED accept count (the
+    #   draft-quality counter), columns 2.. the emitted tokens — ONE
+    #   block-granularity fetch.
+    init_draft: Optional[Callable] = None
+    draft_prefill: Optional[Callable] = None
+    draft_extend: Optional[Callable] = None
+    draft_evict: Optional[Callable] = None
+    draft_arm: Optional[Callable] = None
+    draft_track: Optional[Callable] = None
+    draft_propose: Optional[Callable] = None
+    spec_verify: Optional[Callable] = None
+    draft_paged: Optional["_Paged"] = None
 
 
 def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
@@ -370,7 +483,9 @@ def _slot_sample(logits: jax.Array, keys: jax.Array, temps: jax.Array,
 def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                      paged: Optional[PagedKVConfig] = None,
                      cache_constraint: Optional[Callable] = None,
-                     state_constraint: Optional[Callable] = None
+                     state_constraint: Optional[Callable] = None,
+                     spec: Optional[Tuple] = None,
+                     draft_constraint: Optional[Callable] = None
                      ) -> SlotDecode:
     """Build the slot-decode primitive set over ``module``/``params`` —
     see :class:`SlotDecode` for the contract of each callable.  With
@@ -384,7 +499,18 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     hot programs re-assert them on their outputs, making the mesh
     layout STRUCTURAL — the engine's shardings cannot silently drift
     (decay to replicated, or pick up a partitioner-invented split that
-    would recompile the next program) across donated iterations."""
+    would recompile the next program) across donated iterations.
+
+    ``spec``: ``(draft_module, draft_params)`` — enable the speculative
+    decode primitive set (:class:`SlotDecode`, the ``draft_*`` /
+    ``spec_verify`` fields): the draft proposes K tokens per slot with
+    its own lightweight KV state, the target verifies all K in one
+    batched multi-token window pass, and per-slot acceptance
+    bookkeeping lives in :class:`SlotState`.  The draft must share the
+    target's ``vocab`` and ``max_len`` (cursor parity);
+    :func:`tied_draft` builds the zero-cost weight-tied variant.
+    ``draft_constraint`` is the draft cache's sharding assert (the
+    target's ``cache_constraint`` twin)."""
     if num_slots < 1:
         raise ValueError(f"num_slots must be >= 1, got {num_slots}")
     if not 1 <= prefill_pad <= module.max_len:
@@ -409,31 +535,40 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             pos=jnp.zeros(s, jnp.int32),
             counts=jnp.zeros(s, jnp.int32),
             temps=jnp.zeros(s, jnp.float32),
-            keys=jnp.zeros((s, 2), jnp.uint32))
+            keys=jnp.zeros((s, 2), jnp.uint32),
+            accepted=jnp.zeros(s, jnp.int32),
+            drafted=jnp.zeros(s, jnp.int32))
 
     def init_slots():
         one = init_cache(1)
         return jax.tree.map(
             lambda a: jnp.zeros((num_slots,) + a.shape, a.dtype), one)
 
-    def _force_chunk(cache, chunk, clen):
+    def _make_force(step_fn):
         """Teacher-force ``chunk[:clen]`` through a batch-1 cache (masked
         fixed-length scan: steps at ``i >= clen`` keep the old cache, so
         every ``clen <= prefill_pad`` shares one program).  Returns the
-        advanced cache and the logits after the LAST live token."""
+        advanced cache and the logits after the LAST live token.
+        Parameterized over the step so the speculative draft model
+        shares the exact prefill mechanics (same program shape)."""
 
-        def body(carry, i):
-            cache, last = carry
-            tok = lax.dynamic_index_in_dim(chunk, i, keepdims=False)
-            nc, logits = step(cache, tok[None, None])
-            live = i < clen
-            cache = jax.tree.map(
-                lambda n, o: jnp.where(live, n, o), nc, cache)
-            last = jnp.where(i == clen - 1, logits[0], last)
-            return (cache, last), None
+        def force(cache, chunk, clen):
+            def body(carry, i):
+                cache, last = carry
+                tok = lax.dynamic_index_in_dim(chunk, i, keepdims=False)
+                nc, logits = step_fn(cache, tok[None, None])
+                live = i < clen
+                cache = jax.tree.map(
+                    lambda n, o: jnp.where(live, n, o), nc, cache)
+                last = jnp.where(i == clen - 1, logits[0], last)
+                return (cache, last), None
 
-        return lax.scan(body, (cache, jnp.zeros((vocab,), jnp.float32)),
-                        jnp.arange(prefill_pad))[0]
+            return lax.scan(body, (cache, jnp.zeros((vocab,), jnp.float32)),
+                            jnp.arange(prefill_pad))[0]
+
+        return force
+
+    _force_chunk = _make_force(step)
 
     def _decode_scan(state, cache, k):
         """The K-step fused decode body shared by the dense and paged
@@ -460,6 +595,380 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
         return lax.scan(body, (state, cache), None, length=k)
 
+    # -- speculative decoding (spec=(draft_module, draft_params)) -----------
+    # The additive primitive set SlotDecode documents: the draft keeps its
+    # own slot cache in cursor lockstep with the target (insert / chunked
+    # prefill / plain-block tracking / spec rollback all move both), the
+    # target verifies a whole drafted window in ONE multi-token pass, and
+    # the only D2H traffic per spec block is the packed token fetch.
+    if spec is not None:
+        d_module, d_params = spec
+        if int(d_module.vocab) != vocab:
+            raise ValueError(
+                f"draft vocab {d_module.vocab} != target vocab {vocab}")
+        if int(d_module.max_len) != int(module.max_len):
+            raise ValueError(
+                f"draft max_len {d_module.max_len} != target max_len "
+                f"{module.max_len} (draft and target cursors move in "
+                "lockstep)")
+        d_init_cache, d_step = make_decode_step(d_module, d_params)
+        d_vstep = jax.vmap(d_step, in_axes=(0, 0))
+        d_force = _make_force(d_step)
+        vwindow = jax.vmap(make_decode_window(module, params))
+
+        def _dconstrain(tree_):
+            return tree_ if draft_constraint is None \
+                else draft_constraint(tree_)
+
+        def _sel_active(active, new, old):
+            def sel(n, o):
+                m = active.reshape((-1,) + (1,) * (n.ndim - 1))
+                return jnp.where(m, n, o)
+
+            return jax.tree.map(sel, new, old)
+
+        def _set_cursors(cache, cur):
+            """Overwrite every cursor leaf of a slot-stacked dense cache
+            with ``cur [S]`` — the spec rollback: K/V past the cursor
+            becomes masked garbage (the same ``live <= pos`` contract
+            the paged gather relies on), so no K/V write is undone."""
+            out = {}
+            for key, val in cache.items():
+                if isinstance(val, dict) and "k" in val and "v" in val:
+                    out[key] = {k2: (v2 if k2 in ("k", "v")
+                                     else cur.astype(v2.dtype))
+                                for k2, v2 in val.items()}
+                else:
+                    out[key] = cur.astype(val.dtype)
+            return out
+
+        def _propose_scan(state, dview, k):
+            """``k + 1`` draft decode steps with in-graph token feedback:
+            steps ``0..k-1`` propose ``d_1..d_k`` (greedy argmax, or a
+            categorical draw on the per-request ``fold_in(fold_in(key,
+            count), 1)`` substream), step ``k`` feeds ``d_k`` so an
+            all-accepted verify leaves draft and target cursors equal.
+            Inactive lanes keep their cache and hold ``last_tok``."""
+
+            def body(carry, i):
+                tok, dc = carry
+                nc, logits = d_vstep(dc, tok[:, None, None])
+                dc = _sel_active(state.active, nc, dc)
+                lg = logits[:, 0]
+                greedy = jnp.argmax(lg, -1).astype(jnp.int32)
+
+                def one(key, lgr, t, c):
+                    kc = jax.random.fold_in(jax.random.fold_in(key, c), 1)
+                    return jax.random.categorical(
+                        kc, lgr / jnp.maximum(t, 1e-6))
+
+                samp = jax.vmap(one)(state.keys, lg, state.temps,
+                                     state.counts + i).astype(jnp.int32)
+                d = jnp.where(state.temps > 0.0, samp, greedy)
+                d = jnp.where(state.active, d,
+                              state.last_tok).astype(jnp.int32)
+                return (d, dc), (d, lg)
+
+            (_, dview), (drafts, dlogits) = lax.scan(
+                body, (state.last_tok, dview), jnp.arange(k + 1))
+            return dview, drafts[:k], dlogits[:k]
+
+        def _accept(state, logits, drafts, dlogits, spec_on, rem):
+            """Leading-prefix acceptance over the verify window, the
+            correction/bonus token, and the per-lane budget clamp.
+
+            ``logits [S, k+1, V]`` — row ``i`` conditioned on the cache
+            prefix + ``w_0..w_i``; rows ``0..k-1`` score candidates
+            ``d_1..d_k``, row ``k`` the all-accepted bonus.  Greedy
+            lanes accept while the draft matches the target argmax —
+            the emitted stream is exactly the sequential oracle's.
+            Sampled lanes use the standard residual-distribution
+            correction (accept ``d`` iff ``u·p_d(d) <= p_t(d)``; on
+            reject draw from ``norm(max(p_t - p_d, 0))``), every draw on
+            a deterministic ``fold_in`` substream of the request's key at
+            that token's stream index, so the stream is independent of
+            cache layout and mesh shape.  Lanes with ``spec_on`` False
+            force zero acceptance and draw their one token on the PLAIN
+            ``fold_in(key, count)`` stream — byte-identical to the
+            non-speculative engine's.  Returns ``(x, a, a_raw, inc,
+            out)`` — ``a_raw`` is the UNCLAMPED accept count (the
+            draft-quality measure acceptance-rate telemetry wants;
+            ``a``/``inc`` are the budget-clamped emission)."""
+            k = drafts.shape[0]
+            d = jnp.swapaxes(drafts, 0, 1)                  # [S, k]
+            ld = jnp.swapaxes(dlogits, 0, 1)                # [S, k, V]
+            lt = logits[:, :k]                              # [S, k, V]
+            temp = jnp.maximum(state.temps, 1e-6)[:, None, None]
+            greedy = state.temps <= 0.0
+            g_acc = d == jnp.argmax(lt, -1)
+            pt = jax.nn.softmax(lt / temp, -1)
+            pd = jax.nn.softmax(ld / temp, -1)
+            pt_d = jnp.take_along_axis(pt, d[..., None], -1)[..., 0]
+            pd_d = jnp.take_along_axis(pd, d[..., None], -1)[..., 0]
+            cidx = state.counts[:, None] + jnp.arange(k)[None]
+
+            def u_one(key, c):
+                kc = jax.random.fold_in(jax.random.fold_in(key, c), 2)
+                return jax.random.uniform(kc)
+
+            u = jax.vmap(lambda key, cs: jax.vmap(
+                lambda c: u_one(key, c))(cs))(state.keys, cidx)
+            s_acc = u * pd_d <= pt_d
+            acc = jnp.where(greedy[:, None], g_acc, s_acc)
+            acc &= (spec_on & state.active)[:, None]
+            a_raw = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(1)
+            # budget clamp: emitted = a + 1 <= rem.  A clamped lane's
+            # final token is its last ACCEPTED draft (a target-verified
+            # token), never a correction drawn for a row that accepted.
+            a = jnp.minimum(a_raw, jnp.maximum(rem - 1, 0))
+            arg_rows = jnp.argmax(logits, -1).astype(jnp.int32)
+            call = state.counts[:, None] + jnp.arange(k + 1)[None]
+
+            def plain_one(key, lgr, t, c):
+                return jax.random.categorical(
+                    jax.random.fold_in(key, c), lgr / jnp.maximum(t, 1e-6))
+
+            plain_rows = jax.vmap(lambda key, ls, t, cs: jax.vmap(
+                lambda lgr, c: plain_one(key, lgr, t, c))(ls, cs))(
+                state.keys, logits, state.temps, call).astype(jnp.int32)
+            res = jnp.maximum(pt - pd, 0.0)
+            has_res = res.sum(-1, keepdims=True) > 0.0
+            res_logits = jnp.where(has_res, jnp.log(res + 1e-30), lt / temp)
+
+            def res_one(key, lgr, c):
+                kc = jax.random.fold_in(jax.random.fold_in(key, c), 3)
+                return jax.random.categorical(kc, lgr)
+
+            res_rows = jax.vmap(lambda key, ls, cs: jax.vmap(
+                lambda lgr, c: res_one(key, lgr, c))(ls, cs))(
+                state.keys, res_logits, cidx).astype(jnp.int32)
+            row_g = jnp.take_along_axis(arg_rows, a[:, None], 1)[:, 0]
+            row_p = jnp.take_along_axis(plain_rows, a[:, None], 1)[:, 0]
+            row_r = jnp.take_along_axis(
+                jnp.concatenate([res_rows, plain_rows[:, -1:]], 1),
+                a[:, None], 1)[:, 0]
+            # row a rejected a pending draft -> residual draw; row k (or a
+            # spec-off lane's row 0) has no pending draft -> plain stream
+            specced = spec_on & state.active & (a < k)
+            x = jnp.where(greedy, row_g, jnp.where(specced, row_r, row_p))
+            # clamped lane: final emitted token is the accepted draft
+            clamp_d = jnp.take_along_axis(
+                d, jnp.minimum(a, k - 1)[:, None], 1)[:, 0]
+            x = jnp.where(a < a_raw, clamp_d, x).astype(jnp.int32)
+            inc = jnp.where(state.active, a + 1, 0).astype(jnp.int32)
+            i_ = jnp.arange(k + 1)[None]
+            dpad = jnp.concatenate(
+                [d, jnp.zeros((num_slots, 1), jnp.int32)], 1)
+            out = jnp.where(i_ < a[:, None], dpad,
+                            jnp.where(i_ == a[:, None], x[:, None], 0))
+            out = jnp.where(state.active[:, None], out, 0)
+            return x, a, a_raw, inc, out
+
+        def _spec_state(state, x, a_raw, inc, spec_on, k):
+            return state._replace(
+                last_tok=jnp.where(state.active, x, state.last_tok),
+                counts=state.counts + inc,
+                pos=state.pos + inc,
+                accepted=state.accepted + a_raw,
+                drafted=state.drafted + jnp.where(
+                    state.active & spec_on, k, 0))
+
+        def _build_spec(pg_target):
+            if pg_target is None:
+                def init_draft():
+                    one = d_init_cache(1)
+                    return jax.tree.map(
+                        lambda a: jnp.zeros((num_slots,) + a.shape, a.dtype),
+                        one)
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_prefill(dcache, prompts, clens, dsts):
+                    lanes = jax.vmap(
+                        lambda p, n: d_force(d_init_cache(1), p, n)[0])(
+                        prompts, clens)
+                    return _dconstrain(jax.tree.map(
+                        lambda full, b: full.at[dsts].set(b), dcache, lanes))
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_extend(dcache, slot, chunk, clen):
+                    lane = jax.tree.map(
+                        lambda full: lax.dynamic_index_in_dim(
+                            full, slot, 0, keepdims=False), dcache)
+                    lane, _ = d_force(lane, chunk, clen)
+                    return _dconstrain(jax.tree.map(
+                        lambda full, lv: lax.dynamic_update_index_in_dim(
+                            full, lv, slot, 0), dcache, lane))
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_evict(dcache, slot):
+                    return _dconstrain(jax.tree.map(
+                        lambda full: lax.dynamic_update_index_in_dim(
+                            full, jnp.zeros(full.shape[1:], full.dtype),
+                            slot, 0), dcache))
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_arm(dcache, slot, pos):
+                    out = {}
+                    for key, val in dcache.items():
+                        if isinstance(val, dict) and "k" in val \
+                                and "v" in val:
+                            out[key] = {
+                                k2: (v2.at[slot].set(
+                                    jnp.zeros(v2.shape[1:], v2.dtype))
+                                    if k2 in ("k", "v")
+                                    else v2.at[slot].set(
+                                        jnp.asarray(pos, v2.dtype)))
+                                for k2, v2 in val.items()}
+                        else:
+                            out[key] = val.at[slot].set(
+                                jnp.asarray(pos, val.dtype))
+                    return _dconstrain(out)
+
+                @partial(jax.jit, donate_argnums=(1,))
+                def draft_track(state, dcache, prev_last, toks):
+                    fed = jnp.concatenate([prev_last[None], toks[:-1]], 0)
+
+                    def body(dc, tok):
+                        nc, _ = d_vstep(dc, tok[:, None, None])
+                        return _sel_active(state.active, nc, dc), None
+
+                    dcache, _ = lax.scan(body, dcache, fed)
+                    return _dconstrain(dcache)
+
+                @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
+                def draft_propose(state, dcache, k):
+                    dcache, drafts, dlogits = _propose_scan(state, dcache, k)
+                    return _dconstrain(dcache), drafts, dlogits
+
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def spec_verify(state, cache, dcache, drafts, dlogits,
+                                spec_on, rem):
+                    pos0 = _cache_cursor(cache)
+                    toks = jnp.concatenate(
+                        [state.last_tok[None], drafts], 0).T
+                    ncache, logits = vwindow(cache, toks)
+                    x, a, a_raw, inc, out = _accept(state, logits, drafts,
+                                                    dlogits, spec_on, rem)
+                    cache = _sel_active(state.active, ncache, cache)
+                    cache = _set_cursors(cache, pos0 + inc)
+                    dcache = _set_cursors(dcache, pos0 + inc)
+                    state = _spec_state(state, x, a_raw, inc, spec_on,
+                                        drafts.shape[0])
+                    packed = jnp.concatenate(
+                        [inc[:, None], a_raw[:, None], out], 1)
+                    return (_constrain_state(state), _constrain(cache),
+                            _dconstrain(dcache), packed)
+
+                return dict(init_draft=init_draft,
+                            draft_prefill=draft_prefill,
+                            draft_extend=draft_extend,
+                            draft_evict=draft_evict, draft_arm=draft_arm,
+                            draft_track=draft_track,
+                            draft_propose=draft_propose,
+                            spec_verify=spec_verify)
+
+            # paged target: the draft KV is its own smaller block pool —
+            # the DRAFT template's bytes at the TARGET pool's geometry
+            # (same num_blocks/block_size, so block ids, the host
+            # allocator, prefix reuse, and evict free-lists are shared;
+            # "smaller" is the per-block byte count, which is what HBM
+            # residency is measured in).
+            d_cfg = PagedKVConfig(num_blocks=paged.num_blocks,
+                                  block_size=paged.block_size,
+                                  quantized=False)
+            pg_d = _Paged(d_init_cache(1), num_slots, d_cfg)
+            d_meta_template = strip_kv(pg_d.template)
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def draft_prefill(dkv, tables, poss, prompts, clens, dsts):
+                def lane(row, pos0, p, n):
+                    meta1 = jax.tree.map(
+                        lambda t: jnp.asarray(pos0, t.dtype),
+                        d_meta_template)
+                    return d_force(pg_d.lane_cache(dkv, row, meta1), p, n)[0]
+
+                lanes = jax.vmap(lane)(tables, poss, prompts, clens)
+                return _dconstrain(pg_d.commit_lanes(
+                    dkv, lanes, tables, dsts, poss, prefill_pad))
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def draft_extend(dkv, slot, chunk, clen):
+                row = dkv.table[slot]
+                meta1 = jax.tree.map(lambda full: full[slot], dkv.meta)
+                pos0 = _cache_cursor(meta1)
+                cache, _ = d_force(pg_d.lane_cache(dkv, row, meta1),
+                                   chunk, clen)
+                return _dconstrain(pg_d.commit_lanes(
+                    dkv, jax.tree.map(lambda a: a[None], cache),
+                    row[None], jnp.reshape(slot, (1,)),
+                    jnp.reshape(pos0, (1,)), prefill_pad))
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def draft_evict(dkv, slot, free_ids):
+                return _dconstrain(pg_d.release(dkv, slot, free_ids))
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def draft_arm(dkv, slot, row, pos):
+                meta = jax.tree.map(
+                    lambda full: full.at[slot].set(
+                        jnp.asarray(pos, full.dtype)), dkv.meta)
+                return _dconstrain(dkv._replace(
+                    table=dkv.table.at[slot].set(row), meta=meta))
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def draft_track(state, dkv, prev_last, toks):
+                k = toks.shape[0]
+                pos0 = _cache_cursor(dkv.meta)
+                view = pg_d.slot_cache(dkv)
+                fed = jnp.concatenate([prev_last[None], toks[:-1]], 0)
+
+                def body(dc, tok):
+                    nc, _ = d_vstep(dc, tok[:, None, None])
+                    return _sel_active(state.active, nc, dc), None
+
+                view, _ = lax.scan(body, view, fed)
+                return _dconstrain(pg_d.commit_slots(
+                    dkv, view, pos0, k, state.active))
+
+            @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
+            def draft_propose(state, dkv, k):
+                pos0 = _cache_cursor(dkv.meta)
+                view, drafts, dlogits = _propose_scan(
+                    state, pg_d.slot_cache(dkv), k)
+                dkv = pg_d.commit_slots(dkv, view, pos0, k + 1,
+                                        state.active)
+                return _dconstrain(dkv), drafts, dlogits
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on, rem):
+                k = drafts.shape[0]
+                pos0 = _cache_cursor(pkv.meta)
+                toks = jnp.concatenate([state.last_tok[None], drafts], 0).T
+                nview, logits = vwindow(pg_target.slot_cache(pkv), toks)
+                x, a, a_raw, inc, out = _accept(state, logits, drafts,
+                                                dlogits, spec_on, rem)
+                pkv = pg_target.commit_slots(pkv, nview, pos0, k + 1,
+                                             state.active)
+                new_cur = pos0 + inc
+                pkv = pkv._replace(meta=jax.tree.map(
+                    lambda full: new_cur.astype(full.dtype), pkv.meta))
+                dkv = dkv._replace(meta=jax.tree.map(
+                    lambda full: new_cur.astype(full.dtype), dkv.meta))
+                state = _spec_state(state, x, a_raw, inc, spec_on, k)
+                packed = jnp.concatenate(
+                    [inc[:, None], a_raw[:, None], out], 1)
+                return (_constrain_state(state), _constrain(pkv),
+                        _dconstrain(dkv), packed)
+
+            return dict(init_draft=pg_d.init, draft_prefill=draft_prefill,
+                        draft_extend=draft_extend, draft_evict=draft_evict,
+                        draft_arm=draft_arm, draft_track=draft_track,
+                        draft_propose=draft_propose, spec_verify=spec_verify,
+                        draft_paged=pg_d)
+    else:
+        def _build_spec(pg_target):  # noqa: ARG001 - uniform call sites
+            return {}
+
     if paged is not None:
         pg = _Paged(init_cache(1), num_slots, paged)
         meta_template = strip_kv(pg.template)
@@ -483,6 +992,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                                   jnp.zeros(num_slots, jnp.int32))
             pkv = _constrain(pg.commit_lanes(pkv, lanes, tables, dsts, poss,
                                              prefill_pad))
+            zero = jnp.zeros(num_slots, jnp.int32)
             state = SlotState(
                 last_tok=state.last_tok.at[dsts].set(
                     jnp.where(last, firsts, 0)),
@@ -490,7 +1000,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 pos=state.pos.at[dsts].set(poss + clens),
                 counts=state.counts.at[dsts].set(last.astype(jnp.int32)),
                 temps=state.temps.at[dsts].set(temps),
-                keys=state.keys.at[dsts].set(keys))
+                keys=state.keys.at[dsts].set(keys),
+                accepted=state.accepted.at[dsts].set(zero),
+                drafted=state.drafted.at[dsts].set(zero))
             return _constrain_state(state), pkv, firsts
 
         @partial(jax.jit, donate_argnums=(0, 1))
@@ -534,7 +1046,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 pos=state.pos.at[slot].set(zero),
                 counts=state.counts.at[slot].set(zero),
                 temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
-                keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
+                keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)),
+                accepted=state.accepted.at[slot].set(zero),
+                drafted=state.drafted.at[slot].set(zero))
             return _constrain_state(state), pkv
 
         @jax.jit
@@ -565,7 +1079,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             decode_block=decode_block_paged, evict=evict_paged,
             sample=jax.jit(_slot_sample), peek_logits=peek_logits_paged,
             paged=pg, export_lane=export_lane_paged,
-            import_lane=import_lane_paged)
+            import_lane=import_lane_paged, **_build_spec(pg))
 
     # The slot state AND cache are donated in every primitive that threads
     # them: the engine always overwrites both with the result, and without
@@ -585,13 +1099,16 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         # program serves every admission-batch size.
         cache = _constrain(jax.tree.map(
             lambda full, b: full.at[dsts].set(b), cache, lanes))
+        zero = jnp.zeros(num_slots, jnp.int32)
         state = SlotState(
             last_tok=state.last_tok.at[dsts].set(jnp.where(last, firsts, 0)),
             active=state.active.at[dsts].set(last),
             pos=state.pos.at[dsts].set(clens),
             counts=state.counts.at[dsts].set(last.astype(jnp.int32)),
             temps=state.temps.at[dsts].set(temps),
-            keys=state.keys.at[dsts].set(keys))
+            keys=state.keys.at[dsts].set(keys),
+            accepted=state.accepted.at[dsts].set(zero),
+            drafted=state.drafted.at[dsts].set(zero))
         return _constrain_state(state), cache, firsts
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -632,7 +1149,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             pos=state.pos.at[slot].set(zero),
             counts=state.counts.at[slot].set(zero),
             temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
-            keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)))
+            keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)),
+            accepted=state.accepted.at[slot].set(zero),
+            drafted=state.drafted.at[slot].set(zero))
         return _constrain_state(state), cache
 
     @jax.jit
@@ -662,7 +1181,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         init_slots=init_slots, insert_batch=insert_batch,
         prefill_extend=prefill_extend, decode_block=decode_block,
         evict=evict, sample=jax.jit(_slot_sample), peek_logits=peek_logits,
-        export_lane=export_lane, import_lane=import_lane)
+        export_lane=export_lane, import_lane=import_lane,
+        **_build_spec(None))
 
 
 def decode_logits(module, params, tokens: jax.Array) -> jax.Array:

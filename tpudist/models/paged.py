@@ -38,6 +38,17 @@ garbage (paged path), so the two paths produce bit-equal attention.
 Tests drive the full heterogeneous-churn oracle sweep over paged
 engines to pin this.
 
+**Draft pool (speculative decoding).**  A spec-enabled paged engine
+(:func:`tpudist.models.generate.make_slot_decode` ``spec=``) gives the
+draft model its own smaller pool: a second :class:`PagedKV` over the
+DRAFT's cache template at the SAME ``(num_blocks, block_size)``
+geometry.  Sharing the geometry means sharing block IDS — one host
+allocator covers both pools, ``insert``'s table rows and ``evict``'s
+free-lists apply to both, and a reused prefix block's draft KV is
+already in place (it was written under the same id when the prefix
+first prefilled).  "Smaller" is the per-block byte count (draft layers
+× heads × dh), which is what HBM residency is measured in.
+
 CPU-smoke honesty: the compiled programs materialize a transient dense
 ``[slots, max_len]`` view per dispatch (XLA scratch, not persistent
 state).  The *resident* KV footprint — what decides how many concurrent

@@ -319,15 +319,21 @@ class Block(nn.Module):
                             dtype=self.dtype)(h)
 
     def _decode_attention(self, q, k, v):
-        """Single-token cached attention: write this step's K/V at the
-        cache cursor, attend causally over the filled prefix.  Static
-        shapes ([max_len] cache, mask instead of slicing) keep the decode
-        step one compiled program.  The cache is sized by the K/V head
-        count — GQA models pay n_kv_heads/n_heads of the MHA cache."""
+        """Cached attention over a decode WINDOW of ``s >= 1`` tokens:
+        write the window's K/V at the cache cursor, attend each query
+        causally over the filled prefix plus the window tokens before it
+        (per-query mask row ``arange(max_len) <= pos + i``).  ``s == 1``
+        is the classic single-token decode step; ``s > 1`` is the
+        speculative-decoding verify pass — K+1 drafted tokens scored
+        against the cache in ONE forward, so the weights and the KV
+        arena stream once per window instead of once per token (the
+        fewer-HBM-sweeps-per-token lever the decode roofline left).
+        Static shapes ([max_len] cache, masks instead of slicing) keep
+        every window size one compiled program.  The cache is sized by
+        the K/V head count — GQA models pay n_kv_heads/n_heads of the
+        MHA cache."""
         b, nh, s, dh = q.shape
         n_kv = k.shape[1]
-        if s != 1:
-            raise ValueError(f"decode consumes one token at a time, got {s}")
         ck = self.variable("cache", "k", jnp.zeros,
                            (b, n_kv, self.max_len, dh), self.dtype)
         cv = self.variable("cache", "v", jnp.zeros,
@@ -342,7 +348,7 @@ class Block(nn.Module):
             ck.value, k.astype(self.dtype), (0, 0, pos, 0))
         cv.value = jax.lax.dynamic_update_slice(
             cv.value, v.astype(self.dtype), (0, 0, pos, 0))
-        ci.value = pos + 1
+        ci.value = pos + s
         scale = dh ** -0.5
         # grouped einsums read the un-repeated cache directly — per-step
         # bandwidth scales with n_kv_heads, the actual GQA win
@@ -350,10 +356,13 @@ class Block(nn.Module):
         qg = q.reshape(b, n_kv, group, s, dh)
         scores = jnp.einsum("bngqd,bnkd->bngqk", qg, ck.value,
                             preferred_element_type=jnp.float32) * scale
-        live = jnp.arange(self.max_len) <= pos
+        # per-query causal rows: window token i sees cache <= pos + i
+        qpos = pos + jnp.arange(s)
+        live = jnp.arange(self.max_len)[None, :] <= qpos[:, None]
         if self.sliding_window is not None:
-            live &= jnp.arange(self.max_len) > pos - self.sliding_window
-        scores = jnp.where(live[None, None, None, None, :], scores, -1e30)
+            live &= (jnp.arange(self.max_len)[None, :]
+                     > qpos[:, None] - self.sliding_window)
+        scores = jnp.where(live[None, None, None, :, :], scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bngqk,bnkd->bngqd", w.astype(self.dtype), cv.value,
                          preferred_element_type=jnp.float32)

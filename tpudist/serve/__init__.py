@@ -27,6 +27,12 @@ output stays byte-identical at every mesh shape.
 ``ServeConfig(disagg=True)`` splits prefill and decode into separate
 worker pools with KV handoff between them
 (:mod:`tpudist.serve.disagg`).
+``ServeConfig(spec=True)`` adds speculative decoding: a small draft
+model proposes K tokens per slot, the target verifies all of them in
+ONE batched multi-token pass — fewer target passes per emitted token,
+the lever past the measured decode HBM roofline.  Greedy output stays
+byte-identical to the sequential oracle; per-request ``spec=False``
+opts out in-batch.
 
 ``python -m tpudist.serve`` runs a self-contained CPU demo.
 """

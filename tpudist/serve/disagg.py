@@ -126,10 +126,16 @@ class DisaggServer:
             SlotEngine(module, params, num_slots=p_slots, decode_block=1,
                        prefix_cache_blocks=cfg.prefix_cache_blocks, **shared)
             for _ in range(max(1, cfg.prefill_workers))]
+        # the DECODE pool owns the speculative draft (prefill workers
+        # never decode, so a draft there is dead weight); handoff
+        # packages are unchanged — an imported lane's draft context
+        # starts cold and warms as it decodes (engine.import_slot doc)
         self.decode_pool: List[SlotEngine] = [
             SlotEngine(module, params, num_slots=cfg.num_slots,
                        decode_block=cfg.decode_block,
-                       prefix_cache_blocks=0, **shared)
+                       prefix_cache_blocks=0,
+                       spec_draft=cfg.resolve_spec_draft(module),
+                       spec_k=cfg.spec_k, **shared)
             for _ in range(max(1, cfg.decode_workers))]
         self.handoff_mode = cfg.handoff
         if self.handoff_mode not in ("device", "serial"):
@@ -196,14 +202,14 @@ class DisaggServer:
     def submit(self, prompt, *, max_new: Optional[int] = None,
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
-               on_token=None) -> RequestHandle:
+               on_token=None, spec: Optional[bool] = None) -> RequestHandle:
         from tpudist import telemetry
 
         try:
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
-                on_token=on_token)
+                on_token=on_token, spec=spec)
         except AdmissionError as e:
             telemetry.event("serve_rejected", reason=e.reason)
             raise
@@ -230,10 +236,25 @@ class DisaggServer:
         return ok
 
     def stats(self) -> dict:
-        dec = {"blocks": 0, "tokens": 0, "dispatch_s": 0.0, "sync_s": 0.0}
+        dec = {"blocks": 0, "tokens": 0, "steps": 0,
+               "dispatch_s": 0.0, "sync_s": 0.0}
         for eng in self.decode_pool:
             for k, v in eng.decode_stats().items():
                 dec[k] += v
+        spec = {"enabled": self.decode_pool[0].spec, "blocks": 0,
+                "lane_passes": 0, "tokens": 0, "accepted": 0,
+                "drafted": 0, "rollbacks": 0,
+                "draft_s": 0.0, "verify_s": 0.0, "sync_s": 0.0}
+        for eng in self.decode_pool:
+            st = eng.spec_stats()
+            for k in ("blocks", "lane_passes", "tokens", "accepted",
+                      "drafted", "rollbacks", "draft_s", "verify_s",
+                      "sync_s"):
+                spec[k] += st[k]
+        spec["accepted_per_pass"] = (spec["tokens"] / spec["lane_passes"]
+                                     if spec["lane_passes"] else None)
+        spec["acceptance_rate"] = (spec["accepted"] / spec["drafted"]
+                                   if spec["drafted"] else None)
         return {
             "completed": self.completed,
             "rejected": self.scheduler.rejected,
@@ -254,6 +275,7 @@ class DisaggServer:
                 "active": sum(e.num_active for e in self.decode_pool),
                 "compile_counts": self.decode_pool[0].compile_counts(),
                 "decode": dec,
+                "spec": spec,
                 "kv": self.decode_pool[0].kv_stats(),
             },
             "spmd": self.decode_pool[0].spmd_stats(),
@@ -481,7 +503,7 @@ class DisaggServer:
                        if self.handoff_mode == "serial" else pkg)
                 slot = free[0]
                 t0 = time.monotonic()
-                eng.import_slot(slot, raw)
+                eng.import_slot(slot, raw, spec=h.request.spec)
                 h.t_decode_start = time.monotonic()
                 h.slot = slot
                 telemetry.event(
@@ -512,19 +534,28 @@ class DisaggServer:
             occ = eng.occupancy
             tele = telemetry.active()
             t0 = time.monotonic()
-            info, blocks = eng.decode_block()
+            info, blocks = eng.decode_auto()
             if tele is not None and info is not None:
                 kv_occ, kv_resident = eng.kv_gauges()
-                tele.record_span(
-                    "decode_block", t0, time.monotonic() - t0,
-                    {"occupancy": occ, "active": eng.num_active,
-                     "k": info["k"], "tokens": info["tokens"],
-                     "dispatch_s": round(info["dispatch_s"], 9),
-                     "sync_s": round(info["sync_s"], 9),
-                     "kv_block_occupancy": kv_occ,
-                     "kv_bytes_resident": kv_resident,
-                     "kv_read_bytes": info["kv_read_bytes"],
-                     "pool": "decode", "worker": w})
+                tags = {"occupancy": occ, "active": eng.num_active,
+                        "k": info["k"], "tokens": info["tokens"],
+                        "dispatch_s": round(info["dispatch_s"], 9),
+                        "sync_s": round(info["sync_s"], 9),
+                        "kv_block_occupancy": kv_occ,
+                        "kv_bytes_resident": kv_resident,
+                        "kv_read_bytes": info["kv_read_bytes"],
+                        "pool": "decode", "worker": w}
+                if info.get("spec"):
+                    tags.update(accepted=info["accepted"],
+                                drafted=info["drafted"],
+                                rollbacks=info["rollbacks"],
+                                draft_s=round(info["draft_s"], 9),
+                                verify_s=round(info["verify_s"], 9))
+                    tele.record_span("spec_verify", t0,
+                                     time.monotonic() - t0, tags)
+                else:
+                    tele.record_span("decode_block", t0,
+                                     time.monotonic() - t0, tags)
             for slot, toks in blocks.items():
                 self._deliver_block(w, slot, toks)
         return worked

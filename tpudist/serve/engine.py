@@ -28,7 +28,16 @@ Hot-path accounting, per engine iteration:
   host picks ``K = min(block, min remaining budget over active slots)``
   from its shadow cursors (bucketed down to a power of two), so a block
   never overshoots a length budget; early stops (EOS) are truncated
-  post-hoc by the caller, wasting at most K - 1 speculated tokens.
+  post-hoc by the caller, wasting at most K - 1 speculated tokens;
+- speculative decode (``spec_draft=``): the iteration becomes ONE
+  ``draft_propose`` dispatch (K cheap draft steps) + ONE ``spec_verify``
+  dispatch (the batched multi-token target pass + in-graph acceptance/
+  rollback) + ONE packed D2H fetch — up to K+1 emitted tokens per lane
+  per TARGET pass, the fewer-passes-per-token lever past the measured
+  decode HBM roofline.  Per-lane budgets ride in as data, so mixed
+  budgets clamp in-graph; ``decode_auto`` falls back to the plain block
+  (draft-tracked, so the draft never desyncs) whenever speculation
+  cannot help the iteration.
 
 Division of labor: the engine is the DEVICE half — slots, caches, the
 on-device state, token emission.  The host keeps *shadow* cursors
@@ -54,7 +63,11 @@ from tpudist.serve.paged_alloc import BlockAllocator
 #: ``start_batch`` item: (slot, prompt_1d_int32, temperature, seed, max_new)
 #: plus an optional 6th element — the prompt's prefix hash chain
 #: (:func:`tpudist.serve.paged_alloc.hash_chain`, stamped at submit by the
-#: scheduler) enabling shared-prefix block reuse on the paged engine.
+#: scheduler) enabling shared-prefix block reuse on the paged engine —
+#: and an optional 7th — the request's speculative-decoding opt
+#: (True/False; only meaningful on a spec engine, where a False lane
+#: rides the same spec programs with acceptance forced to zero and its
+#: tokens drawn on the plain per-request stream).
 InsertItem = Tuple[int, np.ndarray, float, int, int]
 
 
@@ -86,7 +99,8 @@ class SlotEngine:
                  paged: bool = False, kv_block: int = 16,
                  kv_blocks: Optional[int] = None, kv_int8: bool = False,
                  prefix_cache_blocks: int = 0,
-                 mesh=None):
+                 mesh=None,
+                 spec_draft=None, spec_k: int = 4):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         self.module = module
@@ -148,6 +162,32 @@ class SlotEngine:
         else:
             state_constraint = None
         self._cache_constraint = cache_constraint
+        # -- speculative decoding (ROADMAP item 5): a small draft model
+        # proposes K tokens per slot, the target verifies all of them in
+        # ONE batched multi-token window pass — fewer target HBM sweeps
+        # per emitted token, the only decode lever left past the
+        # measured roofline.  ``spec_draft``: an int ties the target's
+        # first N layers (zero extra params, tied_draft); a
+        # ``(module, params)`` pair loads a separately-built draft
+        # (e.g. serve_bench's distilled variant).
+        self.spec = spec_draft is not None
+        self.spec_k = max(1, int(spec_k))
+        spec_pair = None
+        if self.spec:
+            from tpudist.models.generate import tied_draft
+
+            if isinstance(spec_draft, int):
+                spec_pair = tied_draft(module, params, spec_draft)
+            else:
+                d_mod, d_par = spec_draft
+                if self.mesh is not None:
+                    from tpudist.serve import spmd
+                    import jax as _jax
+
+                    d_par = _jax.device_put(
+                        d_par, spmd.serve_spec_param_sharding(
+                            self.mesh, d_par))
+                spec_pair = (d_mod, d_par)
         self.alloc: Optional[BlockAllocator] = None
         if paged:
             kv_block = min(int(kv_block), self.max_len)
@@ -165,7 +205,9 @@ class SlotEngine:
             self.fns = make_slot_decode(module, params, num_slots,
                                         prefill_pad, paged=self.paged_cfg,
                                         cache_constraint=cache_constraint,
-                                        state_constraint=state_constraint)
+                                        state_constraint=state_constraint,
+                                        spec=spec_pair,
+                                        draft_constraint=cache_constraint)
             self.alloc = BlockAllocator(
                 self.paged_cfg.num_blocks, kv_block, self.max_len,
                 prefix_cache_blocks=prefix_cache_blocks)
@@ -174,12 +216,15 @@ class SlotEngine:
             self.fns = make_slot_decode(module, params, num_slots,
                                         prefill_pad,
                                         cache_constraint=cache_constraint,
-                                        state_constraint=state_constraint)
+                                        state_constraint=state_constraint,
+                                        spec=spec_pair,
+                                        draft_constraint=cache_constraint)
         self.num_slots = num_slots
         self.prefill_pad = prefill_pad
         self.block = max(1, int(decode_block if decode_block else 8))
         self.state = self.fns.init_state()
         self.cache = self.fns.init_slots()
+        self.dcache = self.fns.init_draft() if self.spec else None
         if self.mesh is not None:
             # place the fresh state/cache on their serving layout ONCE;
             # the programs' output constraint keeps it there through
@@ -195,11 +240,22 @@ class SlotEngine:
                 spmd.serve_paged_sharding(self.mesh, self.cache)
                 if self.alloc is not None
                 else spmd.serve_cache_sharding(self.mesh, self.cache))
+            if self.dcache is not None:
+                self.dcache = _jax.device_put(
+                    self.dcache,
+                    spmd.serve_paged_sharding(self.mesh, self.dcache)
+                    if self.alloc is not None
+                    else spmd.serve_cache_sharding(self.mesh, self.dcache))
         self.occupied = np.zeros(num_slots, bool)
         self.decoding = np.zeros(num_slots, bool)
         self.pos = np.zeros(num_slots, np.int32)
         self.counts = np.zeros(num_slots, np.int32)
         self.budget = np.zeros(num_slots, np.int32)
+        #: per-slot speculative opt (host shadow of the mask the spec
+        #: programs take as data; True for every tenant unless its
+        #: request opted out — a False lane rides the same programs with
+        #: acceptance forced to zero)
+        self.spec_on = np.ones(num_slots, bool)
         #: slot → (full prompt, next chunk offset) for prompts longer
         #: than one prefill chunk (the host-side half of chunked prefill)
         self._prefill_rest: Dict[int, Tuple[np.ndarray, int]] = {}
@@ -208,11 +264,30 @@ class SlotEngine:
         #: the lanes actually fill under load; serve_bench records this
         self.peak_occupied = 0
         # decode hot-path counters (the bench's dispatch/sync overhead
-        # split reads these through ``decode_stats``)
+        # split reads these through ``decode_stats``).  Spec blocks fold
+        # into these too (their draft+verify time is the device-busy
+        # cost per emitted token), and additionally into the finer
+        # ``spec_stats`` split below.
         self.n_decode_blocks = 0
         self.n_decode_tokens = 0
+        #: sequential TARGET passes dispatched: a plain block of K fused
+        #: steps counts K (one full-model pass per emitted token — the
+        #: single-model latency floor), a speculative block counts 1
+        #: (ONE batched verify pass emits up to K+1 tokens per lane —
+        #: the passes-per-token lever itself)
+        self.n_decode_steps = 0
         self.t_decode_dispatch_s = 0.0
         self.t_decode_sync_s = 0.0
+        # speculative-decode counters (spec_stats)
+        self.n_spec_blocks = 0
+        self.n_spec_lane_passes = 0  # Σ active lanes over spec blocks
+        self.n_spec_tokens = 0
+        self.n_spec_accepted = 0
+        self.n_spec_drafted = 0
+        self.n_spec_rollbacks = 0
+        self.t_spec_draft_s = 0.0
+        self.t_spec_verify_s = 0.0
+        self.t_spec_sync_s = 0.0
         # per-decode-block telemetry gauges must not rebuild the full
         # kv_stats() dict on the hot path: precompute the constants
         if self.fns.paged is not None:
@@ -255,8 +330,12 @@ class SlotEngine:
         (``decode_block`` alone grows one entry per power-of-two block
         bucket actually used)."""
         out = {}
-        for name in ("insert_batch", "prefill_extend", "decode_block",
-                     "evict", "export_lane", "import_lane"):
+        names = ["insert_batch", "prefill_extend", "decode_block",
+                 "evict", "export_lane", "import_lane"]
+        if self.spec:
+            names += ["draft_prefill", "draft_extend", "draft_evict",
+                      "draft_propose", "spec_verify", "draft_track"]
+        for name in names:
             fn = getattr(self.fns, name)
             size = getattr(fn, "_cache_size", None)
             out[name] = int(size()) if callable(size) else -1
@@ -269,9 +348,50 @@ class SlotEngine:
         return {
             "blocks": self.n_decode_blocks,
             "tokens": self.n_decode_tokens,
+            "steps": self.n_decode_steps,
             "dispatch_s": self.t_decode_dispatch_s,
             "sync_s": self.t_decode_sync_s,
         }
+
+    def spec_stats(self) -> Dict[str, float]:
+        """Speculative-decode counters: blocks, emitted tokens, drafted
+        vs accepted (→ ``accepted_per_pass`` = tokens/blocks, acceptance
+        rate = accepted/drafted), rollback events (a verify that
+        rejected at least one drafted token), and the draft/verify/fetch
+        wall split the telemetry report aggregates."""
+        out = {
+            "enabled": self.spec,
+            "blocks": self.n_spec_blocks,
+            "lane_passes": self.n_spec_lane_passes,
+            "tokens": self.n_spec_tokens,
+            "accepted": self.n_spec_accepted,
+            "drafted": self.n_spec_drafted,
+            "rollbacks": self.n_spec_rollbacks,
+            # emitted tokens PER LANE per verify pass (1.0 = no better
+            # than plain decode) — normalized by lane passes, not
+            # blocks, so batch occupancy cannot masquerade as
+            # acceptance (the telemetry report's per-lane metric)
+            "accepted_per_pass": (
+                self.n_spec_tokens / self.n_spec_lane_passes
+                if self.n_spec_lane_passes else None),
+            "acceptance_rate": (self.n_spec_accepted / self.n_spec_drafted
+                                if self.n_spec_drafted else None),
+            "draft_s": self.t_spec_draft_s,
+            "verify_s": self.t_spec_verify_s,
+            "sync_s": self.t_spec_sync_s,
+            "spec_k": self.spec_k if self.spec else None,
+        }
+        if self.spec:
+            # draft KV residency: the "smaller pool" claim, quantified
+            if self.fns.draft_paged is not None:
+                out["draft_pool_bytes"] = self.fns.draft_paged.pool_bytes
+            else:
+                total = 0
+                for val in self.dcache.values():
+                    if isinstance(val, dict) and "k" in val and "v" in val:
+                        total += 2 * val["k"].size * val["k"].dtype.itemsize
+                out["draft_pool_bytes"] = int(total)
+        return out
 
     def _bytes_per_pos(self) -> float:
         """Resident KV bytes per cached position.  Paged: pool bytes /
@@ -379,12 +499,21 @@ class SlotEngine:
         return self.alloc.can_admit(int(package["pos"]),
                                     int(package["budget"]), ())
 
-    def import_slot(self, slot: int, package: Dict[str, object]) -> None:
+    def import_slot(self, slot: int, package: Dict[str, object], *,
+                    spec: Optional[bool] = None) -> None:
         """Install an exported lane into free ``slot`` and arm it for
         decode.  Paged: the remaining footprint is reserved on THIS
         pool (fresh blocks — handed-off lanes never share prefix blocks
         across pools; the prefill pool's prefix cache already saved the
-        recompute) and the lane scatters into the new row in-graph."""
+        recompute) and the lane scatters into the new row in-graph.
+
+        Speculative engine: handoff packages are UNCHANGED (the decode
+        pool owns the draft), so the imported lane's draft cache starts
+        COLD — cursor at ``pos`` over zeroed context.  The draft's
+        missing prompt context can only lower acceptance, never
+        correctness (the target verify is the oracle), and the draft
+        warms with every token the lane decodes from here on.  ``spec``
+        False opts the lane out of speculation entirely."""
         if self.occupied[slot]:
             raise ValueError(f"slot {slot} is occupied")
         if bool(package["paged"]) != (self.alloc is not None):
@@ -402,15 +531,24 @@ class SlotEngine:
             self.state, self.cache = self.fns.import_lane(
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(full), package["lane"], package["state"])
+            if self.spec:
+                self.dcache = self.fns.draft_arm(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(full), jnp.asarray(pos, jnp.int32))
         else:
             self.state, self.cache = self.fns.import_lane(
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 package["lane"], package["state"])
+            if self.spec:
+                self.dcache = self.fns.draft_arm(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(pos, jnp.int32))
         self.occupied[slot] = True
         self.decoding[slot] = True
         self.pos[slot] = pos
         self.counts[slot] = counts
         self.budget[slot] = budget
+        self.spec_on[slot] = True if spec is None else bool(spec)
         self.peak_occupied = max(self.peak_occupied, self.num_occupied)
 
     # -- lifecycle of a request -------------------------------------------
@@ -523,9 +661,12 @@ class SlotEngine:
         # must not leak half-reserved slots
         norm = []
         taken = set()
+        spec_flags = {}
         for item in items:
             slot, prompt, temperature, seed, max_new = item[:5]
             hashes = tuple(item[5]) if len(item) > 5 else ()
+            spec_flags[int(slot)] = (bool(item[6]) if len(item) > 6
+                                     and item[6] is not None else True)
             if self.occupied[slot] or slot in taken:
                 raise ValueError(f"slot {slot} is occupied")
             taken.add(slot)
@@ -581,17 +722,30 @@ class SlotEngine:
                 jnp.asarray(reused_len), jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
                 jnp.asarray(temps), jnp.asarray(last))
+            if self.spec:
+                # same chunks, same (host-built) table rows: the draft's
+                # pool blocks mirror the target's ids, so a reused
+                # prefix's draft KV is already in place
+                self.dcache = self.fns.draft_prefill(
+                    self.dcache, jnp.asarray(tables),
+                    jnp.asarray(reused_len), jnp.asarray(prompts),
+                    jnp.asarray(clens), jnp.asarray(dsts))
         else:
             self.state, self.cache, firsts = self.fns.insert_batch(
                 self.state, self.cache, jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
                 jnp.asarray(temps), jnp.asarray(last))
+            if self.spec:
+                self.dcache = self.fns.draft_prefill(
+                    self.dcache, jnp.asarray(prompts), jnp.asarray(clens),
+                    jnp.asarray(dsts))
         firsts_h = np.asarray(firsts) if last.any() else None
         out: Dict[int, Optional[int]] = {}
         for j, (slot, prompt, temperature, seed, max_new, _) in \
                 enumerate(norm):
             self.occupied[slot] = True
             self.budget[slot] = max_new
+            self.spec_on[slot] = spec_flags[slot]
             self.pos[slot] = reused_len[j] + clens[j]
             if self.alloc is not None:
                 self.alloc.note_progress(slot, int(self.pos[slot]))
@@ -628,6 +782,10 @@ class SlotEngine:
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
                 jnp.asarray(is_last))
+            if self.spec:
+                self.dcache = self.fns.draft_extend(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(chunk), jnp.asarray(clen, jnp.int32))
             self.pos[slot] += clen
             if self.alloc is not None:
                 # prompt blocks now fully written become shareable
@@ -650,7 +808,10 @@ class SlotEngine:
         budget)`` bucketed to a power of two, so no slot can overshoot
         its length budget.  Returns ``(info, slot → K tokens)`` where
         ``info`` carries the dispatch/sync attribution (``None`` when no
-        slot is decoding)."""
+        slot is decoding).  SPEC-UNSAFE on its own: a spec engine's
+        draft cache must see every emitted token — call through
+        :meth:`decode_auto` / :meth:`decode_auto_plain` (which
+        draft-track) instead."""
         if not self.decoding.any():
             return None, {}
         dec = np.nonzero(self.decoding)[0]
@@ -681,6 +842,7 @@ class SlotEngine:
         t2 = time.perf_counter()
         self.n_decode_blocks += 1
         self.n_decode_tokens += k * len(dec)
+        self.n_decode_steps += k
         self.t_decode_dispatch_s += t1 - t0
         self.t_decode_sync_s += t2 - t1
         self.counts[dec] += k
@@ -701,9 +863,148 @@ class SlotEngine:
     def step(self) -> Dict[int, int]:
         """One single-token decode iteration (a K=1 block) — the
         per-token path ``decode_block`` amortizes; kept for tests and
-        K=1 comparisons.  Returns ``slot → token`` for decoding slots."""
-        _, toks = self.decode_block(max_k=1)
+        K=1 comparisons.  Returns ``slot → token`` for decoding slots.
+        On a spec engine the emitted token is draft-tracked (the
+        plain-path rule: the draft must never desync from the target —
+        :meth:`decode_block` alone is spec-UNSAFE; go through
+        :meth:`decode_auto` / :meth:`decode_auto_plain`)."""
+        _, toks = (self.decode_auto_plain(max_k=1) if self.spec
+                   else self.decode_block(max_k=1))
         return {s: t[0] for s, t in toks.items()}
+
+    def spec_decode_block(self, max_k: Optional[int] = None
+                          ) -> Tuple[Optional[dict], Dict[int, List[int]]]:
+        """One speculative block over every decoding slot: K draft
+        proposal steps (one cheap dispatch), ONE batched target verify
+        of the whole ``K+1``-token window, in-graph acceptance +
+        rollback, one D2H fetch of the packed emitted tokens.  Each lane
+        emits 1..K+1 tokens — ``accepted + 1`` — for ~one target
+        weight/KV sweep, which is how wall-TPOT drops below the
+        single-model device-busy floor once the draft agrees often
+        enough.  Per-lane budgets are clamped IN-GRAPH (``rem`` rides as
+        data), so mixed remaining budgets never overshoot and a lane
+        with 1 remaining still participates.  K is capped by cache
+        headroom (the window must fit below ``max_len`` in every active
+        lane) and bucketed to a power of two (jit cache bounded like
+        ``decode_block``'s).  Falls back to ``None, {}`` when no slot is
+        decoding; the caller should use :meth:`decode_auto`, which also
+        falls back to the plain block when speculation cannot run."""
+        if not self.spec:
+            raise RuntimeError("engine built without spec_draft")
+        if not self.decoding.any():
+            return None, {}
+        dec = np.nonzero(self.decoding)[0]
+        remaining = self.budget[dec] - self.counts[dec]
+        if (remaining < 1).any():
+            raise RuntimeError(
+                "decoding slot with exhausted budget — the caller must "
+                "evict finished slots before the next block")
+        if (self.pos[dec] >= self.max_len).any():
+            raise RuntimeError("active slot at max_len — admission budget "
+                               "violated")
+        import jax
+        import jax.numpy as jnp
+
+        # the verify window writes K+1 positions in every active lane:
+        # K is bounded by the tightest lane's cache headroom (for
+        # correctly-admitted lanes headroom >= remaining, so this only
+        # bites when the budget rule was bypassed — the cache_full path)
+        headroom = int((self.max_len - self.pos[dec]).min())
+        cap = self.spec_k if max_k is None else max(1, int(max_k))
+        # also capped by the LARGEST remaining budget: when every lane
+        # needs exactly one more token, drafting is pure waste — the
+        # plain (draft-tracked) block serves that iteration
+        cap = min(cap, max(int(remaining.max()) - 1, 0),
+                  max(headroom - 1, 0))
+        k = _pow2_floor(cap) if cap >= 1 else 0
+        if k < 1:
+            return self.decode_auto_plain()
+        rem = np.zeros(self.num_slots, np.int32)
+        rem[dec] = remaining
+        t0 = time.perf_counter()
+        self.dcache, drafts, dlogits = self.fns.draft_propose(
+            self.state, self.dcache, k)
+        jax.block_until_ready(drafts)
+        t1 = time.perf_counter()
+        self.state, self.cache, self.dcache, packed = self.fns.spec_verify(
+            self.state, self.cache, self.dcache, drafts, dlogits,
+            jnp.asarray(self.spec_on), jnp.asarray(rem))
+        t2 = time.perf_counter()
+        pk = np.asarray(packed)  # ONE host sync: counts + token block
+        t3 = time.perf_counter()
+        n_emit = pk[dec, 0]
+        a_raw = pk[dec, 1]
+        accepted = int(a_raw.sum())
+        drafted = int(k * (self.spec_on[dec]).sum())
+        emitted = int(n_emit.sum())
+        # a rollback is a verify that REJECTED a draft (budget-clamped
+        # full accepts are not rollbacks — the drafts were right)
+        rollbacks = int(((a_raw < k) & self.spec_on[dec]).sum())
+        self.counts[dec] += n_emit
+        self.pos[dec] += n_emit
+        self.n_decode_blocks += 1
+        self.n_decode_tokens += emitted
+        self.n_decode_steps += 1  # ONE target pass per spec block
+        self.t_decode_dispatch_s += t2 - t0
+        self.t_decode_sync_s += t3 - t2
+        self.n_spec_blocks += 1
+        self.n_spec_lane_passes += len(dec)
+        self.n_spec_tokens += emitted
+        self.n_spec_accepted += accepted
+        self.n_spec_drafted += drafted
+        self.n_spec_rollbacks += rollbacks
+        self.t_spec_draft_s += t1 - t0
+        self.t_spec_verify_s += t2 - t1
+        self.t_spec_sync_s += t3 - t2
+        out = {int(s): [int(t) for t in pk[s, 2:2 + pk[s, 0]]] for s in dec
+               if pk[s, 0] > 0}
+        # the verify's ONE KV sweep covers every lane's filled prefix +
+        # the K+1 window; the draft adds its own (smaller) sweeps
+        pos_sum = int(self.pos[dec].astype(np.int64).sum())
+        kv_read = (pos_sum + len(dec) * (k + 1)) * self._bytes_per_pos()
+        info = {"spec": True, "k": k, "tokens": emitted,
+                "accepted": accepted, "drafted": drafted,
+                "rollbacks": rollbacks,
+                "draft_s": t1 - t0, "verify_s": t2 - t1,
+                "dispatch_s": t2 - t0, "sync_s": t3 - t2,
+                "kv_read_bytes": int(kv_read)}
+        return info, out
+
+    def decode_auto_plain(self, max_k: Optional[int] = None
+                          ) -> Tuple[Optional[dict],
+                                     Dict[int, List[int]]]:
+        """A plain fused decode block that ALSO teacher-forces its
+        emitted tokens through the draft cache (``draft_track``), so
+        draft and target cursors stay in lockstep across
+        non-speculative iterations and acceptance survives the next
+        spec block."""
+        import jax.numpy as jnp
+
+        prev_last = (self.state.last_tok.copy()
+                     if self.spec and self.decoding.any() else None)
+        info, blocks = self.decode_block(max_k=max_k)
+        if self.spec and info is not None and blocks:
+            k = info["k"]
+            toks = np.zeros((k, self.num_slots), np.int32)
+            for s, ts in blocks.items():
+                toks[:, s] = ts
+            self.dcache = self.fns.draft_track(
+                self.state, self.dcache, prev_last, jnp.asarray(toks))
+        if info is not None:
+            info = {**info, "spec": False}
+        return info, blocks
+
+    def decode_auto(self) -> Tuple[Optional[dict], Dict[int, List[int]]]:
+        """The serving loop's decode dispatcher: the speculative block
+        when the engine has a draft and any decoding lane opted in,
+        else the plain fused block (draft-tracked when spec is on, so
+        the draft never desyncs)."""
+        if not self.spec:
+            return self.decode_block()
+        dec = self.decoding
+        if not (dec & self.spec_on).any():
+            return self.decode_auto_plain()
+        return self.spec_decode_block()
 
     def evict(self, slot: int) -> None:
         """Free a lane: zero its cache and device state (no K/V leakage
@@ -723,12 +1024,23 @@ class SlotEngine:
             self.state, self.cache = self.fns.evict(
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(free_ids))
+            if self.spec:
+                # same recycled block ids: the draft pool's copies are
+                # zeroed alongside the target's (no cross-tenant K/V
+                # leakage in either pool)
+                self.dcache = self.fns.draft_evict(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(free_ids))
         else:
             self.state, self.cache = self.fns.evict(
                 self.state, self.cache, jnp.asarray(slot, jnp.int32))
+            if self.spec:
+                self.dcache = self.fns.draft_evict(
+                    self.dcache, jnp.asarray(slot, jnp.int32))
         self.occupied[slot] = False
         self.decoding[slot] = False
         self.pos[slot] = 0
         self.counts[slot] = 0
         self.budget[slot] = 0
+        self.spec_on[slot] = True
         self._prefill_rest.pop(slot, None)

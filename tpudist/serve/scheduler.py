@@ -72,6 +72,12 @@ class Request:
     #: prompt prefix hash chain, stamped ONCE at submit (paged engine:
     #: shared-prefix block reuse keys on it; admission never re-hashes)
     prefix_hashes: tuple = ()
+    #: speculative-decoding opt: None = the server's default (speculate
+    #: when the engine has a draft), False = this request decodes on the
+    #: plain per-token stream even on a spec engine (its lane rides the
+    #: same programs with acceptance forced to zero — the mixed
+    #: spec/non-spec traffic story), True = explicit opt-in.
+    spec: Optional[bool] = None
 
 
 class RequestHandle:
@@ -196,6 +202,7 @@ class Scheduler:
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
+               spec: Optional[bool] = None,
                ) -> RequestHandle:
         """Admit a request or raise :class:`AdmissionError` (backpressure
         is synchronous — the caller learns NOW, not after a timeout)."""
@@ -231,6 +238,7 @@ class Scheduler:
             eos_id=None if eos_id is None else int(eos_id),
             on_token=on_token,
             prefix_hashes=hashes,
+            spec=spec,
         )
         with self._lock:
             reason = self._refuse_reason

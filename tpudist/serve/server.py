@@ -86,6 +86,25 @@ class ServeConfig:
     prefill_slots: Optional[int] = None  # per prefill worker; None: num_slots
     handoff: str = "device"  # "device" (in-mesh) | "serial" (byte transfer)
     handoff_queue: int = 8  # bounded pending-handoff packages
+    # -- speculative decoding (draft-propose / batched target-verify) ------
+    spec: bool = False  # draft proposes K, target verifies in one pass
+    spec_k: int = 4  # drafted tokens per speculative block
+    # tied-draft depth (target's first N layers; 0 = half the target
+    # depth).  A separately-built draft (e.g. distilled) is passed
+    # programmatically via ``spec_draft`` and wins over the layer tie.
+    spec_draft_layers: int = 0
+    spec_draft: Optional[object] = None  # (module, params); not env-loadable
+
+    def resolve_spec_draft(self, module):
+        """The engine-facing ``spec_draft`` argument (None = spec off):
+        a programmatic ``(module, params)`` pair if one was injected,
+        else the tied-layer count."""
+        if not self.spec:
+            return None
+        if self.spec_draft is not None:
+            return self.spec_draft
+        layers = self.spec_draft_layers or max(1, int(module.n_layers) // 2)
+        return int(layers)
 
     def mesh_config(self):
         """The engine-facing mesh spec (None when unset/1-device)."""
@@ -125,6 +144,10 @@ class ServeConfig:
             handoff=os.environ.get(
                 "TPUDIST_SERVE_HANDOFF", "").strip() or "device",
             handoff_queue=env_int("TPUDIST_SERVE_HANDOFF_QUEUE", 8) or 8,
+            spec=env_flag("TPUDIST_SERVE_SPEC", False),
+            spec_k=env_int("TPUDIST_SERVE_SPEC_K", 4) or 4,
+            spec_draft_layers=env_int(
+                "TPUDIST_SERVE_SPEC_DRAFT_LAYERS", 0) or 0,
         )
 
 
@@ -150,7 +173,9 @@ class InferenceServer:
             paged=self.config.paged, kv_block=self.config.kv_block,
             kv_blocks=self.config.kv_blocks, kv_int8=self.config.kv_int8,
             prefix_cache_blocks=self.config.prefix_cache_blocks,
-            mesh=self.config.mesh_config())
+            mesh=self.config.mesh_config(),
+            spec_draft=self.config.resolve_spec_draft(module),
+            spec_k=self.config.spec_k)
         hasher = None
         if self.config.paged and self.config.prefix_cache_blocks > 0:
             from tpudist.serve.paged_alloc import hash_chain
@@ -208,16 +233,19 @@ class InferenceServer:
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
+               spec: Optional[bool] = None,
                ) -> RequestHandle:
         """Thread-safe ingestion; raises :class:`AdmissionError` on
-        backpressure/budget rejection (reason stamped into telemetry)."""
+        backpressure/budget rejection (reason stamped into telemetry).
+        ``spec=False`` opts this request out of speculative decoding on
+        a spec-enabled server (mixed spec/non-spec traffic)."""
         from tpudist import telemetry
 
         try:
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
-                on_token=on_token)
+                on_token=on_token, spec=spec)
         except AdmissionError as e:
             telemetry.event("serve_rejected", reason=e.reason)
             raise
@@ -265,6 +293,7 @@ class InferenceServer:
                                if self._steps else 0.0),
             "compile_counts": self.engine.compile_counts(),
             "decode": self.engine.decode_stats(),
+            "spec": self.engine.spec_stats(),
             "kv": self.engine.kv_stats(),
             "spmd": self.engine.spmd_stats(),
         }
@@ -376,7 +405,8 @@ class InferenceServer:
                         items.append((slot, h.request.prompt,
                                       h.request.temperature, h.request.seed,
                                       h.request.max_new,
-                                      h.request.prefix_hashes))
+                                      h.request.prefix_hashes,
+                                      h.request.spec))
                         self._slot_handles[slot] = h
                     with telemetry.span("prefill", n=len(items)):
                         firsts = eng.start_batch(items)
@@ -392,27 +422,43 @@ class InferenceServer:
                     done = eng.advance_prefill()
                 for slot, tok in done.items():
                     self._deliver_block(slot, [tok])
-            # one fused decode block over every decoding lane
+            # one fused decode block over every decoding lane — the
+            # speculative draft-propose/target-verify block when the
+            # engine carries a draft (decode_auto falls back to the
+            # plain block, draft-tracked, when speculation cannot run)
             if eng.num_active:
                 occ = eng.occupancy
                 active = eng.num_active
                 tele = telemetry.active()
                 t0 = time.monotonic()
-                info, blocks = eng.decode_block()
+                info, blocks = eng.decode_auto()
                 if tele is not None and info is not None:
                     kv_occ, kv_resident = eng.kv_gauges()
-                    tele.record_span(
-                        "decode_block", t0, time.monotonic() - t0,
-                        {"occupancy": occ, "active": active, "k": info["k"],
-                         "tokens": info["tokens"],
-                         "dispatch_s": round(info["dispatch_s"], 9),
-                         "sync_s": round(info["sync_s"], 9),
-                         # the KV capacity/bandwidth gauges: pool block
-                         # occupancy (None on dense), resident bytes,
-                         # and the bytes this block's attention streamed
-                         "kv_block_occupancy": kv_occ,
-                         "kv_bytes_resident": kv_resident,
-                         "kv_read_bytes": info["kv_read_bytes"]})
+                    tags = {"occupancy": occ, "active": active,
+                            "k": info["k"], "tokens": info["tokens"],
+                            "dispatch_s": round(info["dispatch_s"], 9),
+                            "sync_s": round(info["sync_s"], 9),
+                            # the KV capacity/bandwidth gauges: pool block
+                            # occupancy (None on dense), resident bytes,
+                            # and the bytes this block's attention streamed
+                            "kv_block_occupancy": kv_occ,
+                            "kv_bytes_resident": kv_resident,
+                            "kv_read_bytes": info["kv_read_bytes"]}
+                    if info.get("spec"):
+                        # the spec_verify span: per-block acceptance +
+                        # the draft/verify wall split the serving
+                        # report's speculation section aggregates
+                        tags.update(
+                            accepted=info["accepted"],
+                            drafted=info["drafted"],
+                            rollbacks=info["rollbacks"],
+                            draft_s=round(info["draft_s"], 9),
+                            verify_s=round(info["verify_s"], 9))
+                        tele.record_span("spec_verify", t0,
+                                         time.monotonic() - t0, tags)
+                    else:
+                        tele.record_span("decode_block", t0,
+                                         time.monotonic() - t0, tags)
                 self._occupancy_sum += occ
                 self._steps += 1
                 for slot, toks in blocks.items():

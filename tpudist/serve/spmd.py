@@ -168,6 +168,20 @@ def serve_param_sharding(mesh, params, *, overlap: bool = False):
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def serve_spec_param_sharding(mesh, draft_params):
+    """Serving layout for a speculative DRAFT model's parameters:
+    column-parallel kernels shard over ``model`` where the axis divides
+    (same byte-identity-safe column rule as the target), everything
+    else replicates.  A draft is small by construction, so replication
+    is always correct and usually cheap — the column split is taken
+    opportunistically when the draft's head counts allow it (a
+    weight-tied draft shares the target's already-sharded params and
+    never reaches this function).  The draft CACHE reuses
+    :func:`serve_cache_sharding` / :func:`serve_paged_sharding` — its
+    arenas have the same axis meaning as the target's."""
+    return serve_param_sharding(mesh, draft_params, overlap=False)
+
+
 def serve_cache_sharding(mesh, cache):
     """Sharding pytree for a DENSE slot cache: the K/V arenas
     ``[num_slots, 1, n_kv, max_len, dh]`` shard slots over ``data`` and

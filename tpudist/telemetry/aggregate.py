@@ -62,6 +62,9 @@ COMPONENT_OF = {
     "prefill": "step",
     "decode_step": "step",
     "decode_block": "step",
+    # speculative decode: one draft-propose + batched-verify block (the
+    # decode work of a spec engine's iteration)
+    "spec_verify": "step",
 }
 
 #: Every component of the breakdown, in report order.  The accounted ones
@@ -214,6 +217,14 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     kv_occ_w, kv_occ_dur, kv_occ_max = 0.0, 0.0, 0.0
     kv_resident_peak, kv_read_bytes = 0, 0
     kv_config = None
+    # speculative decoding (spec_verify spans): per-block acceptance →
+    # accepted-tokens-per-pass percentiles, the draft/verify wall
+    # split, and rollback counts.  Streams without spec events (every
+    # pre-spec run, and non-spec engines) skip the whole section.
+    spec_blocks, spec_tokens, spec_accepted, spec_drafted = 0, 0, 0, 0
+    spec_rollbacks = 0
+    spec_draft_s, spec_verify_s = 0.0, 0.0
+    spec_per_pass: List[float] = []
     # disaggregated serving (tpudist.serve.disagg): spans tagged with
     # their pool; TTFT belongs to the prefill pool (token 0 is sampled
     # there) and TPOT to the decode pool, with the coordinator's
@@ -243,7 +254,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         if isinstance(pool, str):
             pool_s[pool] = pool_s.get(pool, 0.0) + float(r.get("dur", 0.0))
             pool_spans[pool] = pool_spans.get(pool, 0) + 1
-        if r.get("name") in ("decode_block", "decode_step"):
+        if r.get("name") in ("decode_block", "decode_step", "spec_verify"):
             serve_spans += 1
             decode_blocks += 1
             dur = float(r.get("dur", 0.0))
@@ -251,6 +262,18 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             decode_tokens += int(r.get("tokens", 0) or 0)
             dispatch_s += float(r.get("dispatch_s", 0.0) or 0.0)
             sync_s += float(r.get("sync_s", 0.0) or 0.0)
+            if r.get("name") == "spec_verify":
+                spec_blocks += 1
+                toks = int(r.get("tokens", 0) or 0)
+                spec_tokens += toks
+                spec_accepted += int(r.get("accepted", 0) or 0)
+                spec_drafted += int(r.get("drafted", 0) or 0)
+                spec_rollbacks += int(r.get("rollbacks", 0) or 0)
+                spec_draft_s += float(r.get("draft_s", 0.0) or 0.0)
+                spec_verify_s += float(r.get("verify_s", 0.0) or 0.0)
+                active = int(r.get("active", 0) or 0)
+                if active > 0:
+                    spec_per_pass.append(toks / active)
             occ = r.get("occupancy")
             if isinstance(occ, (int, float)):
                 occ_w += float(occ) * dur
@@ -309,6 +332,28 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
                                      if decode_tokens and kv_read_bytes
                                      else None),
         }
+    spec: Optional[dict] = None
+    if spec_blocks:
+        pp = sorted(spec_per_pass)
+        spec = {
+            "blocks": spec_blocks,
+            "tokens": spec_tokens,
+            "accepted": spec_accepted,
+            "drafted": spec_drafted,
+            "acceptance_rate": (round(spec_accepted / spec_drafted, 4)
+                                if spec_drafted else None),
+            "rollbacks": spec_rollbacks,
+            # emitted tokens per verify pass PER LANE — the
+            # fewer-target-passes-per-token headline (1.0 = no better
+            # than plain decode; the pass emits accepted + 1)
+            "accepted_per_pass": ({
+                "mean": round(sum(pp) / len(pp), 4),
+                "p50": round(_percentile(pp, 50), 4),
+                "p95": round(_percentile(pp, 95), 4),
+                "max": round(pp[-1], 4)} if pp else None),
+            "draft_s": round(spec_draft_s, 6),
+            "verify_s": round(spec_verify_s, 6),
+        }
     pools: Optional[dict] = None
     if pool_s or disagg_config is not None or handoffs:
         hwaits = sorted(float(r["handoff_wait_s"]) for r in fins
@@ -360,6 +405,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         "occupancy_mean": round(occ_w / occ_dur, 4) if occ_dur > 0 else None,
         "occupancy_max": round(occ_max, 4) if occ_dur > 0 else None,
         **({"kv": kv} if kv is not None else {}),
+        **({"spec": spec} if spec is not None else {}),
         **({"pools": pools} if pools is not None else {}),
     }
 
@@ -514,6 +560,20 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"- batch occupancy: mean {sv['occupancy_mean']:.2f}, "
                 f"max {sv['occupancy_max']:.2f}")
+        if sv.get("spec"):
+            sp = sv["spec"]
+            app = sp.get("accepted_per_pass") or {}
+            bits = [f"{sp['blocks']} verify passes",
+                    f"{sp['accepted']}/{sp['drafted']} drafts accepted"
+                    + (f" ({sp['acceptance_rate'] * 100:.0f}%)"
+                       if sp.get("acceptance_rate") is not None else ""),
+                    f"{sp['rollbacks']} rollbacks"]
+            if app:
+                bits.append(f"tokens/pass p50 {app['p50']:.2f} / "
+                            f"p95 {app['p95']:.2f}")
+            bits.append(f"draft {sp['draft_s']:.3f} s vs verify "
+                        f"{sp['verify_s']:.3f} s")
+            lines.append("- speculative decode: " + "; ".join(bits))
         if sv.get("pools"):
             pp = sv["pools"]
             bits = [f"prefill {pp['prefill']['span_s']:.3f} s "

@@ -115,6 +115,11 @@ ENV_VARS = {
     "TPUDIST_SERVE_HANDOFF":
         "KV handoff transport: device (in-mesh) | serial (byte transfer)",
     "TPUDIST_SERVE_HANDOFF_QUEUE": "bounded pending-KV-handoff queue length",
+    "TPUDIST_SERVE_SPEC":
+        "speculative decoding: draft proposes K, target verifies in one pass",
+    "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
+    "TPUDIST_SERVE_SPEC_DRAFT_LAYERS":
+        "tied-draft depth (target's first N layers; 0 = half the depth)",
     # telemetry & goodput
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
