@@ -227,12 +227,26 @@ def _trace_phase(fn, blocks: int, top: int) -> dict:
         shutil.rmtree(tdir, ignore_errors=True)
 
 
+def _slice_table(table, keys=("total_us", "groups", "top_ops", "error")):
+    """Phase-table slice + the cross-phase comparison metric: on
+    backends without a distinct device track (CPU smoke) the "other"
+    bucket absorbs host/trace bookkeeping, so attributed-op time
+    (``op_us_excl_other``) is what phases compare on."""
+    out = {kk: table.get(kk) for kk in keys if kk in table}
+    groups = table.get("groups") or {}
+    other = (groups.get("other") or {}).get("us", 0.0)
+    if table.get("total_us") is not None:
+        out["op_us_excl_other"] = round(table["total_us"] - other, 1)
+    return out
+
+
 def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
                            d_model: int = 64, n_layers: int = 2,
                            n_heads: int = 2, vocab: int = 128,
                            max_len: int = 128, slots: int = 4,
                            k: int = 8, blocks: int = 16,
-                           top: int = 25, spec: bool = True) -> dict:
+                           top: int = 25, spec: bool = True,
+                           paged: bool = True) -> dict:
     """Trace the bf16 fused decode loop and attribute its device time
     per op (module doc, ``--capture-decode``).  Returns the artifact
     dict; writes it to ``out_path`` when given.
@@ -242,7 +256,14 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
     rollback (cursor-reset) program in isolation — so the residual
     table distinguishes where a spec block's device time goes (the
     rollback is cursor arithmetic and should profile as ~free; the
-    table proves it instead of asserting it)."""
+    table proves it instead of asserting it).
+
+    ``paged``: additionally trace the PAGED decode loop twice — the
+    gather path (dense view per dispatch) and the Pallas
+    paged-attention kernel path — as separate phase rows, so the
+    artifact splits paged-kernel time (the ``custom (pallas/kernels)``
+    group on TPU; interpret-lowered ops on CPU) from the residual
+    fusion/layout ops the kernel exists to shrink."""
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     import jax
     import jax.numpy as jnp
@@ -356,25 +377,58 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
 
         rollback_table = _trace_phase(rollback_phase, blocks, top)
 
-        def _slice(table, keys=("total_us", "groups", "top_ops", "error")):
-            out = {kk: table.get(kk) for kk in keys if kk in table}
-            # on backends without a distinct device track (CPU smoke)
-            # the "other" bucket absorbs host/trace bookkeeping — the
-            # cross-phase comparison metric is attributed-op time
-            groups = table.get("groups") or {}
-            other = (groups.get("other") or {}).get("us", 0.0)
-            if table.get("total_us") is not None:
-                out["op_us_excl_other"] = round(
-                    table["total_us"] - other, 1)
-            return out
-
         spec_tables = {
             "draft_k": sk,
-            "draft": _slice(draft_table),
-            "verify": _slice(verify_table),
-            "rollback": _slice(rollback_table, ("total_us", "groups",
-                                                "error")),
+            "draft": _slice_table(draft_table),
+            "verify": _slice_table(verify_table),
+            "rollback": _slice_table(rollback_table, ("total_us", "groups",
+                                                      "error")),
         }
+    paged_tables = None
+    if paged:
+        # -- paged decode: gather vs the Pallas kernel, phase by phase.
+        # Same geometry, same traffic; the kernel row's attention time
+        # lands in "custom (pallas/kernels)" on TPU traces (interpret-
+        # lowered ops on CPU), split from the fusion/layout residual
+        # the dense-view gather pays.
+        from tpudist.models.paged import PagedKVConfig
+
+        kv_block = 16 if max_len % 16 == 0 else max_len
+        pcfg = PagedKVConfig(num_blocks=slots * (max_len // kv_block),
+                             block_size=kv_block)
+        paged_tables = {"kv_block": kv_block}
+        for arm in ("gather", "paged"):
+            pfns = make_slot_decode(module, params, slots, pad,
+                                    paged=pcfg, attn_kernel=arm)
+            pstate, pkv = pfns.init_state(), pfns.init_slots()
+            M = max_len // kv_block
+            tables = np.stack([np.arange(j * M, (j + 1) * M)
+                               for j in range(slots)]).astype(np.int32)
+            pstate, pkv, _ = pfns.insert_batch(
+                pstate, pkv, jnp.asarray(tables),
+                jnp.zeros(slots, jnp.int32), jnp.asarray(prompts),
+                jnp.full(slots, pad, jnp.int32),
+                jnp.arange(slots, dtype=jnp.int32),
+                jnp.zeros(slots, jnp.int32), jnp.zeros(slots, jnp.float32),
+                jnp.ones(slots, bool))
+            pstate, pkv, ptoks = pfns.decode_block(pstate, pkv, k)  # warmup
+            jax.block_until_ready(ptoks)
+            pc = {"state": pstate, "kv": pkv}
+
+            def paged_block():
+                pc["state"], pc["kv"], t = pfns.decode_block(
+                    pc["state"], pc["kv"], k)
+                return t
+
+            n_pb = min(blocks, max(2, (max_len - 2 * pad) // k - 1))
+            table = _trace_phase(paged_block, n_pb, top)
+            key = "kernel" if arm == "paged" else arm
+            paged_tables[key] = _slice_table(table)
+            kg = (table.get("groups") or {}).get(
+                "custom (pallas/kernels)") or {}
+            paged_tables[key]["kernel_us"] = kg.get("us", 0.0)
+            paged_tables[key]["kernel_pct"] = kg.get("pct", 0.0)
+
     groups = s.get("groups", {})
     mxu = groups.get("matmul (MXU)", {"us": 0.0, "pct": 0.0})
     residual = {g: row for g, row in groups.items() if g != "matmul (MXU)"}
@@ -395,6 +449,7 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
         "residual_groups": dict(sorted(
             residual.items(), key=lambda kv: -kv[1]["us"])),
         **({"spec": spec_tables} if spec_tables is not None else {}),
+        **({"paged": paged_tables} if paged_tables is not None else {}),
         **({"error": s["error"]} if "error" in s else {}),
     }
     if out_path is not None:

@@ -485,7 +485,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                      cache_constraint: Optional[Callable] = None,
                      state_constraint: Optional[Callable] = None,
                      spec: Optional[Tuple] = None,
-                     draft_constraint: Optional[Callable] = None
+                     draft_constraint: Optional[Callable] = None,
+                     attn_kernel: str = "gather"
                      ) -> SlotDecode:
     """Build the slot-decode primitive set over ``module``/``params`` —
     see :class:`SlotDecode` for the contract of each callable.  With
@@ -510,7 +511,27 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     target's ``vocab`` and ``max_len`` (cursor parity);
     :func:`tied_draft` builds the zero-cost weight-tied variant.
     ``draft_constraint`` is the draft cache's sharding assert (the
-    target's ``cache_constraint`` twin)."""
+    target's ``cache_constraint`` twin).
+
+    ``attn_kernel`` selects the DECODE attention execution on a paged
+    cache: ``"gather"`` (default) materializes a transient dense view
+    per dispatch; ``"paged"`` routes ``decode_block`` and
+    ``spec_verify`` through the Pallas paged-attention kernel
+    (:mod:`tpudist.ops.paged_attention`) — the block table is walked
+    inside the kernel, only live blocks are fetched, and the
+    dispatch's fresh tokens ride a small window buffer committed back
+    via :meth:`~tpudist.models.paged._Paged.commit_window`.  The
+    prefill/insert/evict programs (compute-bound teacher-forcing and
+    surgery, not the bandwidth-bound hot path) and the DRAFT's own
+    small pool keep the gather path either way, so the program set and
+    its compile pins are unchanged — only the decode arms swap."""
+    if attn_kernel not in ("gather", "paged"):
+        raise ValueError(
+            f"attn_kernel must be 'gather' or 'paged', got {attn_kernel!r}")
+    if attn_kernel == "paged" and paged is None:
+        raise ValueError(
+            "attn_kernel='paged' is the paged-pool kernel — it requires "
+            "a paged cache (pass paged=PagedKVConfig(...))")
     if num_slots < 1:
         raise ValueError(f"num_slots must be >= 1, got {num_slots}")
     if not 1 <= prefill_pad <= module.max_len:
@@ -595,6 +616,17 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
         return lax.scan(body, (state, cache), None, length=k)
 
+    def _sel_active(active, new, old):
+        """Keep ``old`` leaves wherever ``active`` is False (inactive
+        lanes neither advance nor corrupt) — shared by the gather-path
+        selects, the spec programs, and the kernel path's window-view
+        scan."""
+        def sel(n, o):
+            m = active.reshape((-1,) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        return jax.tree.map(sel, new, old)
+
     # -- speculative decoding (spec=(draft_module, draft_params)) -----------
     # The additive primitive set SlotDecode documents: the draft keeps its
     # own slot cache in cursor lockstep with the target (insert / chunked
@@ -619,13 +651,6 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         def _dconstrain(tree_):
             return tree_ if draft_constraint is None \
                 else draft_constraint(tree_)
-
-        def _sel_active(active, new, old):
-            def sel(n, o):
-                m = active.reshape((-1,) + (1,) * (n.ndim - 1))
-                return jnp.where(m, n, o)
-
-            return jax.tree.map(sel, new, old)
 
         def _set_cursors(cache, cur):
             """Overwrite every cursor leaf of a slot-stacked dense cache
@@ -944,11 +969,23 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 k = drafts.shape[0]
                 pos0 = _cache_cursor(pkv.meta)
                 toks = jnp.concatenate([state.last_tok[None], drafts], 0).T
-                nview, logits = vwindow(pg_target.slot_cache(pkv), toks)
+                if attn_kernel == "paged":
+                    # the verify window runs through the SAME paged
+                    # kernel as s=1 decode (the fused spec-window
+                    # mask): one batched K+1-query pass, live blocks
+                    # only, window committed via commit_window
+                    wview = pg_target.window_view(pkv, k + 1)
+                    nview, logits = _kernel_window(pkv, wview, pos0, toks)
+                else:
+                    nview, logits = vwindow(pg_target.slot_cache(pkv), toks)
                 x, a, a_raw, inc, out = _accept(state, logits, drafts,
                                                 dlogits, spec_on, rem)
-                pkv = pg_target.commit_slots(pkv, nview, pos0, k + 1,
-                                             state.active)
+                if attn_kernel == "paged":
+                    pkv = pg_target.commit_window(pkv, nview, pos0, k + 1,
+                                                  state.active)
+                else:
+                    pkv = pg_target.commit_slots(pkv, nview, pos0, k + 1,
+                                                 state.active)
                 new_cur = pos0 + inc
                 pkv = pkv._replace(meta=jax.tree.map(
                     lambda full: new_cur.astype(full.dtype), pkv.meta))
@@ -972,6 +1009,39 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     if paged is not None:
         pg = _Paged(init_cache(1), num_slots, paged)
         meta_template = strip_kv(pg.template)
+        use_kernel = attn_kernel == "paged"
+        if use_kernel:
+            # The kernel path's model clone: Block._decode_attention
+            # dispatches to the Pallas paged-attention kernel, the
+            # decode cache becomes a per-layer WINDOW buffer, and the
+            # pool rides in read-only through the "pool" collection.
+            # Runs BATCHED over slots (no vmap): the kernel's grid
+            # covers all slots in one call per layer, per-slot cursors
+            # ride as vectors.
+            dec_kernel_mod = module.clone(decode=True, moe_fn=None,
+                                          decode_kernel="paged")
+
+            def _pool_col(pkv, pos0):
+                # one shared entry per layer; the leaves are the SAME
+                # tracers, so nothing is duplicated or sliced (a
+                # per-layer pool slice would copy a full layer's pool
+                # per dispatch — the kernel indexes the [L, ...] pool
+                # with its static layer_idx instead)
+                col = dict(pk=pkv.pool_k, pv=pkv.pool_v, sk=pkv.scale_k,
+                           sv=pkv.scale_v, table=pkv.table,
+                           pos0=pos0.astype(jnp.int32))
+                return {name: col for name in pg.layers}
+
+            def _kernel_window(pkv, view, pos0, toks):
+                """One batched multi-token pass over a window view:
+                every lane's ``s`` tokens in ONE forward, attention
+                through the paged kernel — ``s == 1`` is the decode
+                scan's body, ``s == K+1`` the spec verify."""
+                logits, mut = dec_kernel_mod.apply(
+                    {"params": params["params"], "cache": view,
+                     "pool": _pool_col(pkv, pos0)},
+                    toks, mutable=["cache"])
+                return mut["cache"], logits.astype(jnp.float32)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
@@ -1027,14 +1097,50 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
             return _constrain_state(state), pkv, first
 
-        @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-        def decode_block_paged(state, pkv, k):
-            pos0 = _cache_cursor(pkv.meta)
-            mask = state.active
-            (state, cache), toks = _decode_scan(
-                state, pg.slot_cache(pkv), k)
-            pkv = _constrain(pg.commit_slots(pkv, cache, pos0, k, mask))
-            return _constrain_state(state), pkv, toks
+        if use_kernel:
+            @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+            def decode_block_paged(state, pkv, k):
+                # The kernel arm: NO dense gather.  The pool is read in
+                # place by the kernel (live blocks only — loop-invariant,
+                # so it stays out of the scan carry); the scan carries
+                # just the [S, n_kv, k, dh] window buffers + meta, and
+                # the commit touches only the blocks the window spans.
+                pos0 = _cache_cursor(pkv.meta)
+                mask = state.active
+                pool = _pool_col(pkv, pos0)
+                view = pg.window_view(pkv, k)
+
+                def body(carry, _):
+                    state, view = carry
+                    logits, mut = dec_kernel_mod.apply(
+                        {"params": params["params"], "cache": view,
+                         "pool": pool},
+                        state.last_tok[:, None], mutable=["cache"])
+                    view = _sel_active(state.active, mut["cache"], view)
+                    toks = _slot_sample(
+                        logits[:, -1].astype(jnp.float32), state.keys,
+                        state.temps, state.counts)
+                    toks = jnp.where(state.active, toks,
+                                     state.last_tok).astype(jnp.int32)
+                    inc = state.active.astype(jnp.int32)
+                    state = state._replace(
+                        last_tok=toks, counts=state.counts + inc,
+                        pos=state.pos + inc)
+                    return (state, view), toks
+
+                (state, view), toks = lax.scan(body, (state, view), None,
+                                               length=k)
+                pkv = _constrain(pg.commit_window(pkv, view, pos0, k, mask))
+                return _constrain_state(state), pkv, toks
+        else:
+            @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+            def decode_block_paged(state, pkv, k):
+                pos0 = _cache_cursor(pkv.meta)
+                mask = state.active
+                (state, cache), toks = _decode_scan(
+                    state, pg.slot_cache(pkv), k)
+                pkv = _constrain(pg.commit_slots(pkv, cache, pos0, k, mask))
+                return _constrain_state(state), pkv, toks
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def evict_paged(state, pkv, slot, free_ids):

@@ -49,12 +49,20 @@ already in place (it was written under the same id when the prefix
 first prefilled).  "Smaller" is the per-block byte count (draft layers
 × heads × dh), which is what HBM residency is measured in.
 
-CPU-smoke honesty: the compiled programs materialize a transient dense
-``[slots, max_len]`` view per dispatch (XLA scratch, not persistent
-state).  The *resident* KV footprint — what decides how many concurrent
-sequences fit — is the pool; a Pallas paged-attention kernel that reads
-blocks in place (dropping the transient view too) is the on-chip
-follow-up, not a prerequisite for the capacity win measured here.
+Two decode-attention executions share this storage (the engine's
+``attn_kernel`` knob, ``TPUDIST_SERVE_ATTN_KERNEL``):
+
+- **gather** (default): the compiled programs materialize a transient
+  dense ``[slots, max_len]`` view per dispatch (:meth:`_Paged.
+  slot_cache` — XLA scratch, not persistent state).  The *resident* KV
+  footprint is the pool either way, but the transient view's bytes
+  scale with pool geometry, not live KV;
+- **paged** (the Pallas kernel, :mod:`tpudist.ops.paged_attention`):
+  the block table is walked INSIDE the kernel, only live blocks are
+  fetched, and the dispatch's own uncommitted tokens live in a small
+  per-layer WINDOW buffer (:meth:`_Paged.window_view`) committed back
+  through :meth:`_Paged.commit_window` touching only the blocks it
+  spans — decode bytes/token ∝ live KV at any occupancy.
 """
 
 from __future__ import annotations
@@ -190,22 +198,24 @@ class _Paged:
 
     def _dense_kv(self, pkv: PagedKV, rows: jax.Array
                   ) -> Tuple[jax.Array, jax.Array]:
-        """Gather ``rows [..., M]`` of block ids into dense K/V
-        ``[L, ..., n_kv, max_len, dh]`` in the compute dtype (sentinel
+        """Gather ``rows [..., M']`` of block ids into dense K/V
+        ``[L, ..., n_kv, M'*bs, dh]`` in the compute dtype (sentinel
         ids clamp — the gathered garbage lands beyond every cursor,
-        where the attention mask excludes it)."""
+        where the attention mask excludes it).  ``M'`` need not be the
+        full table width: the window commit gathers only the TOUCHED
+        blocks of a dispatch."""
         bs = self.cfg.block_size
+        span = rows.shape[-1] * bs
 
         def view(pool, scale):
-            g = pool[:, rows]                      # [L, ..., M, nk, bs, dh]
+            g = pool[:, rows]                      # [L, ..., M', nk, bs, dh]
             g = g.astype(self.compute_dtype)
             if self.cfg.quantized:
-                s = scale[:, rows]                 # [L, ..., M, nk]
+                s = scale[:, rows]                 # [L, ..., M', nk]
                 g = g * s[..., None, None].astype(self.compute_dtype)
-            # [L, ..., M, nk, bs, dh] -> [L, ..., nk, M*bs, dh]
+            # [L, ..., M', nk, bs, dh] -> [L, ..., nk, M'*bs, dh]
             g = jnp.moveaxis(g, -3, -4)
-            return g.reshape(g.shape[:-4] + (self.n_kv, self.max_len,
-                                             self.dh))
+            return g.reshape(g.shape[:-4] + (self.n_kv, span, self.dh))
 
         return (view(pkv.pool_k, pkv.scale_k),
                 view(pkv.pool_v, pkv.scale_v))
@@ -268,11 +278,19 @@ class _Paged:
             x = jnp.transpose(x, (1, 0, 3, 2, 4, 5))
             return x.reshape(x.shape[0], -1, self.n_kv, bs, self.dh)
 
-        vk, vv = vals_of(dense_k), vals_of(dense_v)
+        return self._scatter_values(pkv, ids, vals_of(dense_k),
+                                    vals_of(dense_v))
+
+    def _scatter_values(self, pkv: PagedKV, ids: jax.Array,
+                        vk: jax.Array, vv: jax.Array) -> PagedKV:
+        """Quantize (int8 mode) and scatter per-block values ``[L, N,
+        n_kv, bs, dh]`` into the pool at ``ids [N]`` (sentinel ids
+        drop) — the one write path both the dense-view commit and the
+        kernel path's window commit funnel through."""
         if self.cfg.quantized:
             def quant(v):
                 amax = jnp.max(jnp.abs(v.astype(jnp.float32)),
-                               axis=(-2, -1))     # [L, S'T, nk]
+                               axis=(-2, -1))     # [L, N, nk]
                 scale = jnp.where(amax > 0, amax / 127.0, 1.0)
                 q = jnp.clip(jnp.round(v.astype(jnp.float32)
                                        / scale[..., None, None]),
@@ -314,6 +332,71 @@ class _Paged:
         meta = jax.tree.map(lambda full, lane: full.at[dsts].set(lane),
                             pkv.meta, strip_kv(cache))
         return pkv._replace(table=pkv.table.at[dsts].set(rows), meta=meta)
+
+    # -- kernel path: window views (no dense gather at all) -----------------
+
+    def window_view(self, pkv: PagedKV, span: int) -> Dict[str, Any]:
+        """The paged-KERNEL path's decode cache: per-layer WINDOW
+        buffers ``k``/``v`` ``[S, n_kv, span, dh]`` (all-zeros — they
+        hold only the dispatch's own uncommitted tokens) plus the
+        slot-stacked meta.  Unlike :meth:`slot_cache` there is NO pool
+        gather here: the Pallas kernel reads live blocks in place, and
+        :meth:`commit_window` scatters the window back touching only
+        the blocks it spans."""
+        cache = jax.tree.map(lambda m: m, pkv.meta)
+        for name in self.layers:
+            cache[name] = dict(
+                cache[name],
+                k=jnp.zeros((self.num_slots, self.n_kv, span, self.dh),
+                            self.compute_dtype),
+                v=jnp.zeros((self.num_slots, self.n_kv, span, self.dh),
+                            self.compute_dtype))
+        return cache
+
+    def commit_window(self, pkv: PagedKV, view: Dict[str, Any],
+                      pos0: jax.Array, span: int,
+                      lane_mask: jax.Array) -> PagedKV:
+        """Commit a window-view cache (post-decode/verify): gather ONLY
+        each live lane's touched blocks (``_touch_count(span)`` of
+        them — never ``max_len``), overlay the window at the lane's
+        in-block offset, requantize, scatter back, adopt the advanced
+        meta.  int8 note: the first touched block re-quantizes with its
+        pre-existing positions included, exactly like the dense-view
+        commit — same touched-block set, same dequant→overlay→requant
+        math, so the commit introduces no divergence of its own (the
+        two paths' pools differ only by the attention accumulation
+        order upstream, at float tolerance)."""
+        bs, B = self.cfg.block_size, self.cfg.num_blocks
+        M, T = self.blocks_per_slot, self._touch_count(span)
+        wk = jnp.stack([view[n]["k"] for n in self.layers])
+        wv = jnp.stack([view[n]["v"] for n in self.layers])
+        t0 = pos0 // bs
+        start = jnp.clip(t0, 0, M - T)
+        logical = start[:, None] + jnp.arange(T)[None]        # [S, T]
+        ids = jnp.take_along_axis(pkv.table, jnp.minimum(logical, M - 1),
+                                  axis=1)
+        live = (logical >= t0[:, None]) & (logical < M) & lane_mask[:, None]
+        ids = jnp.where(live, ids, B)                 # sentinel -> dropped
+        # old contents of the touched blocks (dequantized) — the part a
+        # partially-overwritten first block must carry forward
+        old_k, old_v = self._dense_kv(pkv, ids)       # [L, S, nk, T*bs, dh]
+        off = pos0 - start * bs
+
+        def overlay(old, w):
+            return jax.vmap(
+                lambda o, ww, f: lax.dynamic_update_slice(
+                    o, ww, (0, 0, f, 0)),
+                in_axes=(1, 1, 0), out_axes=1)(old, w, off)
+
+        def vals_of(x):   # [L, S, nk, T*bs, dh] -> [L, S*T, nk, bs, dh]
+            x = x.reshape(x.shape[0], x.shape[1], self.n_kv, T, bs, self.dh)
+            x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+            return x.reshape(x.shape[0], -1, self.n_kv, bs, self.dh)
+
+        pkv = self._scatter_values(
+            pkv, ids.reshape(-1), vals_of(overlay(old_k, wk)),
+            vals_of(overlay(old_v, wv)))
+        return pkv._replace(meta=strip_kv(view))
 
     # -- KV handoff (prefill/decode disaggregation) -------------------------
 

@@ -103,14 +103,19 @@ def rope_rotate(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
     Angles are computed in f32 (precision-sensitive at long context) on the
     GLOBAL sequence axis — callers apply it before any seq sharding, so
     ring-attention shards see correct absolute positions.  Half-split
-    rotation (GPT-NeoX convention).  ``offset`` (static or traced scalar)
-    shifts positions — the KV-cache decode path rotates single tokens at
-    their absolute position.
+    rotation (GPT-NeoX convention).  ``offset`` (static or traced scalar,
+    or a ``[batch]`` vector for the slot-batched paged-kernel decode path
+    where every lane sits at its own cursor) shifts positions — the
+    KV-cache decode path rotates tokens at their absolute position.
     """
     half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    positions = offset + jnp.arange(x.shape[-2], dtype=jnp.float32)
-    angles = positions[:, None] * freqs[None]
+    off = jnp.asarray(offset, jnp.float32)
+    positions = off[..., None] + jnp.arange(x.shape[-2], dtype=jnp.float32)
+    angles = positions[..., None] * freqs            # [(b,) s, half]
+    if off.ndim:
+        # per-batch offsets: broadcast over the heads axis
+        angles = angles[:, None]                     # [b, 1, s, half]
     sin, cos = jnp.sin(angles), jnp.cos(angles)
     # rotate in f32 (position precision at long context), cast back after
     x1 = x[..., :half].astype(jnp.float32)
@@ -239,6 +244,23 @@ class Block(nn.Module):
     # ``max_len`` K/V cache carried in the flax "cache" collection.
     decode: bool = False
     max_len: int = 2048  # cache length (decode only)
+    # Decode-attention execution (decode mode only) — the third arm of
+    # the attention dispatch (reference / flash are the training arms):
+    #   None      — the dense cached softmax below (the gather path:
+    #               a paged engine gathers its pool to a dense view
+    #               first, a dense engine owns the arena outright);
+    #   "paged"   — the Pallas paged-attention kernel
+    #               (tpudist.ops.paged_attention): the block table is
+    #               walked INSIDE the kernel, so only live blocks are
+    #               fetched.  The cache collection then carries a small
+    #               WINDOW buffer instead of a [max_len] arena, and the
+    #               block pool rides in through the read-only "pool"
+    #               collection ({pk, pv, sk, sv, table, pos0} per
+    #               layer) — built by the slot-decode programs
+    #               (tpudist.models.generate), never flax-initialized.
+    decode_kernel: Optional[str] = None
+    # static layer index into the [L, ...] pool (decode_kernel only)
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -266,7 +288,15 @@ class Block(nn.Module):
         k = heads(k, n_kv)
         v = heads(v, n_kv)
         if self.decode:
-            attn = self._decode_attention(q, k, v)
+            if self.decode_kernel == "paged":
+                attn = self._decode_attention_paged(q, k, v)
+            elif self.decode_kernel is not None:
+                raise ValueError(
+                    f"unknown decode_kernel {self.decode_kernel!r} "
+                    "(None = dense cached softmax, 'paged' = the Pallas "
+                    "paged-attention kernel)")
+            else:
+                attn = self._decode_attention(q, k, v)
         else:
             if self.sliding_window is not None and getattr(
                     self.attention_fn, "window", None) != self.sliding_window:
@@ -368,6 +398,68 @@ class Block(nn.Module):
                          preferred_element_type=jnp.float32)
         return out.reshape(b, nh, s, dh).astype(q.dtype)
 
+    def _decode_attention_paged(self, q, k, v):
+        """Cached decode attention through the Pallas paged-attention
+        kernel (:func:`tpudist.ops.paged_attention`): the KV pool stays
+        paged — the kernel walks this slot batch's block tables in its
+        grid and fetches only live blocks, so no dense ``[max_len]``
+        view is ever materialized and bytes/token track live KV.
+
+        Runs BATCHED over the slot axis (``b = num_slots``), not
+        vmapped like the gather path: the kernel's grid covers every
+        slot in one call, so per-slot cursors ride in as vectors.  The
+        cache collection carries, per layer, a small decode-WINDOW
+        buffer (``k``/``v`` ``[b, n_kv, W, dh]`` — this dispatch's
+        uncommitted tokens; the slot-decode program commits them to the
+        pool post-scan) and the per-slot absolute cursor ``idx [b]``;
+        the pool itself ({pk, pv, sk, sv, table, pos0}) rides in
+        read-only through the "pool" collection.  The same per-query
+        causal window mask serves s == 1 decode and the s == K+1
+        speculative verify pass (it is fused into the kernel)."""
+        b, nh, s, dh = q.shape
+
+        def _missing():
+            raise ValueError(
+                "decode_kernel='paged' caches are window views built by "
+                "the slot-decode programs (tpudist.models.generate.make_"
+                "slot_decode(attn_kernel='paged')) — they are supplied "
+                "with apply(), never flax-initialized")
+
+        pool_k = self.get_variable("pool", "pk")
+        pool_v = self.get_variable("pool", "pv")
+        scale_k = self.get_variable("pool", "sk")
+        scale_v = self.get_variable("pool", "sv")
+        table = self.get_variable("pool", "table")
+        pos0 = self.get_variable("pool", "pos0")
+        if pool_k is None:
+            _missing()
+        ck = self.variable("cache", "k", _missing)
+        cv = self.variable("cache", "v", _missing)
+        ci = self.variable("cache", "idx", _missing)
+        pos = ci.value                      # [b] absolute cursors
+        fill = (pos - pos0).astype(jnp.int32)   # window tokens already in
+        if self.rope:
+            q = rope_rotate(q, offset=pos)
+            k = rope_rotate(k, offset=pos)
+        # append this call's K/V at each lane's window offset (the
+        # kernel consumes them as the walk's final virtual block)
+        ck.value = jax.vmap(
+            lambda buf, kk, f: jax.lax.dynamic_update_slice(
+                buf, kk, (0, f, 0)))(ck.value, k.astype(self.dtype), fill)
+        cv.value = jax.vmap(
+            lambda buf, vv, f: jax.lax.dynamic_update_slice(
+                buf, vv, (0, f, 0)))(cv.value, v.astype(self.dtype), fill)
+        ci.value = pos + s
+        from tpudist.ops.paged_attention import paged_attention
+
+        # interpret mode = the tier-1 CPU path (the flash-kernel rule)
+        interpret = jax.devices()[0].platform != "tpu"
+        return paged_attention(
+            q, pool_k, pool_v, scale_k, scale_v, table,
+            pos0.astype(jnp.int32), fill, ck.value, cv.value,
+            layer=self.layer_idx, window=self.sliding_window,
+            interpret=interpret)
+
 
 class TransformerLM(nn.Module):
     """Causal LM: token + learned position embeddings, N pre-LN blocks,
@@ -403,6 +495,11 @@ class TransformerLM(nn.Module):
     # KV-cache decode mode (see tpudist.models.generate): one token per
     # call, positions tracked in the flax "cache" collection.
     decode: bool = False
+    # Decode-attention arm (see Block.decode_kernel): None = dense
+    # cached softmax over a gathered/dense arena, "paged" = the Pallas
+    # paged-attention kernel walking the block pool in place (the
+    # slot-batched path — cursors become [batch] vectors).
+    decode_kernel: Optional[str] = None
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activation memory drops from O(layers × per-block internals) to the
     # block boundaries, at ~1 extra forward of FLOPs — the lever that fits
@@ -467,13 +564,19 @@ class TransformerLM(nn.Module):
             if self.decode:
                 pi = self.variable("cache", "pos",
                                    lambda: jnp.zeros((), jnp.int32))
-                positions = pi.value + jnp.arange(seq, dtype=jnp.int32)
+                if pi.value.ndim:
+                    # slot-batched paged-kernel decode: every lane sits
+                    # at its own cursor, so positions are [batch, seq]
+                    positions = (pi.value[:, None]
+                                 + jnp.arange(seq, dtype=jnp.int32)[None])
+                else:
+                    positions = pi.value + jnp.arange(seq, dtype=jnp.int32)
                 pi.value = pi.value + seq
             elif positions is None:
                 positions = jnp.arange(seq, dtype=jnp.int32)
             pos = nn.Embed(self.max_len, self.d_model, name="pos_embed",
                            dtype=self.dtype)(positions)
-            x = x + pos[None]
+            x = x + (pos if pos.ndim == 3 else pos[None])
         block_cls = Block
         if self.remat and not self.decode:
             # static_argnums: nothing — Block takes only the activation.
@@ -498,6 +601,7 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype, rope=self.rope,
                 n_kv_heads=self.n_kv_heads, decode=self.decode,
                 max_len=self.max_len, sliding_window=self.sliding_window,
+                decode_kernel=self.decode_kernel, layer_idx=i,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)

@@ -6,12 +6,20 @@ as fallbacks and in correctness tests (interpret mode on CPU).
   blocks within a chip).
 - :mod:`fused_mlp` — the toy workload's 5-layer MLP in one kernel, weights
   zero-padded to lane-aligned tiles, activations pinned in VMEM.
+- :mod:`paged_attention` — serving-decode attention that walks the paged
+  KV cache's block table INSIDE the kernel (vLLM-PagedAttention style):
+  live blocks only, int8 dequant in-registers, the decode-window mask
+  fused so s=1 decode and the speculative verify share one kernel.
 """
 
 from tpudist.ops.flash_attention import (  # noqa: F401
     blockwise_attention,
     flash_attention,
     flash_attention_with_lse,
+)
+from tpudist.ops.paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_reference,
 )
 from tpudist.ops.fused_mlp import (  # noqa: F401
     fused_mlp,

@@ -124,18 +124,23 @@ class DisaggServer:
         # private blocks only (a handed-off lane never shares).
         self.prefill_pool: List[SlotEngine] = [
             SlotEngine(module, params, num_slots=p_slots, decode_block=1,
-                       prefix_cache_blocks=cfg.prefix_cache_blocks, **shared)
+                       prefix_cache_blocks=cfg.prefix_cache_blocks,
+                       attn_kernel="gather", **shared)
             for _ in range(max(1, cfg.prefill_workers))]
         # the DECODE pool owns the speculative draft (prefill workers
         # never decode, so a draft there is dead weight); handoff
         # packages are unchanged — an imported lane's draft context
         # starts cold and warms as it decodes (engine.import_slot doc)
+        # the decode pool is where the paged-attention kernel earns its
+        # keep (the bandwidth-bound hot path); prefill workers stay on
+        # the gather path — they teacher-force, never decode
         self.decode_pool: List[SlotEngine] = [
             SlotEngine(module, params, num_slots=cfg.num_slots,
                        decode_block=cfg.decode_block,
                        prefix_cache_blocks=0,
                        spec_draft=cfg.resolve_spec_draft(module),
-                       spec_k=cfg.spec_k, **shared)
+                       spec_k=cfg.spec_k, attn_kernel=cfg.attn_kernel,
+                       **shared)
             for _ in range(max(1, cfg.decode_workers))]
         self.handoff_mode = cfg.handoff
         if self.handoff_mode not in ("device", "serial"):
@@ -237,7 +242,7 @@ class DisaggServer:
 
     def stats(self) -> dict:
         dec = {"blocks": 0, "tokens": 0, "steps": 0,
-               "dispatch_s": 0.0, "sync_s": 0.0}
+               "dispatch_s": 0.0, "sync_s": 0.0, "kv_read_bytes": 0}
         for eng in self.decode_pool:
             for k, v in eng.decode_stats().items():
                 dec[k] += v

@@ -100,9 +100,26 @@ class SlotEngine:
                  kv_blocks: Optional[int] = None, kv_int8: bool = False,
                  prefix_cache_blocks: int = 0,
                  mesh=None,
-                 spec_draft=None, spec_k: int = 4):
+                 spec_draft=None, spec_k: int = 4,
+                 attn_kernel: Optional[str] = None):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
+        # -- decode attention path: "gather" (dense view per dispatch)
+        # or "paged" (the Pallas paged-attention kernel — block table
+        # walked in-kernel, bytes/token ∝ live KV).  Like every other
+        # engine parameter this is env-free; the TPUDIST_SERVE_ATTN_KERNEL
+        # knob is parsed ONCE by ServeConfig.from_env.
+        if attn_kernel is None:
+            attn_kernel = "gather"
+        if attn_kernel not in ("gather", "paged"):
+            raise ValueError(
+                f"attn_kernel must be 'gather' or 'paged', got "
+                f"{attn_kernel!r} (TPUDIST_SERVE_ATTN_KERNEL)")
+        if attn_kernel == "paged" and not paged:
+            raise ValueError(
+                "attn_kernel='paged' walks the paged block pool in-kernel "
+                "— it requires paged=True (TPUDIST_SERVE_PAGED)")
+        self.attn_kernel = attn_kernel
         self.module = module
         self.max_len = int(module.max_len)
         # -- SPMD serving mesh (tpudist.serve.spmd): params + KV storage
@@ -207,7 +224,8 @@ class SlotEngine:
                                         cache_constraint=cache_constraint,
                                         state_constraint=state_constraint,
                                         spec=spec_pair,
-                                        draft_constraint=cache_constraint)
+                                        draft_constraint=cache_constraint,
+                                        attn_kernel=attn_kernel)
             self.alloc = BlockAllocator(
                 self.paged_cfg.num_blocks, kv_block, self.max_len,
                 prefix_cache_blocks=prefix_cache_blocks)
@@ -278,6 +296,10 @@ class SlotEngine:
         self.n_decode_steps = 0
         self.t_decode_dispatch_s = 0.0
         self.t_decode_sync_s = 0.0
+        #: cumulative KV bytes the decode attention streamed, per the
+        #: ACTIVE path's honest model (see _decode_kv_read_bytes) — the
+        #: per-rung bytes/token column in serve_bench reads the delta
+        self.kv_read_bytes_total = 0
         # speculative-decode counters (spec_stats)
         self.n_spec_blocks = 0
         self.n_spec_lane_passes = 0  # Σ active lanes over spec blocks
@@ -351,6 +373,7 @@ class SlotEngine:
             "steps": self.n_decode_steps,
             "dispatch_s": self.t_decode_dispatch_s,
             "sync_s": self.t_decode_sync_s,
+            "kv_read_bytes": self.kv_read_bytes_total,
         }
 
     def spec_stats(self) -> Dict[str, float]:
@@ -407,6 +430,42 @@ class SlotEngine:
                 total += 2 * n_kv * dh * val["k"].dtype.itemsize
         return float(total)
 
+    def _decode_kv_read_bytes(self, pos0: np.ndarray, passes: int,
+                              window_per_lane: int) -> int:
+        """KV bytes the decode attention streams for one dispatch, per
+        the ACTIVE path — the honest accounting the serving report's
+        ``kv`` section quotes (the old formula charged live-KV on every
+        path, under-charging the gather/dense arms whose dense view
+        spans ``max_len`` regardless of cursors):
+
+        - **paged kernel**: each of ``passes`` attention passes walks
+          each lane's LIVE blocks (whole blocks — the DMA unit) at the
+          dispatch-start cursor ``pos0``, plus ``window`` window-buffer
+          positions per lane per pass — bytes/token ∝ live KV;
+        - **gather / dense**: every pass sweeps the full
+          ``[num_slots, max_len]`` arena (the gathered view or the
+          dense arena — inactive lanes compute too, fixed shapes), so
+          bytes scale with pool geometry, which is exactly what the
+          kernel exists to fix.
+
+        ``passes`` = full attention sweeps (``k`` for a plain scan, 1
+        for the fused verify); ``window_per_lane`` = total window-buffer
+        positions one lane reads across the dispatch (``k(k+1)/2`` for
+        the scan's growing window, ``k+1`` for the verify).  Window
+        positions are charged at the COMPUTE dtype's per-position size
+        — the buffer is unquantized even on an int8 pool.
+        """
+        bpp = self._bytes_per_pos()
+        if self.attn_kernel == "paged":
+            pg = self.fns.paged
+            bs = self.paged_cfg.block_size
+            live = ((pos0.astype(np.int64) + bs - 1) // bs) * bs
+            window_bpp = (2 * len(pg.layers) * pg.n_kv * pg.dh
+                          * np.dtype(pg.compute_dtype).itemsize)
+            return int(passes * int(live.sum()) * bpp
+                       + len(pos0) * window_per_lane * window_bpp)
+        return int(passes * self.num_slots * self.max_len * bpp)
+
     def kv_stats(self) -> Dict[str, object]:
         """KV residency accounting — the serving report's capacity
         story.  ``bytes_resident`` is what actually pins HBM: the whole
@@ -418,7 +477,8 @@ class SlotEngine:
         if self.alloc is None:
             total = self.num_slots * self.max_len * bpp
             return {
-                "paged": False, "quantized": False,
+                "paged": False, "attn_kernel": self.attn_kernel,
+                "quantized": False,
                 "block_size": None, "blocks_total": None,
                 "blocks_in_use": None, "blocks_free": None,
                 "cached_blocks": None, "block_occupancy": None,
@@ -429,7 +489,8 @@ class SlotEngine:
             }
         pg, al = self.fns.paged, self.alloc
         return {
-            "paged": True, "quantized": self.paged_cfg.quantized,
+            "paged": True, "attn_kernel": self.attn_kernel,
+            "quantized": self.paged_cfg.quantized,
             "block_size": self.paged_cfg.block_size,
             "blocks_total": al.num_blocks,
             "blocks_in_use": al.blocks_in_use,
@@ -834,6 +895,7 @@ class SlotEngine:
         # "cache_full" (cache_full_slots) instead of decoding garbage.
         headroom = int((self.max_len - self.pos[dec]).min())
         k = _pow2_floor(min(cap, int(remaining.min()), headroom))
+        pos0 = self.pos[dec].copy()  # dispatch-start cursors (accounting)
         t0 = time.perf_counter()
         self.state, self.cache, blocks = self.fns.decode_block(
             self.state, self.cache, k)
@@ -848,13 +910,11 @@ class SlotEngine:
         self.counts[dec] += k
         self.pos[dec] += k
         out = {int(s): [int(t) for t in arr[:, s]] for s in dec}
-        # KV bytes the block's attention streamed: step s of a lane whose
-        # pre-block cursor was p0 attends over p0 + s positions, so the
-        # block reads Σ_lanes (k·p0 + k(k+1)/2) positions × bytes/pos —
-        # the decode bytes/token lever the int8 path halves-or-better.
-        pos0_sum = int((self.pos[dec].astype(np.int64) - k).sum())
-        kv_read = (k * pos0_sum + len(dec) * k * (k + 1) // 2) \
-            * self._bytes_per_pos()
+        # KV bytes the block's attention streamed, per the ACTIVE path
+        # (_decode_kv_read_bytes): k full sweeps; the kernel's window
+        # buffer grows one token per step (Σ = k(k+1)/2 per lane).
+        kv_read = self._decode_kv_read_bytes(pos0, k, k * (k + 1) // 2)
+        self.kv_read_bytes_total += kv_read
         info = {"k": k, "tokens": k * len(dec),
                 "dispatch_s": t1 - t0, "sync_s": t2 - t1,
                 "kv_read_bytes": int(kv_read)}
@@ -921,6 +981,7 @@ class SlotEngine:
             return self.decode_auto_plain()
         rem = np.zeros(self.num_slots, np.int32)
         rem[dec] = remaining
+        pos0 = self.pos[dec].copy()  # dispatch-start cursors (accounting)
         t0 = time.perf_counter()
         self.dcache, drafts, dlogits = self.fns.draft_propose(
             self.state, self.dcache, k)
@@ -958,10 +1019,11 @@ class SlotEngine:
         self.t_spec_sync_s += t3 - t2
         out = {int(s): [int(t) for t in pk[s, 2:2 + pk[s, 0]]] for s in dec
                if pk[s, 0] > 0}
-        # the verify's ONE KV sweep covers every lane's filled prefix +
-        # the K+1 window; the draft adds its own (smaller) sweeps
-        pos_sum = int(self.pos[dec].astype(np.int64).sum())
-        kv_read = (pos_sum + len(dec) * (k + 1)) * self._bytes_per_pos()
+        # the verify is ONE attention sweep over each lane's prefix +
+        # the K+1-token window (the draft adds its own smaller sweeps,
+        # not charged here) — per the active path's honest model
+        kv_read = self._decode_kv_read_bytes(pos0, 1, k + 1)
+        self.kv_read_bytes_total += kv_read
         info = {"spec": True, "k": k, "tokens": emitted,
                 "accepted": accepted, "drafted": drafted,
                 "rollbacks": rollbacks,

@@ -74,6 +74,10 @@ class ServeConfig:
     kv_blocks: Optional[int] = None
     kv_int8: bool = False  # int8 KV storage + per-block scales
     prefix_cache_blocks: int = 0  # shared-prefix LRU cache bound (blocks)
+    # decode attention path on the paged cache: "gather" (dense view
+    # per dispatch) or "paged" (the Pallas paged-attention kernel —
+    # block table walked in-kernel, decode bytes/token ∝ live KV)
+    attn_kernel: str = "gather"
     # -- SPMD serving mesh (tpudist/serve/spmd.py) -------------------------
     # "DxM" (data × model) or "M"; "1" = single device.  Declarative on
     # purpose (AMP-style): a planner searches this field, not the code.
@@ -134,6 +138,8 @@ class ServeConfig:
             kv_int8=env_flag("TPUDIST_SERVE_KV_INT8", False),
             prefix_cache_blocks=env_int(
                 "TPUDIST_SERVE_PREFIX_CACHE", 0) or 0,
+            attn_kernel=os.environ.get(
+                "TPUDIST_SERVE_ATTN_KERNEL", "").strip() or "gather",
             mesh=os.environ.get("TPUDIST_SERVE_MESH", "").strip() or None,
             tp_overlap=os.environ.get(
                 "TPUDIST_SERVE_TP_OVERLAP", "").strip() or None,
@@ -173,6 +179,7 @@ class InferenceServer:
             paged=self.config.paged, kv_block=self.config.kv_block,
             kv_blocks=self.config.kv_blocks, kv_int8=self.config.kv_int8,
             prefix_cache_blocks=self.config.prefix_cache_blocks,
+            attn_kernel=self.config.attn_kernel,
             mesh=self.config.mesh_config(),
             spec_draft=self.config.resolve_spec_draft(module),
             spec_k=self.config.spec_k)
@@ -215,6 +222,7 @@ class InferenceServer:
         kv = self.engine.kv_stats()
         telemetry.event(
             "serve_kv_config", paged=kv["paged"], quantized=kv["quantized"],
+            attn_kernel=kv["attn_kernel"],
             block_size=kv["block_size"], blocks_total=kv["blocks_total"],
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)

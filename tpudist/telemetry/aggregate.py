@@ -314,6 +314,10 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             # static geometry from the serve_kv_config stamp
             **({"paged": kv_config.get("paged"),
                 "quantized": kv_config.get("quantized"),
+                # which decode-attention path produced read_bytes —
+                # live-KV accounting (paged kernel) vs pool-geometry
+                # accounting (gather/dense) are different quantities
+                "attn_kernel": kv_config.get("attn_kernel"),
                 "block_size": kv_config.get("block_size"),
                 "blocks_total": kv_config.get("blocks_total"),
                 "pool_bytes": kv_config.get("pool_bytes"),
@@ -612,8 +616,13 @@ def render_markdown(report: dict) -> str:
                 bits.append(f"peak resident "
                             f"{kv['bytes_resident_peak']:,} B")
             if kv.get("read_bytes_per_token"):
+                # which attention path produced the number: live-KV
+                # accounting (paged kernel) vs pool-geometry (gather)
+                via = (f" via {kv['attn_kernel']}"
+                       if kv.get("attn_kernel") else "")
                 bits.append(f"decode streams "
-                            f"{kv['read_bytes_per_token']:,.0f} B/token")
+                            f"{kv['read_bytes_per_token']:,.0f} B/token"
+                            f"{via}")
             lines.append("- KV cache: " + "; ".join(bits))
     if report.get("stages"):
         lines += ["", "## Host stages (StageTimer)", ""]

@@ -102,6 +102,9 @@ ENV_VARS = {
     "TPUDIST_SERVE_KV_BLOCKS": "KV pool size in blocks (default: dense-equivalent)",
     "TPUDIST_SERVE_KV_INT8": "int8 KV storage with per-block dequant scales",
     "TPUDIST_SERVE_PREFIX_CACHE": "shared-prefix LRU cache bound in blocks (0 off)",
+    "TPUDIST_SERVE_ATTN_KERNEL":
+        "decode attention on the paged cache: gather (dense view per "
+        "dispatch) | paged (Pallas kernel, in-kernel block-table walk)",
     "TPUDIST_SERVE_MESH":
         "serving mesh shape 'DxM' (data x model; '1' = single device)",
     "TPUDIST_SERVE_TP_OVERLAP":
