@@ -97,6 +97,10 @@ _SLOW_PATTERNS = (
     # serving: sustained-load dynamics (late join / backpressure / drain
     # under load); the fast slot/scheduler/server cases stay default
     "TestServeUnderLoad",
+    # fleet-recovery chaos drives (each builds multi-worker disagg
+    # servers and kills workers mid-flight; the fast envelope +
+    # requeue-bookkeeping units stay default in test_serve_recovery.py)
+    "TestWorkerLossChaos",
     # sharded-serving sweeps: full mesh-shape × engine-mode oracle
     # matrix + disagg server e2e (the fast engine-level mesh/handoff
     # oracles stay default in TestServeSpmd)
@@ -187,6 +191,10 @@ _SLOW_PATTERNS = (
     "TestTrainerStrategies::test_lm_strategies_loss_parity",
     # real multi-process scaling rung (subprocess rendezvous)
     "TestScalingMultiproc",
+    # elastic world-size rung (three tpurun-launched multi-process
+    # training runs with kill chaos — the fast tpurun-elastic units
+    # stay default in test_launch.py)
+    "TestElasticBench",
     # pallas native-lowering lane (TPU-only Mosaic compiles; the
     # interpret-mode kernel tests stay tier-1 — marker `pallas` selects
     # the whole kernel suite, see pyproject markers)

@@ -543,6 +543,51 @@ class TestServeBench:
         assert cc["decode_block"] == -1 or 1 <= cc["decode_block"] <= 4
 
 
+class TestElasticBench:
+    def test_three_scenarios_and_attribution(self, tmp_path):
+        """The elastic rung's contract: all three tpurun-launched
+        scenarios complete their budget; the fixed-size restart's
+        recovery gap lands in ``lost_restart`` and the elastic resume's
+        in ``resize`` (finishing at world n−1 from the saved step); the
+        summary quotes goodput retained vs baseline for both paths."""
+        import json as _json
+
+        from benchmarks.elastic_bench import main
+
+        out = tmp_path / "BENCH_ELASTIC.json"
+        rc = main(["--out", str(out)])
+        assert rc == 0
+        rec = _json.loads(out.read_text())
+        rows = {r["scenario"]: r for r in rec["rungs"]}
+        assert set(rows) == {"baseline", "fixed_restart", "elastic_resume"}
+        for r in rows.values():
+            assert "error" not in r, r
+            assert r["completed"] == r["iters"]  # budget completed
+            # goodput components sum exactly to the report wall-clock
+            assert abs(r["goodput_sum_s"] - r["report_wall_s"]) < 1e-3
+        base, fixed, ela = (rows["baseline"], rows["fixed_restart"],
+                            rows["elastic_resume"])
+        assert base["generations"] == 1
+        assert base["resize_s"] == 0 and base["lost_restart_s"] == 0
+        # fixed-size restart: same world both generations, gap is
+        # lost_restart
+        assert fixed["final_world"] == 2
+        assert fixed["world_sizes"] == {"0": 2, "1": 2}
+        assert fixed["lost_restart_s"] > 0 and fixed["resize_s"] == 0
+        assert fixed["resume_start"] > 0  # resumed, not replayed from 0
+        # elastic resume: finished at n-1 from the saved step, gap is
+        # resize
+        assert ela["final_world"] == 1
+        assert ela["world_sizes"] == {"0": 2, "1": 1}
+        assert ela["resize_s"] > 0 and ela["lost_restart_s"] == 0
+        assert ela["resume_start"] == fixed["resume_start"]
+        for key in ("goodput_retained_fixed_restart",
+                    "goodput_retained_elastic_resume",
+                    "elastic_over_fixed_throughput"):
+            assert rec[key] > 0, key
+        assert rec["elastic_completed_at_world"] == 1
+
+
 class TestLossParity:
     def test_all_entry_points_match(self):
         from benchmarks.loss_parity import main

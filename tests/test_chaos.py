@@ -139,6 +139,123 @@ def test_corrupt_latest_falls_back_then_completes(
     assert "corrupted checkpoint step 16" in err
 
 
+# The elastic-resume worker: real multi-process DP training (gloo CPU
+# collectives across ranks), checkpointing on a cadence, resuming via the
+# RESHARD path — the world size comes from the launch contract, so the
+# same script runs the 2-rank first generation and the 1-rank survivor.
+WORKER_ELASTIC = """
+    import json, os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # 1 device per process
+    os.environ.setdefault("OMP_NUM_THREADS", "1")
+
+    import jax
+    if int(os.environ.get("TPUDIST_NUM_PROCESSES", "1")) > 1:
+        # gloo CPU collectives need the distributed client (world > 1)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import optax
+
+    from tpudist.checkpoint import CheckpointConfig, CheckpointManager
+    from tpudist.data import ShardPlan, ShardedLoader, make_toy_data
+    from tpudist.models import create_toy_model
+    from tpudist.runtime import bootstrap
+    from tpudist.runtime.mesh import data_parallel_mesh
+    from tpudist.train import (TrainLoopConfig, init_model_states,
+                               make_multi_model_train_step, run_training)
+
+    ctx = bootstrap.initialize()
+    out = os.environ["CHAOS_OUT"]
+
+    mesh = data_parallel_mesh()
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    mx, px = create_toy_model(kx)
+    my, py = create_toy_model(ky)
+    models = {"model_X": (mx.apply, px), "model_Y": (my.apply, py)}
+    tx = optax.adam(1e-3)
+    states = init_model_states(models, tx)
+    step = make_multi_model_train_step(
+        {k: f for k, (f, _) in models.items()}, tx, mesh)
+    data = make_toy_data(seed=0)
+    plan = ShardPlan(num_samples=len(data), num_shards=ctx.num_processes,
+                     shard_id=ctx.process_id, seed=0, mode="distributed")
+    loader = ShardedLoader(data, batch_size=32, plan=plan)
+
+    mgr = CheckpointManager(CheckpointConfig(
+        directory=os.environ["CHAOS_CKPT"], save_every=8, async_save=False))
+    start = 0
+    if mgr.latest_step is not None:
+        # the elastic-resume seam: the saved logical shardings re-bind
+        # onto THIS (possibly smaller) mesh
+        states, meta = mgr.restore_resharded(states, mesh=mesh)
+        start = int(meta["iteration"])
+    if ctx.process_id == 0:
+        with open(out, "a") as f:
+            f.write(json.dumps({
+                "gen": os.environ.get("TPUDIST_RESTART_COUNT"),
+                "world": ctx.num_processes, "start": start}) + "\\n")
+
+    cfg = TrainLoopConfig(total_iterations=24, progress_bar=False,
+                          sync_every=4, device_cache=False)
+    states, losses = run_training(states, step, loader, mesh, config=cfg,
+                                  ckpt=mgr, start_iteration=start)
+    mgr.wait_until_finished()
+    if ctx.process_id == 0:
+        with open(out, "a") as f:
+            f.write(json.dumps({
+                "gen": os.environ.get("TPUDIST_RESTART_COUNT"),
+                "world": ctx.num_processes, "done": True,
+                "latest": mgr.latest_step,
+                "loss": float(losses["model_X"])}) + "\\n")
+    mgr.close()
+    bootstrap.shutdown()
+"""
+
+
+def test_elastic_kill_completes_at_n_minus_one(tmp_path, chaos_env,
+                                               monkeypatch):
+    """The PR-12 acceptance chain: kill rank 1 of a 2-rank DP run after
+    the step-8 cadence save → the (zero-budget) restart exhausts →
+    ``tpurun --elastic`` relaunches at the surviving world 1 → the
+    survivor resumes from the exact saved iteration through the reshard
+    path and completes the budget — and the merged goodput report shows
+    a NONZERO resize component, generation-stamped world sizes, and
+    components still summing exactly to wall-clock."""
+    worker = tmp_path / "worker_elastic.py"
+    worker.write_text(textwrap.dedent(WORKER_ELASTIC))
+    tele = tmp_path / "tele"
+    monkeypatch.setenv("TPUDIST_FAULT", "kill@step:13,rank:1")
+    rc = tpurun_main(["--nprocs", "2", "--max-restarts", "0", "--elastic",
+                      "--restart-backoff", "0.1",
+                      "--tmpdir", str(tmp_path / "s"),
+                      "--telemetry-dir", str(tele),
+                      "--", sys.executable, str(worker)])
+    assert rc == 0
+    rows = _rows(tmp_path)
+    starts = [r for r in rows if "start" in r]
+    dones = [r for r in rows if r.get("done")]
+    # gen 0 trained at world 2 from scratch; gen 1 is the SURVIVOR
+    # world: it resumed at the exact saved iteration (loss-curve
+    # continuity — no replay from 0) and finished the budget
+    assert starts[0] == {"gen": "0", "world": 2, "start": 0}
+    assert starts[1]["world"] == 1 and starts[1]["gen"] == "1"
+    assert starts[1]["start"] == 8, rows
+    assert dones[-1]["latest"] == 24 and dones[-1]["world"] == 1
+    import math
+    assert math.isfinite(dones[-1]["loss"])
+
+    report = json.loads((tele / "report.json").read_text())
+    assert report["world_sizes"] == {"0": 2, "1": 1}
+    assert report["goodput"]["resize"]["s"] > 0, report["goodput"]
+    # the resize gap is attributed as resize, NOT lost_restart, and the
+    # components still sum exactly to the (mean-rank) wall clock
+    assert abs(report["goodput_sum_s"] - report["wall_clock_s"]) < 1e-3
+    names = [e["name"] for e in report["events"]]
+    assert "restart_exhausted" in names and "world_resized" in names
+    rs = next(e for e in report["events"] if e["name"] == "world_resized")
+    assert rs["from_world"] == 2 and rs["to_world"] == 1
+
+
 def test_watchdog_stall_is_restarted_by_tpurun(tmp_path, monkeypatch):
     """A worker whose loop wedges (never pets the watchdog) is aborted
     with exit 124 and restarted by the agent; the restarted attempt (which

@@ -83,6 +83,54 @@ class TestGrammar:
         faults.inject_host()
         faults.inject_init(0)
         assert faults.inject_ckpt_save(0, "/nonexistent") is False
+        assert faults.inject_serve_worker(1, 0, 99) is False
+        assert faults.inject_handoff({"blob": [(b"x", "uint8", (1,))]}) \
+            is False
+
+    def test_parse_serve_kinds(self):
+        plan = faults.parse(
+            "serve_worker_kill@call:8,pool:1,worker:2;handoff_corrupt@nth:3")
+        assert plan[0].kind == "serve_worker_kill"
+        assert plan[0].params == {"call": 8, "pool": 1, "worker": 2}
+        assert plan[1].params == {"nth": 3}
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse("serve_worker_kill@pool:1")  # missing call
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse("handoff_corrupt@call:1")  # wrong param
+
+
+class TestServeInjection:
+    def teardown_method(self):
+        faults.disarm()
+
+    def test_serve_worker_kill_gates_on_pool_worker_call(self):
+        faults.arm("serve_worker_kill@call:3,pool:1,worker:1")
+        # wrong pool / wrong worker never fire
+        assert not faults.inject_serve_worker(0, 1, 99)
+        assert not faults.inject_serve_worker(1, 0, 99)
+        # right target, below the call threshold
+        assert not faults.inject_serve_worker(1, 1, 2)
+        assert faults.inject_serve_worker(1, 1, 3)
+        # one-shot: the restarted/recovered fleet is not re-killed
+        assert not faults.inject_serve_worker(1, 1, 4)
+
+    def test_serve_worker_kill_pool_defaults_to_decode(self):
+        faults.arm("serve_worker_kill@call:1")
+        assert not faults.inject_serve_worker(0, 0, 5)  # prefill: no
+        assert faults.inject_serve_worker(1, 0, 5)
+
+    def test_handoff_corrupt_counts_serializes_and_garbles_once(self):
+        faults.arm("handoff_corrupt@nth:2")
+        mk = lambda: {"blob": [(bytes(range(16)), "uint8", (16,))]}  # noqa: E731
+        first = mk()
+        assert not faults.inject_handoff(first)
+        assert first["blob"][0][0] == bytes(range(16))  # untouched
+        second = mk()
+        assert faults.inject_handoff(second)
+        assert second["blob"][0][0] != bytes(range(16))
+        assert len(second["blob"][0][0]) == 16  # same length, flipped bytes
+        third = mk()
+        assert not faults.inject_handoff(third)  # one-shot
 
 
 class TestGating:

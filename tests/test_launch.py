@@ -154,6 +154,106 @@ class TestTpurun:
                           "--", sys.executable, str(worker)])
         assert rc == 1
 
+    def test_elastic_relaunches_at_surviving_world(self, tmp_path,
+                                                   monkeypatch):
+        """--elastic survivor relaunch, end to end through the agent: a
+        rank that dies at world 2 exhausts the (zero) restart budget →
+        the group relaunches at world 1 with a fresh budget and a
+        monotone generation, the dead rank named from the agent's own
+        exit observation (a SIGKILLed worker leaves no crash record),
+        and the exhaustion + resize land in the agent's telemetry
+        stream for the merged report."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import json, os, sys, time
+            world = int(os.environ["TPUDIST_NUM_PROCESSES"])
+            rank = int(os.environ["TPUDIST_PROCESS_ID"])
+            if world > 1:
+                if rank == 1:
+                    sys.exit(9)      # the dying rank
+                time.sleep(30)       # survivor: terminated by the agent
+                sys.exit(0)
+            with open(os.path.join(os.environ["OUT_DIR"],
+                                   f"ok{rank}.json"), "w") as f:
+                json.dump({"world": world,
+                           "gen": os.environ["TPUDIST_RESTART_COUNT"]}, f)
+        """)
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        tele_dir = tmp_path / "tele"
+        monkeypatch.setenv("OUT_DIR", str(out_dir))
+        rc = tpurun_main(["--nprocs", "2", "--max-restarts", "0",
+                          "--elastic", "--restart-backoff", "0.05",
+                          "--tmpdir", str(tmp_path / "scratch"),
+                          "--telemetry-dir", str(tele_dir),
+                          "--", sys.executable, str(worker)])
+        assert rc == 0
+        ok = json.load(open(out_dir / "ok0.json"))
+        assert ok == {"world": 1, "gen": "1"}  # resized, gen monotone
+        assert not (out_dir / "ok1.json").exists()
+        # agent stream (pseudo-rank = initial world + node_rank = 2):
+        # exhaustion stamped, then the resize with the observed dead rank
+        recs = [json.loads(l) for l in
+                (tele_dir / "rank2_gen0.jsonl").read_text().splitlines()]
+        names = [r["name"] for r in recs]
+        assert "restart_exhausted" in names and "world_resized" in names
+        ex = next(r for r in recs if r["name"] == "restart_exhausted")
+        assert ex["world"] == 2 and ex["attempts"] == 1
+        assert ex["dead_ranks"] == [1]
+        rs = next(r for r in recs if r["name"] == "world_resized")
+        assert rs["from_world"] == 2 and rs["to_world"] == 1
+        assert rs["dead_ranks"] == [1]
+
+    def test_elastic_world_one_exhaustion_gives_up(self, tmp_path,
+                                                   monkeypatch):
+        """Elastic cannot shrink below 1: exhaustion at world 1 is the
+        end of the line (rc 1, restart_exhausted still stamped)."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import sys
+            sys.exit(3)
+        """)
+        tele_dir = tmp_path / "tele"
+        rc = tpurun_main(["--nprocs", "1", "--max-restarts", "0",
+                          "--elastic", "--restart-backoff", "0.05",
+                          "--tmpdir", str(tmp_path / "scratch"),
+                          "--telemetry-dir", str(tele_dir),
+                          "--", sys.executable, str(worker)])
+        assert rc == 1
+        recs = [json.loads(l) for l in
+                (tele_dir / "rank1_gen0.jsonl").read_text().splitlines()]
+        assert any(r["name"] == "restart_exhausted" and r["world"] == 1
+                   for r in recs)
+        assert not any(r["name"] == "world_resized" for r in recs)
+
+    def test_restart_exhausted_event_without_elastic(self, tmp_path,
+                                                     monkeypatch):
+        """The satellite: exhaustion is no longer stderr-only — the
+        fixed-size path stamps restart_exhausted into the telemetry the
+        merged report reads."""
+        _clean_env(monkeypatch)
+        worker = _write_worker(tmp_path, """
+            import sys
+            sys.exit(7)
+        """)
+        tele_dir = tmp_path / "tele"
+        rc = tpurun_main(["--nprocs", "2", "--max-restarts", "1",
+                          "--restart-backoff", "0.05",
+                          "--tmpdir", str(tmp_path / "scratch"),
+                          "--telemetry-dir", str(tele_dir),
+                          "--", sys.executable, str(worker)])
+        assert rc == 1
+        recs = [json.loads(l) for l in
+                (tele_dir / "rank2_gen0.jsonl").read_text().splitlines()]
+        ex = next(r for r in recs if r["name"] == "restart_exhausted")
+        assert ex["attempts"] == 2 and ex["world"] == 2
+
+    def test_elastic_requires_single_node(self):
+        with pytest.raises(SystemExit, match="elastic"):
+            tpurun_main(["--nnodes", "2", "--node-rank", "0",
+                         "--coordinator", "h:1", "--elastic",
+                         "--", "python", "x.py"])
+
     def test_cmd_must_start_with_python(self, tmp_path):
         # torchrun_launcher.sh:23-25 parity.
         with pytest.raises(SystemExit):

@@ -1,0 +1,488 @@
+"""Self-healing disaggregated serving (PR 12): handoff schema/integrity
+versioning, worker-loss lane recovery, and backpressure pool resizing.
+
+Fast lane: package envelope contract (schema_version reject, digest
+corruption reject — the doctored-package regressions) and the requeue
+bookkeeping units (`_lose_worker` routing + replay-skip arithmetic,
+driven directly, no decoding).  Slow lane (conftest patterns): the chaos
+drives — kill a decode/prefill pool worker mid-flight through the
+`TPUDIST_FAULT` grammar and assert every request finishes on survivors
+BYTE-IDENTICAL to an unkilled twin; corrupt a handoff package in flight
+and assert that one request finishes with a reason while the server
+keeps serving; sustained handoff backpressure shrinks the prefill slot
+budget and slack grows it back."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models import create_transformer, generate
+from tpudist.runtime import faults
+from tpudist.serve import DisaggServer, ServeConfig
+from tpudist.serve.disagg import (
+    HANDOFF_SCHEMA_VERSION,
+    HandoffError,
+    check_package_schema,
+    deserialize_package,
+    serialize_package,
+)
+from tpudist.serve.scheduler import Request, RequestHandle
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+def _prompt(plen, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _reference(model, prompt, max_new):
+    module, params = model
+    out = generate(module, params, jnp.asarray(prompt)[None], max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _fake_pkg():
+    return {"paged": False, "pos": 3, "counts": 1, "budget": 8,
+            "lane": {"k": jnp.arange(8, dtype=jnp.float32).reshape(2, 4)},
+            "state": {"last": jnp.asarray(7, jnp.int32)}}
+
+
+class TestPackageEnvelope:
+    """serialize/deserialize versioning + integrity (fast lane)."""
+
+    def test_round_trip_carries_schema_and_digest(self):
+        ser = serialize_package(_fake_pkg())
+        assert ser["schema_version"] == HANDOFF_SCHEMA_VERSION
+        assert isinstance(ser["digest"], str) and len(ser["digest"]) == 32
+        out = deserialize_package(ser)
+        np.testing.assert_array_equal(np.asarray(out["lane"]["k"]),
+                                      np.arange(8).reshape(2, 4))
+        assert out["pos"] == 3 and out["budget"] == 8
+
+    @pytest.mark.parametrize("doctor", ["mismatch", "missing"])
+    def test_doctored_schema_version_rejected(self, doctor):
+        """The regression the satellite asks for: a doctored package
+        fails LOUDLY at the envelope, never as a shape crash mid-import."""
+        ser = serialize_package(_fake_pkg())
+        if doctor == "mismatch":
+            ser["schema_version"] = HANDOFF_SCHEMA_VERSION + 7
+        else:
+            del ser["schema_version"]
+        with pytest.raises(HandoffError) as ei:
+            deserialize_package(ser)
+        assert ei.value.reason == "schema"
+        assert "schema_version" in str(ei.value)
+        with pytest.raises(HandoffError):
+            check_package_schema(ser)  # the cheap envelope check agrees
+
+    def test_flipped_blob_byte_fails_integrity(self):
+        ser = serialize_package(_fake_pkg())
+        b, dt, shape = ser["blob"][0]
+        ser["blob"][0] = (bytes([b[0] ^ 0x01]) + b[1:], dt, shape)
+        with pytest.raises(HandoffError) as ei:
+            deserialize_package(ser)
+        assert ei.value.reason == "corrupt"
+
+    def test_handoff_corrupt_fault_garbles_nth_package(self):
+        """The chaos grammar's wire-corruption kind: the nth serialize
+        is garbled after the digest stamp, so deserialize detects it."""
+        faults.arm("handoff_corrupt@nth:2")
+        try:
+            first = serialize_package(_fake_pkg())
+            deserialize_package(first)  # 1st package untouched
+            second = serialize_package(_fake_pkg())
+            with pytest.raises(HandoffError) as ei:
+                deserialize_package(second)
+            assert ei.value.reason == "corrupt"
+            third = serialize_package(_fake_pkg())
+            deserialize_package(third)  # one-shot: 3rd clean again
+        finally:
+            faults.disarm()
+
+
+class TestRequeueBookkeeping:
+    """`_lose_worker` routing + replay-skip arithmetic, driven directly
+    (no decoding — the fast-lane half; the chaos drives are slow-lane)."""
+
+    @pytest.fixture()
+    def srv(self, model):
+        module, params = model
+        cfg = ServeConfig(num_slots=2, prefill_slots=2, prefill_workers=2,
+                          decode_workers=2, disagg=True, handoff="serial")
+        s = DisaggServer(module, params, cfg, install_signal_handler=False)
+        yield s  # never started: the loop stays ours to drive
+
+    def _handle(self, hid, ntoks=0, max_new=99):
+        h = RequestHandle(Request(prompt=_prompt(3, hid), max_new=max_new),
+                          hid)
+        for t in range(ntoks):
+            h._deliver(t)
+        return h
+
+    def test_decode_loss_requeues_stash_with_skip(self, srv):
+        h = self._handle(1, ntoks=4)  # token0 + 3 decoded since import
+        srv._slot_handles[("decode", 0, 0)] = h
+        srv._import_pkg[(0, 0)] = ({"pkg": "sentinel"}, 1)  # l0 = 1
+        srv._lose_worker("decode", 0, RuntimeError("boom"))
+        assert 0 in srv._dead["decode"] and srv.workers_lost == 1
+        assert not h.done  # recovered, not aborted
+        assert list(srv._handoff) == [(h, {"pkg": "sentinel"})]
+        assert srv._skip[h.id] == 3  # re-decode drops exactly 3 dups
+        assert ("decode", 0, 0) not in srv._slot_handles
+
+    def test_deliver_block_drops_exactly_skip_tokens(self, srv):
+        h = self._handle(2, ntoks=2)
+        srv._slot_handles[("decode", 1, 0)] = h
+        srv._skip[h.id] = 2
+        srv._deliver_block(1, 0, [10, 11, 12])
+        assert h.tokens == [0, 1, 12]  # 10, 11 were duplicates
+        assert h.id not in srv._skip  # counter fully consumed
+        srv._deliver_block(1, 0, [13])
+        assert h.tokens == [0, 1, 12, 13]
+
+    def test_prefill_loss_requeues_for_replay(self, srv):
+        h = self._handle(3, ntoks=1)  # token0 out, export had stalled
+        srv._slot_handles[("prefill", 0, 1)] = h
+        srv._lose_worker("prefill", 0, RuntimeError("boom"))
+        assert list(srv._requeue) == [h]
+        assert srv._skip[h.id] == 1  # the re-prefilled token 0 skips
+        assert not h.done
+
+    def test_no_survivor_finishes_worker_lost(self, srv):
+        srv._dead["decode"].add(1)  # only worker 0 left...
+        h = self._handle(4, ntoks=2)
+        srv._slot_handles[("decode", 0, 0)] = h
+        srv._import_pkg[(0, 0)] = ({"pkg": "x"}, 1)
+        srv._lose_worker("decode", 0, RuntimeError("boom"))  # ...and dies
+        assert h.done and h.finish_reason == "worker_lost"
+        assert not srv._handoff
+
+    def test_recover_off_reraises(self, model):
+        module, params = model
+        cfg = ServeConfig(num_slots=2, disagg=True, handoff="serial",
+                          recover=False)
+        srv = DisaggServer(module, params, cfg,
+                           install_signal_handler=False)
+        with pytest.raises(RuntimeError, match="boom"):
+            srv._lose_worker("decode", 0, RuntimeError("boom"))
+
+    def test_mid_batch_export_death_spares_sibling_completions(self, srv):
+        """A worker dying during the FIRST lane's export must not crash
+        the sibling completions of the same admission batch (their slot
+        handles were already popped by the recovery) — the loop carries
+        on and every lane survives, requeued or re-exported."""
+        import time as _time
+
+        faults.arm("serve_worker_kill@call:2,pool:0,worker:0")
+        try:
+            hs = [srv.submit(_prompt(3 + i, i), max_new=6) for i in range(2)]
+            # drive the admission phase directly (the server is never
+            # started): tick 1 = start_batch, tick 2 = the first
+            # completion's export -> injected death mid-batch
+            srv._admit_prefill(_time.monotonic())
+        finally:
+            faults.disarm()
+        assert srv.workers_lost == 1
+        assert 0 in srv._dead["prefill"]
+        # nothing crashed, nothing aborted: both lanes are still live —
+        # re-prefillled on the surviving worker (and possibly already
+        # exported) or waiting in the requeue line
+        assert all(not h.done for h in hs)
+        assert (len(srv._requeue) + len(srv._handoff)
+                + len(srv._slot_handles)) == 2
+
+    def test_blocked_replay_head_stops_fresh_admissions(self, srv,
+                                                        monkeypatch):
+        """While the requeue head cannot pass a worker's admission gate,
+        that worker must not admit FRESH requests into the blocks the
+        recovered lane is waiting for (starvation guard)."""
+        import time as _time
+
+        blocked = self._handle(77)
+        srv._requeue.append(blocked)
+        monkeypatch.setattr(
+            srv.prefill_pool[0].__class__, "kv_admission_probe",
+            lambda self, *a, **k: None)  # every gate refuses
+        fresh = srv.submit(_prompt(3, 1), max_new=4)
+        srv._admit_prefill(_time.monotonic())
+        # neither admitted: the replay head blocked, and fresh traffic
+        # did not jump it
+        assert list(srv._requeue) == [blocked]
+        assert srv.scheduler.pending() == 1
+        assert not fresh.done and not srv._slot_handles
+
+    def test_outstanding_counts_requeue_and_abort_flushes_it(self, srv):
+        h = self._handle(5)
+        srv._requeue.append(h)
+        srv._skip[h.id] = 2  # a recovering lane...
+        assert srv._outstanding() == 1
+        srv._abort_outstanding()
+        assert h.done and h.finish_reason == "shutdown"
+        assert srv._outstanding() == 0
+        # ...whose early end must not leak its replay-skip entry (every
+        # finish path funnels through _note_finished's cleanup)
+        assert h.id not in srv._skip
+
+    def test_finish_key_completes_handle_even_if_evict_kills_worker(
+            self, srv, monkeypatch):
+        """recover=False compat: _finish_key must finish the request
+        BEFORE the evict can take the loop down — once popped from
+        _slot_handles the handle is invisible to _abort_outstanding, so
+        a later finish would never come (stranded-waiter regression)."""
+        srv.recover = False
+        h = self._handle(6, ntoks=4, max_new=4)
+        srv._slot_handles[("decode", 0, 1)] = h
+        monkeypatch.setattr(
+            srv.decode_pool[0], "evict",
+            lambda slot: (_ for _ in ()).throw(RuntimeError("evict boom")))
+        with pytest.raises(RuntimeError, match="evict boom"):
+            srv._finish_key(("decode", 0, 1), "length")
+        assert h.done and h.finish_reason == "length"
+
+
+def _drain_handles(hs, timeout=180):
+    for h in hs:
+        assert h.wait(timeout), "request timed out"
+
+
+class TestWorkerLossChaos:
+    """Slow-lane chaos drives: the acceptance contract — kill a pool
+    worker mid-flight, every in-flight request finishes on survivors
+    with greedy output byte-identical to an unkilled twin, and no handle
+    ends ``"shutdown"``."""
+
+    def test_decode_worker_kill_lanes_finish_byte_identical(
+            self, model, tmp_path):
+        from tpudist import telemetry
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        module, params = model
+        reqs = [(_prompt(3, 0), 8), (_prompt(5, 1), 8), (_prompt(6, 3), 6),
+                (_prompt(4, 4), 7)]
+        telemetry.start(tmp_path)
+        faults.arm("serve_worker_kill@call:3,pool:1,worker:0")
+        try:
+            cfg = ServeConfig(num_slots=2, prefill_slots=2,
+                              prefill_workers=1, decode_workers=2,
+                              disagg=True, handoff="serial",
+                              decode_block=2)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            hs = [srv.submit(p, max_new=mn, seed=i)
+                  for i, (p, mn) in enumerate(reqs)]
+            _drain_handles(hs)
+            for h, (p, mn) in zip(hs, reqs):
+                assert h.finish_reason == "length", h.finish_reason
+                assert h.tokens == _reference(model, p, mn)
+            st = srv.stats()
+            assert st["workers_lost"] == 1
+            assert st["lanes_recovered"] >= 1
+            assert st["decode_pool"]["dead"] == [0]
+            assert srv.close(timeout=60)
+        finally:
+            faults.disarm()
+            telemetry.finish(write_report=False)
+        report = aggregate_run(tmp_path)
+        pools = report["serving"]["pools"]
+        assert pools["workers_lost"] == 1
+        assert pools["lanes_recovered"] >= 1
+        assert any(e["name"] == "worker_lost" for e in report["events"])
+        assert any(e["name"] == "lane_recovered" for e in report["events"])
+        # acceptance: nothing ended "shutdown"
+        assert "shutdown" not in report["serving"]["finish_reasons"]
+
+    def test_decode_worker_kill_sampled_streams_identical(self, model):
+        """Replay correctness for SAMPLED lanes: the fold_in(key, count)
+        stream rides in the package, so the survivor re-draws the same
+        tokens — the recovered stream equals the unkilled twin's."""
+        module, params = model
+        reqs = [(_prompt(3, 0), 8), (_prompt(5, 1), 8)]
+
+        def run(arm):
+            if arm:
+                faults.arm("serve_worker_kill@call:4,pool:1,worker:0")
+            try:
+                cfg = ServeConfig(num_slots=2, prefill_slots=2,
+                                  prefill_workers=1, decode_workers=2,
+                                  disagg=True, handoff="serial",
+                                  decode_block=2)
+                srv = DisaggServer(module, params, cfg,
+                                   install_signal_handler=False).start()
+                hs = [srv.submit(p, max_new=mn, temperature=0.8, seed=17 + i)
+                      for i, (p, mn) in enumerate(reqs)]
+                _drain_handles(hs)
+                toks = [list(h.tokens) for h in hs]
+                st = srv.stats()
+                assert srv.close(timeout=60)
+                return toks, st
+            finally:
+                if arm:
+                    faults.disarm()
+
+        want, _ = run(arm=False)
+        got, st = run(arm=True)
+        assert st["workers_lost"] == 1
+        assert got == want
+
+    def test_double_decode_loss_still_byte_identical(self, model):
+        """A lane recovered once and lost AGAIN (its new worker dies
+        mid/post replay) must still continue byte-identically — the
+        stash records the package-equivalent delivered count net of any
+        pending replay skip, so the second recovery skips exactly the
+        delivered tokens (the double-loss regression)."""
+        module, params = model
+        reqs = [(_prompt(3, 0), 10), (_prompt(5, 1), 10)]
+        # worker 0: 2 import ticks + 1 delivered decode block, dies on
+        # its SECOND decode dispatch (lanes now owe a 2-token replay
+        # skip); worker 1: 2 import ticks, dies on its FIRST replay
+        # dispatch — the skip is still pending, the exact double-loss
+        # window the stash arithmetic must survive
+        faults.arm("serve_worker_kill@call:4,pool:1,worker:0;"
+                   "serve_worker_kill@call:3,pool:1,worker:1")
+        try:
+            cfg = ServeConfig(num_slots=2, prefill_slots=2,
+                              prefill_workers=1, decode_workers=3,
+                              disagg=True, handoff="serial",
+                              decode_block=2)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            hs = [srv.submit(p, max_new=mn, seed=i)
+                  for i, (p, mn) in enumerate(reqs)]
+            _drain_handles(hs)
+            st = srv.stats()
+            assert st["workers_lost"] == 2, st["workers_lost"]
+            for h, (p, mn) in zip(hs, reqs):
+                assert h.finish_reason == "length", h.finish_reason
+                assert h.tokens == _reference(model, p, mn)
+            assert srv.close(timeout=60)
+        finally:
+            faults.disarm()
+
+    def test_prefill_worker_kill_replays_on_survivor(self, model):
+        module, params = model
+        faults.arm("serve_worker_kill@call:2,pool:0,worker:0")
+        try:
+            cfg = ServeConfig(num_slots=2, prefill_slots=1,
+                              prefill_workers=2, decode_workers=1,
+                              disagg=True, handoff="serial",
+                              decode_block=2)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            # one prompt longer than the pad (chunked prefill mid-kill)
+            reqs = [(_prompt(12, 7), 5), (_prompt(4, 2), 5)]
+            hs = [srv.submit(p, max_new=mn) for p, mn in reqs]
+            _drain_handles(hs)
+            for h, (p, mn) in zip(hs, reqs):
+                assert h.finish_reason == "length", h.finish_reason
+                assert h.tokens == _reference(model, p, mn)
+            st = srv.stats()
+            assert st["workers_lost"] == 1
+            assert st["prefill_pool"]["dead"] == [0]
+            assert srv.close(timeout=60)
+        finally:
+            faults.disarm()
+
+    def test_corrupt_handoff_finishes_with_reason_server_survives(
+            self, model, tmp_path):
+        from tpudist import telemetry
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        module, params = model
+        telemetry.start(tmp_path)
+        faults.arm("handoff_corrupt@nth:2")
+        try:
+            cfg = ServeConfig(num_slots=2, disagg=True, handoff="serial",
+                              decode_block=2)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            reqs = [(_prompt(3, 0), 6), (_prompt(5, 1), 6),
+                    (_prompt(6, 3), 6)]
+            hs = [srv.submit(p, max_new=mn) for p, mn in reqs]
+            _drain_handles(hs)
+            reasons = [h.finish_reason for h in hs]
+            assert reasons.count("handoff_corrupt") == 1
+            for h, (p, mn) in zip(hs, reqs):
+                if h.finish_reason == "length":
+                    assert h.tokens == _reference(model, p, mn)
+            # the server kept serving AFTER the rejection
+            h2 = srv.submit(_prompt(4, 9), max_new=4)
+            assert h2.wait(120) and h2.finish_reason == "length"
+            assert h2.tokens == _reference(model, _prompt(4, 9), 4)
+            assert srv.close(timeout=60)
+        finally:
+            faults.disarm()
+            telemetry.finish(write_report=False)
+        report = aggregate_run(tmp_path)
+        assert any(e["name"] == "handoff_rejected"
+                   for e in report["events"])
+        assert report["serving"]["finish_reasons"]["handoff_corrupt"] == 1
+
+    def test_decode_pool_collapse_finishes_loudly_never_hangs(self, model):
+        """The ONLY worker of the decode pool dies: every dependent
+        request finishes with reason ``worker_lost`` (queued handoff
+        packages included — nothing lingers, nothing ends "shutdown"
+        silently mid-serve), new submits reject with the same reason,
+        and the server still drains cleanly."""
+        from tpudist.serve.scheduler import AdmissionError
+
+        module, params = model
+        faults.arm("serve_worker_kill@call:2,pool:1,worker:0")
+        try:
+            cfg = ServeConfig(num_slots=2, prefill_slots=2,
+                              prefill_workers=1, decode_workers=1,
+                              disagg=True, handoff="serial",
+                              decode_block=2)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            hs = [srv.submit(_prompt(3 + i, i), max_new=8, seed=i)
+                  for i in range(4)]
+            _drain_handles(hs, timeout=120)
+            assert all(h.finish_reason == "worker_lost" for h in hs), \
+                [h.finish_reason for h in hs]
+            with pytest.raises(AdmissionError, match="worker_lost"):
+                srv.submit(_prompt(3, 9), max_new=4)
+            assert srv.close(timeout=60)
+        finally:
+            faults.disarm()
+
+    def test_backpressure_shrinks_then_grows_prefill_cap(
+            self, model, tmp_path):
+        """Sustained full handoff queue (decode pool is the bottleneck)
+        shrinks the prefill slot budget; slack grows it back — both
+        moves stamped as pool_resize events."""
+        from tpudist import telemetry
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        module, params = model
+        telemetry.start(tmp_path)
+        try:
+            cfg = ServeConfig(num_slots=2, prefill_slots=4,
+                              prefill_workers=1, decode_workers=1,
+                              disagg=True, handoff="serial",
+                              decode_block=1, handoff_queue=1,
+                              pool_resize=4)
+            srv = DisaggServer(module, params, cfg,
+                               install_signal_handler=False).start()
+            hs = [srv.submit(_prompt(3 + i % 3, i), max_new=20)
+                  for i in range(5)]
+            _drain_handles(hs)
+            st = srv.stats()
+            assert st["pool_resizes"] >= 2  # at least one shrink + grow
+            assert all(h.finish_reason == "length" for h in hs)
+            # slack at drain end: the budget recovered
+            assert st["prefill_pool"]["slot_cap"] >= 2
+            assert srv.close(timeout=60)
+        finally:
+            telemetry.finish(write_report=False)
+        report = aggregate_run(tmp_path)
+        dirs = [e.get("direction") for e in report["events"]
+                if e["name"] == "pool_resize"]
+        assert "shrink" in dirs and "grow" in dirs
+        assert report["serving"]["pools"]["pool_resizes"] >= 2

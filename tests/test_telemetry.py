@@ -218,6 +218,55 @@ class TestAggregation:
         assert abs(total - report["wall_clock_s"]) <= \
             0.05 * report["wall_clock_s"]
 
+    def _session_start(self, gen, t, world, rank=0):
+        return {"kind": "event", "name": "session_start", "t": round(t, 6),
+                "dur": 0.0, "rank": rank, "gen": gen, "world": world}
+
+    def test_world_change_gap_is_resize_not_lost_restart(self, tmp_path):
+        """The elastic-relaunch attribution: a generation gap whose
+        world size CHANGED (session_start stamps) lands in the new
+        ``resize`` component; the merged report carries the
+        generation-stamped world sizes; components still sum exactly."""
+        # rank 0 survives the resize: gen0 at world 2, gen1 at world 1
+        end0 = self._write_gen(tmp_path, 0, 100.0, steps=10, rank=0,
+                               extra=[self._session_start(0, 100.0, 2)])
+        self._write_gen(tmp_path, 1, end0 + 2.0, steps=10, rank=0,
+                        extra=[self._session_start(1, end0 + 2.0, 1)])
+        # rank 1 died at the resize: gen0 only
+        self._write_gen(tmp_path, 0, 100.0, steps=10, rank=1,
+                        extra=[self._session_start(0, 100.0, 2, rank=1)])
+        rep = aggregate_run(tmp_path)
+        assert rep["world_sizes"] == {"0": 2, "1": 1}
+        assert abs(rep["goodput"]["resize"]["s"] - 1.0) < 1e-6  # 2s/2 ranks
+        assert rep["goodput"]["lost_restart"]["s"] == 0.0
+        total = sum(rep["goodput"][c]["s"] for c in COMPONENTS)
+        assert abs(total - rep["wall_clock_s"]) < 1e-6
+        md = render_markdown(rep)
+        assert "| resize |" in md or "resize" in md
+        assert "world size by generation" in md
+
+    def test_same_world_gap_stays_lost_restart(self, tmp_path):
+        """A fixed-size restart (same world either side of the gap) is
+        still lost_restart — resize only moves when the world does."""
+        end0 = self._write_gen(tmp_path, 0, 100.0, steps=10, rank=0,
+                               extra=[self._session_start(0, 100.0, 2)])
+        self._write_gen(tmp_path, 1, end0 + 2.0, steps=10, rank=0,
+                        extra=[self._session_start(1, end0 + 2.0, 2)])
+        rep = aggregate_run(tmp_path)
+        assert abs(rep["goodput"]["lost_restart"]["s"] - 2.0) < 1e-6
+        assert rep["goodput"]["resize"]["s"] == 0.0
+
+    def test_session_start_stamps_world_from_launch_contract(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUDIST_NUM_PROCESSES", "4")
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        assert s.world == 4
+        telemetry.finish(write_report=False)
+        recs = [json.loads(l) for l in
+                (tmp_path / "rank0_gen0.jsonl").read_text().splitlines()]
+        start = next(r for r in recs if r["name"] == "session_start")
+        assert start["world"] == 4
+
     def test_event_only_stream_excluded_from_goodput(self, tmp_path):
         self._write_gen(tmp_path, 0, 100.0, steps=10, rank=0)
         (tmp_path / "rank8_gen0.jsonl").write_text(json.dumps(

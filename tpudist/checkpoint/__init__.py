@@ -23,4 +23,5 @@ from tpudist.checkpoint.manager import (  # noqa: F401
     checkpoint_dir_for,
     resolve_checkpoint_location,
     setup_checkpointing,
+    sharding_meta,
 )

@@ -44,6 +44,68 @@ class CheckpointRestoreError(RuntimeError):
     """Every retained checkpoint step failed to restore."""
 
 
+# -- logical shardings (the reshard-on-restore contract) ---------------------
+
+def sharding_meta(states) -> dict:
+    """JSON-serializable record of a state pytree's LOGICAL shardings —
+    per-leaf ``PartitionSpec`` entries by axis *name* plus the mesh
+    geometry they were bound to.  Saved as a sidecar next to every
+    checkpoint step so :meth:`CheckpointManager.restore_resharded` can
+    re-bind the same logical layout onto ANY current mesh shape (a
+    surviving world after an elastic resize, a different dp×model split,
+    a single chip): names survive topology changes, device assignments
+    do not."""
+    leaves = jax.tree.leaves(states)
+    specs = []
+    mesh_info = None
+    for leaf in leaves:
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, jax.sharding.NamedSharding):
+            specs.append([list(e) if isinstance(e, tuple) else e
+                          for e in tuple(sh.spec)])
+            if mesh_info is None:
+                m = sh.mesh
+                mesh_info = {
+                    "axis_names": list(m.axis_names),
+                    "shape": [int(m.shape[a]) for a in m.axis_names],
+                }
+        else:
+            specs.append(None)
+    return {
+        "version": 1,
+        "mesh": mesh_info,
+        "specs": specs,
+        "world": {
+            "process_count": int(jax.process_count()),
+            "device_count": int(jax.device_count()),
+        },
+    }
+
+
+def _map_spec_onto_mesh(spec, shape, mesh) -> "jax.sharding.PartitionSpec":
+    """Re-bind one saved logical spec onto the CURRENT mesh: an axis name
+    survives iff the mesh has it AND the leaf dimension still divides its
+    (new) size; anything else drops to replicated for that dimension —
+    restoring slightly-less-sharded beats refusing to restore at all."""
+    from jax.sharding import PartitionSpec as P
+
+    if not spec:
+        return P()
+    entries = []
+    for dim, e in enumerate(spec):
+        names = [e] if isinstance(e, str) else list(e or [])
+        kept = [n for n in names if n in mesh.axis_names]
+        prod = 1
+        for n in kept:
+            prod *= int(mesh.shape[n])
+        if (not kept or prod <= 0
+                or dim >= len(shape) or shape[dim] % prod != 0):
+            entries.append(None)
+        else:
+            entries.append(kept[0] if len(kept) == 1 else tuple(kept))
+    return P(*entries)
+
+
 def checkpoint_dir_for(
     scratch_dir: Optional[str] = None, exp_name: Optional[str] = None
 ) -> Path:
@@ -149,6 +211,15 @@ class CheckpointManager:
                 " (error may be from an earlier async save)"
                 if self.config.async_save else ""),
         )
+        # Logical-sharding sidecar: the reshard-on-restore contract (the
+        # elastic world-size path).  Host-side JSON, atomic, best-effort
+        # — a failed sidecar degrades restore_resharded to abstract_like,
+        # never the save itself.
+        if jax.process_index() == 0:
+            try:
+                self._write_sharding_meta(step, sharding_meta(states))
+            except (OSError, TypeError, ValueError):
+                pass
         self._gc_meta_overlays()
         # Chaos harness: a due ckpt_corrupt fault garbles this step after
         # the (possibly async) write completes.  One None-check when unarmed.
@@ -183,16 +254,46 @@ class CheckpointManager:
             return {}  # torn write of the stamp: fall back to base meta
 
     def _gc_meta_overlays(self) -> None:
-        """Drop overlays whose step was retired by Orbax retention."""
+        """Drop overlays/sidecars whose step was retired by retention."""
         if jax.process_index() != 0:
             return
         live = set(self._mgr.all_steps())
-        for p in self._dir.glob("meta_overlay_*.json"):
-            try:
-                if int(p.stem.rsplit("_", 1)[1]) not in live:
-                    p.unlink(missing_ok=True)
-            except (ValueError, OSError):
-                pass
+        for pattern in ("meta_overlay_*.json", "sharding_meta_*.json"):
+            for p in self._dir.glob(pattern):
+                try:
+                    if int(p.stem.rsplit("_", 1)[1]) not in live:
+                        p.unlink(missing_ok=True)
+                except (ValueError, OSError):
+                    pass
+
+    # -- sharding sidecars (reshard-on-restore) -----------------------------
+
+    def _sharding_meta_path(self, step: int) -> Path:
+        return self._dir / f"sharding_meta_{step}.json"
+
+    def _write_sharding_meta(self, step: int, meta: dict) -> None:
+        import json
+
+        tmp = self._sharding_meta_path(step).with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, self._sharding_meta_path(step))
+
+    def saved_sharding_meta(self, step: Optional[int] = None
+                            ) -> Optional[dict]:
+        """The logical-sharding sidecar of ``step`` (default: latest), or
+        ``None`` when the step predates the sidecar contract / the write
+        failed — callers fall back to a caller-built layout."""
+        import json
+
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        p = self._sharding_meta_path(step)
+        try:
+            return dict(json.loads(p.read_text()))
+        except (OSError, ValueError):
+            return None
 
     # -- restore ------------------------------------------------------------
 
@@ -228,6 +329,50 @@ class CheckpointManager:
             )
         with telemetry.span("ckpt_restore", step=step):
             return self._restore(step, abstract_state, explicit)
+
+    def restore_resharded(
+        self, template: Any, *, mesh=None, step: Optional[int] = None
+    ) -> Tuple[Any, dict]:
+        """Restore onto the CURRENT topology: shapes/dtypes come from
+        ``template`` (a freshly initialized state pytree on this process's
+        mesh — same structure as the saved one), shardings come from the
+        step's logical-sharding sidecar re-bound to ``mesh``.  This is the
+        elastic-resume seam: a checkpoint saved at world ``n`` restores
+        bit-faithfully at ``n−1`` (or any other mesh shape) because the
+        sidecar records axis NAMES, and Orbax reshards the on-disk arrays
+        into whatever layout the abstract target requests.
+
+        - ``mesh``: the current :class:`jax.sharding.Mesh`.  Saved axis
+          names missing from it (or whose new size no longer divides the
+          leaf dimension) drop to replicated for that dimension.
+        - ``mesh=None`` or no sidecar (pre-contract checkpoint): falls
+          back to ``template``'s own shardings (:func:`abstract_like`).
+
+        Degraded-mode fallback (corrupt latest step) applies exactly as
+        in :meth:`restore` when ``step`` is ``None``.
+        """
+        saved = self.saved_sharding_meta(step)
+        if mesh is None or saved is None or not saved.get("specs"):
+            return self.restore(abstract_like(template), step=step)
+        leaves, treedef = jax.tree.flatten(template)
+        specs = saved["specs"]
+        if len(specs) != len(leaves):
+            raise CheckpointRestoreError(
+                f"sharding sidecar records {len(specs)} leaves but the "
+                f"restore template has {len(leaves)} — the saved state "
+                "and the template must share one pytree structure")
+        targets = []
+        for leaf, spec in zip(leaves, specs):
+            if isinstance(leaf, (jax.Array, jax.ShapeDtypeStruct)) or \
+                    hasattr(leaf, "shape"):
+                shape = tuple(getattr(leaf, "shape", ()))
+                sharding = jax.sharding.NamedSharding(
+                    mesh, _map_spec_onto_mesh(spec, shape, mesh))
+                targets.append(jax.ShapeDtypeStruct(
+                    shape, leaf.dtype, sharding=sharding))
+            else:
+                targets.append(leaf)
+        return self.restore(jax.tree.unflatten(treedef, targets), step=step)
 
     def _restore(self, step: int, abstract_state: Any,
                  explicit: bool) -> Tuple[Any, dict]:
@@ -410,17 +555,26 @@ def resolve_checkpoint_location(
 
 
 def setup_checkpointing(
-    states: Any, directory: str, *, save_every: int = 0, resume: bool = False
+    states: Any, directory: str, *, save_every: int = 0, resume: bool = False,
+    mesh=None,
 ) -> Tuple["CheckpointManager", Any, int]:
     """Build the manager over a resolved ``directory``; on resume, restore
     the latest step into the current states' layout.  Returns
-    ``(manager, states, start_iteration)``."""
+    ``(manager, states, start_iteration)``.
+
+    With ``mesh``, resume goes through :meth:`CheckpointManager.
+    restore_resharded` — the saved logical shardings re-bind onto the
+    CURRENT mesh, so a run relaunched at a different world size (elastic
+    ``tpurun``) resumes from a checkpoint written at the old one."""
     mgr = CheckpointManager(
         CheckpointConfig(directory=directory, save_every=save_every)
     )
     start = 0
     if resume and mgr.latest_step is not None:
-        states, meta = mgr.restore(abstract_like(states))
+        if mesh is not None:
+            states, meta = mgr.restore_resharded(states, mesh=mesh)
+        else:
+            states, meta = mgr.restore(abstract_like(states))
         start = int(meta.get("iteration", 0))
     return mgr, states, start
 

@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-restarts", type=int, default=3,
                    help="whole-group restarts on worker failure "
                         "(torchrun_launcher.sh:19 default)")
+    p.add_argument("--elastic", action="store_true",
+                   help="on restart exhaustion, relaunch the group at the "
+                        "SURVIVING world size instead of giving up: crash "
+                        "records identify the dead ranks, the remaining "
+                        "workers renumber 0..n'-1 with a fresh "
+                        "TPUDIST_NUM_PROCESSES, and the restart budget "
+                        "resets per world size (single-node agents only "
+                        "for now — the rank renumbering is node-local)")
     p.add_argument("--restart-backoff", type=float, default=5.0,
                    help="base seconds between restarts (doubles each retry)")
     p.add_argument("--stage-data", default=None,
@@ -167,6 +175,12 @@ def _read_crash_records(error_template: str, world: int) -> List[dict]:
 # loop, and tests all see one source of truth.
 _preempt_state: dict = {"flag": False, "procs": []}
 
+#: Ranks the LAST attempt observed failing spontaneously (nonzero exit
+#: before the agent terminated the rest of the group).  A SIGKILLed
+#: worker writes no crash record — this observation is what lets the
+#: elastic path name the dead ranks anyway.
+_last_failed_ranks: List[int] = []
+
 
 def _handle_agent_sigterm(signum, frame):  # noqa: ARG001
     """Agent-side preemption: mark, forward to workers, keep running.
@@ -199,12 +213,18 @@ def _terminate(procs: List[subprocess.Popen], grace_s: float = 10.0) -> None:
 
 def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
                  run_id: str, restart_count: int, error_template: str,
-                 tmpdir: str, telemetry_dir: Optional[str] = None) -> int:
-    """Launch the local worker group once; return 0 iff all workers exit 0."""
+                 tmpdir: str, telemetry_dir: Optional[str] = None,
+                 nprocs: Optional[int] = None) -> int:
+    """Launch the local worker group once; return 0 iff all workers exit 0.
+
+    ``nprocs`` overrides ``args.nprocs`` — the elastic path relaunches
+    with fewer local workers than the original request."""
+    if nprocs is None:
+        nprocs = args.nprocs
     procs: List[subprocess.Popen] = []
     _preempt_state["procs"] = procs
     base_env = dict(os.environ)
-    if args.nprocs > 1 and (
+    if nprocs > 1 and (
         os.path.exists("/dev/accel0") or base_env.get("TPU_NAME")
     ) and not any(k.startswith("TPU_") and "VISIBLE" in k for k in base_env):
         # The standard JAX shape on TPU hosts is ONE process per host that
@@ -213,21 +233,22 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
         # request (the operator may have set per-chip topology envs another
         # way) but say so.
         print(
-            f"[tpurun] warning: {args.nprocs} workers on a TPU host without "
+            f"[tpurun] warning: {nprocs} workers on a TPU host without "
             "per-process chip binding (TPU_VISIBLE_* env); TPU jobs normally "
             "run 1 process/host — see launch/README.md",
             file=sys.stderr,
         )
-    for i in range(args.nprocs):
-        rank = args.node_rank * args.nprocs + i
+    for i in range(nprocs):
+        rank = args.node_rank * nprocs + i
         env = _worker_env(base_env, coordinator=coordinator, world=world,
-                          rank=rank, local_rank=i, nprocs=args.nprocs,
+                          rank=rank, local_rank=i, nprocs=nprocs,
                           run_id=run_id, restart_count=restart_count,
                           error_template=error_template, tmpdir=tmpdir,
                           telemetry_dir=telemetry_dir,
                           devices_per_proc=args.devices_per_proc)
         procs.append(subprocess.Popen(cmd, env=env))
     failed_rc = 0
+    del _last_failed_ranks[:]
     try:
         live = list(procs)
         while live:
@@ -238,6 +259,11 @@ def _run_attempt(cmd: List[str], args, coordinator: str, world: int,
                 live.remove(p)
                 if rc != 0:
                     failed_rc = rc
+                    # the rank that died on its own — a SIGKILLed worker
+                    # leaves no crash record, so the agent's observation
+                    # is the elastic path's dead-rank source of truth
+                    _last_failed_ranks.append(
+                        args.node_rank * nprocs + procs.index(p))
                     if _preempt_state["flag"]:
                         # Preempting: a straggler may still be finishing
                         # the collective save — keep waiting, don't kill.
@@ -263,6 +289,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         raise SystemExit(
             f"tpurun: invalid topology nprocs={args.nprocs} nnodes={args.nnodes} "
             f"node_rank={args.node_rank}")
+
+    if args.elastic and args.nnodes != 1:
+        raise SystemExit(
+            "tpurun: --elastic currently requires --nnodes 1 (survivor "
+            "renumbering is node-local; multi-node elasticity needs a "
+            "cross-agent rendezvous)")
 
     world = args.nnodes * args.nprocs
     standalone = args.standalone or (args.nnodes == 1 and args.coordinator is None)
@@ -308,6 +340,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                          or (os.path.join("runs", "telemetry") if owns_tmpdir
                              else os.path.join(tmpdir, "telemetry")))
 
+    # The agent has no global telemetry session; staging phases and the
+    # restart_exhausted / world_resized lifecycle events record into ONE
+    # lazily-created agent stream (pseudo-rank = initial world +
+    # node_rank: past every worker rank AND distinct per node, so agents
+    # sharing a --telemetry-dir never clobber each other's stream).
+    # Event-only, so the aggregator never counts it as a goodput rank.
+    agent_tele: Dict[str, object] = {"session": None}
+    agent_rank = world + args.node_rank
+
+    def _agent_session():
+        if not telemetry_dir or agent_tele["session"] is not None:
+            return agent_tele["session"]
+        try:
+            from tpudist import telemetry as _tele
+
+            agent_tele["session"] = _tele.TelemetrySession(
+                telemetry_dir, rank=agent_rank, generation=0)
+        except Exception:  # noqa: BLE001 — telemetry never kills the run
+            pass
+        return agent_tele["session"]
+
     if args.stage_data:
         from tpudist.launch.staging import extract_tarballs
         from tpudist.utils.profiling import StageTimer
@@ -315,18 +368,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         stage_timer = StageTimer()
         with stage_timer.phase("stage_data"):
             extract_tarballs(args.stage_data.split(","), tmpdir)
-        if telemetry_dir:
-            # The agent has no global session; record the staging phase
-            # into its own stream (pseudo-rank = world + node_rank: past
-            # every worker rank AND distinct per node, so agents sharing
-            # a --telemetry-dir never clobber each other's stream).
-            from tpudist import telemetry as _tele
-
-            s = _tele.TelemetrySession(telemetry_dir,
-                                       rank=world + args.node_rank,
-                                       generation=0)
+        s = _agent_session()
+        if s is not None:
             stage_timer.emit(session=s)
-            s.close()
 
     # Preemption protocol: SLURM SIGTERMs the agent's process group; the
     # agent must survive it (forwarding to workers that missed the group
@@ -343,14 +387,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         prev_handler = signal.signal(signal.SIGTERM, _handle_agent_sigterm)
     try:
         max_attempts = args.max_restarts + 1
-        for attempt in range(max_attempts):
+        nprocs = args.nprocs
+        attempt_in_world = 0  # restarts consumed at the CURRENT world size
+        generation = 0        # TPUDIST_RESTART_COUNT across ALL launches,
+        #                       monotone through elastic resizes so every
+        #                       telemetry stream / crash record is distinct
+        while True:
             error_template = os.path.join(
-                error_dir, f"error_attempt{attempt}_rank%r.json")
-            if attempt > 0:
-                backoff = args.restart_backoff * (2 ** (attempt - 1))
-                print(f"[tpurun] restarting worker group "
-                      f"(attempt {attempt + 1}/{max_attempts}) in {backoff:.1f}s",
-                      file=sys.stderr)
+                error_dir, f"error_attempt{generation}_rank%r.json")
+            if generation > 0:
+                backoff = args.restart_backoff * (
+                    2 ** max(0, attempt_in_world - 1))
+                print(f"[tpurun] restarting worker group (attempt "
+                      f"{attempt_in_world + 1}/{max_attempts} at world "
+                      f"{world}) in {backoff:.1f}s", file=sys.stderr)
                 time.sleep(backoff)
                 if standalone and world > 1:
                     # Fresh rendezvous port: the dead service may linger in
@@ -364,9 +414,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("[tpurun] preemption signal during restart window; "
                       "not launching a new worker group", file=sys.stderr)
                 return 1
-            rc = _run_attempt(cmd, args, coordinator, world, run_id, attempt,
-                              error_template, tmpdir,
-                              telemetry_dir=telemetry_dir)
+            rc = _run_attempt(cmd, args, coordinator, world, run_id,
+                              generation, error_template, tmpdir,
+                              telemetry_dir=telemetry_dir, nprocs=nprocs)
             if rc == WATCHDOG_EXIT_CODE:
                 # The hang watchdog aborted a wedged worker on purpose so
                 # THIS restart loop could re-admit the group — say so (the
@@ -395,10 +445,65 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"[tpurun] worker group failed (exit {rc}); no crash "
                       f"record written (segfault or unhandled signal?)",
                       file=sys.stderr)
-        print(f"[tpurun] giving up after {max_attempts} attempts",
-              file=sys.stderr)
-        return 1
+            generation += 1
+            attempt_in_world += 1
+            if attempt_in_world < max_attempts:
+                continue
+            # Restart budget exhausted at this world size.  Stamp the
+            # event into the merged report (exhaustion used to be
+            # stderr-only — invisible to `tpudist.telemetry report`)...
+            # Dead ranks = the CULPRITS only: the timestamp-first crash
+            # record plus the agent's first observed spontaneous exit.
+            # Victims of the cascade (ranks whose collectives error and
+            # record before the agent's SIGTERM lands) must NOT count —
+            # over-shrinking throws away healthy workers, while
+            # under-shrinking is safe: a still-doomed smaller world just
+            # exhausts again and shrinks again.
+            dead = set(_last_failed_ranks)
+            if records and isinstance(records[0].get("process_id"), int):
+                dead.add(int(records[0]["process_id"]))
+            dead = sorted(dead)
+            first = records[0] if records else {}
+            s = _agent_session()
+            if s is not None:
+                s.event("restart_exhausted", attempts=attempt_in_world,
+                        world=world, dead_ranks=dead,
+                        exc_type=first.get("exc_type"),
+                        message=str(first.get("message", ""))[:200])
+                s.flush()
+            # ...then either give up (fixed-size semantics) or relaunch
+            # the group at the SURVIVING world size (--elastic): the
+            # crash records name the dead ranks, survivors renumber
+            # 0..n'-1, and the workers rebuild their mesh from the new
+            # TPUDIST_NUM_PROCESSES.  The trainer resumes through the
+            # reshardable-checkpoint path.
+            if args.elastic and world > 1:
+                new_world = max(1, world - max(1, len(dead)))
+                print(f"[tpurun] elastic: restart budget exhausted at "
+                      f"world {world}; relaunching at surviving world "
+                      f"{new_world} (dead ranks: {dead or 'unknown'})",
+                      file=sys.stderr)
+                if s is not None:
+                    s.event("world_resized", from_world=world,
+                            to_world=new_world, generation=generation,
+                            dead_ranks=dead)
+                    s.flush()
+                world = nprocs = new_world
+                attempt_in_world = 0
+                if standalone:
+                    coordinator = (f"127.0.0.1:{find_free_port()}"
+                                   if world > 1 else "")
+                continue
+            print(f"[tpurun] giving up after {attempt_in_world} attempts "
+                  f"at world {world}", file=sys.stderr)
+            return 1
     finally:
+        session = agent_tele["session"]
+        if session is not None:
+            try:
+                session.close()
+            except Exception:  # noqa: BLE001
+                pass
         if in_main_thread and prev_handler is not None:
             try:
                 signal.signal(signal.SIGTERM, prev_handler)
@@ -436,6 +541,7 @@ def _emit_telemetry_report(telemetry_dir: Optional[str]) -> None:
             f"data {g['data']['frac'] * 100:.0f}%, "
             f"ckpt {g['ckpt']['frac'] * 100:.0f}%, "
             f"idle {g['idle']['frac'] * 100:.0f}%, "
+            f"resize {g.get('resize', {}).get('frac', 0.0) * 100:.0f}%, "
             f"lost-to-restart {g['lost_restart']['frac'] * 100:.0f}%",
             file=sys.stderr,
         )

@@ -14,6 +14,11 @@ changes::
     TPUDIST_FAULT=host_delay@ms:500         # stall every host collective 500ms
     TPUDIST_FAULT=init_fail@attempts:2      # fail the first 2 init attempts
     TPUDIST_FAULT=ckpt_corrupt@step:16;kill@step:19   # compose with ';'
+    TPUDIST_FAULT=serve_worker_kill@call:8,pool:1,worker:0
+                                            # kill decode worker 0 at its
+                                            # 8th engine call (disagg loop)
+    TPUDIST_FAULT=handoff_corrupt@nth:2     # garble the 2nd serialized
+                                            # KV-handoff package in flight
 
 Grammar: ``kind@key:int[,key:int][;kind@...]``.  Common keys: ``rank``
 restricts the fault to one process (default: all); ``attempt`` fires only
@@ -44,6 +49,12 @@ _SCHEMA: Dict[str, tuple] = {
     "ckpt_corrupt": ({"step"}, {"step", "rank", "attempt"}),
     "host_delay": ({"ms"}, {"ms", "rank"}),
     "init_fail": ({"attempts"}, {"attempts", "rank"}),
+    # serve-side chaos (tpudist.serve.disagg): kill a pool worker at its
+    # Nth engine call (pool: 0=prefill, 1=decode [default]; worker
+    # default 0), or garble the Nth serialized KV-handoff package —
+    # recovery drives through the SAME grammar as the training faults.
+    "serve_worker_kill": ({"call"}, {"call", "pool", "worker", "rank"}),
+    "handoff_corrupt": ({"nth"}, {"nth", "rank"}),
 }
 
 
@@ -61,6 +72,10 @@ class FaultSpec:
     kind: str
     params: Dict[str, int]
     fired: int = 0
+    #: events observed by a counting injection point (e.g. serialized
+    #: handoff packages seen by ``handoff_corrupt``) — distinct from
+    #: ``fired`` so "the Nth occurrence" gating composes with fire-once.
+    seen: int = 0
 
     def param(self, key: str, default: Optional[int] = None) -> Optional[int]:
         return self.params.get(key, default)
@@ -267,6 +282,59 @@ def inject_ckpt_save(step: int, step_dir: os.PathLike,
 
             telemetry.event("fault_injected", fault="ckpt_corrupt",
                             step=step, files=n)
+            return True
+    return False
+
+
+def inject_serve_worker(pool: int, worker: int, ncalls: int) -> bool:
+    """Disagg-loop injection point, consulted before every engine
+    interaction of pool worker ``(pool, worker)`` (``pool``: 0=prefill,
+    1=decode; ``ncalls`` = that worker's cumulative engine-call count).
+    Returns True when a due ``serve_worker_kill`` says THIS call must
+    die — the serving loop raises in response, driving the SAME
+    worker-lost recovery path a real engine failure would."""
+    if _PLAN is None:
+        return False
+    for spec in _PLAN:
+        if (spec.kind == "serve_worker_kill" and spec.fired == 0
+                and spec.param("pool", 1) == pool
+                and spec.param("worker", 0) == worker
+                and ncalls >= spec.params["call"]
+                and _rank_matches(spec)):
+            spec.fired += 1
+            _log(f"injecting serve worker kill: pool "
+                 f"{'decode' if pool else 'prefill'} worker {worker} at "
+                 f"engine call {ncalls}")
+            return True
+    return False
+
+
+def inject_handoff(ser: dict) -> bool:
+    """Handoff-transport injection point: a due ``handoff_corrupt``
+    garbles the ``nth`` serialized KV package in place (first blob
+    leaf's leading bytes flipped — the integrity digest then rejects it
+    at deserialize, the detectable-wire-corruption scenario).  Returns
+    whether it fired."""
+    if _PLAN is None:
+        return False
+    for spec in _PLAN:
+        if (spec.kind == "handoff_corrupt" and spec.fired == 0
+                and _rank_matches(spec)):
+            spec.seen += 1
+            if spec.seen < spec.params["nth"]:
+                continue
+            blob = ser.get("blob")
+            if not blob:
+                continue
+            b, dt, shape = blob[0]
+            blob[0] = (bytes(x ^ 0xFF for x in b[:8]) + b[8:], dt, shape)
+            spec.fired += 1
+            _log(f"corrupted handoff package #{spec.seen} "
+                 f"({len(b)} B leaf garbled)")
+            from tpudist import telemetry
+
+            telemetry.event("fault_injected", fault="handoff_corrupt",
+                            nth=spec.seen)
             return True
     return False
 

@@ -34,10 +34,39 @@ re-quantize bit-exactly on import; tests pin it).
 Thread contract mirrors :class:`tpudist.serve.server.InferenceServer`:
 one engine thread drives every engine in both pools (the device
 programs serialize anyway on one host), any number of threads submit,
-SIGTERM/``close()`` drain everything admitted.  If a pool worker dies
-(any engine-loop exception), the loop aborts every outstanding request
-with reason ``"shutdown"`` — the same no-stranded-waiters contract as
-the single-pool server; requests never hang on a dead pool.
+SIGTERM/``close()`` drain everything admitted.
+
+**Self-healing fleet** (``ServeConfig.recover``, default on): a pool
+worker that dies mid-flight (any exception out of its engine calls —
+injected via ``TPUDIST_FAULT=serve_worker_kill@...`` or real) no longer
+takes the server down.  The loop marks the worker dead (``worker_lost``
+telemetry), and every lane it was hosting continues on survivors:
+
+- a **decode** lane replays its stashed handoff package on a surviving
+  decode worker.  Decode is a pure function of ``(state, cache)`` and
+  the per-slot ``fold_in(key, count)`` sampling stream — both ride IN
+  the package — so re-importing and re-decoding reproduces the exact
+  token sequence, greedy or sampled; the tokens the dead worker already
+  delivered are dropped on re-emission (the replay-skip counter) and
+  the stream continues BYTE-IDENTICALLY from the first new token
+  (``lane_recovered`` telemetry);
+- a **prefill** lane (no KV exported yet) requeues at the head of the
+  admission line and re-prefills its prompt on a surviving prefill
+  worker (same skip rule for a token 0 that was already delivered).
+
+Only when a pool has NO survivors do its lanes finish, with reason
+``"worker_lost"`` (never a silent hang).  The stashed packages cost one
+extra copy of each in-flight decode lane's KV; ``recover=False``
+restores the PR-7 behavior (any worker death aborts everything as
+``"shutdown"``).
+
+**Backpressure pool resize** (``ServeConfig.pool_resize`` iterations,
+0 = off): a handoff queue that stays full for that many consecutive
+loop iterations means the decode pool is the bottleneck — the prefill
+pool's effective slot budget shrinks by one (admission backpressure
+moves to the queue instead of piling KV into stalled prefill slots),
+and grows back once the queue stays at most half full for as long
+(``pool_resize`` telemetry events carry each move).
 """
 
 from __future__ import annotations
@@ -51,6 +80,38 @@ from tpudist.serve.engine import SlotEngine
 from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
 
 _IDLE_WAIT_S = 0.01
+
+#: Wire-format version of a serialized KV-handoff package.  Bumped
+#: whenever the blob layout changes; :func:`deserialize_package` REJECTS
+#: a missing or mismatched version with a clear error instead of
+#: shape-crashing mid-import (mixed tpudist versions across pools, or a
+#: replayed package from an old run).  v2 added the schema field itself
+#: plus the blob integrity digest.
+HANDOFF_SCHEMA_VERSION = 2
+
+
+class HandoffError(RuntimeError):
+    """A serialized handoff package this pool must not import: wrong or
+    missing ``schema_version`` (``reason="schema"``) or failed blob
+    integrity check (``reason="corrupt"``).  The serving loop finishes
+    the affected request with reason ``"handoff_corrupt"`` and keeps
+    serving everyone else."""
+
+    def __init__(self, msg: str, reason: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
+def _blob_digest(blob) -> str:
+    """blake2b over every blob leaf — wire-corruption detection (a
+    flipped byte in a KV lane would otherwise deserialize silently into
+    garbage attention)."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for b, _, _ in blob:
+        h.update(b)
+    return h.hexdigest()
 
 
 def _np_dtype(name: str):
@@ -83,17 +144,50 @@ def serialize_package(pkg: dict) -> dict:
     for leaf in flat:
         a = np.asarray(leaf)
         blob.append((a.tobytes(), a.dtype.name, a.shape))
-    return {"paged": pkg["paged"], "pos": pkg["pos"],
-            "counts": pkg["counts"], "budget": pkg["budget"],
-            "blob": blob, "tree": tree,
-            "bytes": sum(len(b) for b, _, _ in blob)}
+    ser = {"schema_version": HANDOFF_SCHEMA_VERSION,
+           "paged": pkg["paged"], "pos": pkg["pos"],
+           "counts": pkg["counts"], "budget": pkg["budget"],
+           "blob": blob, "tree": tree,
+           "digest": _blob_digest(blob),
+           "bytes": sum(len(b) for b, _, _ in blob)}
+    # chaos harness: a due handoff_corrupt fault garbles the package
+    # AFTER the digest is stamped — detectable wire corruption.  One
+    # None-check when disarmed.
+    from tpudist.runtime import faults
+
+    faults.inject_handoff(ser)
+    return ser
+
+
+def check_package_schema(ser: dict) -> None:
+    """Raise :class:`HandoffError` unless ``ser`` carries the expected
+    ``schema_version`` — the cheap envelope check a full decode pool
+    runs per blocked iteration (no blob work)."""
+    ver = ser.get("schema_version")
+    if ver != HANDOFF_SCHEMA_VERSION:
+        raise HandoffError(
+            f"handoff package schema_version {ver!r} != expected "
+            f"{HANDOFF_SCHEMA_VERSION} (missing = pre-versioning sender; "
+            "mismatched = mixed tpudist versions across pools) — "
+            "rejected instead of shape-crashing mid-import",
+            reason="schema")
 
 
 def deserialize_package(ser: dict) -> dict:
-    """Inverse of :func:`serialize_package` (byte-preserving)."""
+    """Inverse of :func:`serialize_package` (byte-preserving).  Rejects
+    a missing/mismatched ``schema_version`` and any blob whose integrity
+    digest no longer matches (:class:`HandoffError`)."""
     import jax
     import numpy as np
 
+    check_package_schema(ser)
+    digest = ser.get("digest")
+    if digest is not None and _blob_digest(ser["blob"]) != digest:
+        raise HandoffError(
+            "handoff package failed its integrity check (blob digest "
+            "mismatch) — corrupted in transit; the request is finished "
+            "with a reason instead of decoding garbage KV",
+            reason="corrupt")
     flat = [np.frombuffer(b, dtype=_np_dtype(d)).reshape(s)
             for b, d, s in ser["blob"]]
     lane, state = jax.tree.unflatten(ser["tree"], flat)
@@ -179,6 +273,37 @@ class DisaggServer:
         self.tokens_out = 0
         self.handoffs = 0
         self.handoff_bytes = 0
+        # -- self-healing fleet state (module doc: recovery contract) ------
+        self.recover = bool(getattr(cfg, "recover", True))
+        #: dead worker indices per pool — skipped by every loop phase
+        self._dead: Dict[str, set] = {"prefill": set(), "decode": set()}
+        #: (decode worker, slot) → (handoff package AS QUEUED, tokens the
+        #: handle had at import time) — the replay stash a dead decode
+        #: worker's lanes recover from.  Costs one extra copy of each
+        #: in-flight lane's KV; dropped the moment the lane finishes.
+        self._import_pkg: Dict[Tuple[int, int], Tuple[dict, int]] = {}
+        #: handle.id → tokens to DROP on re-emission (a recovered lane
+        #: re-decodes what the dead worker already delivered; presence in
+        #: this dict marks the handle as in-recovery)
+        self._skip: Dict[int, int] = {}
+        #: prefill-replay line: lanes whose prefill worker died re-prefill
+        #: from the prompt, ahead of fresh admissions
+        self._requeue: "collections.deque[RequestHandle]" = \
+            collections.deque()
+        #: cumulative engine-call counter per (pool, worker) — the
+        #: serve_worker_kill fault injection clock
+        self._calls: Dict[Tuple[str, int], int] = {}
+        self.workers_lost = 0
+        self.lanes_recovered = 0
+        # -- backpressure-driven pool resize -------------------------------
+        self.pool_resize = max(0, int(getattr(cfg, "pool_resize", 0)))
+        self._prefill_slots_total = p_slots * max(1, cfg.prefill_workers)
+        #: effective prefill slot budget (across the pool) — shrinks
+        #: under sustained handoff-queue backpressure, grows back on slack
+        self._prefill_cap = self._prefill_slots_total
+        self._bp_full = 0
+        self._bp_free = 0
+        self.pool_resizes = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -186,8 +311,12 @@ class DisaggServer:
         if self._thread is not None:
             raise RuntimeError("server already started")
         from tpudist import telemetry
-        from tpudist.runtime import preemption
+        from tpudist.runtime import faults, preemption
 
+        # chaos harness: TPUDIST_FAULT's serve-side kinds
+        # (serve_worker_kill / handoff_corrupt) arm with zero code
+        # changes, exactly like the training loops arm at their entry
+        faults.arm_from_env()
         telemetry.ensure_started()
         telemetry.event(
             "serve_disagg_config",
@@ -268,14 +397,22 @@ class DisaggServer:
             "handoffs": self.handoffs,
             "handoff_bytes": self.handoff_bytes,
             "handoff_queued": len(self._handoff),
+            # fleet-recovery gauges (module doc)
+            "workers_lost": self.workers_lost,
+            "lanes_recovered": self.lanes_recovered,
+            "requeued": len(self._requeue),
+            "pool_resizes": self.pool_resizes,
             "prefill_pool": {
                 "workers": len(self.prefill_pool),
+                "dead": sorted(self._dead["prefill"]),
                 "slots": self.prefill_pool[0].num_slots,
+                "slot_cap": self._prefill_cap,
                 "occupied": sum(e.num_occupied for e in self.prefill_pool),
                 "compile_counts": self.prefill_pool[0].compile_counts(),
             },
             "decode_pool": {
                 "workers": len(self.decode_pool),
+                "dead": sorted(self._dead["decode"]),
                 "slots": self.decode_pool[0].num_slots,
                 "active": sum(e.num_active for e in self.decode_pool),
                 "compile_counts": self.decode_pool[0].compile_counts(),
@@ -304,10 +441,115 @@ class DisaggServer:
             h, _ = self._handoff.popleft()
             h._finish("shutdown")
             self._note_finished(h)
+        while self._requeue:
+            h = self._requeue.popleft()
+            h._finish("shutdown")
+            self._note_finished(h)
         for h in self.scheduler.take(1 << 30):
             if not h.done:
                 h._finish("shutdown")
             self._note_finished(h)
+
+    # -- worker-loss recovery ----------------------------------------------
+
+    def _alive(self, pool: str) -> List[int]:
+        pools = (self.prefill_pool if pool == "prefill"
+                 else self.decode_pool)
+        return [i for i in range(len(pools)) if i not in self._dead[pool]]
+
+    def _tick(self, pool: str, w: int) -> None:
+        """Count one engine interaction of ``(pool, worker)`` and let a
+        due ``serve_worker_kill`` fault turn it into a death (raises —
+        the caller's worker-lost handler takes it from there, the same
+        path a real engine failure drives)."""
+        from tpudist.runtime import faults
+
+        key = (pool, w)
+        self._calls[key] = n = self._calls.get(key, 0) + 1
+        if faults.inject_serve_worker(0 if pool == "prefill" else 1, w, n):
+            raise RuntimeError(
+                f"injected serve_worker_kill: {pool} worker {w}")
+
+    def _lose_worker(self, pool: str, w: int, exc: BaseException) -> None:
+        """A pool worker's engine died mid-flight.  With recovery on:
+        mark it dead, re-route every lane it hosted onto survivors —
+        decode lanes replay their stashed handoff package (re-decode is
+        byte-identical; already-delivered tokens drop via the replay-skip
+        counter), prefill lanes requeue for a fresh prefill.  A pool with
+        no survivors finishes its lanes as ``"worker_lost"``.  With
+        ``recover=False`` the exception propagates and the loop aborts
+        everything as ``"shutdown"`` (the PR-7 contract)."""
+        if not self.recover:
+            raise exc
+        if w in self._dead[pool]:
+            return
+        from tpudist import telemetry
+
+        self._dead[pool].add(w)
+        self.workers_lost += 1
+        keys = [k for k in self._slot_handles
+                if k[0] == pool and k[1] == w]
+        telemetry.event("worker_lost", pool=pool, worker=w,
+                        error=repr(exc)[:200], lanes=len(keys))
+        survivors = bool(self._alive(pool))
+        for key in keys:
+            _, _, slot = key
+            h = self._slot_handles.pop(key)
+            if pool == "decode":
+                stash = self._import_pkg.pop((w, slot), None)
+                if survivors and stash is not None:
+                    pkg, l0 = stash
+                    # everything the dead worker emitted since import
+                    # re-emits on replay — drop exactly that many
+                    self._skip[h.id] = max(0, len(h.tokens) - l0)
+                    self._handoff.appendleft((h, pkg))
+                    continue
+            else:
+                if survivors:
+                    # nothing exported yet: re-prefill the prompt on a
+                    # surviving worker (ahead of fresh admissions); a
+                    # token 0 that was already delivered skips once
+                    self._skip[h.id] = len(h.tokens)
+                    self._requeue.append(h)
+                    continue
+            h._finish("worker_lost")
+            self._note_finished(h)
+        if not survivors:
+            self._pool_collapsed(pool)
+
+    def _pool_collapsed(self, pool: str) -> None:
+        """A pool lost its LAST worker: nothing that depends on it can
+        ever complete — finish the dependents loudly (``worker_lost``,
+        never a hang) and refuse new admissions with the same reason.
+        The serve path needs both pools, so either collapse is terminal
+        for new work; already-decoding lanes on the OTHER pool still
+        finish normally."""
+        if pool == "decode":
+            while self._handoff:
+                h, _ = self._handoff.popleft()
+                h._finish("worker_lost")
+                self._note_finished(h)
+        else:
+            while self._requeue:
+                h = self._requeue.popleft()
+                h._finish("worker_lost")
+                self._note_finished(h)
+        self.scheduler.refuse_new("worker_lost")
+        for h in self.scheduler.take(1 << 30):
+            if not h.done:
+                h._finish("worker_lost")
+            self._note_finished(h)
+
+    def _reject_package(self, h: RequestHandle, e: "HandoffError") -> None:
+        """A handoff package this pool must not import (schema mismatch
+        or wire corruption): finish ITS request with a reason and keep
+        serving everyone else."""
+        from tpudist import telemetry
+
+        telemetry.event("handoff_rejected", reason=e.reason,
+                        error=str(e)[:200])
+        h._finish("handoff_corrupt")
+        self._note_finished(h)
 
     def _loop(self) -> None:
         from tpudist import telemetry
@@ -324,7 +566,7 @@ class DisaggServer:
 
     def _outstanding(self) -> int:
         return (self.scheduler.pending() + len(self._slot_handles)
-                + len(self._handoff))
+                + len(self._handoff) + len(self._requeue))
 
     def _run_loop(self) -> None:
         from tpudist import telemetry
@@ -350,6 +592,15 @@ class DisaggServer:
                 else:
                     kept.append((h, pkg))
             self._handoff = kept
+            kept_rq: "collections.deque[RequestHandle]" = collections.deque()
+            while self._requeue:
+                h = self._requeue.popleft()
+                if h._expired(now):
+                    h._finish("deadline")
+                    self._note_finished(h)
+                else:
+                    kept_rq.append(h)
+            self._requeue = kept_rq
             for h in sched.expire_queued(now):
                 self._note_finished(h)
             did_work = False
@@ -357,10 +608,12 @@ class DisaggServer:
             did_work |= self._advance_prefill()
             did_work |= self._place_handoffs()
             did_work |= self._decode()
+            if self.pool_resize:
+                self._pool_resize_tick()
             if self._draining and self._outstanding() == 0:
                 break
             if not did_work:
-                if sched.pending() or self._handoff:
+                if sched.pending() or self._handoff or self._requeue:
                     # gate-blocked (pool/slots full): nothing frees until
                     # a later iteration — don't spin the engine thread
                     time.sleep(_IDLE_WAIT_S)
@@ -369,12 +622,53 @@ class DisaggServer:
 
     # -- prefill pool -------------------------------------------------------
 
+    def _pool_resize_tick(self) -> None:
+        """Backpressure-driven prefill slot budget (module doc): a
+        handoff queue pinned at its limit for ``pool_resize`` consecutive
+        iterations shrinks the effective prefill slot budget by one
+        (admission backpressure instead of KV piling up in stalled
+        prefill slots); sustained slack (queue at most half full) grows
+        it back."""
+        from tpudist import telemetry
+
+        q = len(self._handoff)
+        if q >= self.handoff_limit:
+            self._bp_full += 1
+            self._bp_free = 0
+            if self._bp_full >= self.pool_resize and self._prefill_cap > 1:
+                self._prefill_cap -= 1
+                self.pool_resizes += 1
+                self._bp_full = 0
+                telemetry.event("pool_resize", pool="prefill",
+                                direction="shrink", cap=self._prefill_cap,
+                                queued=q)
+        elif q * 2 <= self.handoff_limit:
+            self._bp_free += 1
+            self._bp_full = 0
+            if (self._bp_free >= self.pool_resize
+                    and self._prefill_cap < self._prefill_slots_total):
+                self._prefill_cap += 1
+                self.pool_resizes += 1
+                self._bp_free = 0
+                telemetry.event("pool_resize", pool="prefill",
+                                direction="grow", cap=self._prefill_cap,
+                                queued=q)
+        else:
+            self._bp_full = 0
+            self._bp_free = 0
+
     def _admit_prefill(self, now: float) -> bool:
         from tpudist import telemetry
 
         worked = False
-        for w, eng in enumerate(self.prefill_pool):
+        for w in self._alive("prefill"):
+            eng = self.prefill_pool[w]
             free = eng.free_slots()
+            # backpressure resize: cap the POOL-WIDE occupied prefill
+            # slots at the current effective budget
+            occupied = sum(self.prefill_pool[i].num_occupied
+                           for i in self._alive("prefill"))
+            free = free[:max(0, self._prefill_cap - occupied)]
             if not free:
                 continue
             reserved, pinned = [0], []
@@ -393,7 +687,23 @@ class DisaggServer:
                 _pinned.extend(got[1])
                 return True
 
-            batch = self.scheduler.take(len(free), now, admit=_gate)
+            # worker-lost replays re-prefill FIRST, ahead of fresh
+            # admissions (their requests were admitted long ago)
+            batch: List[RequestHandle] = []
+            replay_blocked = False
+            while self._requeue and len(batch) < len(free):
+                if not _gate(self._requeue[0]):
+                    # head-of-line, like the scheduler queue — and this
+                    # WORKER takes no fresh admissions while its gate
+                    # blocks the replay head, or steady small-request
+                    # traffic would starve the recovered lane out of the
+                    # very blocks it is waiting for
+                    replay_blocked = True
+                    break
+                batch.append(self._requeue.popleft())
+            if len(batch) < len(free) and not replay_blocked:
+                batch += self.scheduler.take(
+                    len(free) - len(batch), now, admit=_gate)
             alive = []
             for h in batch:
                 if h.done:
@@ -406,14 +716,21 @@ class DisaggServer:
             items, t0 = [], time.monotonic()
             for h, slot in zip(alive, free):
                 h.slot = slot
-                h.t_admitted = t0
+                if h.t_admitted is None:
+                    h.t_admitted = t0
                 items.append((slot, h.request.prompt, h.request.temperature,
                               h.request.seed, h.request.max_new,
                               h.request.prefix_hashes))
                 self._slot_handles[("prefill", w, slot)] = h
-            with telemetry.span("prefill", n=len(items), pool="prefill",
-                                worker=w):
-                firsts = eng.start_batch(items)
+            try:
+                self._tick("prefill", w)
+                with telemetry.span("prefill", n=len(items), pool="prefill",
+                                    worker=w):
+                    firsts = eng.start_batch(items)
+            except Exception as e:  # worker died admitting: the lanes
+                # just registered recover through the standard path
+                self._lose_worker("prefill", w, e)
+                continue
             for slot, tok in firsts.items():
                 if tok is not None:
                     self._prefill_complete(w, slot, tok)
@@ -423,14 +740,20 @@ class DisaggServer:
         from tpudist import telemetry
 
         worked = False
-        for w, eng in enumerate(self.prefill_pool):
+        for w in self._alive("prefill"):
+            eng = self.prefill_pool[w]
             if not eng.prefilling_slots():
                 continue
             worked = True
-            with telemetry.span("prefill",
-                                chunks=len(eng.prefilling_slots()),
-                                pool="prefill", worker=w):
-                done = eng.advance_prefill()
+            try:
+                self._tick("prefill", w)
+                with telemetry.span("prefill",
+                                    chunks=len(eng.prefilling_slots()),
+                                    pool="prefill", worker=w):
+                    done = eng.advance_prefill()
+            except Exception as e:
+                self._lose_worker("prefill", w, e)
+                continue
             for slot, tok in done.items():
                 self._prefill_complete(w, slot, tok)
         return worked
@@ -438,19 +761,50 @@ class DisaggServer:
     def _prefill_complete(self, w: int, slot: int, tok: int) -> None:
         """A prompt finished in prefill worker ``w``: deliver token 0
         (TTFT stamps here — in the prefill pool), then either finish
-        (budget of 1) or export the lane for the decode pool."""
+        (budget of 1) or export the lane for the decode pool.  A
+        recovered lane (re-prefilled after its worker died) skips the
+        re-emission of a token 0 it already delivered."""
+        from tpudist import telemetry
+
         key = ("prefill", w, slot)
-        h = self._slot_handles[key]
+        h = self._slot_handles.get(key)
+        if h is None:
+            # the worker died under an EARLIER completion of this same
+            # batch (_export -> _lose_worker popped every lane it
+            # hosted, this one included — it is already requeued/aborted)
+            return
         h.t_prefill_done = time.monotonic()
         eos = h.request.eos_id
-        h._deliver(tok)
-        self.tokens_out += 1
         eng = self.prefill_pool[w]
-        if (eos is not None and tok == eos) \
-                or len(h.tokens) >= h.request.max_new:
+        if h.id in self._skip:
+            # prefill replay complete: the lane is whole again
+            replayed = self._skip.pop(h.id)
+            self.lanes_recovered += 1
+            telemetry.event("lane_recovered", pool="prefill", worker=w,
+                            slot=slot, replayed=replayed)
+            if replayed > 0:
+                # token 0 was already delivered by the lost worker —
+                # the re-emission is a duplicate, drop it (its finish
+                # checks ran at original delivery and did not fire,
+                # else the lane would never have been requeued)
+                tok = None
+        if tok is not None:
+            h._deliver(tok)
+            self.tokens_out += 1
+            if (eos is not None and tok == eos) \
+                    or len(h.tokens) >= h.request.max_new:
+                del self._slot_handles[key]
+                eng.evict(slot)
+                h._finish("eos" if eos is not None and tok == eos
+                          else "length")
+                self._note_finished(h)
+                return
+        if not self._alive("decode"):
+            # decode pool collapsed: the remaining budget can never be
+            # served — finish loudly instead of queueing forever
             del self._slot_handles[key]
             eng.evict(slot)
-            h._finish("eos" if eos is not None and tok == eos else "length")
+            h._finish("worker_lost")
             self._note_finished(h)
             return
         if len(self._handoff) >= self.handoff_limit:
@@ -462,12 +816,20 @@ class DisaggServer:
 
     def _export(self, w: int, slot: int, h: RequestHandle) -> None:
         eng = self.prefill_pool[w]
-        pkg = eng.export_slot(slot)
-        if self.handoff_mode == "serial":
-            ser = serialize_package(pkg)
-            self.handoff_bytes += ser["bytes"]
-            pkg = ser
-        eng.evict(slot)
+        try:
+            self._tick("prefill", w)
+            pkg = eng.export_slot(slot)
+            if self.handoff_mode == "serial":
+                ser = serialize_package(pkg)
+                self.handoff_bytes += ser["bytes"]
+                pkg = ser
+            eng.evict(slot)
+        except Exception as e:
+            # the worker died exporting: the lane is still registered
+            # under this key — standard recovery (full re-prefill on a
+            # survivor; the already-delivered token 0 skips once)
+            self._lose_worker("prefill", w, e)
+            return
         del self._slot_handles[("prefill", w, slot)]
         self._handoff.append((h, pkg))
         self.handoffs += 1
@@ -476,11 +838,14 @@ class DisaggServer:
         """Prefill slots whose export stalled on a full handoff queue
         (decoding=True but still in the prefill pool) retry here."""
         worked = False
-        for w, eng in enumerate(self.prefill_pool):
+        for w in self._alive("prefill"):
+            eng = self.prefill_pool[w]
             for slot in list(range(eng.num_slots)):
                 key = ("prefill", w, slot)
                 if (eng.decoding[slot] and key in self._slot_handles
                         and len(self._handoff) < self.handoff_limit):
+                    if not self._alive("decode"):
+                        break
                     self._export(w, slot, self._slot_handles[key])
                     worked = True
         return worked
@@ -494,8 +859,20 @@ class DisaggServer:
         worked = False
         while self._handoff:
             h, pkg = self._handoff[0]
+            if self.handoff_mode == "serial":
+                # cheap envelope check first: a schema-mismatched package
+                # must not wedge the queue head (or crash can_import on
+                # missing fields) — finish ITS request, keep serving
+                try:
+                    check_package_schema(pkg)
+                except HandoffError as e:
+                    self._handoff.popleft()
+                    self._reject_package(h, e)
+                    worked = True
+                    continue
             placed = False
-            for w, eng in enumerate(self.decode_pool):
+            for w in self._alive("decode"):
+                eng = self.decode_pool[w]
                 free = eng.free_slots()
                 # gate on the serialized dict directly (pos/budget/paged
                 # are top-level fields either way) — a full decode pool
@@ -504,11 +881,29 @@ class DisaggServer:
                 if not free or not eng.can_import(pkg):
                     continue
                 self._handoff.popleft()
-                raw = (deserialize_package(pkg)
-                       if self.handoff_mode == "serial" else pkg)
+                if self.handoff_mode == "serial":
+                    try:
+                        raw = deserialize_package(pkg)
+                    except HandoffError as e:
+                        # wire corruption (digest mismatch): this lane's
+                        # KV is gone — a reason, not garbage attention
+                        self._reject_package(h, e)
+                        placed = worked = True
+                        break
+                else:
+                    raw = pkg
                 slot = free[0]
                 t0 = time.monotonic()
-                eng.import_slot(slot, raw, spec=h.request.spec)
+                try:
+                    self._tick("decode", w)
+                    eng.import_slot(slot, raw, spec=h.request.spec)
+                except Exception as e:
+                    # the worker died importing: the package is intact —
+                    # back to the queue head, a survivor takes it
+                    self._handoff.appendleft((h, pkg))
+                    self._lose_worker("decode", w, e)
+                    placed = worked = True
+                    break
                 h.t_decode_start = time.monotonic()
                 h.slot = slot
                 telemetry.event(
@@ -517,6 +912,24 @@ class DisaggServer:
                     wait_s=round(h.handoff_wait_s or 0.0, 6),
                     import_s=round(h.t_decode_start - t0, 6))
                 self._slot_handles[("decode", w, slot)] = h
+                # replay stash: what a dead worker's lanes recover from.
+                # A RECOVERY placement still owes _skip duplicates, so
+                # the package-equivalent delivered count is len(tokens)
+                # MINUS the pending skip — stashing raw len would make a
+                # SECOND loss of this lane under-skip and re-deliver
+                # already-streamed tokens
+                self._import_pkg[(w, slot)] = (
+                    pkg, len(h.tokens) - self._skip.get(h.id, 0))
+                if h.id in self._skip:
+                    # this IS a recovery placement — the lane continues
+                    # byte-identically (re-decoded tokens up to the
+                    # skip count drop as duplicates)
+                    self.lanes_recovered += 1
+                    telemetry.event("lane_recovered", pool="decode",
+                                    worker=w, slot=slot,
+                                    replayed=self._skip[h.id])
+                    if self._skip[h.id] == 0:
+                        del self._skip[h.id]
                 placed = worked = True
                 break
             if not placed:
@@ -529,7 +942,8 @@ class DisaggServer:
         from tpudist import telemetry
 
         worked = False
-        for w, eng in enumerate(self.decode_pool):
+        for w in self._alive("decode"):
+            eng = self.decode_pool[w]
             for slot in eng.cache_full_slots():
                 if ("decode", w, slot) in self._slot_handles:
                     self._finish_key(("decode", w, slot), "cache_full")
@@ -539,7 +953,15 @@ class DisaggServer:
             occ = eng.occupancy
             tele = telemetry.active()
             t0 = time.monotonic()
-            info, blocks = eng.decode_auto()
+            try:
+                self._tick("decode", w)
+                info, blocks = eng.decode_auto()
+            except Exception as e:
+                # the worker died mid-decode: its lanes replay their
+                # stashed packages on survivors (byte-identical — module
+                # doc), or the loop aborts if recovery is off
+                self._lose_worker("decode", w, e)
+                continue
             if tele is not None and info is not None:
                 kv_occ, kv_resident = eng.kv_gauges()
                 tags = {"occupancy": occ, "active": eng.num_active,
@@ -566,9 +988,25 @@ class DisaggServer:
         return worked
 
     def _deliver_block(self, w: int, slot: int, toks) -> None:
-        h = self._slot_handles[("decode", w, slot)]
+        h = self._slot_handles.get(("decode", w, slot))
+        if h is None:
+            # the worker died delivering an EARLIER slot of this same
+            # block (_finish_key's evict -> _lose_worker re-routed the
+            # remaining lanes): these tokens re-emit on replay — do not
+            # deliver them here too, the replay-skip count is already set
+            return
         eos = h.request.eos_id
         for tok in toks:
+            skip = self._skip.get(h.id, 0)
+            if skip > 0:
+                # replay of a recovered lane: this token was already
+                # delivered by the lost worker — the re-emission is a
+                # duplicate (its finish checks ran the first time)
+                if skip == 1:
+                    del self._skip[h.id]
+                else:
+                    self._skip[h.id] = skip - 1
+                continue
             h._deliver(tok)
             self.tokens_out += 1
             if eos is not None and tok == eos:
@@ -581,14 +1019,32 @@ class DisaggServer:
     def _finish_key(self, key, reason: str) -> None:
         pool, w, slot = key
         h = self._slot_handles.pop(key)
-        (self.prefill_pool if pool == "prefill"
-         else self.decode_pool)[w].evict(slot)
+        if pool == "decode":
+            self._import_pkg.pop((w, slot), None)
+        # finish FIRST: once popped from _slot_handles this handle is
+        # invisible to _abort_outstanding, so if the evict below kills
+        # the worker with recovery OFF (_lose_worker re-raises), a
+        # not-yet-finished handle would strand its waiter forever
         h._finish(reason)
         self._note_finished(h)
+        if w not in self._dead[pool]:
+            eng = (self.prefill_pool if pool == "prefill"
+                   else self.decode_pool)[w]
+            try:
+                eng.evict(slot)
+            except Exception as e:
+                # the evict itself killed the worker: this handle is
+                # already finished; its REMAINING lanes recover
+                self._lose_worker(pool, w, e)
 
     def _note_finished(self, h: RequestHandle) -> None:
         from tpudist import telemetry
 
+        # the ONE cleanup point for recovery bookkeeping: every finish
+        # path funnels here, so a recovering lane that ends early (a
+        # deadline sweep while its replay waits in the queue, a drain)
+        # can never leak its replay-skip entry
+        self._skip.pop(h.id, None)
         self.completed += 1
         telemetry.event(
             "request_finished", id=h.id, reason=h.finish_reason,

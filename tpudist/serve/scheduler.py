@@ -40,10 +40,15 @@ import numpy as np
 #: finish reasons a handle can carry (``finish_reason`` is always one of
 #: these once ``done`` is set): completed its token budget, emitted its
 #: stop token, missed its deadline, was cut off by a non-graceful server
-#: stop, or hit a full KV cache with budget unspent (``cache_full`` —
-#: the loud ending the silent-overflow fix installed; admission's budget
-#: rule makes it unreachable unless that rule is bypassed).
-FINISH_REASONS = ("length", "eos", "deadline", "shutdown", "cache_full")
+#: stop, hit a full KV cache with budget unspent (``cache_full`` — the
+#: loud ending the silent-overflow fix installed; admission's budget
+#: rule makes it unreachable unless that rule is bypassed), lost its
+#: pool worker with NO survivor to recover onto (``worker_lost`` — with
+#: survivors the lane replays and finishes normally), or rode a handoff
+#: package the decode pool rejected (``handoff_corrupt``: schema
+#: mismatch or failed integrity digest).
+FINISH_REASONS = ("length", "eos", "deadline", "shutdown", "cache_full",
+                  "worker_lost", "handoff_corrupt")
 
 
 class AdmissionError(RuntimeError):

@@ -90,6 +90,15 @@ class ServeConfig:
     prefill_slots: Optional[int] = None  # per prefill worker; None: num_slots
     handoff: str = "device"  # "device" (in-mesh) | "serial" (byte transfer)
     handoff_queue: int = 8  # bounded pending-handoff packages
+    # self-healing fleet: a dead pool worker's lanes replay onto
+    # survivors (stashed handoff packages — costs one extra copy of each
+    # in-flight decode lane's KV); off = any worker death aborts all
+    # outstanding work as "shutdown" (the pre-recovery behavior)
+    recover: bool = True
+    # backpressure pool resize: consecutive loop iterations the handoff
+    # queue must stay full before the prefill slot budget shrinks by one
+    # (and at most half-full before it grows back); 0 = off
+    pool_resize: int = 0
     # -- speculative decoding (draft-propose / batched target-verify) ------
     spec: bool = False  # draft proposes K, target verifies in one pass
     spec_k: int = 4  # drafted tokens per speculative block
@@ -150,6 +159,8 @@ class ServeConfig:
             handoff=os.environ.get(
                 "TPUDIST_SERVE_HANDOFF", "").strip() or "device",
             handoff_queue=env_int("TPUDIST_SERVE_HANDOFF_QUEUE", 8) or 8,
+            recover=env_flag("TPUDIST_SERVE_RECOVER", True),
+            pool_resize=env_int("TPUDIST_SERVE_POOL_RESIZE", 0) or 0,
             spec=env_flag("TPUDIST_SERVE_SPEC", False),
             spec_k=env_int("TPUDIST_SERVE_SPEC_K", 4) or 4,
             spec_draft_layers=env_int(
@@ -213,8 +224,13 @@ class InferenceServer:
         if self._thread is not None:
             raise RuntimeError("server already started")
         from tpudist import telemetry
-        from tpudist.runtime import preemption
+        from tpudist.runtime import faults, preemption
 
+        # chaos harness: arm TPUDIST_FAULT at the serving entry like the
+        # training loops do at theirs (the serve-side kinds inject in
+        # the disagg loop; arming here keeps the grammar's no-code-
+        # changes contract uniform across servers)
+        faults.arm_from_env()
         telemetry.ensure_started()
         # one config-stamp event: the static KV geometry the aggregator
         # pairs with the per-block occupancy gauges (block size, pool

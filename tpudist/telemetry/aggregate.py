@@ -68,16 +68,21 @@ COMPONENT_OF = {
 }
 
 #: Every component of the breakdown, in report order.  The accounted ones
-#: (all but idle/lost_restart) come from spans; idle is the per-rank
-#: remainder; lost_restart the inter-generation gaps.
+#: (all but idle/resize/lost_restart) come from spans; idle is the
+#: per-rank remainder; the inter-generation gaps split into ``resize``
+#: (the next generation launched at a DIFFERENT world size — an elastic
+#: relaunch, classified from the ``world`` stamp each session carries)
+#: and ``lost_restart`` (a fixed-size restart of the same world).
 COMPONENTS = ("step", "compile", "data", "ckpt", "comm", "init", "other",
-              "idle", "lost_restart")
+              "idle", "resize", "lost_restart")
 
 #: Event names surfaced in the report's event log (joined across ranks and
 #: generations on the wall-clock axis).
 _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
                     "prefetch_stats", "serve_drain", "serve_loop_error",
-                    "serve_disagg_config")
+                    "serve_disagg_config", "restart_exhausted",
+                    "world_resized", "worker_lost", "lane_recovered",
+                    "handoff_rejected", "pool_resize")
 
 
 def find_telemetry_dir(run_dir: "str | Path") -> Path:
@@ -132,27 +137,47 @@ def _rank_breakdown(rank_recs: List[dict]) -> dict:
     t1 = max(float(r["t"]) + float(r.get("dur", 0.0)) for r in rank_recs)
     wall = max(0.0, t1 - t0)
 
-    # lost_restart: gap between one generation's last record and the next's
-    # first — the successor process's spawn/re-admit/re-init dead time.
-    lost = 0.0
+    # Per-generation world size (the session_start stamp) — what lets a
+    # gap be attributed as resize vs lost_restart below.
+    world_of: Dict[int, Optional[int]] = {}
+    for g in gens:
+        world_of[g] = next(
+            (int(r["world"]) for r in by_gen[g]
+             if r.get("name") == "session_start"
+             and isinstance(r.get("world"), int)), None)
+
+    # Inter-generation gaps: the successor process's spawn/re-admit/
+    # re-init dead time.  A gap into a generation whose world size
+    # CHANGED is ``resize`` (the elastic relaunch shrinking/growing the
+    # group); same (or unknown) world is ``lost_restart``.
+    lost, resize = 0.0, 0.0
     for a, b in zip(gens, gens[1:]):
         end_a = max(float(r["t"]) + float(r.get("dur", 0.0))
                     for r in by_gen[a])
         start_b = min(float(r["t"]) for r in by_gen[b])
-        lost += max(0.0, start_b - end_a)
+        gap = max(0.0, start_b - end_a)
+        wa, wb = world_of.get(a), world_of.get(b)
+        if wa is not None and wb is not None and wa != wb:
+            resize += gap
+        else:
+            lost += gap
 
     comp = {c: 0.0 for c in COMPONENTS}
     comp["lost_restart"] = lost
+    comp["resize"] = resize
     for r in rank_recs:
         if r.get("kind") != "span" or "parent" in r:
             continue  # nested spans are detail, not additional wall-clock
         comp[COMPONENT_OF.get(r["name"], "other")] += float(r.get("dur", 0.0))
-    busy = sum(comp[c] for c in COMPONENTS if c not in ("idle", "lost_restart"))
-    idle = wall - busy - lost
+    busy = sum(comp[c] for c in COMPONENTS
+               if c not in ("idle", "resize", "lost_restart"))
+    idle = wall - busy - lost - resize
     comp["idle"] = max(0.0, idle)
     return {
         "rank": int(rank_recs[0].get("rank", 0)),
         "generations": len(gens),
+        "worlds": {str(g): world_of[g] for g in gens
+                   if world_of[g] is not None},
         "wall_s": wall,
         "t0": t0,
         "t1": t1,
@@ -234,6 +259,9 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     handoffs = 0
     handoff_import_s: List[float] = []
     disagg_config = None
+    # fleet recovery (self-healing disagg): dead workers, lanes replayed
+    # onto survivors, and backpressure-driven pool resizes
+    workers_lost, lanes_recovered, pool_resizes = 0, 0, 0
     for r in records:
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_kv_config"):
@@ -247,6 +275,15 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             handoffs += 1
             if isinstance(r.get("import_s"), (int, float)):
                 handoff_import_s.append(float(r["import_s"]))
+            continue
+        if r.get("kind") == "event" and r.get("name") == "worker_lost":
+            workers_lost += 1
+            continue
+        if r.get("kind") == "event" and r.get("name") == "lane_recovered":
+            lanes_recovered += 1
+            continue
+        if r.get("kind") == "event" and r.get("name") == "pool_resize":
+            pool_resizes += 1
             continue
         if r.get("kind") != "span":
             continue
@@ -359,7 +396,8 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             "verify_s": round(spec_verify_s, 6),
         }
     pools: Optional[dict] = None
-    if pool_s or disagg_config is not None or handoffs:
+    if (pool_s or disagg_config is not None or handoffs
+            or workers_lost or lanes_recovered):
         hwaits = sorted(float(r["handoff_wait_s"]) for r in fins
                         if isinstance(r.get("handoff_wait_s"), (int, float)))
         pools = {
@@ -388,6 +426,9 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
                 "p50_s": round(_percentile(sorted(handoff_import_s), 50), 6),
                 "max_s": round(max(handoff_import_s), 6)}
                 if handoff_import_s else None),
+            "workers_lost": workers_lost,
+            "lanes_recovered": lanes_recovered,
+            "pool_resizes": pool_resizes,
         }
     return {
         "requests_finished": len(fins),
@@ -461,10 +502,20 @@ def aggregate_run(run_dir: "str | Path") -> dict:
             events.append(r)
     events.sort(key=lambda e: e.get("t", 0.0))
 
+    # Generation-stamped world sizes merged across ranks (the elastic
+    # story: gen → how many processes that generation ran with).
+    world_sizes: Dict[str, int] = {}
+    for p in per_rank:
+        for g, w in p.get("worlds", {}).items():
+            world_sizes[g] = max(world_sizes.get(g, 0), int(w))
+
     report = {
         "num_records": len(records),
         "num_ranks": n,
         "generations": max(p["generations"] for p in per_rank),
+        **({"world_sizes": {g: world_sizes[g]
+                            for g in sorted(world_sizes, key=int)}}
+           if world_sizes else {}),
         "wall_clock_s": round(wall_mean, 6),
         "run_span_s": round(
             max(p["t1"] for p in per_rank) - min(p["t0"] for p in per_rank),
@@ -509,6 +560,11 @@ def render_markdown(report: dict) -> str:
         f"(run envelope {report['run_span_s']:.3f} s, "
         f"{report['generations']} process generation"
         f"{'s' if report['generations'] != 1 else ''})")
+    if report.get("world_sizes"):
+        lines.append(
+            "- world size by generation: "
+            + ", ".join(f"gen {g} → {w}"
+                        for g, w in report["world_sizes"].items()))
     st = report["step"]
     lines.append(
         f"- steps: {st['count']} in {st['total_s']:.3f} s "
@@ -530,8 +586,9 @@ def render_markdown(report: dict) -> str:
               f"{sg['max_step_s']:.3f} s in steps vs rank "
               f"{sg['min_step_rank']}'s {sg['min_step_s']:.3f} s", "",
               "| rank | gens | wall s | step | compile | data | ckpt | comm "
-              "| init | other | idle | lost_restart |",
-              "|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|"]
+              "| init | other | idle | resize | lost_restart |",
+              "|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:|---:"
+              "|---:|"]
     for p in report["per_rank"]:
         lines.append(
             f"| {p['rank']} | {p['generations']} | {p['wall_s']:.3f} | "
@@ -589,6 +646,11 @@ def render_markdown(report: dict) -> str:
             if hw:
                 bits.append(f"handoff wait p50 {hw['p50_s'] * 1e3:.1f} ms / "
                             f"p95 {hw['p95_s'] * 1e3:.1f} ms")
+            if pp.get("workers_lost"):
+                bits.append(f"{pp['workers_lost']} worker(s) lost, "
+                            f"{pp['lanes_recovered']} lane(s) recovered")
+            if pp.get("pool_resizes"):
+                bits.append(f"{pp['pool_resizes']} backpressure resize(s)")
             lines.append("- disaggregated pools: " + "; ".join(bits))
             for label, pool, key in (("TTFT", "prefill", "ttft"),
                                      ("TPOT", "decode", "tpot")):

@@ -87,6 +87,11 @@ class TelemetrySession:
             (env_int("TPUDIST_RESTART_COUNT", 0) or 0)
             if generation is None else int(generation)
         )
+        #: this generation's world size (launch contract) — stamped on
+        #: ``session_start`` so the aggregator can tell an ELASTIC
+        #: relaunch (world changed → the inter-generation gap is
+        #: ``resize`` time) from a fixed-size restart (``lost_restart``).
+        self.world = env_int("TPUDIST_NUM_PROCESSES", None)
         if ring_size is None:
             ring_size = env_int(ENV_RING, DEFAULT_RING) or DEFAULT_RING
         self.ring: "collections.deque[dict]" = collections.deque(
@@ -108,7 +113,8 @@ class TelemetrySession:
             self._file = open(self.path, "w", buffering=1)  # line buffered
         except OSError:
             pass  # ring-only session: recording must not take the job down
-        self.event("session_start", pid=os.getpid())
+        self.event("session_start", pid=os.getpid(),
+                   **({"world": self.world} if self.world else {}))
 
     # -- recording ----------------------------------------------------------
 

@@ -223,9 +223,12 @@ class Trainer:
         ckpt = None
         start_iteration = 0
         if ckpt_dir is not None:
+            # mesh= routes resume through the reshard path: a checkpoint
+            # saved at a different world size (elastic tpurun relaunch)
+            # re-binds its logical shardings onto THIS mesh.
             ckpt, states, start_iteration = setup_checkpointing(
                 states, ckpt_dir, save_every=self.checkpoint_every,
-                resume=self.resume,
+                resume=self.resume, mesh=mesh,
             )
 
         logger: MetricsLogger = init_metrics(
@@ -341,7 +344,7 @@ class Trainer:
         if ckpt_dir is not None:
             ckpt, state, start_iteration = setup_checkpointing(
                 state, ckpt_dir, save_every=self.checkpoint_every,
-                resume=self.resume,
+                resume=self.resume, mesh=mesh,
             )
         logger: MetricsLogger = init_metrics(
             project=self.project, group=self.group or "trainer",

@@ -118,6 +118,12 @@ ENV_VARS = {
     "TPUDIST_SERVE_HANDOFF":
         "KV handoff transport: device (in-mesh) | serial (byte transfer)",
     "TPUDIST_SERVE_HANDOFF_QUEUE": "bounded pending-KV-handoff queue length",
+    "TPUDIST_SERVE_RECOVER":
+        "self-healing disagg fleet: dead-worker lanes replay on survivors "
+        "(default on; 0 = worker death aborts outstanding work)",
+    "TPUDIST_SERVE_POOL_RESIZE":
+        "iterations of sustained handoff-queue backpressure before the "
+        "prefill slot budget shrinks by one (0 = off)",
     "TPUDIST_SERVE_SPEC":
         "speculative decoding: draft proposes K, target verifies in one pass",
     "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
