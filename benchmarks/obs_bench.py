@@ -1,0 +1,350 @@
+#!/usr/bin/env python3
+"""Observability-overhead + trace-acceptance bench: the measured (not
+assumed) cost of the live observability plane, frozen into
+``BENCH_OBS_r{NN}.json``.
+
+Two rungs:
+
+- **obs_twin** — the SAME request set served twice on identical
+  engines: once with the live plane armed (metrics feed + per-request
+  trace lifelines + a scrape endpoint being polled mid-run), once with
+  ``TPUDIST_METRICS=0`` / ``TPUDIST_TRACE=0`` (post-hoc telemetry only,
+  yesterday's behavior).  The artifact quotes the wall-TPOT and
+  device-busy-per-token deltas — the number the "overhead must be
+  measured" acceptance criterion asks for.  On the CPU rig the absolute
+  times are interpreter mechanics; the DELTA is the host-side
+  record+feed cost, which is exactly the quantity of interest (the
+  plane is host-side by construction).
+
+- **trace_chaos** — a disaggregated serve (serial handoff, 2 decode
+  workers) with a chaos-killed decode worker
+  (``TPUDIST_FAULT=serve_worker_kill``), tracing on.  Validates and
+  freezes the acceptance criteria: a single request's trace_id spans
+  prefill pool → handoff → decode pool in the exported Perfetto-loadable
+  Chrome trace, the chaos-killed lane's replay appears on the survivor
+  (two ``req_decode`` segments, different workers), the live ``/metrics``
+  scrape parses, and the live TTFT/TPOT percentiles agree with the
+  post-hoc aggregator within the quoted sketch-resolution bound
+  (``metrics.QUANTILE_REL_ERROR``).
+
+Usage: ``python benchmarks/obs_bench.py [--smoke] [--out PATH]``
+(CPU-safe; round_snapshot.py freezes it per round).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CFG = dict(vocab=32, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=64)
+
+
+def _model(seed: int = 0):
+    import jax
+
+    from tpudist.models import create_transformer
+
+    return create_transformer(jax.random.PRNGKey(seed), seq_len=16, **CFG)
+
+
+def _prompts(n, plen, vocab, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=plen).astype(np.int32)
+            for _ in range(n)]
+
+
+def _serve_once(model, prompts, max_new, *, disagg=False, telemetry_dir=None):
+    """One serve pass; returns (handles, decode_stats_delta, server)."""
+    from tpudist import telemetry
+    from tpudist.serve import DisaggServer, InferenceServer, ServeConfig
+
+    if telemetry_dir is not None:
+        telemetry.start(telemetry_dir, rank=0, generation=0)
+    cfg = ServeConfig(num_slots=4, max_new=max_new, decode_block=8,
+                      disagg=disagg, decode_workers=2 if disagg else 1,
+                      handoff="serial" if disagg else "device")
+    cls = DisaggServer if disagg else InferenceServer
+    srv = cls(*model, cfg, install_signal_handler=False).start()
+    handles = []
+    for i, p in enumerate(prompts):
+        handles.append(srv.submit(p, max_new=max_new, tenant=f"t{i % 2}"))
+    for h in handles:
+        assert h.wait(600), "request timed out"
+    return handles, srv
+
+
+def _tpot_stats(handles):
+    vals = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
+    if not vals:
+        return {"mean": None, "p50": None}
+    return {"mean": sum(vals) / len(vals),
+            "p50": vals[len(vals) // 2]}
+
+
+def run_obs_twin(n_requests: int, max_new: int, pairs: int = 3) -> dict:
+    """Metrics+trace ON vs OFF on identical traffic and ONE server —
+    every wave rides the same compiled programs, so the wave deltas
+    isolate the host-side plane cost from XLA compile noise."""
+    from tpudist import telemetry
+    from tpudist.serve import InferenceServer, ServeConfig
+    from tpudist.telemetry import metrics, statusz
+
+    model = _model()
+    tdir = Path(os.environ.get("TPUDIST_TELEMETRY_DIR",
+                               "runs/telemetry")) / "obs_twin"
+    telemetry.start(str(tdir), rank=0, generation=0)
+    srv = InferenceServer(
+        *model, ServeConfig(num_slots=4, max_new=max_new, decode_block=8),
+        install_signal_handler=False).start()
+    ep = statusz.ensure_started(port=0)
+
+    def _wave(arm: str, seed: int) -> dict:
+        on = arm == "on"
+        os.environ["TPUDIST_METRICS"] = "1" if on else "0"
+        os.environ["TPUDIST_TRACE"] = "1" if on else "0"
+        metrics.arm_from_env()
+        d0 = dict(srv.engine.decode_stats())
+        handles = []
+        scrapes = 0
+        for i, p in enumerate(_prompts(n_requests, 6, CFG["vocab"],
+                                       seed=seed)):
+            handles.append(srv.submit(p, max_new=max_new,
+                                      tenant=f"t{i % 2}"))
+        for h in handles:
+            assert h.wait(600), "request timed out"
+        if on and ep is not None:
+            # prove the endpoint is live while the server is up; OUTSIDE
+            # the measured wave — 3 scrapes inside a ~ms CPU-smoke wave
+            # would model a scrape every few ms, 1000x any real cadence
+            for _ in range(3):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ep.port}/metrics", timeout=5).read()
+                scrapes += 1
+        d1 = srv.engine.decode_stats()
+        tokens = sum(len(h.tokens) for h in handles)
+        return {
+            "tpot": _tpot_stats(handles),
+            "tokens": tokens,
+            "busy_per_token_s": ((d1["dispatch_s"] - d0["dispatch_s"]
+                                  + d1["sync_s"] - d0["sync_s"]) / tokens
+                                 if tokens else None),
+            "scrapes": scrapes,
+        }
+
+    def _median(vals):
+        vals = sorted(v for v in vals if v is not None)
+        return vals[len(vals) // 2] if vals else None
+
+    # alternating off/on pairs: scheduler noise hits both arms, and each
+    # pair is temporally adjacent so the per-pair ratio cancels drift
+    offs, ons = [], []
+    try:
+        _wave("warmup", seed=7)  # pays every XLA compile; discarded
+        for i in range(pairs):
+            offs.append(_wave("off", seed=i))
+            ons.append(_wave("on", seed=i))  # identical prompts per pair
+    finally:
+        srv.close()
+        telemetry.finish(write_report=False)
+        statusz.stop()
+    tpot_on = _median([w["tpot"]["mean"] for w in ons])
+    tpot_off = _median([w["tpot"]["mean"] for w in offs])
+    # paired estimator: each pair serves identical prompts back-to-back,
+    # so its on/off ratio is immune to the slow load drift that swamps
+    # the unpaired medians on a shared CPU rig; the quoted overhead is
+    # the MEDIAN pair ratio, with the full spread frozen alongside so
+    # the artifact self-documents the rig's noise floor
+    ratios = [on["tpot"]["mean"] / off["tpot"]["mean"]
+              for on, off in zip(ons, offs)
+              if on["tpot"]["mean"] and off["tpot"]["mean"]]
+    overhead = (_median(ratios) - 1.0) if ratios else None
+    return {
+        "rung": "obs_twin",
+        "regime": "cpu-smoke",
+        "requests": n_requests,
+        "max_new": max_new,
+        "waves_per_arm": pairs,
+        "tokens": sum(w["tokens"] for w in ons),
+        "tpot_on_s": tpot_on,
+        "tpot_off_s": tpot_off,
+        "tpot_overhead_frac": overhead,
+        "tpot_on_s_all": [round(w["tpot"]["mean"], 9) for w in ons],
+        "tpot_off_s_all": [round(w["tpot"]["mean"], 9) for w in offs],
+        "tpot_pair_ratios": [round(r, 6) for r in ratios],
+        "busy_per_token_on_s": _median([w["busy_per_token_s"] for w in ons]),
+        "busy_per_token_off_s": _median(
+            [w["busy_per_token_s"] for w in offs]),
+        "mid_run_scrapes": sum(w["scrapes"] for w in ons),
+        "note": ("one server, shared compiled programs, warmup wave "
+                 "discarded, overhead = median per-pair on/off ratio "
+                 "over alternating off/on waves (identical prompts per "
+                 "pair) — the on-vs-off DELTA is the host-side "
+                 "metrics+trace cost (the plane is host-side by "
+                 "construction); CPU-rig absolute TPOT is interpreter "
+                 "mechanics and the pair-ratio spread is the rig's "
+                 "noise floor"),
+    }
+
+
+def run_trace_chaos(n_requests: int, max_new: int) -> dict:
+    """Chaos-killed disagg serve with the plane on: freeze the
+    acceptance booleans + live-vs-posthoc percentile agreement."""
+    from tpudist import telemetry
+    from tpudist.runtime import faults
+    from tpudist.telemetry import metrics, statusz, trace
+    from tpudist.telemetry.aggregate import aggregate_run, load_records
+
+    model = _model()
+    prompts = _prompts(n_requests, 6, CFG["vocab"], seed=1)
+    os.environ["TPUDIST_METRICS"] = "1"
+    os.environ["TPUDIST_TRACE"] = "1"
+    os.environ["TPUDIST_FAULT"] = "serve_worker_kill@call:6,pool:1,worker:0"
+    metrics.registry().clear()
+    tdir = Path(os.environ.get("TPUDIST_TELEMETRY_DIR",
+                               "runs/telemetry")) / "obs_trace_chaos"
+    try:
+        handles, srv = _serve_once(model, prompts, max_new, disagg=True,
+                                   telemetry_dir=str(tdir))
+        # live scrape: the endpoint must serve parseable text mid-run
+        scrape_ok = False
+        ep = statusz.ensure_started(port=0)
+        if ep is not None:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/metrics", timeout=5
+            ).read().decode()
+            scrape_ok = all(
+                line.startswith("# TYPE ") or " " in line
+                for line in body.strip().splitlines()) and bool(body.strip())
+        workers_lost = srv.workers_lost
+        lanes_recovered = srv.lanes_recovered
+        srv.close()
+    finally:
+        os.environ.pop("TPUDIST_FAULT", None)
+        faults.disarm()
+    # live percentiles BEFORE closing the session (scrape-time view)
+    reg = metrics.registry()
+    live = {}
+    for name, metric in (("ttft", "tpudist_ttft_seconds"),
+                         ("tpot", "tpudist_tpot_seconds")):
+        merged = metrics.Histogram()
+        for tenant in ("t0", "t1", "default"):
+            merged.merge(reg.histogram(metric, tenant=tenant))
+        live[name] = {"p50": merged.quantile(50), "p95": merged.quantile(95),
+                      "count": merged.count}
+    telemetry.finish(write_report=False)
+    statusz.stop()
+    # post-hoc: the exact-value aggregator over the same stream
+    report = aggregate_run(tdir)
+    sv = report["serving"]
+    agreement = {}
+    within = True
+    bound = metrics.QUANTILE_REL_ERROR
+    for name in ("ttft", "tpot"):
+        for q, field in ((50, "p50_s"), (95, "p95_s")):
+            exact = (sv.get(name) or {}).get(field)
+            got = live[name][f"p{q}"]
+            if not exact:
+                continue
+            rel = abs(got - exact) / exact
+            ok = rel <= bound + 1e-9
+            within &= ok
+            agreement[f"{name}_p{q}"] = {
+                "live_s": round(got, 6), "posthoc_s": round(exact, 6),
+                "rel_err": round(rel, 6), "ok": ok}
+    # the exported timeline: crossing + replay
+    out_trace = trace.export_chrome_trace(tdir)
+    doc = json.loads(out_trace.read_text())
+    joined = trace.join_traces(load_records(tdir))
+    crossed = sum(1 for rs in joined.values()
+                  if {"req_prefill", "req_handoff", "req_decode"}
+                  <= {r["name"] for r in rs})
+    replays = 0
+    for rs in joined.values():
+        dec = [r for r in rs if r.get("name") == "req_decode"]
+        if len(dec) > 1 and len({d.get("worker") for d in dec}) > 1:
+            replays += 1
+    return {
+        "rung": "trace_chaos",
+        "regime": "cpu-smoke",
+        "requests": n_requests,
+        "workers_lost": workers_lost,
+        "lanes_recovered": lanes_recovered,
+        "lifelines": len(joined),
+        "lifelines_crossing_pools": crossed,
+        "replays_on_survivor": replays,
+        "crossed_pools": crossed > 0,
+        "replay_on_survivor": replays > 0,
+        "chrome_trace": str(out_trace),
+        "chrome_trace_events": len(doc.get("traceEvents", [])),
+        "chrome_trace_loadable": bool(doc.get("traceEvents")),
+        "scrape_ok": scrape_ok,
+        "live_vs_posthoc": agreement,
+        "quantile_rel_error_bound": round(bound, 6),
+        "live_within_bound": within,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CI scale (fewer requests/tokens)")
+    p.add_argument("--requests", type=int, default=None)
+    p.add_argument("--max-new", type=int, default=None)
+    p.add_argument("--pairs", type=int, default=3,
+                   help="off/on wave pairs in the twin rung (more pairs "
+                        "= tighter overhead median; each pair is cheap)")
+    p.add_argument("--out", default=str(REPO / "BENCH_OBS.json"))
+    args = p.parse_args(argv)
+
+    n = args.requests or (6 if args.smoke else 16)
+    max_new = args.max_new or (10 if args.smoke else 24)
+    # hermetic telemetry: this bench owns its streams — and restores
+    # every env key it mutates on exit, because the tier-1 bench test
+    # calls main() IN-PROCESS (a leaked TPUDIST_TELEMETRY_DIR pointing
+    # at this run's temp dir would silently redirect later tests)
+    mutated = ("TPUDIST_TELEMETRY_DIR", "TPUDIST_METRICS_PORT",
+               "TPUDIST_METRICS", "TPUDIST_TRACE", "TPUDIST_FAULT")
+    saved = {k: os.environ.get(k) for k in mutated}
+    tmp = tempfile.mkdtemp(prefix="tpudist_obs_bench_")
+    os.environ["TPUDIST_TELEMETRY_DIR"] = tmp
+    os.environ.pop("TPUDIST_METRICS_PORT", None)  # we bind explicitly
+
+    t0 = time.time()
+    try:
+        rows = [run_trace_chaos(n, max_new),
+                run_obs_twin(n, max_new, pairs=args.pairs)]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        from tpudist.telemetry import metrics as _metrics
+
+        _metrics.arm_from_env()
+    for r in rows:
+        r["wall_s"] = round(time.time() - t0, 3)
+        print(json.dumps(r))
+    out = Path(args.out)
+    out.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    print(json.dumps({"wrote": str(out)}))
+    chaos = rows[0]
+    ok = (chaos["crossed_pools"] and chaos["replay_on_survivor"]
+          and chaos["live_within_bound"] and chaos["chrome_trace_loadable"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

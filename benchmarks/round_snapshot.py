@@ -160,6 +160,28 @@ def main(argv=None) -> None:
             {"regime": "multiprocess-cpu", "error": repr(e)}) + "\n")
         print(f"{elastic_out.name}: error {e!r}")
 
+    # Observability rung (PR 13): measured metrics+trace overhead twin
+    # plus the chaos cross-pool trace acceptance booleans, frozen as
+    # BENCH_OBS_r{NN}.json.  Failure-isolated like the serve snapshot.
+    obs_out = REPO / f"BENCH_OBS_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "obs_bench.py"),
+             # --max-new 48: ≥6 decode blocks per request, so the twin's
+             # per-handle TPOT amortizes block-boundary quantization (at
+             # the smoke default of 10 a µs-scale host delta can cost a
+             # whole extra dispatch block and read as a 2x outlier)
+             "--smoke", "--pairs", "7", "--max-new", "48",
+             "--out", str(obs_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{obs_out.name}: {json.dumps(data[-1])}")
+    except Exception as e:
+        obs_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{obs_out.name}: error {e!r}")
+
     # Decode per-op attribution (VERDICT Weak #2): trace the bf16 fused
     # decode loop and freeze the table naming the non-matmul residual.
     # Failure-isolated like the serve snapshot.

@@ -101,6 +101,9 @@ _SLOW_PATTERNS = (
     # servers and kills workers mid-flight; the fast envelope +
     # requeue-bookkeeping units stay default in test_serve_recovery.py)
     "TestWorkerLossChaos",
+    # cross-pool trace chaos drive (multi-worker disagg + kill; the
+    # fast lifeline/schema/export units stay default in test_trace.py)
+    "TestTraceChaos",
     # sharded-serving sweeps: full mesh-shape × engine-mode oracle
     # matrix + disagg server e2e (the fast engine-level mesh/handoff
     # oracles stay default in TestServeSpmd)
@@ -195,10 +198,27 @@ _SLOW_PATTERNS = (
     # training runs with kill chaos — the fast tpurun-elastic units
     # stay default in test_launch.py)
     "TestElasticBench",
+    # observability rung (builds servers + chaos kill + twin waves; the
+    # fast metrics/statusz/trace units stay default in their own files)
+    "TestObsBench",
     # pallas native-lowering lane (TPU-only Mosaic compiles; the
     # interpret-mode kernel tests stay tier-1 — marker `pallas` selects
     # the whole kernel suite, see pyproject markers)
     "TestPagedAttentionNative",
+    # spec-decode heavy variants, relocated to hold the default lane
+    # under the tier-1 wall budget after the observability tests joined
+    # it (the same discipline as the paged-kernel variants below): the
+    # default lane keeps the K=2 sampled dense-vs-paged stream
+    # equivalence, the full greedy byte-identity sweep, and the
+    # churn compile pins; these siblings extend to K∈{4,8} sampled and
+    # the cross-mesh pin matrix
+    "TestSpecOracle::test_sampled_stream_equivalence_dense_vs_paged[4]",
+    "TestSpecOracle::test_sampled_stream_equivalence_dense_vs_paged[8]",
+    "TestSpecCompilePins::test_compile_counts_flat_across_mesh_shapes",
+    # the serve_bench spec-decode sweep smoke (~80s: distills a draft +
+    # runs the rung matrix); the sweep still freezes per round via
+    # round_snapshot and the non-spec serve_bench smokes stay default
+    "TestServeBench::test_smoke_spec_sweep",
     # paged-kernel engine-level variants (each builds+compiles fresh
     # engines; the default lane keeps the op-level equivalence sweep,
     # the f32 gather-vs-kernel-vs-oracle byte-identity drive, the

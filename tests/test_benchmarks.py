@@ -588,6 +588,45 @@ class TestElasticBench:
         assert rec["elastic_completed_at_world"] == 1
 
 
+class TestObsBench:
+    def test_rungs_freeze_acceptance_fields(self, tmp_path, monkeypatch):
+        """The observability rung's contract: the chaos arm freezes the
+        acceptance booleans (a lifeline crossing prefill → handoff →
+        decode, the killed lane's replay on the survivor, a parseable
+        live scrape, live percentiles within the quoted sketch bound)
+        and the twin arm quotes a MEASURED metrics+trace on-vs-off TPOT
+        delta — never an assumed one."""
+        import json as _json
+
+        from benchmarks.obs_bench import main
+        from tpudist.telemetry import metrics
+
+        monkeypatch.delenv("TPUDIST_METRICS_PORT", raising=False)
+        out = tmp_path / "BENCH_OBS.json"
+        rc = main(["--smoke", "--out", str(out), "--requests", "5",
+                   "--max-new", "8"])
+        assert rc == 0
+        rows = {_json.loads(line)["rung"]: _json.loads(line)
+                for line in out.read_text().splitlines()}
+        assert set(rows) == {"trace_chaos", "obs_twin"}
+        chaos = rows["trace_chaos"]
+        assert chaos["workers_lost"] == 1
+        assert chaos["crossed_pools"] and chaos["lifelines_crossing_pools"] > 0
+        assert chaos["replay_on_survivor"]
+        assert chaos["chrome_trace_loadable"]
+        assert chaos["scrape_ok"]
+        assert chaos["live_within_bound"]
+        assert chaos["quantile_rel_error_bound"] == pytest.approx(
+            metrics.QUANTILE_REL_ERROR, rel=1e-3)
+        for cell in chaos["live_vs_posthoc"].values():
+            assert cell["ok"], cell
+        twin = rows["obs_twin"]
+        assert twin["tokens"] > 0
+        for col in ("tpot_on_s", "tpot_off_s", "tpot_overhead_frac",
+                    "busy_per_token_on_s", "busy_per_token_off_s"):
+            assert twin[col] is not None, col
+
+
 class TestLossParity:
     def test_all_entry_points_match(self):
         from benchmarks.loss_parity import main

@@ -1,0 +1,82 @@
+"""Finish-reason inventory gate (the env-var-inventory pattern): every
+string literal the serving loops pass to a ``_finish*`` call must be
+registered in ``tpudist.serve.scheduler.FINISH_REASONS`` and documented
+in ``docs/ARCHITECTURE.md``, and every registered reason must still be
+emitted somewhere — so a new finish reason (there are ~40 emission
+sites scattered across ``serve/*.py``) cannot ship unregistered, and a
+dead one cannot linger.  Telemetry consumers (the aggregate report's
+``finish_reasons`` counts, the live
+``tpudist_requests_finished_total{reason=}`` counter) key on these
+names; an unregistered reason is an unqueryable one."""
+
+import ast
+from pathlib import Path
+
+from tpudist.serve.scheduler import FINISH_REASONS
+
+REPO = Path(__file__).resolve().parent.parent
+SERVE = REPO / "tpudist" / "serve"
+DOCS = REPO / "docs" / "ARCHITECTURE.md"
+
+#: The calls whose string arguments ARE finish reasons.
+_FINISH_CALLS = ("_finish", "_finish_slot", "_finish_key")
+
+
+def _emitted_reasons():
+    """AST-walk every serve/*.py for string literals passed to a finish
+    call — robust to the conditional-expression sites
+    (``_finish("eos" if ... else "length")``) a regex would garble."""
+    reasons = {}  # reason -> [site, ...]
+    for path in sorted(SERVE.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None)
+            if name not in _FINISH_CALLS or not node.args:
+                continue
+            # the reason is always the LAST positional argument (the
+            # only one for _finish; _finish_slot/_finish_key lead with
+            # the slot/key — whose pool-name tuple element must not be
+            # mistaken for a reason)
+            for sub in ast.walk(node.args[-1]):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    reasons.setdefault(sub.value, []).append(
+                        f"{path.name}:{sub.lineno}")
+    return reasons
+
+
+def test_every_emitted_reason_is_registered():
+    emitted = _emitted_reasons()
+    assert emitted, "AST scan found no finish sites — pattern drifted?"
+    unregistered = sorted(set(emitted) - set(FINISH_REASONS))
+    assert not unregistered, (
+        f"finish reasons emitted in serve/*.py but missing from "
+        f"scheduler.FINISH_REASONS (register + document them): "
+        f"{ {r: emitted[r] for r in unregistered} }")
+
+
+def test_every_registered_reason_is_emitted():
+    emitted = _emitted_reasons()
+    stale = sorted(set(FINISH_REASONS) - set(emitted))
+    assert not stale, (
+        f"FINISH_REASONS entries no longer emitted anywhere in "
+        f"serve/*.py (remove them or wire them back up): {stale}")
+
+
+def test_every_registered_reason_is_documented():
+    text = DOCS.read_text()
+    undocumented = sorted(r for r in FINISH_REASONS
+                          if f"`{r}`" not in text and f'"{r}"' not in text)
+    assert not undocumented, (
+        f"FINISH_REASONS entries missing from docs/ARCHITECTURE.md "
+        f"(add them to the finish-reason table): {undocumented}")
+
+
+def test_registry_descriptions_nonempty():
+    for name, desc in FINISH_REASONS.items():
+        assert isinstance(desc, str) and len(desc) >= 8, (
+            f"{name}: the registry entry needs a real one-line contract")

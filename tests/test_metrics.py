@@ -1,0 +1,285 @@
+"""Live metrics registry (tpudist.telemetry.metrics): log-bucket sketch
+quantiles vs the exact nearest-rank percentile (within the QUOTED
+resolution bound), exact sketch merging, label handling, Prometheus
+text rendering, the span/event → registry feeder, and SLO attainment
+accounting."""
+
+import json
+import random
+
+import pytest
+
+from tpudist import telemetry
+from tpudist.telemetry import metrics
+from tpudist.telemetry.aggregate import _percentile
+from tpudist.telemetry.metrics import (
+    BUCKET_LO,
+    GROWTH,
+    NBUCKETS,
+    QUANTILE_REL_ERROR,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_plane(monkeypatch):
+    """Fresh registry + no ambient observability env per test; the
+    process-global registry is restored empty afterwards."""
+    for var in (metrics.ENV_METRICS, metrics.ENV_SLO_TTFT,
+                metrics.ENV_SLO_TPOT, telemetry.ENV_ENABLE,
+                telemetry.ENV_DIR):
+        monkeypatch.delenv(var, raising=False)
+    metrics.registry().clear()
+    metrics.disarm()
+    telemetry.finish(write_report=False)
+    yield
+    telemetry.finish(write_report=False)
+    metrics.registry().clear()
+    metrics.disarm()
+
+
+class TestSketch:
+    def _exact_vs_sketch(self, vals):
+        h = Histogram()
+        for v in vals:
+            h.observe(v)
+        sv = sorted(vals)
+        for q in (10, 50, 90, 95, 99):
+            exact = _percentile(sv, q)
+            got = h.quantile(q)
+            assert abs(got - exact) <= QUANTILE_REL_ERROR * exact + 1e-12, (
+                f"q{q}: sketch {got} vs exact {exact} exceeds the quoted "
+                f"{QUANTILE_REL_ERROR:.4f} relative bound")
+
+    def test_quantiles_within_quoted_bound_lognormal(self):
+        """The contract the live/post-hoc agreement rests on: nearest-
+        rank quantiles from the sketch agree with the exact percentile
+        (the post-hoc aggregator's _percentile) within the quoted
+        bucket-resolution bound, across a latency-shaped distribution."""
+        rng = random.Random(0)
+        self._exact_vs_sketch(
+            [rng.lognormvariate(-4.0, 1.5) for _ in range(2000)])
+
+    def test_quantiles_within_bound_across_scales(self):
+        rng = random.Random(1)
+        for scale in (1e-5, 1e-3, 0.1, 10.0, 100.0):
+            self._exact_vs_sketch(
+                [scale * (1.0 + rng.random()) for _ in range(300)])
+
+    def test_merge_is_exact(self):
+        """Cross-rank/cross-pool merge = elementwise bucket addition:
+        merging two sketches is byte-identical to one sketch that saw
+        the concatenated stream."""
+        rng = random.Random(2)
+        vals = [rng.lognormvariate(-3, 1.0) for _ in range(1000)]
+        whole = Histogram()
+        a, b = Histogram(), Histogram()
+        for v in vals:
+            whole.observe(v)
+        for v in vals[:500]:
+            a.observe(v)
+        for v in vals[500:]:
+            b.observe(v)
+        a.merge(b)
+        assert a.buckets == whole.buckets
+        assert a.count == whole.count
+        assert a.min == whole.min and a.max == whole.max
+        for q in (50, 95, 99):
+            assert a.quantile(q) == whole.quantile(q)
+
+    def test_bucket_edges_monotone_and_clamped(self):
+        from tpudist.telemetry.metrics import bucket_index
+
+        assert bucket_index(0.0) == 0
+        assert bucket_index(-1.0) == 0
+        assert bucket_index(BUCKET_LO) == 0
+        assert bucket_index(BUCKET_LO * GROWTH ** 0.5) == 1
+        assert bucket_index(1e12) == NBUCKETS - 1
+        prev = -1
+        v = BUCKET_LO / 2
+        while v < 1e4:
+            idx = bucket_index(v)
+            assert idx >= prev
+            prev = idx
+            v *= 1.3
+
+    def test_summary_mean_exact(self):
+        h = Histogram()
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 3
+        assert abs(s["mean"] - 0.2) < 1e-9  # sum/count tracked exactly
+        assert s["min"] == pytest.approx(0.1) and s["max"] == pytest.approx(0.3)
+
+
+class TestRegistry:
+    def test_labels_distinct_and_stable(self):
+        r = MetricsRegistry()
+        r.counter("c_total", pool="prefill").inc(2)
+        r.counter("c_total", pool="decode").inc(5)
+        assert r.counter("c_total", pool="prefill").value == 2
+        assert r.counter("c_total", pool="decode").value == 5
+        # label order does not split the metric
+        r.counter("d_total", a="1", b="2").inc()
+        assert r.counter("d_total", b="2", a="1").value == 1
+
+    def test_snapshot_shape(self):
+        r = MetricsRegistry()
+        r.gauge("g", tenant="t").set(1.5)
+        r.histogram("h").observe(0.25)
+        snap = r.snapshot()
+        assert snap["gauges"]['g{tenant="t"}'] == 1.5
+        assert snap["histograms"]["h"]["count"] == 1
+        json.dumps(snap)  # JSON-safe by contract (statusz serves it)
+
+    def test_prometheus_text_parses(self):
+        """Every non-comment line of the exposition must match the
+        ``name{labels} value`` grammar — the format contract the smoke
+        test re-checks against a real scrape."""
+        import re
+
+        r = MetricsRegistry()
+        r.counter("tpudist_requests_finished_total",
+                  reason="length", tenant="a b").inc(3)
+        r.gauge("tpudist_slot_occupancy", pool="decode").set(0.75)
+        r.histogram("tpudist_ttft_seconds").observe(0.012)
+        text = r.render_prometheus()
+        line_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+            r' -?[0-9.e+-]+(nan|inf)?$')
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert line.startswith("# TYPE ")
+                continue
+            assert line_re.match(line), f"unparseable exposition line: {line!r}"
+        assert 'reason="length"' in text
+        assert "tpudist_ttft_seconds_count" in text
+
+    def test_prometheus_large_counters_keep_full_precision(self):
+        """A long-lived counter past 1e6 must not render through %g's 6
+        significant digits — small increments between scrapes would
+        vanish and Prometheus rate() would read 0 then spike."""
+        r = MetricsRegistry()
+        r.counter("tpudist_tokens_out_total").inc(10_000_123)
+        r.gauge("tpudist_kv_pool_bytes").set(1_234_567_890.0)
+        text = r.render_prometheus()
+        assert "tpudist_tokens_out_total 10000123" in text
+        assert "tpudist_kv_pool_bytes 1234567890" in text
+
+
+class TestFeeder:
+    def test_session_arms_feed_and_spans_populate(self, tmp_path):
+        """The PR-2 seams feed the live registry with zero site changes:
+        a decode_block span recorded through a session lands as
+        counters + a latency sketch + the occupancy gauge."""
+        telemetry.start(tmp_path, rank=0, generation=0)
+        assert metrics.armed()
+        s = telemetry.active()
+        s.record_span("decode_block", 0.0, 0.004,
+                      {"tokens": 16, "occupancy": 0.5, "pool": "decode"})
+        r = metrics.registry()
+        assert r.counter("tpudist_decode_blocks_total", pool="decode").value == 1
+        assert r.counter("tpudist_decode_tokens_total", pool="decode").value == 16
+        assert r.gauge("tpudist_slot_occupancy", pool="decode").value == 0.5
+        assert r.histogram("tpudist_decode_block_seconds",
+                           pool="decode").count == 1
+
+    def test_metrics_env_disarms_feed_only(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(metrics.ENV_METRICS, "0")
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        assert not metrics.armed()
+        s.event("request_finished", reason="length", ttft_s=0.01)
+        assert metrics.registry().snapshot()["counters"] == {}
+        # the post-hoc stream still records
+        assert any(r["name"] == "request_finished" for r in s.ring)
+
+    def test_request_finished_feeds_latency_and_tenant(self, tmp_path):
+        telemetry.start(tmp_path, rank=0, generation=0)
+        telemetry.event("request_finished", reason="length", tenant="acme",
+                        ttft_s=0.02, tpot_s=0.004, queue_wait_s=0.001,
+                        tokens_out=8)
+        r = metrics.registry()
+        assert r.counter("tpudist_requests_finished_total",
+                         reason="length", tenant="acme").value == 1
+        assert r.counter("tpudist_tokens_out_total", tenant="acme").value == 8
+        assert r.histogram("tpudist_ttft_seconds", tenant="acme").count == 1
+        # no tenant tag pools under "default"
+        telemetry.event("request_finished", reason="eos", ttft_s=0.01)
+        assert r.histogram("tpudist_ttft_seconds", tenant="default").count == 1
+
+    def test_slo_attainment_gauges(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(metrics.ENV_SLO_TTFT, "15")  # 15 ms target
+        telemetry.start(tmp_path, rank=0, generation=0)  # re-arms, caches SLO
+        for ttft in (0.010, 0.020, 0.012, 0.013):  # 3 of 4 within 15 ms
+            telemetry.event("request_finished", reason="length", ttft_s=ttft)
+        r = metrics.registry()
+        assert r.counter("tpudist_slo_ttft_total", tenant="default").value == 4
+        assert r.counter("tpudist_slo_ttft_ok_total",
+                         tenant="default").value == 3
+        assert r.gauge("tpudist_slo_attainment", metric="ttft",
+                       tenant="default").value == pytest.approx(0.75)
+
+    def test_no_slo_targets_no_slo_series(self, tmp_path):
+        telemetry.start(tmp_path, rank=0, generation=0)
+        telemetry.event("request_finished", reason="length", ttft_s=0.01)
+        snap = metrics.registry().snapshot()
+        assert not any("slo" in k for k in snap["counters"])
+
+    def test_tenant_label_cardinality_capped(self, tmp_path):
+        """Tenant strings are caller data: past TENANT_LABEL_CAP
+        distinct tenants, new ones pool under "other" instead of
+        allocating fresh sketches forever (per-user-UUID tenants must
+        not grow process memory without bound)."""
+        telemetry.start(tmp_path, rank=0, generation=0)
+        cap = metrics.TENANT_LABEL_CAP
+        for i in range(cap + 20):
+            telemetry.event("request_finished", reason="length",
+                            tenant=f"uuid-{i}", ttft_s=0.01)
+        snap = metrics.registry().snapshot()
+        tenants = {k.split('tenant="')[1].split('"')[0]
+                   for k in snap["counters"]
+                   if k.startswith("tpudist_requests_finished_total")}
+        assert "other" in tenants
+        assert len(tenants) <= cap + 1  # the cap set plus "other"
+        r = metrics.registry()
+        assert r.counter("tpudist_requests_finished_total",
+                         reason="length", tenant="other").value == 20
+
+    def test_feeder_never_raises_on_garbage(self):
+        metrics.feed_record({"kind": "span", "name": "decode_block",
+                             "dur": "not-a-number-is-guarded", "tokens": None})
+        metrics.feed_record({"kind": "event", "name": "request_finished",
+                             "ttft_s": "nope"})
+        metrics.feed_record({})
+
+
+class TestLiveVsPostHoc:
+    def test_live_percentiles_match_aggregator_within_bound(self, tmp_path):
+        """The acceptance-criterion cross-check at unit scope: the SAME
+        request_finished stream seen live (sketch) and post-hoc
+        (aggregator percentiles over exact values) agrees within the
+        quoted sketch-resolution bound."""
+        telemetry.start(tmp_path, rank=0, generation=0)
+        rng = random.Random(3)
+        for _ in range(300):
+            telemetry.event(
+                "request_finished", reason="length",
+                ttft_s=rng.lognormvariate(-3.5, 0.8),
+                tpot_s=rng.lognormvariate(-5.5, 0.5), tokens_out=4)
+        telemetry.finish(write_report=False)
+        from tpudist.telemetry.aggregate import aggregate_run
+
+        rep = aggregate_run(tmp_path)["serving"]
+        r = metrics.registry()
+        for key, metric in (("ttft", "tpudist_ttft_seconds"),
+                            ("tpot", "tpudist_tpot_seconds")):
+            h = r.histogram(metric, tenant="default")
+            for q, field in ((50, "p50_s"), (95, "p95_s")):
+                exact = rep[key][field]
+                live = h.quantile(q)
+                assert abs(live - exact) <= QUANTILE_REL_ERROR * exact + 1e-9, (
+                    f"{key} p{q}: live {live} vs post-hoc {exact}")

@@ -1,0 +1,215 @@
+"""Scrape endpoints (tpudist.telemetry.statusz): the tier-1 smoke test
+that starts a REAL server on an ephemeral port (``TPUDIST_METRICS_PORT=
+0``), scrapes ``/metrics`` and ``/healthz`` MID-SERVE, and validates
+the Prometheus text format parses; plus the healthz-semantics
+regressions — ``/healthz`` must go non-200 when the engine loop has
+aborted (``serve_loop_error``) or its heartbeat is stale, not merely
+when the HTTP thread is alive."""
+
+import json
+import re
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist import telemetry
+from tpudist.models import create_transformer
+from tpudist.serve import InferenceServer, ServeConfig
+from tpudist.telemetry import metrics, statusz
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+@pytest.fixture(autouse=True)
+def clean_plane(monkeypatch, tmp_path):
+    """Ephemeral-port endpoint + fresh registry + tmp telemetry dir per
+    test; the singleton endpoint is torn down afterwards."""
+    monkeypatch.setenv(statusz.ENV_PORT, "0")
+    monkeypatch.setenv(telemetry.ENV_DIR, str(tmp_path))
+    for var in (metrics.ENV_SLO_TTFT, metrics.ENV_SLO_TPOT):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.finish(write_report=False)
+    metrics.registry().clear()
+    statusz.stop()
+    yield
+    statusz.stop()
+    telemetry.finish(write_report=False)
+    metrics.registry().clear()
+    metrics.disarm()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _get_code(port, path):
+    """Status code even for non-2xx (urlopen raises on those)."""
+    try:
+        return _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+#: Prometheus text exposition grammar (format 0.0.4): metric lines only;
+#: comments must be TYPE lines.
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' -?[0-9.eE+-]+$')
+
+
+def assert_prometheus_parses(text):
+    assert text.strip(), "empty /metrics body"
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE "), f"bad comment: {line!r}"
+        else:
+            assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+
+
+class TestSmokeScrape:
+    def test_scrape_metrics_and_healthz_mid_serve(self, model):
+        """THE smoke test: ephemeral-port endpoint, live scrape while
+        requests are in flight, Prometheus format validated, /statusz
+        JSON carries the serve section, a stale engine heartbeat flips
+        /healthz to 503, registration names deduplicate, the endpoint
+        unregisters the serve section on close.  (One server build —
+        each build recompiles the slot programs, so the whole surface
+        drives off one instance for the tier-1 wall budget.)"""
+        srv = InferenceServer(
+            *model, ServeConfig(num_slots=2, max_new=8),
+            install_signal_handler=False).start()
+        ep = statusz.active()
+        assert ep is not None and ep.port > 0
+        try:
+            rng = np.random.default_rng(0)
+            handles = [srv.submit(rng.integers(0, 16, size=4).astype(np.int32),
+                                  max_new=8, tenant="smoke")
+                       for _ in range(4)]
+            # scrape MID-SERVE (some requests still in flight)
+            code, body = _get(ep.port, "/metrics")
+            assert code == 200
+            assert_prometheus_parses(body)
+            code, hz = _get(ep.port, "/healthz")
+            assert code == 200
+            hz = json.loads(hz)
+            assert hz["ok"] and hz["checks"]["serve"]["ok"]
+            for h in handles:
+                assert h.wait(60)
+            code, body = _get(ep.port, "/metrics")
+            assert_prometheus_parses(body)
+            assert "tpudist_requests_finished_total" in body
+            assert 'tenant="smoke"' in body
+            assert "tpudist_ttft_seconds" in body
+            code, st = _get(ep.port, "/statusz")
+            doc = json.loads(st)
+            assert doc["serve"]["slots"]["total"] == 2
+            assert doc["serve"]["completed"] == 4
+            # every submit's +1 met its finish's -1 (the +1 lands
+            # BEFORE the handle is visible, so no phantom can pin)
+            assert doc["serve"]["tenants_in_flight"] == {}
+            assert "dropped" in doc["telemetry"]
+            # -- stale heartbeat → 503 (regression: HTTP liveness alone
+            # must never read as health) --------------------------------
+            assert srv._beat is not None
+            srv.health_stale_s = 0.0  # any age is stale
+            code, body = _get_code(ep.port, "/healthz")
+            assert code == 503
+            assert json.loads(body)["checks"]["serve"]["heartbeat_stale"]
+            srv.health_stale_s = 60.0
+            code, _ = _get_code(ep.port, "/healthz")
+            assert code == 200
+            # -- name dedup: a second registrant under the same name
+            # lands as serve-2, not a clobber ----------------------------
+            name2 = ep.register_status("serve", lambda: {"second": True})
+            assert name2 == "serve-2"
+            doc = json.loads(_get(ep.port, "/statusz")[1])
+            assert "serve" in doc and doc["serve-2"] == {"second": True}
+            ep.unregister(name2)
+        finally:
+            srv.close()
+        # close() unregistered the serve section; endpoint stays up
+        code, st = _get(ep.port, "/statusz")
+        assert "serve" not in json.loads(st)
+
+    def test_unknown_path_404(self, model):
+        statusz.ensure_started()
+        code, _ = _get_code(statusz.active().port, "/nope")
+        assert code == 404
+
+    def test_endpoint_off_when_env_unset(self, monkeypatch):
+        monkeypatch.delenv(statusz.ENV_PORT, raising=False)
+        assert statusz.ensure_started() is None
+        assert statusz.active() is None
+
+
+class TestHealthzSemantics:
+    def test_unhealthy_on_engine_loop_abort(self, model, monkeypatch):
+        """REGRESSION (hygiene pass): an injected engine-loop exception
+        must flip /healthz to 503 naming serve_loop_error — the HTTP
+        thread being alive is not health."""
+        srv = InferenceServer(*model, ServeConfig(num_slots=2),
+                              install_signal_handler=False).start()
+        try:
+            # regression (while the server is still healthy): a submit
+            # that fails for ANY reason — bad prompt, not just
+            # AdmissionError — must give its tenant +1 back
+            with pytest.raises(Exception):
+                srv.submit("not token ids", max_new=4, tenant="leaky")
+            assert srv._tenant_inflight == {}
+            monkeypatch.setattr(
+                srv.engine, "decode_auto",
+                lambda *a, **k: (_ for _ in ()).throw(
+                    RuntimeError("injected engine-loop death")))
+            h = srv.submit(np.arange(4, dtype=np.int32), max_new=4)
+            assert h.wait(30)
+            assert h.finish_reason == "shutdown"
+            srv._thread.join(10)  # the loop re-raises and the thread exits
+            code, body = _get_code(statusz.active().port, "/healthz")
+            assert code == 503
+            doc = json.loads(body)
+            assert not doc["ok"]
+            assert not doc["checks"]["serve"]["ok"]
+            assert "injected engine-loop death" in str(
+                doc["checks"]["serve"]["loop_error"])
+        finally:
+            srv.close()
+
+    def test_watchdog_freshness_feeds_healthz(self):
+        from tpudist.runtime.watchdog import Watchdog
+
+        statusz.ensure_started()
+        dog = Watchdog(30.0, name="t_statusz", abort=lambda code: None)
+        dog.start()
+        try:
+            code, body = _get(statusz.active().port, "/healthz")
+            doc = json.loads(body)
+            assert doc["checks"]["watchdog"]["watchdogs"]["t_statusz"]["fresh"]
+            assert code == 200
+        finally:
+            dog.stop()
+        # stopped watchdog drops out of the report
+        _, body = _get(statusz.active().port, "/healthz")
+        assert "t_statusz" not in json.loads(
+            body)["checks"]["watchdog"]["watchdogs"]
+
+    def test_provider_exception_is_unhealthy_not_500(self):
+        srv = statusz.ensure_started()
+        name = srv.register_health(
+            "boom", lambda: (_ for _ in ()).throw(ValueError("bad check")))
+        try:
+            code, body = _get_code(srv.port, "/healthz")
+            assert code == 503
+            assert "bad check" in json.loads(body)["checks"]["boom"]["error"]
+        finally:
+            srv.unregister(name)

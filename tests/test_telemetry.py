@@ -521,6 +521,140 @@ class TestMetricsDurability:
         logger.finish()  # must not raise
 
 
+class TestDropAccounting:
+    """Telemetry drops are no longer silent: ring evictions and stream
+    write failures count in ``session.dropped``, warn once, stamp a
+    ``telemetry_dropped`` event at close, and surface in the report."""
+
+    def test_ring_evictions_counted_on_ring_only_session(self, tmp_path):
+        s = telemetry.start(tmp_path, rank=0, generation=0, ring_size=8)
+        # degrade to a RING-ONLY session (the stream-never-opened
+        # shape): from here an evicted record exists nowhere
+        s._file.close()
+        s._file = None
+        for i in range(20):
+            s.event("tick", i=i)
+        # session_start + 20 ticks through an 8-deep ring
+        assert s.dropped["ring"] == 21 - 8
+        assert s.dropped["write"] == 0
+
+    def test_ring_rotation_with_live_stream_is_not_a_drop(self, tmp_path):
+        """A healthy long run rotates its ring constantly while the
+        JSONL captures everything — that must NOT stamp the 'report is
+        incomplete' banner (regression: every real run would have)."""
+        s = telemetry.start(tmp_path, rank=0, generation=0, ring_size=8)
+        for i in range(20):
+            s.event("tick", i=i)
+        assert s.dropped == {"ring": 0, "write": 0}
+        telemetry.finish(write_report=False)
+        recs = load_records(tmp_path)
+        assert len([r for r in recs if r["name"] == "tick"]) == 20
+        assert not any(r["name"] == "telemetry_dropped" for r in recs)
+        assert "telemetry_dropped" not in aggregate_run(tmp_path)
+
+    def test_write_failures_counted_and_warned_once(self, tmp_path):
+        import warnings as _w
+
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        s._file.close()  # simulate the stream dying underneath
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            s.event("a")
+            s.event("b")
+        assert s.dropped["write"] == 2
+        runtime = [w for w in caught if "dropping records" in str(w.message)]
+        assert len(runtime) == 1  # warned ONCE per session
+
+    def test_close_stamps_dropped_event_into_stream(self, tmp_path):
+        """A session whose stream FAILED mid-run but recovered gets its
+        drop count into the surviving stream at close."""
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        f = s._file
+        s._file = None  # stream "down": these records are ring-only...
+        for i in range(3):
+            s.event("lost", i=i)
+            s._count_write_drop()  # ...and their write failures counted
+        s._file = f  # stream recovered
+        telemetry.finish(write_report=False)
+        recs = load_records(tmp_path)
+        drops = [r for r in recs if r["name"] == "telemetry_dropped"]
+        assert len(drops) == 1 and drops[0]["write"] == 3
+
+    def test_report_surfaces_drop_totals(self, tmp_path):
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        with telemetry.span("step"):
+            pass
+        s.dropped["write"] = 5  # as _count_write_drop would have
+        telemetry.finish(write_report=False)
+        report = aggregate_run(tmp_path)
+        assert report["telemetry_dropped"]["write"] == 5
+        assert "telemetry dropped" in render_markdown(report)
+
+
+class TestAggregatorBackCompat:
+    """PR-8 "old streams untouched" discipline, observability edition:
+    a pre-observability JSONL stream (no trace_ids, no metrics/slo
+    events, no drop markers) must aggregate EXACTLY as before — no new
+    report keys, byte-identical JSON across repeated aggregation."""
+
+    _OLD_STREAM = [
+        {"kind": "event", "name": "session_start", "t": 100.0, "dur": 0.0,
+         "rank": 0, "gen": 0, "pid": 1},
+        {"kind": "span", "name": "prefill", "t": 100.1, "dur": 0.05,
+         "rank": 0, "gen": 0, "n": 2},
+        {"kind": "span", "name": "decode_block", "t": 100.2, "dur": 0.1,
+         "rank": 0, "gen": 0, "occupancy": 0.5, "k": 8, "tokens": 8,
+         "dispatch_s": 0.01, "sync_s": 0.02},
+        {"kind": "event", "name": "request_finished", "t": 100.4, "dur": 0.0,
+         "rank": 0, "gen": 0, "id": 0, "reason": "length", "prompt_len": 4,
+         "tokens_out": 8, "ttft_s": 0.2, "tpot_s": 0.01,
+         "queue_wait_s": 0.001},
+        {"kind": "event", "name": "session_end", "t": 100.5, "dur": 0.0,
+         "rank": 0, "gen": 0},
+    ]
+
+    def _write_old(self, tmp_path):
+        with open(tmp_path / "rank0_gen0.jsonl", "w") as f:
+            for r in self._OLD_STREAM:
+                f.write(json.dumps(r) + "\n")
+
+    def test_old_stream_gains_no_new_sections(self, tmp_path):
+        self._write_old(tmp_path)
+        report = aggregate_run(tmp_path)
+        assert "telemetry_dropped" not in report
+        assert "slo" not in report["serving"]
+        assert report["serving"]["requests_finished"] == 1
+        # no trace artifacts leak into the report of a trace-less stream
+        assert "trace" not in json.dumps(report).lower()
+
+    def test_old_stream_aggregates_deterministically(self, tmp_path):
+        self._write_old(tmp_path)
+        a = json.dumps(aggregate_run(tmp_path), sort_keys=True)
+        b = json.dumps(aggregate_run(tmp_path), sort_keys=True)
+        assert a == b
+
+    def test_new_fields_are_purely_additive(self, tmp_path):
+        """The SAME stream plus the new observability records produces
+        the SAME values for every pre-existing field — new sections
+        bolt on, nothing moves."""
+        self._write_old(tmp_path)
+        before = aggregate_run(tmp_path)
+        with open(tmp_path / "rank0_gen0.jsonl", "a") as f:
+            f.write(json.dumps(
+                {"kind": "span", "name": "req_decode", "t": 100.25,
+                 "dur": 0.1, "rank": 0, "gen": 0, "parent": "request",
+                 "trace_id": "ab" * 8}) + "\n")
+            f.write(json.dumps(
+                {"kind": "event", "name": "slo_config", "t": 100.0,
+                 "dur": 0.0, "rank": 0, "gen": 0, "ttft_ms": 500.0}) + "\n")
+        after = aggregate_run(tmp_path)
+        assert after["serving"]["slo"]["overall"]["ttft_attainment"] == 1.0
+        for key in ("goodput", "step", "wall_clock_s", "per_rank"):
+            assert before[key] == after[key], f"{key} moved"
+        for key in ("ttft", "tpot", "finish_reasons", "decode_tokens"):
+            assert before["serving"][key] == after["serving"][key]
+
+
 class TestStageTimerPlumbing:
     def test_emit_reaches_metrics_and_telemetry(self, tmp_path):
         from tpudist.utils.metrics import MetricsLogger

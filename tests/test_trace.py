@@ -1,0 +1,250 @@
+"""Per-request cross-pool tracing (tpudist.telemetry.trace): trace_id
+minting/threading, lifeline spans goodput-invisible, the handoff
+package schema bump (v3 carries trace_id, v2 still deserializes), the
+Chrome trace export format, and — in the slow lane — the chaos drive
+where a killed decode worker's lane visibly replays on the survivor in
+one joined lifeline."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist import telemetry
+from tpudist.models import create_transformer
+from tpudist.serve import InferenceServer, ServeConfig
+from tpudist.telemetry import trace
+from tpudist.telemetry.aggregate import aggregate_run, load_records
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+@pytest.fixture(autouse=True)
+def clean_session(monkeypatch):
+    monkeypatch.delenv(trace.ENV_TRACE, raising=False)
+    monkeypatch.delenv("TPUDIST_METRICS_PORT", raising=False)
+    telemetry.finish(write_report=False)
+    yield
+    telemetry.finish(write_report=False)
+
+
+def _serve(model, run_dir, n=3, **submit_kw):
+    telemetry.start(run_dir, rank=0, generation=0)
+    srv = InferenceServer(*model, ServeConfig(num_slots=2, max_new=6),
+                          install_signal_handler=False).start()
+    rng = np.random.default_rng(0)
+    hs = [srv.submit(rng.integers(0, 16, size=4).astype(np.int32),
+                     max_new=5, **submit_kw) for _ in range(n)]
+    for h in hs:
+        assert h.wait(60)
+    srv.close()
+    telemetry.finish(write_report=False)
+    return hs
+
+
+@pytest.fixture(scope="module")
+def served_run(model, tmp_path_factory):
+    """ONE recorded serve shared by every read-only trace test — each
+    server build recompiles the slot programs, so the tests that only
+    READ the stream share a single run (tier-1 wall budget)."""
+    run_dir = tmp_path_factory.mktemp("trace_run")
+    handles = _serve(model, str(run_dir), n=4, tenant="t0")
+    return handles, run_dir, load_records(run_dir)
+
+
+class TestTraceIds:
+    def test_minted_at_submit_and_unique(self, served_run):
+        hs, _, _ = served_run
+        ids = [h.trace_id for h in hs]
+        assert all(isinstance(t, str) and len(t) == 16 for t in ids)
+        assert len(set(ids)) == 4
+
+    def test_lifeline_spans_emitted_and_joined(self, served_run):
+        hs, _, recs = served_run
+        joined = trace.join_traces(recs)
+        for h in hs:
+            names = [r["name"] for r in joined[h.trace_id]]
+            assert "req_queue" in names
+            assert "req_prefill" in names
+            assert "req_decode" in names
+            assert "request_finished" in names
+        # lifeline spans are DETAIL: parented so goodput never counts
+        # the same wall-clock twice
+        for r in recs:
+            if r.get("name", "").startswith("req_"):
+                assert r.get("parent") == "request"
+
+    def test_lifelines_do_not_change_goodput(self, served_run):
+        """The req_* spans re-describe time the prefill/decode spans
+        already account — the goodput components must not grow by the
+        lifeline's duration (old-streams discipline, forward edition)."""
+        _, run_dir, recs = served_run
+        rep = aggregate_run(run_dir)
+        total_req = sum(float(r.get("dur", 0)) for r in recs
+                        if r.get("name", "").startswith("req_"))
+        assert total_req > 0  # the lifelines exist...
+        gp = sum(v["s"] for k, v in rep["goodput"].items()
+                 if k not in ("idle", "resize", "lost_restart"))
+        wall = rep["wall_clock_s"]
+        assert gp <= wall * 1.01  # ...and did not inflate busy time
+
+    def test_trace_env_disarms_lifelines(self, model, tmp_path, monkeypatch):
+        monkeypatch.setenv(trace.ENV_TRACE, "0")
+        hs = _serve(model, str(tmp_path), n=2)
+        recs = load_records(tmp_path)
+        assert not any(r.get("name", "").startswith("req_") for r in recs)
+        # request_finished still carries the id (the join key survives)
+        fins = [r for r in recs if r.get("name") == "request_finished"]
+        assert all(r.get("trace_id") == h.trace_id
+                   for r, h in zip(sorted(fins, key=lambda r: r["id"]),
+                                   sorted(hs, key=lambda h: h.id)))
+
+
+class TestHandoffSchema:
+    def _pkg(self):
+        return {"paged": False, "pos": 3, "counts": 2, "budget": 5,
+                "trace_id": "cafe0123deadbeef",
+                "lane": {"k": np.arange(6, dtype=np.float32).reshape(2, 3)},
+                "state": {"last": np.int32(7)}}
+
+    def test_v3_round_trips_trace_id(self):
+        from tpudist.serve.disagg import (HANDOFF_SCHEMA_VERSION,
+                                          deserialize_package,
+                                          serialize_package)
+
+        ser = serialize_package(self._pkg())
+        assert ser["schema_version"] == HANDOFF_SCHEMA_VERSION == 3
+        assert ser["trace_id"] == "cafe0123deadbeef"
+        out = deserialize_package(ser)
+        assert out["trace_id"] == "cafe0123deadbeef"
+        np.testing.assert_array_equal(out["lane"]["k"],
+                                      self._pkg()["lane"]["k"])
+
+    def test_v2_package_still_deserializes(self):
+        """BACK-COMPAT (PR-8 discipline): a schema_version-2 package —
+        the pre-trace wire format, no trace_id field — must still
+        import; its trace_id reads back None."""
+        from tpudist.serve.disagg import (deserialize_package,
+                                          serialize_package)
+
+        ser = serialize_package(self._pkg())
+        ser["schema_version"] = 2
+        del ser["trace_id"]  # exactly what a v2 sender puts on the wire
+        out = deserialize_package(ser)
+        assert out["trace_id"] is None
+        assert out["pos"] == 3 and out["budget"] == 5
+        np.testing.assert_array_equal(out["lane"]["k"],
+                                      self._pkg()["lane"]["k"])
+
+    def test_unsupported_versions_still_rejected(self):
+        from tpudist.serve.disagg import (HandoffError,
+                                          deserialize_package,
+                                          serialize_package)
+
+        for doctor in (lambda s: s.__setitem__("schema_version", 1),
+                       lambda s: s.__setitem__("schema_version", 9),
+                       lambda s: s.pop("schema_version")):
+            ser = serialize_package(self._pkg())
+            doctor(ser)
+            with pytest.raises(HandoffError) as ei:
+                deserialize_package(ser)
+            assert ei.value.reason == "schema"
+
+
+class TestChromeExport:
+    def test_export_is_loadable_and_crosses_tracks(self, served_run):
+        _, run_dir, _ = served_run
+        out = trace.export_chrome_trace(run_dir)
+        doc = json.loads(out.read_text())  # Perfetto loads valid JSON
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert xs, "no complete events"
+        for e in xs:
+            assert {"name", "pid", "tid", "ts", "dur"} <= set(e)
+            assert e["dur"] > 0
+        # flow arrows stitch multi-span lifelines
+        assert any(e["ph"] == "s" for e in evs)
+        assert any(e["ph"] == "f" for e in evs)
+        # process metadata names the pools
+        names = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert any("prefill" in n for n in names)
+        assert any("decode" in n for n in names)
+
+    def test_empty_stream_exports_empty_but_loadable(self, tmp_path):
+        (tmp_path / "rank0_gen0.jsonl").write_text(
+            json.dumps({"kind": "span", "name": "step", "t": 1.0,
+                        "dur": 0.1, "rank": 0, "gen": 0}) + "\n")
+        out = trace.export_chrome_trace(tmp_path)
+        doc = json.loads(out.read_text())
+        assert doc["traceEvents"] == []
+
+    def test_cli_trace_subcommand(self, served_run, capsys):
+        _, run_dir, _ = served_run
+        from tpudist.telemetry.__main__ import main
+
+        rc = main(["trace", str(run_dir)])
+        assert rc == 0
+        assert "trace.json" in capsys.readouterr().out
+
+
+class TestTraceChaos:
+    def test_killed_decode_lane_replays_on_survivor_in_one_lifeline(
+            self, model, tmp_path, monkeypatch):
+        """The acceptance drive at test scope: disagg serve with a
+        chaos-killed decode worker — ONE trace_id's lifeline crosses
+        prefill pool → handoff → decode pool AND shows the replay
+        jumping workers, with the lane_recovered marker tagged."""
+        from tpudist.serve import DisaggServer
+
+        monkeypatch.setenv("TPUDIST_FAULT",
+                           "serve_worker_kill@call:6,pool:1,worker:0")
+        telemetry.start(tmp_path, rank=0, generation=0)
+        cfg = ServeConfig(num_slots=2, max_new=10, disagg=True,
+                          decode_workers=2, handoff="serial")
+        srv = DisaggServer(*model, cfg, install_signal_handler=False).start()
+        rng = np.random.default_rng(0)
+        hs = [srv.submit(rng.integers(0, 16, size=4).astype(np.int32),
+                         max_new=10) for _ in range(6)]
+        for h in hs:
+            assert h.wait(120)
+        assert {h.finish_reason for h in hs} == {"length"}
+        assert srv.workers_lost == 1 and srv.lanes_recovered >= 1
+        srv.close()
+        telemetry.finish(write_report=False)
+        from tpudist.runtime import faults
+
+        faults.disarm()
+        recs = load_records(tmp_path)
+        joined = trace.join_traces(recs)
+        # every lifeline crossed the pools
+        crossing = [rs for rs in joined.values()
+                    if {"req_prefill", "req_handoff", "req_decode"}
+                    <= {r["name"] for r in rs}]
+        assert len(crossing) == 6
+        # at least one lifeline shows the worker jump + recovery marker
+        replayed = []
+        for tid, rs in joined.items():
+            dec = [r for r in rs if r["name"] == "req_decode"]
+            if len(dec) > 1:
+                assert len({d["worker"] for d in dec}) > 1, (
+                    "replay segments must name different workers")
+                assert any(r.get("name") == "lane_recovered" for r in rs)
+                replayed.append(tid)
+        assert replayed, "no lifeline recorded the survivor replay"
+        # and the exported timeline is loadable with the jump visible
+        out = trace.export_chrome_trace(tmp_path)
+        doc = json.loads(out.read_text())
+        dec_tids = {(e["pid"], e["tid"]) for e in doc["traceEvents"]
+                    if e["ph"] == "X" and e["name"] == "req_decode"
+                    and e["args"].get("trace_id") in replayed}
+        assert len(dec_tids) > 1  # two worker rows in the decode pool
+        assert any(e["ph"] == "i" and e["name"] == "lane_recovered"
+                   for e in doc["traceEvents"])

@@ -155,6 +155,15 @@ def _worker_env(base: Dict[str, str], *, coordinator: Optional[str], world: int,
         # per-rank/per-generation file names keep them apart, and the
         # end-of-run merge joins them into the goodput report.
         env["TPUDIST_TELEMETRY_DIR"] = telemetry_dir
+    # Scrape-endpoint port fan-out: the AGENT binds the configured port
+    # before any worker launches, so workers inheriting the same value
+    # would all fail to bind and silently lose their endpoints — exactly
+    # the serve/train /metrics the feature exists for.  A fixed port P
+    # maps workers to P+1+local_rank (deterministic, documented); 0
+    # (ephemeral) passes through — every process binds its own.
+    port = env.get("TPUDIST_METRICS_PORT", "").strip()
+    if port and port.isdigit() and int(port) > 0:
+        env["TPUDIST_METRICS_PORT"] = str(int(port) + 1 + local_rank)
     return env
 
 
@@ -361,6 +370,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             pass
         return agent_tele["session"]
 
+    # Live observability: the agent exposes /metrics /healthz /statusz
+    # when TPUDIST_METRICS_PORT is set — fleet-level restart/resize state
+    # that was stderr-only before.  Best-effort; never kills the run.
+    agent_state = {"world": world, "generation": 0, "attempt_in_world": 0,
+                   "nprocs": args.nprocs, "run_id": run_id,
+                   "restarts_max": args.max_restarts, "elastic":
+                   bool(getattr(args, "elastic", False))}
+    try:
+        from tpudist.telemetry import statusz as _statusz
+
+        _agent_statusz = _statusz.ensure_started()
+        if _agent_statusz is not None:
+            _agent_statusz.register_status(
+                "tpurun", lambda: dict(agent_state))
+    except Exception:  # noqa: BLE001
+        pass
+
     if args.stage_data:
         from tpudist.launch.staging import extract_tarballs
         from tpudist.utils.profiling import StageTimer
@@ -447,6 +473,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr)
             generation += 1
             attempt_in_world += 1
+            agent_state.update(generation=generation,
+                               attempt_in_world=attempt_in_world)
             if attempt_in_world < max_attempts:
                 continue
             # Restart budget exhausted at this world size.  Stamp the
@@ -490,6 +518,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     s.flush()
                 world = nprocs = new_world
                 attempt_in_world = 0
+                agent_state.update(world=world, nprocs=nprocs,
+                                   attempt_in_world=0)
                 if standalone:
                     coordinator = (f"127.0.0.1:{find_free_port()}"
                                    if world > 1 else "")

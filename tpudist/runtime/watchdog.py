@@ -31,6 +31,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from typing import Callable, Dict, Optional
 
 #: Process exit code on a stall abort (the ``timeout(1)`` convention, so
@@ -46,6 +47,30 @@ def timeout_from_env(default: Optional[float] = None) -> Optional[float]:
     from tpudist.utils.envutil import env_positive_float
 
     return env_positive_float(TIMEOUT_ENV, default)
+
+
+#: Running watchdogs, for the ``/healthz`` freshness check
+#: (:mod:`tpudist.telemetry.statusz`): weak so a dropped watchdog never
+#: pins itself in the health report.
+_LIVE: "weakref.WeakSet[Watchdog]" = weakref.WeakSet()
+
+
+def freshness() -> Dict[str, dict]:
+    """Heartbeat freshness of every RUNNING watchdog: seconds since the
+    last pet vs the current stall deadline.  Empty when none is armed —
+    the health check treats that as vacuously healthy."""
+    out: Dict[str, dict] = {}
+    for dog in list(_LIVE):
+        if dog._thread is None:
+            continue  # built but not started / already stopped
+        age = time.monotonic() - dog._last
+        deadline = dog._deadline()
+        out[dog.name] = {
+            "age_s": round(age, 3),
+            "deadline_s": round(deadline, 3),
+            "fresh": age <= deadline,
+        }
+    return out
 
 
 def dump_all_stacks() -> Dict[str, str]:
@@ -102,6 +127,7 @@ class Watchdog:
             target=self._run, name=f"tpudist-watchdog[{self.name}]", daemon=True
         )
         self._thread.start()
+        _LIVE.add(self)  # visible to the /healthz freshness check
         return self
 
     def pet(self) -> None:
@@ -117,6 +143,7 @@ class Watchdog:
 
     def stop(self) -> None:
         self._stop.set()
+        _LIVE.discard(self)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

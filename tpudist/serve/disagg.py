@@ -78,16 +78,23 @@ from typing import Dict, List, Optional, Tuple
 
 from tpudist.serve.engine import SlotEngine
 from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
+from tpudist.serve.server import _Observability
 
 _IDLE_WAIT_S = 0.01
 
 #: Wire-format version of a serialized KV-handoff package.  Bumped
 #: whenever the blob layout changes; :func:`deserialize_package` REJECTS
-#: a missing or mismatched version with a clear error instead of
+#: a missing or unsupported version with a clear error instead of
 #: shape-crashing mid-import (mixed tpudist versions across pools, or a
 #: replayed package from an old run).  v2 added the schema field itself
-#: plus the blob integrity digest.
-HANDOFF_SCHEMA_VERSION = 2
+#: plus the blob integrity digest; v3 added the per-request ``trace_id``
+#: (the cross-pool tracing join key).  v2 packages still DESERIALIZE
+#: (their trace_id reads back ``None``) — the new field is additive and
+#: outside the digested blob, so the old wire format stays valid.
+HANDOFF_SCHEMA_VERSION = 3
+
+#: Oldest wire format :func:`deserialize_package` accepts.
+HANDOFF_SCHEMA_MIN = 2
 
 
 class HandoffError(RuntimeError):
@@ -147,6 +154,7 @@ def serialize_package(pkg: dict) -> dict:
     ser = {"schema_version": HANDOFF_SCHEMA_VERSION,
            "paged": pkg["paged"], "pos": pkg["pos"],
            "counts": pkg["counts"], "budget": pkg["budget"],
+           "trace_id": pkg.get("trace_id"),
            "blob": blob, "tree": tree,
            "digest": _blob_digest(blob),
            "bytes": sum(len(b) for b, _, _ in blob)}
@@ -160,16 +168,19 @@ def serialize_package(pkg: dict) -> dict:
 
 
 def check_package_schema(ser: dict) -> None:
-    """Raise :class:`HandoffError` unless ``ser`` carries the expected
-    ``schema_version`` — the cheap envelope check a full decode pool
-    runs per blocked iteration (no blob work)."""
+    """Raise :class:`HandoffError` unless ``ser`` carries a supported
+    ``schema_version`` (``HANDOFF_SCHEMA_MIN`` .. current — v2 streams
+    without trace_ids still import) — the cheap envelope check a full
+    decode pool runs per blocked iteration (no blob work)."""
     ver = ser.get("schema_version")
-    if ver != HANDOFF_SCHEMA_VERSION:
+    if (not isinstance(ver, int)
+            or not HANDOFF_SCHEMA_MIN <= ver <= HANDOFF_SCHEMA_VERSION):
         raise HandoffError(
-            f"handoff package schema_version {ver!r} != expected "
-            f"{HANDOFF_SCHEMA_VERSION} (missing = pre-versioning sender; "
-            "mismatched = mixed tpudist versions across pools) — "
-            "rejected instead of shape-crashing mid-import",
+            f"handoff package schema_version {ver!r} not in supported "
+            f"range [{HANDOFF_SCHEMA_MIN}, {HANDOFF_SCHEMA_VERSION}] "
+            "(missing = pre-versioning sender; out of range = mixed "
+            "tpudist versions across pools) — rejected instead of "
+            "shape-crashing mid-import",
             reason="schema")
 
 
@@ -193,14 +204,17 @@ def deserialize_package(ser: dict) -> dict:
     lane, state = jax.tree.unflatten(ser["tree"], flat)
     return {"paged": ser["paged"], "pos": ser["pos"],
             "counts": ser["counts"], "budget": ser["budget"],
+            "trace_id": ser.get("trace_id"),  # None on a v2 package
             "lane": lane, "state": state}
 
 
-class DisaggServer:
+class DisaggServer(_Observability):
     """Disaggregated continuous-batching server: prefill pool → KV
     handoff → decode pool.  Config rides the same
     :class:`tpudist.serve.server.ServeConfig` (``disagg=True`` selects
     this class in :func:`tpudist.serve.server.serve_forever`)."""
+
+    _statusz_name = "serve-disagg"
 
     def __init__(self, module, params, config=None, *,
                  install_signal_handler: bool = True):
@@ -273,6 +287,8 @@ class DisaggServer:
         self.tokens_out = 0
         self.handoffs = 0
         self.handoff_bytes = 0
+        # -- live observability plane (server._Observability) --------------
+        self._init_observability()
         # -- self-healing fleet state (module doc: recovery contract) ------
         self.recover = bool(getattr(cfg, "recover", True))
         #: dead worker indices per pool — skipped by every loop phase
@@ -326,6 +342,7 @@ class DisaggServer:
             decode_slots=self.decode_pool[0].num_slots,
             handoff=self.handoff_mode,
             mesh=self.decode_pool[0].spmd_stats().get("mesh"))
+        self._start_observability()
         if self._install_signal:
             self._installed_preemption = preemption.install()
         self._thread = threading.Thread(
@@ -336,16 +353,24 @@ class DisaggServer:
     def submit(self, prompt, *, max_new: Optional[int] = None,
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
-               on_token=None, spec: Optional[bool] = None) -> RequestHandle:
+               on_token=None, spec: Optional[bool] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         from tpudist import telemetry
 
+        # +1 BEFORE the handle is visible to the engine thread (see
+        # InferenceServer.submit: a fast finish must never decrement
+        # first and pin a phantom in-flight)
+        tkey = None if tenant is None else str(tenant)
+        self._track_tenant(tkey, +1)
         try:
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
-                on_token=on_token, spec=spec)
-        except AdmissionError as e:
-            telemetry.event("serve_rejected", reason=e.reason)
+                on_token=on_token, spec=spec, tenant=tenant)
+        except BaseException as e:
+            self._track_tenant(tkey, -1)  # never admitted (ANY failure)
+            if isinstance(e, AdmissionError):
+                telemetry.event("serve_rejected", reason=e.reason)
             raise
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -362,12 +387,75 @@ class DisaggServer:
 
     def close(self, timeout: Optional[float] = None) -> bool:
         ok = self.drain(timeout)
+        self._stop_observability()
         if self._installed_preemption:
             from tpudist.runtime import preemption
 
             preemption.reset()
             self._installed_preemption = False
         return ok
+
+    def _observability_gauges(self) -> dict:
+        return {
+            "tpudist_serve_prefill_workers": len(self.prefill_pool),
+            "tpudist_serve_decode_workers": len(self.decode_pool),
+            "tpudist_serve_handoff_queue_limit": self.handoff_limit,
+            "tpudist_serve_queue_limit": self.config.queue_limit,
+        }
+
+    def _statusz_doc(self) -> dict:
+        """``/statusz`` with per-pool sections: worker liveness, slot
+        occupancy, the handoff queue's depth (the backpressure signal),
+        KV residency of the decode pool, per-tenant in-flight."""
+        from tpudist.utils.envutil import env_int
+
+        def _pool(pool: str, engines: List[SlotEngine]) -> dict:
+            alive = self._alive(pool)
+            return {
+                "workers": len(engines),
+                "dead": sorted(self._dead[pool]),
+                "slots_per_worker": engines[0].num_slots,
+                "occupied": sum(engines[i].num_occupied for i in alive),
+                "active": sum(engines[i].num_active for i in alive),
+            }
+
+        kv_occ, kv_resident = self.decode_pool[0].kv_gauges()
+        return {
+            "pools": {
+                "prefill": {**_pool("prefill", self.prefill_pool),
+                            "slot_cap": self._prefill_cap},
+                "decode": _pool("decode", self.decode_pool),
+            },
+            "handoff": {
+                "queued": len(self._handoff),
+                "limit": self.handoff_limit,
+                "total": self.handoffs,
+                "bytes": self.handoff_bytes,
+            },
+            "queue": {
+                "pending": self.scheduler.pending(),
+                "limit": self.config.queue_limit,
+                "rejected": self.scheduler.rejected,
+            },
+            "kv": {
+                "bytes_resident": int(kv_resident),
+                "block_occupancy": (None if kv_occ is None
+                                    else round(float(kv_occ), 4)),
+            },
+            "recovery": {
+                "workers_lost": self.workers_lost,
+                "lanes_recovered": self.lanes_recovered,
+                "requeued": len(self._requeue),
+                "pool_resizes": self.pool_resizes,
+            },
+            "completed": self.completed,
+            "tokens_out": self.tokens_out,
+            "tenants_in_flight": dict(self._tenant_inflight),
+            "world": env_int("TPUDIST_NUM_PROCESSES", None),
+            "generation": env_int("TPUDIST_RESTART_COUNT", 0),
+            "draining": self._draining,
+            "loop_error": self.loop_error,
+        }
 
     def stats(self) -> dict:
         dec = {"blocks": 0, "tokens": 0, "steps": 0,
@@ -496,6 +584,10 @@ class DisaggServer:
             _, _, slot = key
             h = self._slot_handles.pop(key)
             if pool == "decode":
+                # close this residency's timeline segment — the replay
+                # on the survivor opens the next one (the worker jump)
+                if h.decode_segments and h.decode_segments[-1][2] is None:
+                    h.decode_segments[-1][2] = time.monotonic()
                 stash = self._import_pkg.pop((w, slot), None)
                 if survivors and stash is not None:
                     pkg, l0 = stash
@@ -558,6 +650,7 @@ class DisaggServer:
             self._run_loop()
         except BaseException as e:
             # a dying pool worker must not strand waiters (module doc)
+            self.loop_error = repr(e)  # /healthz goes 503 on this
             telemetry.event("serve_loop_error", error=repr(e))
             raise
         finally:
@@ -573,6 +666,7 @@ class DisaggServer:
 
         sched = self.scheduler
         while True:
+            self._beat = time.monotonic()  # /healthz heartbeat
             if not self._draining and self._should_drain():
                 self._draining = True
                 sched.refuse_new("draining")
@@ -716,6 +810,7 @@ class DisaggServer:
             items, t0 = [], time.monotonic()
             for h, slot in zip(alive, free):
                 h.slot = slot
+                h.prefill_worker = w  # timeline attribution
                 if h.t_admitted is None:
                     h.t_admitted = t0
                 items.append((slot, h.request.prompt, h.request.temperature,
@@ -781,7 +876,8 @@ class DisaggServer:
             replayed = self._skip.pop(h.id)
             self.lanes_recovered += 1
             telemetry.event("lane_recovered", pool="prefill", worker=w,
-                            slot=slot, replayed=replayed)
+                            slot=slot, trace_id=h.trace_id,
+                            replayed=replayed)
             if replayed > 0:
                 # token 0 was already delivered by the lost worker —
                 # the re-emission is a duplicate, drop it (its finish
@@ -819,6 +915,10 @@ class DisaggServer:
         try:
             self._tick("prefill", w)
             pkg = eng.export_slot(slot)
+            # the trace_id crosses the pool boundary IN the package (the
+            # wire field is what joins the lifeline when the pools are
+            # separate processes; schema v3)
+            pkg["trace_id"] = h.trace_id
             if self.handoff_mode == "serial":
                 ser = serialize_package(pkg)
                 self.handoff_bytes += ser["bytes"]
@@ -904,13 +1004,18 @@ class DisaggServer:
                     self._lose_worker("decode", w, e)
                     placed = worked = True
                     break
-                h.t_decode_start = time.monotonic()
+                if h.t_decode_start is None:
+                    h.t_decode_start = time.monotonic()
+                # one decode segment per residency: a replay after
+                # worker loss opens a SECOND segment on the survivor —
+                # the visible jump in the exported timeline
+                h.decode_segments.append([w, time.monotonic(), None])
                 h.slot = slot
                 telemetry.event(
                     "kv_handoff", worker=w, slot=slot,
-                    mode=self.handoff_mode,
+                    mode=self.handoff_mode, trace_id=h.trace_id,
                     wait_s=round(h.handoff_wait_s or 0.0, 6),
-                    import_s=round(h.t_decode_start - t0, 6))
+                    import_s=round(time.monotonic() - t0, 6))
                 self._slot_handles[("decode", w, slot)] = h
                 # replay stash: what a dead worker's lanes recover from.
                 # A RECOVERY placement still owes _skip duplicates, so
@@ -927,6 +1032,7 @@ class DisaggServer:
                     self.lanes_recovered += 1
                     telemetry.event("lane_recovered", pool="decode",
                                     worker=w, slot=slot,
+                                    trace_id=h.trace_id,
                                     replayed=self._skip[h.id])
                     if self._skip[h.id] == 0:
                         del self._skip[h.id]
@@ -1039,6 +1145,7 @@ class DisaggServer:
 
     def _note_finished(self, h: RequestHandle) -> None:
         from tpudist import telemetry
+        from tpudist.telemetry import trace
 
         # the ONE cleanup point for recovery bookkeeping: every finish
         # path funnels here, so a recovering lane that ends early (a
@@ -1046,8 +1153,17 @@ class DisaggServer:
         # can never leak its replay-skip entry
         self._skip.pop(h.id, None)
         self.completed += 1
+        self._track_tenant(h.request.tenant, -1)
+        # close the last decode residency segment at the request's end
+        if h.decode_segments and h.decode_segments[-1][2] is None:
+            h.decode_segments[-1][2] = h.t_done
         telemetry.event(
             "request_finished", id=h.id, reason=h.finish_reason,
             prompt_len=int(len(h.request.prompt)), tokens_out=len(h.tokens),
             ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s,
-            pool="disagg", handoff_wait_s=h.handoff_wait_s)
+            pool="disagg", handoff_wait_s=h.handoff_wait_s,
+            trace_id=h.trace_id,
+            **({"tenant": h.request.tenant} if h.request.tenant else {}))
+        # per-request lifeline (req_queue → req_prefill → req_handoff →
+        # one req_decode per residency segment): the cross-pool trace
+        trace.emit_request_lifeline(h)

@@ -37,18 +37,36 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-#: finish reasons a handle can carry (``finish_reason`` is always one of
-#: these once ``done`` is set): completed its token budget, emitted its
-#: stop token, missed its deadline, was cut off by a non-graceful server
-#: stop, hit a full KV cache with budget unspent (``cache_full`` — the
-#: loud ending the silent-overflow fix installed; admission's budget
-#: rule makes it unreachable unless that rule is bypassed), lost its
-#: pool worker with NO survivor to recover onto (``worker_lost`` — with
-#: survivors the lane replays and finishes normally), or rode a handoff
-#: package the decode pool rejected (``handoff_corrupt``: schema
-#: mismatch or failed integrity digest).
-FINISH_REASONS = ("length", "eos", "deadline", "shutdown", "cache_full",
-                  "worker_lost", "handoff_corrupt")
+from tpudist.telemetry.trace import new_trace_id
+
+#: THE finish-reason registry: every reason a handle can carry
+#: (``finish_reason`` is always one of these once ``done`` is set),
+#: name → one-line contract.  The serving loops emit these as string
+#: literals at ~40 sites across ``serve/*.py``; this dict is the single
+#: place that enumerates and documents them, and
+#: ``tests/test_finish_reasons.py`` is the gate (the env-var-inventory
+#: pattern): every literal passed to a ``_finish*`` call must be
+#: registered here AND documented in ``docs/ARCHITECTURE.md``, and every
+#: registered reason must still be emitted somewhere.  Telemetry
+#: consumers (the aggregate report's ``finish_reasons`` counts, the
+#: live ``tpudist_requests_finished_total{reason=}`` counter) key on
+#: these names, so an unregistered reason is an unqueryable one.
+FINISH_REASONS = {
+    "length": "completed its max_new output-token budget",
+    "eos": "emitted its per-request stop token",
+    "deadline": "missed its relative deadline (queued or mid-decode)",
+    "shutdown": "cut off by a non-graceful server stop (dead engine "
+                "loop, hard drain, never-started server)",
+    "cache_full": "hit a full KV cache with budget unspent — only "
+                  "reachable when the admission budget rule is bypassed "
+                  "(finished loudly instead of decoding garbage)",
+    "worker_lost": "its pool worker died with NO survivor to recover "
+                   "onto (with survivors the lane replays and finishes "
+                   "normally)",
+    "handoff_corrupt": "rode a KV-handoff package the decode pool "
+                       "rejected (schema mismatch or failed integrity "
+                       "digest)",
+}
 
 
 class AdmissionError(RuntimeError):
@@ -83,6 +101,11 @@ class Request:
     #: same programs with acceptance forced to zero — the mixed
     #: spec/non-spec traffic story), True = explicit opt-in.
     spec: Optional[bool] = None
+    #: tenant label: rides into telemetry (``request_finished``), the
+    #: live metrics registry (per-tenant latency sketches + SLO
+    #: attainment), and ``/statusz`` per-tenant in-flight.  None =
+    #: untagged (pools under "default" in per-tenant views).
+    tenant: Optional[str] = None
 
 
 class RequestHandle:
@@ -102,12 +125,24 @@ class RequestHandle:
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         self.slot: Optional[int] = None
+        #: per-request trace id (tpudist.telemetry.trace), minted at
+        #: submit and threaded through admission, prefill, the
+        #: serialized handoff package, decode lanes, recovery replays,
+        #: and request_finished — the cross-pool join key.
+        self.trace_id: str = new_trace_id()
         #: disaggregated serving only (tpudist.serve.disagg): when the
         #: prefill pool finished the prompt (and sampled token 0), and
         #: when the KV landed in a decode-pool slot — the handoff-wait
         #: gap between them is the disagg coordinator's own latency.
         self.t_prefill_done: Optional[float] = None
         self.t_decode_start: Optional[float] = None
+        #: worker attribution for the exported timeline: which prefill
+        #: worker ran the prompt, and one (worker, t_start, t_end)
+        #: segment per decode residency — a lane that replays onto a
+        #: survivor after worker loss grows a SECOND segment, which is
+        #: the visible jump in the Chrome trace.
+        self.prefill_worker: Optional[int] = None
+        self.decode_segments: List[list] = []
 
     # -- caller side --------------------------------------------------------
 
@@ -207,7 +242,7 @@ class Scheduler:
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
-               spec: Optional[bool] = None,
+               spec: Optional[bool] = None, tenant: Optional[str] = None,
                ) -> RequestHandle:
         """Admit a request or raise :class:`AdmissionError` (backpressure
         is synchronous — the caller learns NOW, not after a timeout)."""
@@ -244,6 +279,7 @@ class Scheduler:
             on_token=on_token,
             prefix_hashes=hashes,
             spec=spec,
+            tenant=None if tenant is None else str(tenant),
         )
         with self._lock:
             reason = self._refuse_reason

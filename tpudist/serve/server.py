@@ -168,7 +168,118 @@ class ServeConfig:
         )
 
 
-class InferenceServer:
+class _Observability:
+    """Shared live-observability wiring for both server flavors
+    (:class:`InferenceServer` here, ``DisaggServer`` in
+    :mod:`tpudist.serve.disagg`): the ``/healthz`` health check (engine
+    thread ALIVE and loop-error-free and heartbeat FRESH — not merely
+    "the HTTP thread answered"), ``/statusz`` registration against the
+    process endpoint, and the ``slo_config`` stamp that makes declared
+    targets visible to the post-hoc aggregator."""
+
+    _statusz_name = "serve"
+
+    def _init_observability(self) -> None:
+        """State both server constructors share — every attribute the
+        mixin's health/status methods read lives here, so a field added
+        for one flavor cannot be missing on the other."""
+        from tpudist.utils.envutil import env_positive_float
+
+        #: the exception that killed the engine loop, if any — /healthz
+        #: goes 503 on it (an HTTP thread answering while the loop is
+        #: dead is the lie the healthz bugfix exists to kill)
+        self.loop_error: Optional[str] = None
+        #: engine-loop heartbeat (stamped every iteration, idle included)
+        self._beat: Optional[float] = None
+        #: /healthz staleness threshold for the heartbeat
+        #: (TPUDIST_SERVE_HEALTH_STALE_S; tightened by tests).  The
+        #: default must exceed the worst dispatch that legitimately
+        #: blocks an iteration — the first request's XLA compile — or
+        #: an orchestrator doing liveness restarts would kill a
+        #: compiling server in a loop.  The hang WATCHDOG (with its own
+        #: first-deadline slack) is the aggressive stall detector.
+        self.health_stale_s = env_positive_float(
+            "TPUDIST_SERVE_HEALTH_STALE_S", 300.0)
+        self._statusz_names: list = []
+        #: tenant → in-flight count (submitted minus finished) for
+        #: /statusz; mutated under _tenant_lock (ingestion + engine
+        #: threads both write)
+        self._tenant_inflight: Dict[str, int] = {}
+        self._tenant_lock = threading.Lock()
+
+    def _start_observability(self) -> None:
+        from tpudist import telemetry
+        from tpudist.telemetry import metrics, statusz
+
+        targets = metrics.slo_targets()
+        if targets["ttft_s"] or targets["tpot_s"]:
+            telemetry.event(
+                "slo_config",
+                **({"ttft_ms": round(targets["ttft_s"] * 1e3, 3)}
+                   if targets["ttft_s"] else {}),
+                **({"tpot_ms": round(targets["tpot_s"] * 1e3, 3)}
+                   if targets["tpot_s"] else {}))
+        # static-geometry gauges: a scrape between server start and the
+        # first request already answers "what is this process serving"
+        if metrics.enabled_from_env():
+            reg = metrics.registry()
+            for name, value in self._observability_gauges().items():
+                reg.gauge(name).set(value)
+        srv = statusz.ensure_started()
+        if srv is not None:
+            self._statusz_names = [
+                srv.register_health(self._statusz_name, self._health_check),
+                srv.register_status(self._statusz_name, self._statusz_doc),
+            ]
+
+    def _stop_observability(self) -> None:
+        from tpudist.telemetry import statusz
+
+        srv = statusz.active()
+        if srv is not None:
+            for name in self._statusz_names:
+                srv.unregister(name)
+        self._statusz_names = []
+
+    def _health_check(self):
+        """(ok, detail) for ``/healthz``.  Unhealthy when the engine
+        loop has aborted (``serve_loop_error``), its thread is gone, or
+        its heartbeat is stale — the regression the hygiene pass pinned:
+        liveness of the HTTP thread alone must never read as healthy."""
+        t = self._thread
+        alive = t is not None and t.is_alive()
+        beat_age = (None if self._beat is None
+                    else time.monotonic() - self._beat)
+        stale = beat_age is not None and beat_age > self.health_stale_s
+        ok = alive and self.loop_error is None and not stale
+        return ok, {
+            "engine_thread_alive": alive,
+            "loop_error": self.loop_error,
+            "beat_age_s": None if beat_age is None else round(beat_age, 3),
+            "heartbeat_stale": stale,
+            "draining": self._draining,
+        }
+
+    def _track_tenant(self, tenant, delta: int) -> None:
+        # submit threads race the engine thread here — one tiny lock
+        # keeps the read-modify-write atomic (display-only data, but a
+        # lost decrement would pin a phantom in-flight forever)
+        key = tenant if tenant else "default"
+        with self._tenant_lock:
+            n = self._tenant_inflight.get(key, 0) + delta
+            if n <= 0:
+                self._tenant_inflight.pop(key, None)
+            else:
+                self._tenant_inflight[key] = n
+
+    def _statusz_doc(self) -> dict:  # per-flavor
+        raise NotImplementedError
+
+    def _observability_gauges(self) -> Dict[str, float]:  # per-flavor
+        return {}
+
+
+class InferenceServer(_Observability):
     """Continuous-batching server over a ``TransformerLM`` decode path.
 
     Usage::
@@ -217,6 +328,8 @@ class InferenceServer:
         self.tokens_out = 0
         self._occupancy_sum = 0.0
         self._steps = 0
+        # -- live observability plane (telemetry.statusz) ------------------
+        self._init_observability()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -242,6 +355,7 @@ class InferenceServer:
             block_size=kv["block_size"], blocks_total=kv["blocks_total"],
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)
+        self._start_observability()
         if self._install_signal:
             # SIGTERM → drain: the same preemption flag the training loop
             # checkpoints on.  Off the main thread install degrades to a
@@ -257,21 +371,34 @@ class InferenceServer:
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
-               spec: Optional[bool] = None,
+               spec: Optional[bool] = None, tenant: Optional[str] = None,
                ) -> RequestHandle:
         """Thread-safe ingestion; raises :class:`AdmissionError` on
         backpressure/budget rejection (reason stamped into telemetry).
         ``spec=False`` opts this request out of speculative decoding on
-        a spec-enabled server (mixed spec/non-spec traffic)."""
+        a spec-enabled server (mixed spec/non-spec traffic); ``tenant``
+        labels the request in telemetry, per-tenant metrics/SLO
+        attainment, and ``/statusz`` in-flight counts."""
         from tpudist import telemetry
 
+        # count the in-flight BEFORE the handle becomes visible to the
+        # engine thread — scheduler.submit enqueues and notifies, so a
+        # fast finish could otherwise decrement first (losing the -1)
+        # and pin a phantom in-flight forever
+        tkey = None if tenant is None else str(tenant)
+        self._track_tenant(tkey, +1)
         try:
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
-                on_token=on_token, spec=spec)
-        except AdmissionError as e:
-            telemetry.event("serve_rejected", reason=e.reason)
+                on_token=on_token, spec=spec, tenant=tenant)
+        except BaseException as e:
+            # never admitted — ANY failure (bad prompt included, not
+            # just AdmissionError) must give the +1 back or the tenant
+            # pins a phantom in-flight forever
+            self._track_tenant(tkey, -1)
+            if isinstance(e, AdmissionError):
+                telemetry.event("serve_rejected", reason=e.reason)
             raise
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -298,12 +425,57 @@ class InferenceServer:
     def close(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown (drain) + handler restore."""
         ok = self.drain(timeout)
+        self._stop_observability()
         if self._installed_preemption:
             from tpudist.runtime import preemption
 
             preemption.reset()
             self._installed_preemption = False
         return ok
+
+    def _observability_gauges(self) -> Dict[str, float]:
+        kv = self.engine.kv_stats()
+        return {
+            "tpudist_serve_slots": self.engine.num_slots,
+            "tpudist_serve_queue_limit": self.config.queue_limit,
+            "tpudist_serve_kv_pool_bytes": kv["pool_bytes"],
+        }
+
+    def _statusz_doc(self) -> dict:
+        """The ``/statusz`` section: current occupancy, KV residency,
+        queue depth, world/generation identity, per-tenant in-flight."""
+        from tpudist.utils.envutil import env_int
+
+        eng = self.engine
+        kv_occ, kv_resident = eng.kv_gauges()
+        kv = eng.kv_stats()
+        return {
+            "slots": {
+                "total": int(eng.num_slots),
+                "active": int(eng.num_active),
+                "prefilling": len(eng.prefilling_slots()),
+                "occupancy": round(float(eng.occupancy), 4),
+            },
+            "queue": {
+                "pending": self.scheduler.pending(),
+                "limit": self.config.queue_limit,
+                "rejected": self.scheduler.rejected,
+            },
+            "kv": {
+                "paged": bool(kv["paged"]),
+                "pool_bytes": kv["pool_bytes"],
+                "bytes_resident": int(kv_resident),
+                "block_occupancy": (None if kv_occ is None
+                                    else round(float(kv_occ), 4)),
+            },
+            "completed": self.completed,
+            "tokens_out": self.tokens_out,
+            "tenants_in_flight": dict(self._tenant_inflight),
+            "world": env_int("TPUDIST_NUM_PROCESSES", None),
+            "generation": env_int("TPUDIST_RESTART_COUNT", 0),
+            "draining": self._draining,
+            "loop_error": self.loop_error,
+        }
 
     def stats(self) -> dict:
         return {
@@ -353,6 +525,7 @@ class InferenceServer:
             # budget-guard RuntimeError) would otherwise strand every
             # in-flight and queued handle in wait() forever while
             # submit() keeps admitting doomed work.
+            self.loop_error = repr(e)  # /healthz goes 503 on this
             telemetry.event("serve_loop_error", error=repr(e))
             raise  # threading excepthook still reports the traceback
         finally:
@@ -364,6 +537,7 @@ class InferenceServer:
 
         eng, sched = self.engine, self.scheduler
         while True:
+            self._beat = time.monotonic()  # /healthz heartbeat
             if not self._draining and self._should_drain():
                 self._draining = True
                 sched.refuse_new("draining")
@@ -518,12 +692,19 @@ class InferenceServer:
 
     def _note_finished(self, h: RequestHandle) -> None:
         from tpudist import telemetry
+        from tpudist.telemetry import trace
 
         self.completed += 1
+        self._track_tenant(h.request.tenant, -1)
         telemetry.event(
             "request_finished", id=h.id, reason=h.finish_reason,
             prompt_len=int(len(h.request.prompt)), tokens_out=len(h.tokens),
-            ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s)
+            ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s,
+            trace_id=h.trace_id,
+            **({"tenant": h.request.tenant} if h.request.tenant else {}))
+        # per-request lifeline spans (req_queue/req_prefill/req_decode)
+        # for the cross-pool trace join + Chrome export
+        trace.emit_request_lifeline(h)
 
 
 def serve_forever(module, params, config: Optional[ServeConfig] = None):

@@ -17,6 +17,20 @@ attribute its own wall-clock:
   joined fault/watchdog/restart event log.
 - ``python -m tpudist.telemetry report <run_dir>`` — the post-hoc CLI.
 
+The LIVE half (this is what a fleet scrapes mid-run):
+
+- :mod:`tpudist.telemetry.metrics` — lock-light in-process registry of
+  counters, gauges, and mergeable log-bucket quantile sketches, fed
+  from the same span/event seams (``TPUDIST_METRICS`` gates the feed),
+  plus SLO attainment from declared ``TPUDIST_SLO_TTFT_MS`` /
+  ``TPUDIST_SLO_TPOT_MS`` targets;
+- :mod:`tpudist.telemetry.trace` — per-request ``trace_id`` lifelines
+  joined across pools/processes, exported as a Perfetto-loadable
+  Chrome trace (``python -m tpudist.telemetry trace <run_dir>``);
+- :mod:`tpudist.telemetry.statusz` — ``/metrics`` (Prometheus text),
+  ``/healthz`` (engine-loop liveness + watchdog freshness), and
+  ``/statusz`` (JSON state) on ``TPUDIST_METRICS_PORT``.
+
 Armed by default; ``TPUDIST_TELEMETRY=0`` disarms it — the disarmed cost
 at every span site is one module-attribute load and a ``None`` check
 (same discipline as :mod:`tpudist.runtime.faults`).  The whole package is
@@ -47,3 +61,7 @@ from tpudist.telemetry.aggregate import (  # noqa: F401
     render_markdown,
     write_reports,
 )
+
+# The live plane (metrics/trace/statusz) is imported lazily by its
+# consumers — `from tpudist.telemetry import metrics` etc. — so the
+# spans hot path never pays for modules it is not using.

@@ -30,7 +30,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default: the telemetry dir)")
     rp.add_argument("--json", action="store_true", dest="json_out",
                     help="print report.json instead of the markdown")
+    tp = sub.add_parser(
+        "trace",
+        help="export a run's per-request lifelines as a Perfetto-"
+             "loadable Chrome trace (trace.json)")
+    tp.add_argument("run_dir",
+                    help="telemetry dir (or a run dir with a telemetry/ "
+                         "subdirectory)")
+    tp.add_argument("--out", default=None,
+                    help="output path (default: <telemetry dir>/trace.json)")
     args = p.parse_args(argv)
+
+    if args.cmd == "trace":
+        import json as _json
+
+        from tpudist.telemetry.trace import export_chrome_trace
+
+        out = export_chrome_trace(args.run_dir, args.out)
+        doc = _json.loads(out.read_text())
+        n_events = len(doc.get("traceEvents", []))
+        n_traces = doc.get("otherData", {}).get("traces", 0)
+        print(f"[tpudist.telemetry] wrote {out} "
+              f"({n_traces} request lifelines, {n_events} trace events) — "
+              f"load it in Perfetto (ui.perfetto.dev) or chrome://tracing")
+        return 0 if n_events else 1
 
     from tpudist.telemetry.aggregate import render_markdown, write_reports
 

@@ -82,7 +82,8 @@ _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
                     "prefetch_stats", "serve_drain", "serve_loop_error",
                     "serve_disagg_config", "restart_exhausted",
                     "world_resized", "worker_lost", "lane_recovered",
-                    "handoff_rejected", "pool_resize")
+                    "handoff_rejected", "pool_resize",
+                    "telemetry_dropped")
 
 
 def find_telemetry_dir(run_dir: "str | Path") -> Path:
@@ -220,6 +221,50 @@ def _step_stats(records: List[dict], num_ranks: int = 1) -> dict:
     }
 
 
+def _slo_summary(fins: List[dict], slo_config: dict) -> dict:
+    """Post-hoc SLO attainment vs the declared targets — the exact
+    numbers the live ``tpudist_slo_attainment`` gauges track mid-run,
+    recomputed from the ``request_finished`` events so live and post-hoc
+    views can be cross-checked.  Per-tenant (requests without a tenant
+    tag pool under ``"default"``) plus the overall row."""
+    targets: Dict[str, float] = {}
+    for key, tag in (("ttft_s", "ttft_ms"), ("tpot_s", "tpot_ms")):
+        v = slo_config.get(tag)
+        if isinstance(v, (int, float)) and v > 0:
+            targets[key] = float(v) / 1e3
+
+    def _attain(group: List[dict]) -> dict:
+        out: Dict[str, object] = {"requests": len(group)}
+        fracs = []
+        for key, target in targets.items():
+            vals = [float(r[key]) for r in group
+                    if isinstance(r.get(key), (int, float))]
+            label = key[:-2] + "_attainment"  # ttft_attainment / tpot_...
+            if not vals:
+                out[label] = None
+                continue
+            frac = sum(1 for v in vals if v <= target) / len(vals)
+            out[label] = round(frac, 4)
+            fracs.append(frac)
+        # the headline: worst per-metric attainment (an SLO with two
+        # clauses is met only as often as its weakest clause)
+        out["attainment"] = round(min(fracs), 4) if fracs else None
+        return out
+
+    by_tenant: Dict[str, List[dict]] = {}
+    for r in fins:
+        t = r.get("tenant")
+        by_tenant.setdefault(
+            t if isinstance(t, str) and t else "default", []).append(r)
+    return {
+        "targets_ms": {
+            ("ttft_ms" if k == "ttft_s" else "tpot_ms"): round(v * 1e3, 3)
+            for k, v in targets.items()},
+        "overall": _attain(fins),
+        "per_tenant": {t: _attain(g) for t, g in sorted(by_tenant.items())},
+    }
+
+
 def _serving_summary(records: List[dict]) -> Optional[dict]:
     """Serving-goodput section from the serve subsystem's records:
     per-request ``request_finished`` events (TTFT/TPOT/queue-wait
@@ -232,6 +277,12 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             and r.get("name") == "request_finished"]
     rejects = sum(1 for r in records if r.get("kind") == "event"
                   and r.get("name") == "serve_rejected")
+    # declared SLO targets (slo_config event, stamped at server start
+    # when TPUDIST_SLO_*_MS is set) — last one wins across restarts
+    slo_config = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "slo_config":
+            slo_config = r
     occ_w, occ_dur, occ_max, decode_s, prefill_s = 0.0, 0.0, 0.0, 0.0, 0.0
     serve_spans = 0
     decode_blocks, decode_tokens = 0, 0
@@ -452,6 +503,10 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         **({"kv": kv} if kv is not None else {}),
         **({"spec": spec} if spec is not None else {}),
         **({"pools": pools} if pools is not None else {}),
+        # SLO section only when targets were declared — old streams (and
+        # target-less runs) aggregate byte-identically without it
+        **({"slo": _slo_summary(fins, slo_config)}
+           if slo_config is not None else {}),
     }
 
 
@@ -502,6 +557,21 @@ def aggregate_run(run_dir: "str | Path") -> dict:
             events.append(r)
     events.sort(key=lambda e: e.get("t", 0.0))
 
+    # Telemetry self-accounting: sessions that dropped records (ring
+    # eviction past the bound, stream write failures) say so at close —
+    # totaled here so a truncated report ANNOUNCES its truncation.
+    # Absent entirely (not zero) for streams without the event, keeping
+    # old-stream aggregation byte-identical.
+    dropped = {"ring": 0, "write": 0}
+    have_drops = False
+    for e in events:
+        if e.get("name") == "telemetry_dropped":
+            have_drops = True
+            for k in ("ring", "write"):
+                v = e.get(k)
+                if isinstance(v, (int, float)):
+                    dropped[k] += int(v)
+
     # Generation-stamped world sizes merged across ranks (the elastic
     # story: gen → how many processes that generation ran with).
     world_sizes: Dict[str, int] = {}
@@ -541,6 +611,7 @@ def aggregate_run(run_dir: "str | Path") -> dict:
         ],
         "stages": {k: round(v, 6) for k, v in sorted(stages.items())},
         "events": events,
+        **({"telemetry_dropped": dropped} if have_drops else {}),
     }
     serving = _serving_summary(records)
     if serving is not None:
@@ -621,6 +692,20 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"- batch occupancy: mean {sv['occupancy_mean']:.2f}, "
                 f"max {sv['occupancy_max']:.2f}")
+        if sv.get("slo"):
+            slo = sv["slo"]
+            tgt = ", ".join(f"{k.replace('_ms', '')} ≤ {v:g} ms"
+                            for k, v in slo["targets_ms"].items())
+            ov = slo["overall"]
+            bits = [f"targets: {tgt}"]
+            if ov.get("attainment") is not None:
+                bits.append(f"overall attainment "
+                            f"{ov['attainment'] * 100:.1f}%")
+            for t, row in slo["per_tenant"].items():
+                if row.get("attainment") is not None:
+                    bits.append(f"{t}: {row['attainment'] * 100:.1f}% "
+                                f"({row['requests']} reqs)")
+            lines.append("- SLO: " + "; ".join(bits))
         if sv.get("spec"):
             sp = sv["spec"]
             app = sp.get("accepted_per_pass") or {}
@@ -686,6 +771,11 @@ def render_markdown(report: dict) -> str:
                             f"{kv['read_bytes_per_token']:,.0f} B/token"
                             f"{via}")
             lines.append("- KV cache: " + "; ".join(bits))
+    if report.get("telemetry_dropped"):
+        td = report["telemetry_dropped"]
+        lines += ["", f"**⚠ telemetry dropped records** — ring evictions: "
+                      f"{td.get('ring', 0)}, stream write failures: "
+                      f"{td.get('write', 0)} (this report is incomplete)"]
     if report.get("stages"):
         lines += ["", "## Host stages (StageTimer)", ""]
         for k, v in report["stages"].items():

@@ -27,7 +27,19 @@ read at session start (span math never mixes clock reads).
 Hot-path cost: disarmed (``TPUDIST_TELEMETRY=0`` or no session) every
 site pays one module-attribute load + ``None`` check; armed, a span is
 two ``monotonic()`` reads, a small dict, and one buffered ``write``.
-Telemetry must never take a job down: I/O errors silently drop records.
+Telemetry must never take a job down: I/O errors drop records — but no
+longer SILENTLY: stream write failures, and ring evictions when the
+session is RING-ONLY (the stream never opened, so an evicted record
+exists nowhere), are counted in the session's ``dropped`` dict
+(surfaced in ``/statusz``, stamped as a ``telemetry_dropped`` event at
+close for the aggregate report, and warned once per session), so a
+truncated report announces itself.  Ring rotation on a healthy stream
+is the ring's designed behavior, not a drop.
+
+Live plane: every emitted record is also offered to the metrics sink
+(:func:`tpudist.telemetry.metrics.feed_record`) when armed
+(``TPUDIST_METRICS``), which is what keeps the scrapeable registry
+current without touching any instrumented site.
 
 Dependency-free (no jax import): rank and generation resolve from the
 launcher env contract via :mod:`tpudist.utils.envutil`, so the watchdog
@@ -42,8 +54,9 @@ import json
 import os
 import threading
 import time
+import warnings
 from pathlib import Path
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 ENV_ENABLE = "TPUDIST_TELEMETRY"
 ENV_DIR = "TPUDIST_TELEMETRY_DIR"
@@ -102,6 +115,13 @@ class TelemetrySession:
         self._tls = threading.local()
         self._write_lock = threading.Lock()
         self._closed = False
+        #: drop accounting (never silent — module doc): ``ring`` = ring
+        #: evictions on a RING-ONLY session (stream never opened, so an
+        #: evicted record exists nowhere), ``write`` = stream
+        #: write/encode failures.  Surfaced in /statusz, stamped as a
+        #: ``telemetry_dropped`` event at close, warned once.
+        self.dropped: Dict[str, int] = {"ring": 0, "write": 0}
+        self._drop_warned = False
         # One clock-pair read: wall-clock for any monotonic stamp is
         # t0_wall + (mono - t0_mono), so a span's t and dur come from the
         # same monotonic reads (never a second time.time() call).
@@ -113,6 +133,15 @@ class TelemetrySession:
             self._file = open(self.path, "w", buffering=1)  # line buffered
         except OSError:
             pass  # ring-only session: recording must not take the job down
+        # arm the live-metrics sink (TPUDIST_METRICS gates it) so every
+        # session — worker, trainer, tpurun agent — feeds the scrapeable
+        # registry without site changes
+        try:
+            from tpudist.telemetry import metrics as _metrics
+
+            _metrics.arm_from_env()
+        except Exception:
+            pass
         self.event("session_start", pid=os.getpid(),
                    **({"world": self.world} if self.world else {}))
 
@@ -128,7 +157,8 @@ class TelemetrySession:
         return st
 
     def record_span(self, name: str, t0_mono: float, dur_s: float,
-                    tags: Optional[Dict] = None) -> None:
+                    tags: Optional[Dict] = None, *,
+                    parent: Optional[str] = None) -> None:
         """Record a completed span from explicit ``monotonic()`` stamps —
         the zero-allocation-on-disarm form the hot loops use::
 
@@ -136,7 +166,11 @@ class TelemetrySession:
             ...work...
             if tele is not None:
                 tele.record_span("step", t0, time.monotonic() - t0)
-        """
+
+        ``parent``: explicit parent override (the per-request lifeline
+        spans in :mod:`tpudist.telemetry.trace` pass ``"request"`` so
+        the goodput accounting treats them as detail, never a second
+        copy of the wall-clock they re-describe)."""
         rec = {
             "kind": "span",
             "name": name,
@@ -145,9 +179,12 @@ class TelemetrySession:
             "rank": self.rank,
             "gen": self.generation,
         }
-        st = self._stack()
-        if st:
-            rec["parent"] = st[-1]
+        if parent is not None:
+            rec["parent"] = parent
+        else:
+            st = self._stack()
+            if st:
+                rec["parent"] = st[-1]
         if tags:
             for k, v in tags.items():
                 if k not in RESERVED_KEYS:
@@ -185,19 +222,45 @@ class TelemetrySession:
     def _emit(self, rec: dict) -> None:
         if self._closed:
             return
+        if self._file is None and len(self.ring) == self.ring.maxlen:
+            # RING-ONLY session (the stream never opened): the deque
+            # eviction is real data loss — nothing else holds the
+            # record.  With a live stream, rotation past the bound is
+            # the ring's designed behavior, not a drop (the JSONL has
+            # every record; counting it would make every long healthy
+            # run's report falsely announce incompleteness).
+            self.dropped["ring"] += 1
         self.ring.append(rec)
+        sink = _SINK
+        if sink is not None:
+            try:
+                sink(rec)  # live-metrics feed (tpudist.telemetry.metrics)
+            except Exception:
+                pass  # the registry must never take the emitter down
         f = self._file
         if f is None:
             return
         try:
             line = json.dumps(rec) + "\n"
         except (TypeError, ValueError):
+            self._count_write_drop()
             return  # unserializable tag: drop the record, not the job
         try:
             with self._write_lock:
                 f.write(line)
         except (OSError, ValueError):
-            pass
+            self._count_write_drop()
+
+    def _count_write_drop(self) -> None:
+        self.dropped["write"] += 1
+        if not self._drop_warned:
+            self._drop_warned = True
+            warnings.warn(
+                f"tpudist.telemetry: dropping records (stream write "
+                f"failure on {self.path}) — the post-hoc report for this "
+                f"run will be incomplete; counts surface in /statusz and "
+                f"the telemetry_dropped event", RuntimeWarning,
+                stacklevel=3)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -218,6 +281,10 @@ class TelemetrySession:
     def close(self) -> None:
         if self._closed:
             return
+        if any(self.dropped.values()):
+            # best-effort last word: if the stream recovered, the
+            # aggregate report learns exactly how much it is missing
+            self.event("telemetry_dropped", **self.dropped)
         self.event("session_end")
         self._closed = True
         f, self._file = self._file, None
@@ -238,6 +305,12 @@ class TelemetrySession:
 
 _ACTIVE: Optional[TelemetrySession] = None
 _lock = threading.Lock()
+
+#: Live-metrics sink: every emitted record is offered to this callable
+#: (``tpudist.telemetry.metrics.feed_record`` when armed; ``None``
+#: disarmed — one attribute load + None check per record).  Installed by
+#: :func:`tpudist.telemetry.metrics.arm_from_env`.
+_SINK: Optional[Callable[[dict], None]] = None
 
 
 # Shared no-op context manager: the disarmed ``span()`` return

@@ -214,6 +214,13 @@ class _DeferredMetrics:
                 {f"{self.config.metric_prefix}{k}": float(v) for k, v in vals.items()},
                 commit=True,
             )
+        # live gauges, once per flush window (the scrape endpoint's view
+        # of training progress; step-time sketches come from step spans)
+        from tpudist.telemetry import metrics
+
+        last_iter, _, _ = pending[-1]
+        metrics.set_train_gauges(
+            last_iter, {k: float(v) for k, v in fetched[-1].items()})
 
 
 def run_training(
@@ -247,6 +254,13 @@ def run_training(
 
     faults.arm_from_env()  # chaos harness: TPUDIST_FAULT grammar, no code changes
     telemetry.ensure_started()  # goodput accounting: TPUDIST_TELEMETRY=0 disarms
+    # live observability: scrape endpoint (TPUDIST_METRICS_PORT gates it)
+    # — step-time/goodput gauges flow from the step spans via the metrics
+    # feed; the training loop adds its iteration/loss gauges at each
+    # metric flush (never per step)
+    from tpudist.telemetry import statusz
+
+    statusz.ensure_started()
     wd = watchdog.from_config(
         config.watchdog_timeout_s, name="train_loop",
         first_deadline_s=(config.watchdog_timeout_s or
@@ -499,3 +513,13 @@ def _flush_scanned(pending, logger, config):
                     },
                     commit=True,
                 )
+    # live gauges, once per flush (the scanned-path twin of
+    # _DeferredMetrics.flush — both loops keep the scrape view current)
+    from tpudist.telemetry import metrics
+
+    first_it, _ = pending[-1]
+    last_window = fetched[-1]
+    length = len(next(iter(last_window.values())))
+    metrics.set_train_gauges(
+        first_it + length - 1,
+        {k: float(vals[-1]) for k, vals in last_window.items()})

@@ -387,6 +387,12 @@ class Trainer:
         )
 
         telemetry.ensure_started()
+        # live observability: scrape endpoint + step-time gauges flow
+        # from the step spans via the metrics feed (TPUDIST_METRICS_PORT
+        # gates the endpoint; no-op when unset)
+        from tpudist.telemetry import statusz
+
+        statusz.ensure_started()
         tele = telemetry.active()
         first_step = True  # first dispatch pays XLA compile → its own span
 

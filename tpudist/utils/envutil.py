@@ -124,6 +124,9 @@ ENV_VARS = {
     "TPUDIST_SERVE_POOL_RESIZE":
         "iterations of sustained handoff-queue backpressure before the "
         "prefill slot budget shrinks by one (0 = off)",
+    "TPUDIST_SERVE_HEALTH_STALE_S":
+        "/healthz engine-heartbeat staleness threshold in seconds "
+        "(default 300 — must exceed the first-dispatch XLA compile)",
     "TPUDIST_SERVE_SPEC":
         "speculative decoding: draft proposes K, target verifies in one pass",
     "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
@@ -133,6 +136,25 @@ ENV_VARS = {
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
     "TPUDIST_TELEMETRY_RING": "in-memory telemetry ring size (records)",
+    # live observability plane (metrics / trace / statusz)
+    "TPUDIST_METRICS":
+        "live metrics registry feed from the span/event seams "
+        "(default on; 0 = post-hoc telemetry only)",
+    "TPUDIST_METRICS_PORT":
+        "scrape endpoint port for /metrics /healthz /statusz "
+        "(unset = off; 0 = ephemeral port for CI)",
+    "TPUDIST_METRICS_ADDR":
+        "scrape endpoint bind address (default 127.0.0.1 — the "
+        "documents are unauthenticated; 0.0.0.0 is an explicit opt-in)",
+    "TPUDIST_TRACE":
+        "per-request trace lifeline spans (req_queue/req_prefill/"
+        "req_handoff/req_decode; default on; 0 = trace_ids only)",
+    "TPUDIST_SLO_TTFT_MS":
+        "declared time-to-first-token SLO target in ms (<=0/unset = "
+        "none) -> live attainment gauges + report slo section",
+    "TPUDIST_SLO_TPOT_MS":
+        "declared time-per-output-token SLO target in ms (<=0/unset = "
+        "none) -> live attainment gauges + report slo section",
     # parallel execution strategy
     "TPUDIST_OVERLAP":
         "collective-matmul overlap mode: off|ring|bidir (default off)",
