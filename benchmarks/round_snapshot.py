@@ -182,6 +182,25 @@ def main(argv=None) -> None:
             {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
         print(f"{obs_out.name}: error {e!r}")
 
+    # Graceful-degradation rung (host-RAM KV tier / preemption / SLO
+    # shedding): resume-vs-re-prefill TTFT, protected-tenant attainment
+    # under overload, preemption twin — frozen as
+    # BENCH_SESSION_r{NN}.json.  Failure-isolated like the serve
+    # snapshot.
+    session_out = REPO / f"BENCH_SESSION_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "session_bench.py"),
+             "--smoke", "--out", str(session_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{session_out.name}: {json.dumps(data[-1])}")
+    except Exception as e:
+        session_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{session_out.name}: error {e!r}")
+
     # Decode per-op attribution (VERDICT Weak #2): trace the bf16 fused
     # decode loop and freeze the table naming the non-matmul residual.
     # Failure-isolated like the serve snapshot.

@@ -104,6 +104,13 @@ _SLOW_PATTERNS = (
     # cross-pool trace chaos drive (multi-worker disagg + kill; the
     # fast lifeline/schema/export units stay default in test_trace.py)
     "TestTraceChaos",
+    # host-tier preemption/session matrices + disagg park/resume e2e
+    # (each cell builds servers; the dense greedy drives, the tier/
+    # scheduler/controller units, and the parked-deadline regression
+    # stay default in test_host_tier.py)
+    "TestPreemptMatrix",
+    "TestSessionMatrix",
+    "TestDisaggHostTier",
     # sharded-serving sweeps: full mesh-shape × engine-mode oracle
     # matrix + disagg server e2e (the fast engine-level mesh/handoff
     # oracles stay default in TestServeSpmd)

@@ -627,6 +627,45 @@ class TestObsBench:
             assert twin[col] is not None, col
 
 
+class TestSessionBench:
+    def test_rungs_freeze_degradation_fields(self, tmp_path):
+        """The graceful-degradation rung's contract: every later
+        session turn resumes from the host tier (no recompute) with
+        byte-equal outputs and a lower TTFT than the re-prefill twin;
+        the overload twin's shed decision is driven by the LIVE
+        attainment gauge (the flip carries the readings) and recovers
+        the protected tenant; the preemption twin parks the bulk lane
+        and still completes its full stream after resume."""
+        import json as _json
+
+        from benchmarks.session_bench import main
+
+        out = tmp_path / "BENCH_SESSION.json"
+        rc = main(["--smoke", "--out", str(out), "--sessions", "4",
+                   "--turns", "3", "--rounds", "5"])
+        assert rc == 0
+        rows = {_json.loads(line)["rung"]: _json.loads(line)
+                for line in out.read_text().splitlines()}
+        assert set(rows) == {"session_twin", "overload_shed",
+                             "preempt_twin"}
+        st = rows["session_twin"]
+        # every later turn rode the no-recompute path, byte-equal
+        assert st["turns_resumed"] == st["turns_expected_resumed"]
+        assert st["outputs_match"]
+        assert st["resume_ttft_s"] < st["reprefill_ttft_s"]
+        assert st["tier"]["parks"] > 0 and st["tier"]["resumes"] > 0
+        ov = rows["overload_shed"]
+        assert ov["shed_state_changes"] >= 1
+        assert ov["shed_driven_by_gauge"]
+        assert ov["last_attainment_readings"]  # the gauge payload
+        assert ov["bulk_shed"] + ov["bulk_rejected_shed_load"] > 0
+        assert ov["protected_recovers"]
+        pt = rows["preempt_twin"]
+        assert pt["preemptions"] >= 1
+        assert pt["bulk_completed_after_resume"]
+        assert pt["gold_ttft_preempt_s"] < pt["gold_ttft_wait_s"]
+
+
 class TestLossParity:
     def test_all_entry_points_match(self):
         from benchmarks.loss_parity import main

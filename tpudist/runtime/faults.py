@@ -19,6 +19,8 @@ changes::
                                             # 8th engine call (disagg loop)
     TPUDIST_FAULT=handoff_corrupt@nth:2     # garble the 2nd serialized
                                             # KV-handoff package in flight
+    TPUDIST_FAULT=host_tier_corrupt@nth:1   # garble the 1st package PARKED
+                                            # in the host-RAM KV tier
 
 Grammar: ``kind@key:int[,key:int][;kind@...]``.  Common keys: ``rank``
 restricts the fault to one process (default: all); ``attempt`` fires only
@@ -55,6 +57,11 @@ _SCHEMA: Dict[str, tuple] = {
     # recovery drives through the SAME grammar as the training faults.
     "serve_worker_kill": ({"call"}, {"call", "pool", "worker", "rank"}),
     "handoff_corrupt": ({"nth"}, {"nth", "rank"}),
+    # host-RAM KV tier (tpudist.serve.host_tier): garble the Nth PARKED
+    # package after its digest is stamped — a corrupt parked blob must
+    # degrade to a full re-prefill (host_tier_corrupt event), never
+    # crash and never import wrong bytes.
+    "host_tier_corrupt": ({"nth"}, {"nth", "rank"}),
 }
 
 
@@ -334,6 +341,37 @@ def inject_handoff(ser: dict) -> bool:
             from tpudist import telemetry
 
             telemetry.event("fault_injected", fault="handoff_corrupt",
+                            nth=spec.seen)
+            return True
+    return False
+
+
+def inject_host_tier(ser: dict) -> bool:
+    """Host-tier injection point (:meth:`tpudist.serve.host_tier.
+    HostKVTier.put`): a due ``host_tier_corrupt`` garbles the ``nth``
+    PARKED serialized package in place, after its digest stamp — the
+    resume path's deserialize then detects the mismatch and degrades to
+    a full re-prefill instead of importing garbage KV.  Returns whether
+    it fired."""
+    if _PLAN is None:
+        return False
+    for spec in _PLAN:
+        if (spec.kind == "host_tier_corrupt" and spec.fired == 0
+                and _rank_matches(spec)):
+            spec.seen += 1
+            if spec.seen < spec.params["nth"]:
+                continue
+            blob = ser.get("blob")
+            if not blob:
+                continue
+            b, dt, shape = blob[0]
+            blob[0] = (bytes(x ^ 0xFF for x in b[:8]) + b[8:], dt, shape)
+            spec.fired += 1
+            _log(f"corrupted parked host-tier package #{spec.seen} "
+                 f"({len(b)} B leaf garbled)")
+            from tpudist import telemetry
+
+            telemetry.event("fault_injected", fault="host_tier_corrupt",
                             nth=spec.seen)
             return True
     return False

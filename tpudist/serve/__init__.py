@@ -33,12 +33,21 @@ ONE batched multi-token pass — fewer target passes per emitted token,
 the lever past the measured decode HBM roofline.  Greedy output stays
 byte-identical to the sequential oracle; per-request ``spec=False``
 opts out in-batch.
+``ServeConfig(host_tier=True)`` adds the overload-robustness layer
+(:mod:`tpudist.serve.host_tier`, :mod:`tpudist.serve.overload`): idle
+session lanes and priority-preempted decode lanes park in a
+byte-budgeted host-RAM store and resume without recompute
+(``submit(session=..., priority=...)``); ``ServeConfig(shed=True)``
+turns the live per-tenant SLO-attainment gauges into load-shedding
+decisions.
 
 ``python -m tpudist.serve`` runs a self-contained CPU demo.
 """
 
 from tpudist.serve.disagg import DisaggServer  # noqa: F401
 from tpudist.serve.engine import SlotEngine  # noqa: F401
+from tpudist.serve.host_tier import HostKVTier, HostTierError  # noqa: F401
+from tpudist.serve.overload import OverloadController  # noqa: F401
 from tpudist.serve.spmd import ServeMeshConfig  # noqa: F401
 from tpudist.serve.scheduler import (  # noqa: F401
     AdmissionError,

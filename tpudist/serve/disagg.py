@@ -60,6 +60,18 @@ extra copy of each in-flight decode lane's KV; ``recover=False``
 restores the PR-7 behavior (any worker death aborts everything as
 ``"shutdown"``).
 
+**Overload & graceful degradation** (``ServeConfig.host_tier``): both
+pools park/resume through the handoff machinery — a finished session
+turn's decode lane exports into the host-RAM KV tier
+(:mod:`tpudist.serve.host_tier`) and the session's next turn resumes it
+on a PREFILL worker (suffix-only teacher-forcing) before handing off to
+decode like any import; a higher-priority handoff-queue head can
+preempt a lower-priority decode lane into the tier (byte-identical
+resume via the same placement path); and the SLO-aware overload
+controller (:mod:`tpudist.serve.overload`) sheds lower-priority work
+off the live attainment gauges.  See the ARCHITECTURE "Overload &
+graceful degradation" section.
+
 **Backpressure pool resize** (``ServeConfig.pool_resize`` iterations,
 0 = off): a handoff queue that stays full for that many consecutive
 loop iterations means the decode pool is the bottleneck — the prefill
@@ -298,10 +310,12 @@ class DisaggServer(_Observability):
         #: worker's lanes recover from.  Costs one extra copy of each
         #: in-flight lane's KV; dropped the moment the lane finishes.
         self._import_pkg: Dict[Tuple[int, int], Tuple[dict, int]] = {}
-        #: handle.id → tokens to DROP on re-emission (a recovered lane
-        #: re-decodes what the dead worker already delivered; presence in
-        #: this dict marks the handle as in-recovery)
-        self._skip: Dict[int, int] = {}
+        # -- graceful degradation (host tier / preemption / shedding) ------
+        # also (re)creates ``self._skip`` — handle.id → tokens to DROP
+        # on re-emission: worker-loss replays AND host-tier re-prefill
+        # fallbacks share the one duplicate-drop counter (presence marks
+        # the handle as in-recovery/fallback)
+        self._init_degradation(self.scheduler)
         #: prefill-replay line: lanes whose prefill worker died re-prefill
         #: from the prompt, ahead of fresh admissions
         self._requeue: "collections.deque[RequestHandle]" = \
@@ -354,7 +368,8 @@ class DisaggServer(_Observability):
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token=None, spec: Optional[bool] = None,
-               tenant: Optional[str] = None) -> RequestHandle:
+               tenant: Optional[str] = None, priority: int = 0,
+               session: Optional[str] = None) -> RequestHandle:
         from tpudist import telemetry
 
         # +1 BEFORE the handle is visible to the engine thread (see
@@ -366,7 +381,8 @@ class DisaggServer(_Observability):
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
-                on_token=on_token, spec=spec, tenant=tenant)
+                on_token=on_token, spec=spec, tenant=tenant,
+                priority=priority, session=session)
         except BaseException as e:
             self._track_tenant(tkey, -1)  # never admitted (ANY failure)
             if isinstance(e, AdmissionError):
@@ -448,6 +464,15 @@ class DisaggServer(_Observability):
                 "requeued": len(self._requeue),
                 "pool_resizes": self.pool_resizes,
             },
+            # host-tier occupancy + overload state (absent when off)
+            **({"host_tier": {**self._tier.stats(),
+                              "parked_requests": len(self._parked),
+                              "preemptions": self.preemptions,
+                              "resumes_served": self.tier_resumes,
+                              "corrupt": self.tier_corrupt}}
+               if self._tier is not None else {}),
+            **({"overload": self._ctrl.stats()}
+               if self._ctrl is not None else {}),
             "completed": self.completed,
             "tokens_out": self.tokens_out,
             "tenants_in_flight": dict(self._tenant_inflight),
@@ -490,6 +515,12 @@ class DisaggServer(_Observability):
             "lanes_recovered": self.lanes_recovered,
             "requeued": len(self._requeue),
             "pool_resizes": self.pool_resizes,
+            "preemptions": self.preemptions,
+            "parked": len(self._parked),
+            "host_tier": (None if self._tier is None
+                          else self._tier.stats()),
+            "overload": (None if self._ctrl is None
+                         else self._ctrl.stats()),
             "prefill_pool": {
                 "workers": len(self.prefill_pool),
                 "dead": sorted(self._dead["prefill"]),
@@ -521,6 +552,7 @@ class DisaggServer(_Observability):
         return preemption.requested()
 
     def _abort_outstanding(self) -> None:
+        self._abort_parked()
         for key in list(self._slot_handles):
             h = self._slot_handles.pop(key)
             h._finish("shutdown")
@@ -621,6 +653,15 @@ class DisaggServer(_Observability):
                 h, _ = self._handoff.popleft()
                 h._finish("worker_lost")
                 self._note_finished(h)
+            # parked preempted lanes need the decode pool to ever finish
+            # — with no survivor they end loudly too (their tier bytes
+            # release with them)
+            while self._parked:
+                hid, h = self._parked.popitem(last=False)
+                if self._tier is not None:
+                    self._tier.discard(("preempt", hid))
+                h._finish("worker_lost")
+                self._note_finished(h)
         else:
             while self._requeue:
                 h = self._requeue.popleft()
@@ -659,7 +700,8 @@ class DisaggServer(_Observability):
 
     def _outstanding(self) -> int:
         return (self.scheduler.pending() + len(self._slot_handles)
-                + len(self._handoff) + len(self._requeue))
+                + len(self._handoff) + len(self._requeue)
+                + len(self._parked))
 
     def _run_loop(self) -> None:
         from tpudist import telemetry
@@ -686,17 +728,17 @@ class DisaggServer(_Observability):
                 else:
                     kept.append((h, pkg))
             self._handoff = kept
-            kept_rq: "collections.deque[RequestHandle]" = collections.deque()
-            while self._requeue:
-                h = self._requeue.popleft()
-                if h._expired(now):
-                    h._finish("deadline")
-                    self._note_finished(h)
-                else:
-                    kept_rq.append(h)
-            self._requeue = kept_rq
+            self._expire_requeue(now)
             for h in sched.expire_queued(now):
                 self._note_finished(h)
+            # parked-lane deadlines + tier TTL, the live-gauge shed
+            # tick, then decode-pool preemption / parked resume — host
+            # decisions, all before placement so freed capacity is
+            # usable this same iteration
+            self._sweep_parked(now)
+            self._shed_tick(now)
+            self._maybe_preempt()
+            self._resume_preempted()
             did_work = False
             did_work |= self._admit_prefill(now)
             did_work |= self._advance_prefill()
@@ -713,6 +755,122 @@ class DisaggServer(_Observability):
                     time.sleep(_IDLE_WAIT_S)
                 else:
                     sched.wait_for_work(_IDLE_WAIT_S)
+
+    # -- priority preemption through the handoff machinery -------------------
+
+    def _maybe_preempt(self) -> None:
+        """Decode-pool preemption: when the handoff queue's HEAD
+        outranks a decoding lane and no alive decode worker can place
+        it, the lowest-priority decoding lane (ties: least progress)
+        exports to the host tier mid-block and frees its slot+blocks —
+        byte-identical continuation later through the same handoff
+        placement every import rides."""
+        if (self._tier is None or not self.config.preempt
+                or self._draining or not self._handoff):
+            return
+        head_h, head_pkg = self._handoff[0]
+        hp = head_h.request.priority
+        for w in self._alive("decode"):
+            eng = self.decode_pool[w]
+            if eng.free_slots() and eng.can_import(head_pkg):
+                return  # the head can already place — nothing to do
+        cands = []
+        for (pool, w, slot), h in self._slot_handles.items():
+            if (pool == "decode" and w not in self._dead["decode"]
+                    and self.decode_pool[w].decoding[slot]
+                    and h.request.priority < hp
+                    and h.id not in self._skip
+                    and h.id not in self._tier_oversize):
+                cands.append((w, slot, h))
+        if not cands:
+            return
+        w, slot, victim = min(
+            cands, key=lambda t: (t[2].request.priority,
+                                  len(t[2].tokens)))
+        eng = self.decode_pool[w]
+        try:
+            self._tick("decode", w)
+            pkg = eng.export_slot(slot)
+        except Exception as e:
+            self._lose_worker("decode", w, e)
+            return
+        pkg["trace_id"] = victim.trace_id
+        stored = self._tier_put(("preempt", victim.id), pkg, pinned=True,
+                                kind="preempt")
+        if stored is None:
+            # tier can't hold the lane: placement just waits — and this
+            # lane must not be re-exported every loop spin
+            self._tier_oversize.add(victim.id)
+            return
+        del self._slot_handles[("decode", w, slot)]
+        self._import_pkg.pop((w, slot), None)
+        self._parked[victim.id] = victim
+        self.preemptions += 1
+        # close this residency's timeline segment — the resume opens
+        # the next one (the same shape a worker-loss replay draws)
+        if victim.decode_segments \
+                and victim.decode_segments[-1][2] is None:
+            victim.decode_segments[-1][2] = time.monotonic()
+        self._tier_event("preempted", id=victim.id, worker=w, slot=slot,
+                         priority=victim.request.priority,
+                         by_priority=hp, bytes=stored,
+                         trace_id=victim.trace_id)
+        try:
+            eng.evict(slot)
+        except Exception as e:
+            self._lose_worker("decode", w, e)
+
+    def _resume_preempted(self) -> None:
+        """Parked preempted lanes re-enter the HANDOFF QUEUE head as
+        decode capacity frees (oldest first, unless the queue head
+        outranks them) — resume rides the exact placement path every
+        import rides.  A spilled or corrupt parked package degrades to
+        a full re-prefill through the requeue line (``host_tier_corrupt``
+        event; already-delivered tokens drop as duplicates)."""
+        if self._tier is None or not self._parked:
+            return
+        while self._parked:
+            hid, h = next(iter(self._parked.items()))
+            if self._handoff \
+                    and self._handoff[0][0].request.priority \
+                    > h.request.priority:
+                return  # the higher class places first
+            ser = self._tier.peek(("preempt", hid))
+            if ser is None or (
+                    ser.get("digest") is not None
+                    and _blob_digest(ser["blob"]) != ser["digest"]):
+                # spilled (missing) or corrupt: full re-prefill fallback
+                # — never a crash, never wrong bytes (duplicate-drop
+                # keeps the stream byte-identical)
+                del self._parked[hid]
+                if ser is not None:
+                    self._tier.get(("preempt", hid))
+                    self.tier_corrupt += 1
+                    self._tier_event("host_tier_corrupt", kind="preempt",
+                                     trace_id=h.trace_id)
+                self._skip[h.id] = len(h.tokens)
+                self._requeue.append(h)
+                continue
+            if not self._alive("decode"):
+                self._tier.get(("preempt", hid))
+                del self._parked[hid]
+                h._finish("worker_lost")
+                self._note_finished(h)
+                continue
+            placeable = any(
+                self.decode_pool[w].free_slots()
+                and self.decode_pool[w].can_import(ser)
+                for w in self._alive("decode"))
+            if not placeable:
+                return  # capacity not back yet — parked head-of-line
+            self._tier.get(("preempt", hid))
+            del self._parked[hid]
+            pkg = (ser if self.handoff_mode == "serial"
+                   else deserialize_package(ser))
+            self._handoff.appendleft((h, pkg))
+            self.tier_resumes += 1
+            self._tier_event("session_resumed", park_kind="preempt",
+                             id=h.id, trace_id=h.trace_id)
 
     # -- prefill pool -------------------------------------------------------
 
@@ -766,9 +924,28 @@ class DisaggServer(_Observability):
             if not free:
                 continue
             reserved, pinned = [0], []
+            resume_pos: Dict[int, int] = {}
 
-            def _gate(h, _eng=eng, _reserved=reserved, _pinned=pinned):
+            def _gate(h, _eng=eng, _reserved=reserved, _pinned=pinned,
+                      _resume=resume_pos):
                 req = h.request
+                if (self._tier is not None and req.session is not None
+                        and h.id not in self._skip):
+                    pos = self._tier.match(
+                        self._session_key(req), req.prompt)
+                    if pos is not None:
+                        # host-tier session hit: resume reserves the
+                        # FULL footprint on the PREFILL worker (the
+                        # suffix teacher-forces there, then the lane
+                        # hands off to the decode pool like any other)
+                        got = _eng.kv_admission_probe(
+                            len(req.prompt), req.max_new, (),
+                            reserve=_reserved[0], protect=_pinned)
+                        if got is None:
+                            return False
+                        _reserved[0] += got[0]
+                        _resume[h.id] = pos
+                        return True
                 got = _eng.kv_admission_probe(
                     len(req.prompt), req.max_new, req.prefix_hashes,
                     reserve=_reserved[0], protect=_pinned)
@@ -809,14 +986,28 @@ class DisaggServer(_Observability):
             worked = True
             items, t0 = [], time.monotonic()
             for h, slot in zip(alive, free):
+                if w in self._dead["prefill"]:
+                    # the worker died placing an EARLIER candidate of
+                    # this batch (a resume import killed it): the rest
+                    # re-prefill via the requeue line on survivors
+                    self._requeue.append(h)
+                    continue
                 h.slot = slot
                 h.prefill_worker = w  # timeline attribution
                 if h.t_admitted is None:
                     h.t_admitted = t0
+                # a session hit resumes its parked lane on this prefill
+                # worker (suffix-only teacher-forcing; falls back to a
+                # fresh prefill on a spilled/corrupt package)
+                if h.id in resume_pos \
+                        and self._resume_session_prefill(w, slot, h):
+                    continue
                 items.append((slot, h.request.prompt, h.request.temperature,
                               h.request.seed, h.request.max_new,
                               h.request.prefix_hashes))
                 self._slot_handles[("prefill", w, slot)] = h
+            if not items:
+                continue
             try:
                 self._tick("prefill", w)
                 with telemetry.span("prefill", n=len(items), pool="prefill",
@@ -830,6 +1021,49 @@ class DisaggServer(_Observability):
                 if tok is not None:
                     self._prefill_complete(w, slot, tok)
         return worked
+
+    def _resume_session_prefill(self, w: int, slot: int,
+                                h: RequestHandle) -> bool:
+        """Resume a parked session lane into prefill worker ``w``: the
+        lane imports at its covered cursor and teacher-forces ONLY the
+        new turn's suffix, then rides the ordinary handoff into the
+        decode pool.  False on a missing/corrupt parked package (the
+        caller falls back to a fresh prefill — degraded, never wrong)."""
+        from tpudist.serve.host_tier import HostTierError
+
+        eng = self.prefill_pool[w]
+        req = h.request
+        try:
+            ser = self._tier.get(self._session_key(req))
+            raw = deserialize_package(ser)  # digest verified here
+        except HostTierError:
+            return False  # raced a TTL sweep / LRU spill: fresh prefill
+        except HandoffError as e:
+            self.tier_corrupt += 1
+            self._tier_event("host_tier_corrupt", kind="session",
+                             error=str(e)[:120], trace_id=h.trace_id)
+            return False
+        t0 = time.monotonic()
+        try:
+            self._tick("prefill", w)
+            eng.resume_slot(slot, raw, req.prompt,
+                            temperature=req.temperature, seed=req.seed,
+                            max_new=req.max_new, spec=req.spec)
+        except Exception as e:
+            # the worker died importing: register the lane first so the
+            # standard recovery requeues it for a full re-prefill on a
+            # survivor (nothing delivered yet — skip lands at 0)
+            self._slot_handles[("prefill", w, slot)] = h
+            self._lose_worker("prefill", w, e)
+            return True  # handled — the caller must not also prefill it
+        h.resumed = True
+        self._slot_handles[("prefill", w, slot)] = h
+        self.tier_resumes += 1
+        self._tier_event("session_resumed", park_kind="turn", worker=w,
+                         slot=slot, covered=int(raw["pos"]),
+                         trace_id=h.trace_id,
+                         import_s=round(time.monotonic() - t0, 6))
+        return True
 
     def _advance_prefill(self) -> bool:
         from tpudist import telemetry
@@ -890,8 +1124,24 @@ class DisaggServer(_Observability):
             if (eos is not None and tok == eos) \
                     or len(h.tokens) >= h.request.max_new:
                 del self._slot_handles[key]
+                if (self._tier is not None
+                        and h.request.session is not None
+                        and eng.exportable(slot, len(h.tokens))):
+                    # a max_new==1 turn finishes in-prefill: its lane
+                    # still parks for the session's next turn
+                    try:
+                        self._tick("prefill", w)
+                        self._park_session_lane(eng, slot, h)
+                    except Exception as e:
+                        h._finish("eos" if eos is not None and tok == eos
+                                  else "session_resumed" if h.resumed
+                                  else "length")
+                        self._note_finished(h)
+                        self._lose_worker("prefill", w, e)
+                        return
                 eng.evict(slot)
                 h._finish("eos" if eos is not None and tok == eos
+                          else "session_resumed" if h.resumed
                           else "length")
                 self._note_finished(h)
                 return
@@ -1102,6 +1352,13 @@ class DisaggServer(_Observability):
             # deliver them here too, the replay-skip count is already set
             return
         eos = h.request.eos_id
+        if self._ctrl is not None:
+            # the fairness gate's measurement: DELIVERED tokens/s per
+            # tenant — replay/fallback duplicates are dropped below and
+            # must not inflate the measured rate
+            delivered = max(0, len(toks) - self._skip.get(h.id, 0))
+            if delivered:
+                self._ctrl.note_tokens(h.request.tenant, delivered)
         for tok in toks:
             skip = self._skip.get(h.id, 0)
             if skip > 0:
@@ -1119,7 +1376,11 @@ class DisaggServer(_Observability):
                 self._finish_key(("decode", w, slot), "eos")
                 return
             if len(h.tokens) >= h.request.max_new:
-                self._finish_key(("decode", w, slot), "length")
+                # a resumed turn's budget-completion is countable from
+                # the finish reasons alone (the bench's resume column)
+                self._finish_key(("decode", w, slot),
+                                 "session_resumed" if h.resumed
+                                 else "length")
                 return
 
     def _finish_key(self, key, reason: str) -> None:
@@ -1136,6 +1397,20 @@ class DisaggServer(_Observability):
         if w not in self._dead[pool]:
             eng = (self.prefill_pool if pool == "prefill"
                    else self.decode_pool)[w]
+            if (pool == "decode" and self._tier is not None
+                    and h.request.session is not None
+                    and reason in ("length", "eos", "session_resumed")
+                    and eng.exportable(slot, len(h.tokens))):
+                # park the finished turn's lane (host-tier session
+                # tier) before the evict zeroes it — the export is an
+                # engine call, so a death here rides the standard
+                # worker-lost path (the handle is already finished)
+                try:
+                    self._tick("decode", w)
+                    self._park_session_lane(eng, slot, h)
+                except Exception as e:
+                    self._lose_worker(pool, w, e)
+                    return
             try:
                 eng.evict(slot)
             except Exception as e:
@@ -1150,8 +1425,9 @@ class DisaggServer(_Observability):
         # the ONE cleanup point for recovery bookkeeping: every finish
         # path funnels here, so a recovering lane that ends early (a
         # deadline sweep while its replay waits in the queue, a drain)
-        # can never leak its replay-skip entry
+        # can never leak its replay-skip entry or oversize-preempt memo
         self._skip.pop(h.id, None)
+        self._tier_oversize.discard(h.id)
         self.completed += 1
         self._track_tenant(h.request.tenant, -1)
         # close the last decode residency segment at the request's end

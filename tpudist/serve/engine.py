@@ -504,6 +504,8 @@ class SlotEngine:
             "prefix_hit_blocks": al.prefix_hit_blocks,
             "prefix_miss_blocks": al.prefix_miss_blocks,
             "prefix_hit_tokens": al.prefix_hit_tokens,
+            "blocks_admitted_total": al.blocks_admitted_total,
+            "blocks_released_total": al.blocks_released_total,
         }
 
     def kv_gauges(self) -> Tuple[Optional[float], int]:
@@ -580,30 +582,10 @@ class SlotEngine:
         if bool(package["paged"]) != (self.alloc is not None):
             raise ValueError("handoff package and engine disagree on "
                              "paged mode — pools must share KV geometry")
-        import jax.numpy as jnp
-
         pos, counts = int(package["pos"]), int(package["counts"])
         budget = int(package["budget"])
-        if self.alloc is not None:
-            row, _ = self.alloc.admit(slot, pos, budget, ())
-            M = self.max_len // self.paged_cfg.block_size
-            full = np.full(M, self.paged_cfg.num_blocks, np.int32)
-            full[:len(row)] = row
-            self.state, self.cache = self.fns.import_lane(
-                self.state, self.cache, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(full), package["lane"], package["state"])
-            if self.spec:
-                self.dcache = self.fns.draft_arm(
-                    self.dcache, jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(full), jnp.asarray(pos, jnp.int32))
-        else:
-            self.state, self.cache = self.fns.import_lane(
-                self.state, self.cache, jnp.asarray(slot, jnp.int32),
-                package["lane"], package["state"])
-            if self.spec:
-                self.dcache = self.fns.draft_arm(
-                    self.dcache, jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(pos, jnp.int32))
+        self._install_lane(slot, package["lane"], package["state"], pos,
+                           admit_span=(pos, budget))
         self.occupied[slot] = True
         self.decoding[slot] = True
         self.pos[slot] = pos
@@ -611,6 +593,118 @@ class SlotEngine:
         self.budget[slot] = budget
         self.spec_on[slot] = True if spec is None else bool(spec)
         self.peak_occupied = max(self.peak_occupied, self.num_occupied)
+
+    def _install_lane(self, slot: int, lane, row_state, pos: int, *,
+                      admit_span: Tuple[int, int]) -> None:
+        """The ONE import dispatch both :meth:`import_slot` (handoff /
+        preemption resume) and :meth:`resume_slot` (session resume)
+        ride: paged engines reserve ``admit_span`` (admission args for
+        the whole-footprint reservation) and build the sentinel-padded
+        table row, then ``import_lane`` installs the lane + state row
+        and ``draft_arm`` cold-starts the draft cursor at ``pos`` — a
+        package-layout or draft-signature change lands in both resume
+        flavors by construction."""
+        import jax.numpy as jnp
+
+        if self.alloc is not None:
+            row, _ = self.alloc.admit(slot, admit_span[0], admit_span[1],
+                                      ())
+            M = self.max_len // self.paged_cfg.block_size
+            full = np.full(M, self.paged_cfg.num_blocks, np.int32)
+            full[:len(row)] = row
+            self.state, self.cache = self.fns.import_lane(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(full), lane, row_state)
+            if self.spec:
+                self.dcache = self.fns.draft_arm(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(full), jnp.asarray(pos, jnp.int32))
+        else:
+            self.state, self.cache = self.fns.import_lane(
+                self.state, self.cache, jnp.asarray(slot, jnp.int32),
+                lane, row_state)
+            if self.spec:
+                self.dcache = self.fns.draft_arm(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(pos, jnp.int32))
+
+    def resume_slot(self, slot: int, package: Dict[str, object], prompt,
+                    *, temperature: float = 0.0, seed: int = 0,
+                    max_new: int = 1, spec: Optional[bool] = None) -> None:
+        """Install a PARKED lane (host-tier session resume,
+        :mod:`tpudist.serve.host_tier`) into free ``slot`` and continue
+        in PREFILL mode: the package's covered positions are a verified
+        prefix of ``prompt`` (the tier checks token equality), so only
+        ``prompt[pos:]`` — the new turn — is teacher-forced, through the
+        ordinary chunked-prefill path.  No new compiled program exists
+        for this: resume is ``import_lane`` + ``prefill_extend``, so the
+        compile pins stay flat under park/resume churn.
+
+        The imported SlotState row is re-armed ON THE HOST for the new
+        turn — fresh ``temps``/``keys`` (derived exactly like
+        ``insert_batch``'s in-graph ``PRNGKey(seed)``) and zeroed
+        ``counts``/acceptance — so the resumed stream is byte-identical
+        to a fresh serve of the full prompt at the same seed, minus the
+        covered prefix's recompute.  Paged engines reserve the FULL
+        ``prompt + max_new`` footprint here (no prefix sharing — a
+        resumed lane's context is private, like an imported handoff)."""
+        if self.occupied[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        if bool(package["paged"]) != (self.alloc is not None):
+            raise ValueError("parked package and engine disagree on "
+                             "paged mode — tiers must share KV geometry")
+        import jax
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pos = int(package["pos"])
+        max_new = int(max_new)
+        if not 0 < pos < len(prompt):
+            raise ValueError(
+                f"resume cursor {pos} outside prompt of {len(prompt)} — "
+                "the parked context must be a strict prefix of the new "
+                "turn's prompt")
+        reason = self.check_budget(len(prompt), max_new)
+        if reason is not None:
+            raise ValueError(reason)
+        # fresh per-turn sampling state, derived EXACTLY like
+        # insert_batch derives it in-graph (int32 seed wrap → PRNGKey),
+        # so a resumed turn's sampled stream equals the fresh-prefill
+        # twin's at the same seed
+        seed32 = int(np.uint32(int(seed) & 0xFFFFFFFF).astype(np.int32))
+        key = np.asarray(jax.random.PRNGKey(seed32), np.uint32)
+        row_state = package["state"]._replace(
+            last_tok=np.zeros((), np.int32),
+            active=np.zeros((), bool),
+            counts=np.zeros((), np.int32),
+            temps=np.asarray(temperature, np.float32),
+            keys=key,
+            accepted=np.zeros((), np.int32),
+            drafted=np.zeros((), np.int32))
+        # full prompt + max_new reservation (no prefix sharing on a
+        # resumed lane), then the same install dispatch imports ride
+        self._install_lane(slot, package["lane"], row_state, pos,
+                           admit_span=(len(prompt), max_new))
+        self.occupied[slot] = True
+        self.decoding[slot] = False
+        self.pos[slot] = pos
+        self.counts[slot] = 0
+        self.budget[slot] = max_new
+        self.spec_on[slot] = True if spec is None else bool(spec)
+        # the uncovered suffix rides the ordinary chunked-prefill path
+        # (its first token is the parked last_tok — teacher-forcing it
+        # writes the one cache position the park left pending)
+        self._prefill_rest[slot] = (prompt, pos)
+        self.peak_occupied = max(self.peak_occupied, self.num_occupied)
+
+    def exportable(self, slot: int, delivered: int) -> bool:
+        """Can this decoding lane park WITHOUT overshoot — device counts
+        equal the ``delivered`` tokens the caller actually streamed?  An
+        EOS that fired mid-block leaves speculated tokens in the cache
+        beyond the delivered stream; parking that lane would corrupt the
+        next turn's context, so the server skips the park (the next turn
+        simply re-prefills — bounded waste, never wrong bytes)."""
+        return bool(self.decoding[slot]) \
+            and int(self.counts[slot]) == int(delivered)
 
     # -- lifecycle of a request -------------------------------------------
 

@@ -96,6 +96,12 @@ class BlockAllocator:
         self.prefix_hit_blocks = 0
         self.prefix_miss_blocks = 0
         self.prefix_hit_tokens = 0
+        # lifetime admission/release churn (park/resume cycles through
+        # the host tier release and re-reserve whole footprints — these
+        # make that churn visible in kv_stats//statusz where a
+        # point-in-time occupancy gauge cannot)
+        self.blocks_admitted_total = 0
+        self.blocks_released_total = 0
 
     # -- accounting ---------------------------------------------------------
 
@@ -230,6 +236,7 @@ class BlockAllocator:
         self.prefix_hit_blocks += len(reused)
         self.prefix_miss_blocks += max(0, n_prompt_blocks - len(reused))
         self.prefix_hit_tokens += len(reused) * self.block_size
+        self.blocks_admitted_total += len(row)
         return row, len(reused) * self.block_size
 
     def _evict_lru_cached(self, protect: Sequence[int] = ()) -> None:
@@ -298,6 +305,7 @@ class BlockAllocator:
             if self._refs[bid] == 0 and bid not in self._cached_id:
                 self._free.append(bid)
                 freed.append(bid)
+        self.blocks_released_total += len(row)
         return freed
 
     def slot_row(self, slot: int) -> Optional[List[int]]:

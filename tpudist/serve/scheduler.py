@@ -12,10 +12,11 @@ meets them:
   ``reason`` instead of a request that can never complete
   (reject-with-reason backpressure — a bounded queue is the only thing
   standing between a traffic spike and an unbounded-memory host);
-- **FIFO-with-budget assignment** — each engine iteration, the server
-  pulls up to ``len(free_slots)`` requests off the queue head; there is
-  no reordering (fairness is arrival order, the budget is the slot
-  count);
+- **priority-ordered FIFO assignment** — each engine iteration, the
+  server pulls up to ``len(free_slots)`` requests off the queue head;
+  the queue is ordered by ``priority`` (higher first) and arrival order
+  within a class, so fairness is arrival order among equals and the
+  budget is the slot count;
 - **deadline enforcement** — a request carries an optional relative
   ``deadline_s``; expired requests finish with reason ``"deadline"``
   whether they are still queued (checked when pulled) or mid-decode
@@ -66,6 +67,19 @@ FINISH_REASONS = {
     "handoff_corrupt": "rode a KV-handoff package the decode pool "
                        "rejected (schema mismatch or failed integrity "
                        "digest)",
+    "preempted": "parked in the host KV tier by a higher-priority "
+                 "arrival and cut off (drain/stop/pool collapse) before "
+                 "it could resume — an in-flight resume finishes with "
+                 "its normal reason instead",
+    "shed_load": "shed from the queue by the SLO-aware overload "
+                 "controller: measured attainment of the protected "
+                 "priority class fell below target, so queued "
+                 "lower-priority work was finished with a reason "
+                 "instead of starving it",
+    "session_resumed": "completed its max_new budget on a lane resumed "
+                       "from the host KV tier without recompute (the "
+                       "multi-turn no-recompute path; eos/deadline "
+                       "still win when they fire first)",
 }
 
 
@@ -106,6 +120,17 @@ class Request:
     #: attainment), and ``/statusz`` per-tenant in-flight.  None =
     #: untagged (pools under "default" in per-tenant views).
     tenant: Optional[str] = None
+    #: priority class (higher = more important; default 0).  Orders the
+    #: queue (FIFO within a class), and on a host-tier-enabled server a
+    #: higher-priority arrival may PREEMPT a strictly-lower-priority
+    #: decode lane (export to host RAM, resume later byte-identically).
+    priority: int = 0
+    #: multi-turn session id: on a host-tier-enabled server, a finished
+    #: turn's KV lane parks in host RAM under ``(tenant, session)`` and
+    #: the session's next turn (whose prompt must EXTEND the parked
+    #: context token-for-token) resumes it without recompute.  None =
+    #: stateless request, never parked.
+    session: Optional[str] = None
 
 
 class RequestHandle:
@@ -143,6 +168,11 @@ class RequestHandle:
         #: the visible jump in the Chrome trace.
         self.prefill_worker: Optional[int] = None
         self.decode_segments: List[list] = []
+        #: host-tier bookkeeping: True once this request was served from
+        #: a resumed session lane (its length-finish reads
+        #: ``session_resumed`` so the resume path is countable from the
+        #: report's finish reasons alone)
+        self.resumed: bool = False
 
     # -- caller side --------------------------------------------------------
 
@@ -235,6 +265,11 @@ class Scheduler:
         self._refuse_reason: Optional[str] = None
         self._next_id = 0
         self.rejected = 0
+        #: optional extra admission gate (the overload controller):
+        #: ``Request, pending -> Optional[reason]``, consulted under the
+        #: lock AFTER the queue/budget checks — must be cheap (gauge
+        #: reads), must not block.
+        self.admission_gate: Optional[Callable] = None
 
     # -- ingestion side -----------------------------------------------------
 
@@ -243,9 +278,12 @@ class Scheduler:
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                spec: Optional[bool] = None, tenant: Optional[str] = None,
+               priority: int = 0, session: Optional[str] = None,
                ) -> RequestHandle:
         """Admit a request or raise :class:`AdmissionError` (backpressure
-        is synchronous — the caller learns NOW, not after a timeout)."""
+        is synchronous — the caller learns NOW, not after a timeout).
+        ``priority`` orders the queue (FIFO within a class; higher wins);
+        ``session`` keys the host-tier multi-turn resume."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # Deadline convention matches TPUDIST_SERVE_DEADLINE_S: ``None``
         # inherits the server default, ``<= 0`` means explicitly NO
@@ -280,6 +318,8 @@ class Scheduler:
             prefix_hashes=hashes,
             spec=spec,
             tenant=None if tenant is None else str(tenant),
+            priority=int(priority),
+            session=None if session is None else str(session),
         )
         with self._lock:
             reason = self._refuse_reason
@@ -287,12 +327,27 @@ class Scheduler:
                 reason = "queue_full"
             if reason is None:
                 reason = self.check_budget(len(prompt), req.max_new)
+            if reason is None and self.admission_gate is not None:
+                # the overload controller's reject-with-reason gate
+                # (SLO-aware shedding, per-tenant fair share) — cheap
+                # gauge reads by contract
+                reason = self.admission_gate(req, len(self._q))
             if reason is not None:
                 self.rejected += 1
                 raise AdmissionError(reason)
             handle = RequestHandle(req, self._next_id)
             self._next_id += 1
-            self._q.append(handle)
+            if self._q and self._q[-1].request.priority < req.priority:
+                # priority insert: before the first strictly-lower-
+                # priority entry, after every same-or-higher one (FIFO
+                # within a class).  O(queue_limit), and the tail check
+                # above keeps the common all-default-priority path O(1).
+                for i, other in enumerate(self._q):
+                    if other.request.priority < req.priority:
+                        self._q.insert(i, handle)
+                        break
+            else:
+                self._q.append(handle)
             self._work.notify_all()
             return handle
 
@@ -345,6 +400,41 @@ class Scheduler:
                 h = self._q.popleft()
                 if h._expired(now):
                     h._finish("deadline")
+                    out.append(h)
+                else:
+                    keep.append(h)
+            self._q = keep
+        return out
+
+    def head_info(self) -> Optional[dict]:
+        """A peek at the queue head (no pop): the fields the server's
+        preemption decision needs — is a HIGHER-priority request waiting
+        than some decoding lane, and what footprint would it take.
+        ``None`` on an empty queue."""
+        with self._lock:
+            if not self._q:
+                return None
+            r = self._q[0].request
+            return {"priority": r.priority, "prompt_len": len(r.prompt),
+                    "max_new": r.max_new,
+                    "prefix_hashes": r.prefix_hashes,
+                    "session": r.session}
+
+    def shed(self, predicate: Callable[[RequestHandle], bool]
+             ) -> List[RequestHandle]:
+        """Finish (and remove) every queued request ``predicate`` marks
+        — the overload controller's load-shedding half: queued
+        lower-priority work ends with reason ``"shed_load"`` NOW so the
+        protected class's SLO attainment can recover, instead of
+        timing out one deadline at a time.  Returns the shed handles for
+        accounting (the caller emits their ``request_finished``)."""
+        out: List[RequestHandle] = []
+        with self._lock:
+            keep: "collections.deque[RequestHandle]" = collections.deque()
+            while self._q:
+                h = self._q.popleft()
+                if predicate(h):
+                    h._finish("shed_load")
                     out.append(h)
                 else:
                     keep.append(h)

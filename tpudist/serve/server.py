@@ -41,10 +41,13 @@ serving section (:mod:`tpudist.telemetry.aggregate`).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from tpudist.serve.engine import SlotEngine
 from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
@@ -99,6 +102,25 @@ class ServeConfig:
     # queue must stay full before the prefill slot budget shrinks by one
     # (and at most half-full before it grows back); 0 = off
     pool_resize: int = 0
+    # -- host-RAM KV session tier + overload control -----------------------
+    # (tpudist/serve/host_tier.py, tpudist/serve/overload.py)
+    host_tier: bool = False  # park idle/preempted lanes in host RAM
+    host_tier_bytes: int = 1 << 30  # tier byte budget (LRU spill beyond)
+    host_tier_ttl_s: Optional[float] = None  # idle parked-session expiry
+    # priority preemption: a higher-priority arrival may preempt a
+    # strictly-lower-priority decode lane into the host tier (resume is
+    # byte-identical); effective only with host_tier on
+    preempt: bool = True
+    # SLO-aware load shedding: when a protected tenant's LIVE attainment
+    # gauge (TPUDIST_SLO_* targets, metrics registry) drops below the
+    # target, lower-priority work rejects/sheds with reason "shed_load"
+    shed: bool = False
+    shed_attainment: float = 0.9  # attainment floor that trips shedding
+    shed_priority: int = 1  # protected priority class (>= is protected)
+    # per-tenant token-rate fairness: reject a tenant drawing more than
+    # this multiple of its equal share once the queue is half full
+    # (0 = off)
+    fair_share: float = 0.0
     # -- speculative decoding (draft-propose / batched target-verify) ------
     spec: bool = False  # draft proposes K, target verifies in one pass
     spec_k: int = 4  # drafted tokens per speculative block
@@ -161,6 +183,21 @@ class ServeConfig:
             handoff_queue=env_int("TPUDIST_SERVE_HANDOFF_QUEUE", 8) or 8,
             recover=env_flag("TPUDIST_SERVE_RECOVER", True),
             pool_resize=env_int("TPUDIST_SERVE_POOL_RESIZE", 0) or 0,
+            host_tier=env_flag("TPUDIST_SERVE_HOST_TIER", False),
+            host_tier_bytes=env_int("TPUDIST_HOST_TIER_BYTES",
+                                    1 << 30) or (1 << 30),
+            host_tier_ttl_s=env_positive_float(
+                "TPUDIST_HOST_TIER_TTL_S", None),
+            preempt=env_flag("TPUDIST_SERVE_PREEMPT", True),
+            shed=env_flag("TPUDIST_SERVE_SHED", False),
+            shed_attainment=env_positive_float(
+                "TPUDIST_SERVE_SHED_ATTAINMENT", 0.9) or 0.9,
+            # plain env_int (no `or`): 0 is a meaningful protected
+            # class here ("protect default-priority, shed negatives"),
+            # not an unset sentinel like the neighboring knobs
+            shed_priority=env_int("TPUDIST_SERVE_SHED_PRIORITY", 1),
+            fair_share=env_positive_float(
+                "TPUDIST_SERVE_FAIR_SHARE", None) or 0.0,
             spec=env_flag("TPUDIST_SERVE_SPEC", False),
             spec_k=env_int("TPUDIST_SERVE_SPEC_K", 4) or 4,
             spec_draft_layers=env_int(
@@ -278,6 +315,168 @@ class _Observability:
     def _observability_gauges(self) -> Dict[str, float]:  # per-flavor
         return {}
 
+    # -- graceful degradation under overload (host tier + shedding) ---------
+    # Shared by both server flavors, like the observability fields above:
+    # a helper added for one flavor cannot be missing on the other.
+
+    def _init_degradation(self, scheduler) -> None:
+        """Host-RAM KV tier (``ServeConfig.host_tier``) + SLO-aware
+        overload controller (``shed``/``fair_share``) — the machinery
+        that turns "pool full" from a hard reject into a degraded-but-
+        alive mode.  Installs the controller as the scheduler's
+        admission gate."""
+        cfg = self.config
+        self._tier = None
+        if getattr(cfg, "host_tier", False):
+            from tpudist.serve.host_tier import HostKVTier
+
+            self._tier = HostKVTier(cfg.host_tier_bytes,
+                                    ttl_s=cfg.host_tier_ttl_s)
+        self._ctrl = None
+        if getattr(cfg, "shed", False) or getattr(cfg, "fair_share", 0) > 0:
+            from tpudist.serve.overload import OverloadController
+
+            self._ctrl = OverloadController(
+                shed=cfg.shed, shed_attainment=cfg.shed_attainment,
+                shed_priority=cfg.shed_priority, fair_share=cfg.fair_share,
+                queue_limit=cfg.queue_limit)
+            scheduler.admission_gate = self._ctrl.gate
+        #: preempted handles parked in the host tier, insertion-ordered
+        #: (resume order); their packages live in the tier under
+        #: ``("preempt", handle.id)``
+        self._parked: "collections.OrderedDict[int, RequestHandle]" = \
+            collections.OrderedDict()
+        #: handle.id -> tokens to DROP on re-emission after a re-prefill
+        #: fallback (a lane whose parked package was spilled or corrupt
+        #: re-decodes from scratch; the duplicate-drop keeps the stream
+        #: byte-identical)
+        self._skip: Dict[int, int] = {}
+        #: handle ids whose preempt package the tier rejected as
+        #: oversize — re-exporting the same lane every loop iteration
+        #: (a full KV device-to-host copy + digest per spin) would
+        #: collapse decode throughput; a lane's footprint only grows,
+        #: so the rejection is permanent for its lifetime
+        self._tier_oversize: set = set()
+        self.preemptions = 0
+        self.tier_resumes = 0
+        self.tier_corrupt = 0
+
+    @staticmethod
+    def _session_key(req) -> tuple:
+        # tenant-scoped on purpose: one tenant can never resume (or
+        # collide with) another tenant's parked session context
+        return ("sess", req.tenant or "default", req.session)
+
+    def _tier_put(self, key: tuple, pkg: dict, **kw):
+        """``HostKVTier.put`` + telemetry: any LRU spills the put forced
+        become a ``host_tier_spill`` event (the tier itself has no
+        telemetry seam — the scrape counter and the report's spill
+        figure both feed off this event)."""
+        t = self._tier
+        s0 = t.spills
+        stored = t.put(key, pkg, **kw)
+        if t.spills > s0:
+            self._tier_event("host_tier_spill", entries=t.spills - s0)
+        return stored
+
+    def _tier_event(self, name: str, **fields) -> None:
+        """Emit a host-tier telemetry event with the tier's occupancy
+        stamped on it — the metrics feeder turns those fields into the
+        live ``tpudist_host_tier_bytes``/``_entries`` gauges, so the
+        scrape tracks occupancy with no extra instrumentation seam."""
+        from tpudist import telemetry
+
+        if self._tier is not None:
+            fields.setdefault("tier_bytes", self._tier.bytes_resident)
+            fields.setdefault("tier_entries", self._tier.entries)
+        telemetry.event(name, **fields)
+
+    def _shed_tick(self, now: float) -> None:
+        """Refresh the overload controller from the LIVE attainment
+        gauges and shed queued lower-priority work while active.  Every
+        state flip is stamped with the gauge readings that drove it —
+        the decision is auditable from the stream alone."""
+        ctrl = self._ctrl
+        if ctrl is None or self._draining:
+            return
+        if ctrl.tick(now):
+            self._tier_event(
+                "shed_state", active=ctrl.shed_active,
+                target=ctrl.shed_attainment,
+                attainment={k: round(v, 4)
+                            for k, v in ctrl.last_attainment.items()})
+        if ctrl.shed_active:
+            shed = self.scheduler.shed(ctrl.shed_predicate)
+            ctrl.sheds += len(shed)
+            for h in shed:
+                self._note_finished(h)
+
+    def _expire_requeue(self, now: float) -> None:
+        """Deadline sweep over the re-prefill fallback line (both
+        flavors own a ``_requeue`` deque): expired entries finish
+        ``deadline`` in place, order preserved for the rest."""
+        if not self._requeue:
+            return
+        kept: "collections.deque" = collections.deque()
+        while self._requeue:
+            h = self._requeue.popleft()
+            if h._expired(now):
+                h._finish("deadline")
+                self._note_finished(h)
+            else:
+                kept.append(h)
+        self._requeue = kept
+
+    def _sweep_parked(self, now: float) -> None:
+        """The deadline sweep covers PARKED lanes too: a preempted
+        request expiring while offloaded releases its host bytes and
+        finishes ``deadline`` NOW — it must not leak its tier entry (and
+        strand its waiter) until LRU pressure happens to evict it.  Idle
+        parked sessions (no live handle) expire by the tier TTL."""
+        if self._tier is None:
+            return
+        expired = self._tier.sweep_expired(now)
+        if expired:
+            self._tier_event("session_expired", entries=len(expired))
+        for hid in [hid for hid, h in self._parked.items()
+                    if h._expired(now)]:
+            h = self._parked.pop(hid)
+            self._tier.discard(("preempt", hid))
+            h._finish("deadline")
+            self._note_finished(h)
+
+    def _park_session_lane(self, eng, slot: int, h) -> None:
+        """Export a finished turn's lane from ``eng`` and park it in the
+        host tier under its session key, with the covered context
+        (prompt + every delivered token) riding beside it — the next
+        turn resumes only if its prompt extends that token-for-token."""
+        req = h.request
+        pkg = eng.export_slot(slot)
+        pkg["trace_id"] = h.trace_id
+        ctx = np.concatenate([np.asarray(req.prompt, np.int32),
+                              np.asarray(h.tokens, np.int32)])
+        stored = self._tier_put(self._session_key(req), pkg, context=ctx,
+                                kind="turn")
+        if stored is not None:
+            self._tier_event("session_parked", park_kind="turn",
+                             pos=int(pkg["pos"]), bytes=stored,
+                             trace_id=h.trace_id)
+
+    def _abort_parked(self) -> None:
+        """Hard-stop path for parked preempted lanes: they can never
+        resume — finish ``preempted`` (not ``shutdown``: telemetry must
+        distinguish preemption victims from crash victims) and release
+        their tier bytes."""
+        while self._parked:
+            hid, h = self._parked.popitem(last=False)
+            if self._tier is not None:
+                self._tier.discard(("preempt", hid))
+            h._finish("preempted")
+            self._note_finished(h)
+
+    def _note_finished(self, h) -> None:  # per-flavor
+        raise NotImplementedError
+
 
 class InferenceServer(_Observability):
     """Continuous-batching server over a ``TransformerLM`` decode path.
@@ -330,6 +529,15 @@ class InferenceServer(_Observability):
         self._steps = 0
         # -- live observability plane (telemetry.statusz) ------------------
         self._init_observability()
+        # -- graceful degradation (host tier / preemption / shedding) ------
+        self._init_degradation(self.scheduler)
+        #: re-prefill fallback line: lanes whose parked package was
+        #: spilled or corrupt restart from the prompt ahead of fresh
+        #: admissions (their requests were admitted long ago); the
+        #: duplicate-drop counter in ``_skip`` keeps their streams
+        #: byte-identical
+        self._requeue: "collections.deque[RequestHandle]" = \
+            collections.deque()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -372,13 +580,18 @@ class InferenceServer(_Observability):
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                spec: Optional[bool] = None, tenant: Optional[str] = None,
+               priority: int = 0, session: Optional[str] = None,
                ) -> RequestHandle:
         """Thread-safe ingestion; raises :class:`AdmissionError` on
         backpressure/budget rejection (reason stamped into telemetry).
         ``spec=False`` opts this request out of speculative decoding on
         a spec-enabled server (mixed spec/non-spec traffic); ``tenant``
         labels the request in telemetry, per-tenant metrics/SLO
-        attainment, and ``/statusz`` in-flight counts."""
+        attainment, and ``/statusz`` in-flight counts.  ``priority``
+        orders the queue and (host tier on) can preempt a lower class's
+        decode lane; ``session`` keys the host-tier multi-turn resume —
+        a prompt extending a parked session's context token-for-token
+        re-imports its KV instead of re-prefilling it."""
         from tpudist import telemetry
 
         # count the in-flight BEFORE the handle becomes visible to the
@@ -391,7 +604,8 @@ class InferenceServer(_Observability):
             return self.scheduler.submit(
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
-                on_token=on_token, spec=spec, tenant=tenant)
+                on_token=on_token, spec=spec, tenant=tenant,
+                priority=priority, session=session)
         except BaseException as e:
             # never admitted — ANY failure (bad prompt included, not
             # just AdmissionError) must give the +1 back or the tenant
@@ -471,6 +685,15 @@ class InferenceServer(_Observability):
             "completed": self.completed,
             "tokens_out": self.tokens_out,
             "tenants_in_flight": dict(self._tenant_inflight),
+            # host-tier occupancy + overload state (None-free when off)
+            **({"host_tier": {**self._tier.stats(),
+                              "parked_requests": len(self._parked),
+                              "preemptions": self.preemptions,
+                              "resumes_served": self.tier_resumes,
+                              "corrupt": self.tier_corrupt}}
+               if self._tier is not None else {}),
+            **({"overload": self._ctrl.stats()}
+               if self._ctrl is not None else {}),
             "world": env_int("TPUDIST_NUM_PROCESSES", None),
             "generation": env_int("TPUDIST_RESTART_COUNT", 0),
             "draining": self._draining,
@@ -492,6 +715,12 @@ class InferenceServer(_Observability):
             "spec": self.engine.spec_stats(),
             "kv": self.engine.kv_stats(),
             "spmd": self.engine.spmd_stats(),
+            "preemptions": self.preemptions,
+            "parked": len(self._parked),
+            "host_tier": (None if self._tier is None
+                          else self._tier.stats()),
+            "overload": (None if self._ctrl is None
+                         else self._ctrl.stats()),
         }
 
     # -- the engine loop ----------------------------------------------------
@@ -505,9 +734,15 @@ class InferenceServer(_Observability):
 
     def _abort_outstanding(self) -> None:
         """Finish every request that can no longer be served (reason
-        ``"shutdown"``) — the hard-stop twin of the graceful drain."""
+        ``"shutdown"``; parked preempted lanes ``"preempted"``) — the
+        hard-stop twin of the graceful drain."""
         for slot in list(self._slot_handles):
             h = self._slot_handles.pop(slot)
+            h._finish("shutdown")
+            self._note_finished(h)
+        self._abort_parked()
+        while self._requeue:
+            h = self._requeue.popleft()
             h._finish("shutdown")
             self._note_finished(h)
         for h in self.scheduler.take(1 << 30):
@@ -561,7 +796,20 @@ class InferenceServer(_Observability):
                     self._finish_slot(slot, "cache_full")
             for h in sched.expire_queued(now):
                 self._note_finished(h)
-            # FIFO-with-budget admission into free lanes: ONE fused
+            # deadline sweep over the re-prefill fallback line AND the
+            # parked (host-tier) lanes — a request offloaded to host RAM
+            # still owns its deadline (satellite: it releases its tier
+            # bytes and finishes "deadline", never leaks until LRU)
+            self._expire_requeue(now)
+            self._sweep_parked(now)
+            # SLO-aware load shedding off the live attainment gauges,
+            # then priority preemption / parked-lane resume — all host
+            # decisions, all BEFORE admission so a freed slot is usable
+            # in this same iteration
+            self._shed_tick(now)
+            self._maybe_preempt()
+            self._resume_preempted()
+            # priority-ordered admission into free lanes: ONE fused
             # prefill+scatter dispatch for the whole admission batch.
             # The paged engine adds a second gate: the queue head is
             # taken only while its whole block footprint fits the pool
@@ -576,9 +824,26 @@ class InferenceServer(_Observability):
                 # land) — the free list only learns about either at
                 # start_batch
                 reserved, pinned = [0], []
+                resume_pos: Dict[int, int] = {}
 
                 def _gate(h):
                     req = h.request
+                    if (self._tier is not None and req.session is not None
+                            and h.id not in self._skip):
+                        pos = self._tier.match(
+                            self._session_key(req), req.prompt)
+                        if pos is not None:
+                            # host-tier session hit: the resume reserves
+                            # its FULL footprint (a resumed lane's
+                            # context is private — no prefix sharing)
+                            got = eng.kv_admission_probe(
+                                len(req.prompt), req.max_new, (),
+                                reserve=reserved[0], protect=pinned)
+                            if got is None:
+                                return False
+                            reserved[0] += got[0]
+                            resume_pos[h.id] = pos
+                            return True
                     got = eng.kv_admission_probe(
                         len(req.prompt), req.max_new, req.prefix_hashes,
                         reserve=reserved[0], protect=pinned)
@@ -588,7 +853,19 @@ class InferenceServer(_Observability):
                     pinned.extend(got[1])
                     return True
 
-                batch = sched.take(len(free), now, admit=_gate)
+                # re-prefill fallbacks first (admitted long ago — the
+                # disagg requeue discipline), head-of-line on a blocked
+                # gate so steady fresh traffic can't starve them
+                batch: List[RequestHandle] = []
+                blocked = False
+                while self._requeue and len(batch) < len(free):
+                    if not _gate(self._requeue[0]):
+                        blocked = True
+                        break
+                    batch.append(self._requeue.popleft())
+                if not blocked and len(batch) < len(free):
+                    batch += sched.take(len(free) - len(batch), now,
+                                        admit=_gate)
                 alive = []
                 for h in batch:
                     if h.done:  # finished in-queue (deadline expired)
@@ -597,20 +874,32 @@ class InferenceServer(_Observability):
                         alive.append(h)
                 if alive:
                     items, t0 = [], time.monotonic()
+                    fresh: List[Tuple[RequestHandle, int]] = []
                     for h, slot in zip(alive, free):
                         h.slot = slot
-                        h.t_admitted = t0
-                        items.append((slot, h.request.prompt,
-                                      h.request.temperature, h.request.seed,
-                                      h.request.max_new,
-                                      h.request.prefix_hashes,
-                                      h.request.spec))
-                        self._slot_handles[slot] = h
-                    with telemetry.span("prefill", n=len(items)):
-                        firsts = eng.start_batch(items)
-                    for slot, tok in firsts.items():
-                        if tok is not None:
-                            self._deliver_block(slot, [tok])
+                        if h.t_admitted is None:
+                            h.t_admitted = t0
+                        # a session hit resumes its parked lane instead
+                        # of prefilling (falls back to fresh on a
+                        # spilled/corrupt package — degraded, not wrong)
+                        if h.id in resume_pos \
+                                and self._resume_session(slot, h):
+                            continue
+                        fresh.append((h, slot))
+                    if fresh:
+                        for h, slot in fresh:
+                            items.append((slot, h.request.prompt,
+                                          h.request.temperature,
+                                          h.request.seed,
+                                          h.request.max_new,
+                                          h.request.prefix_hashes,
+                                          h.request.spec))
+                            self._slot_handles[slot] = h
+                        with telemetry.span("prefill", n=len(items)):
+                            firsts = eng.start_batch(items)
+                        for slot, tok in firsts.items():
+                            if tok is not None:
+                                self._deliver_block(slot, [tok])
             # chunked prefill: one prompt chunk per prefilling slot per
             # iteration — long prompts never stall decode for more than
             # one chunk's worth of device time
@@ -663,7 +952,11 @@ class InferenceServer(_Observability):
                     self._deliver_block(slot, toks)
             elif eng.prefilling_slots():
                 pass  # prefill work continues next iteration
-            elif self._draining and sched.pending() == 0:
+            elif (self._draining and sched.pending() == 0
+                    and not self._parked and not self._requeue):
+                # drain completes parked/preempted work too: admission
+                # is refused, so slots free up and the resume phases
+                # above finish every offloaded lane before the loop ends
                 break
             else:
                 sched.wait_for_work(_IDLE_WAIT_S)
@@ -671,29 +964,189 @@ class InferenceServer(_Observability):
     def _deliver_block(self, slot: int, toks) -> None:
         """Stream a token block to the slot's request, truncating
         post-hoc at its stop token or length budget (the device block is
-        speculative past either — bounded by the block size)."""
+        speculative past either — bounded by the block size).  A lane
+        re-decoding after a re-prefill fallback (spilled/corrupt parked
+        package) drops exactly its already-delivered duplicates first
+        (``_skip``) — the stream stays byte-identical."""
         h = self._slot_handles[slot]
         eos = h.request.eos_id
+        if self._ctrl is not None:
+            # the fairness gate's measurement: DELIVERED tokens/s per
+            # tenant — duplicates a fallback lane re-decodes are dropped
+            # below and must not inflate its measured rate
+            delivered = max(0, len(toks) - self._skip.get(h.id, 0))
+            if delivered:
+                self._ctrl.note_tokens(h.request.tenant, delivered)
         for tok in toks:
+            skip = self._skip.get(h.id, 0)
+            if skip > 0:
+                if skip == 1:
+                    del self._skip[h.id]
+                else:
+                    self._skip[h.id] = skip - 1
+                continue
             h._deliver(tok)
             self.tokens_out += 1
             if eos is not None and tok == eos:
                 self._finish_slot(slot, "eos")
                 return
             if len(h.tokens) >= h.request.max_new:
-                self._finish_slot(slot, "length")
+                # a resumed turn's budget-completion is countable from
+                # the finish reasons alone (the bench's resume column)
+                self._finish_slot(slot, "session_resumed" if h.resumed
+                                  else "length")
                 return
 
     def _finish_slot(self, slot: int, reason: str) -> None:
         h = self._slot_handles.pop(slot)
+        if (self._tier is not None and h.request.session is not None
+                and reason in ("length", "eos", "session_resumed")
+                and self.engine.exportable(slot, len(h.tokens))):
+            # park the finished turn's lane BEFORE the evict zeroes it:
+            # the session's next turn resumes without recompute.  An
+            # eos that fired mid-block leaves speculated tokens in the
+            # cache beyond the delivered stream — exportable() refuses
+            # those lanes, so a park can never carry diverged context.
+            self._park_session_lane(self.engine, slot, h)
         self.engine.evict(slot)
         h._finish(reason)
         self._note_finished(h)
+
+    def _resume_session(self, slot: int, h: RequestHandle) -> bool:
+        """Serve this turn from its parked session lane (import + a
+        suffix-only prefill).  False on a missing or corrupt package —
+        the caller falls back to an ordinary fresh prefill (degraded,
+        never wrong bytes)."""
+        from tpudist.serve.disagg import HandoffError, deserialize_package
+        from tpudist.serve.host_tier import HostTierError
+
+        req = h.request
+        try:
+            ser = self._tier.get(self._session_key(req))
+            raw = deserialize_package(ser)  # digest verified here
+        except HostTierError:
+            return False  # raced a TTL sweep / LRU spill: fresh prefill
+        except HandoffError as e:
+            self.tier_corrupt += 1
+            self._tier_event("host_tier_corrupt", kind="session",
+                             error=str(e)[:120], trace_id=h.trace_id)
+            return False
+        t0 = time.monotonic()
+        self.engine.resume_slot(
+            slot, raw, req.prompt, temperature=req.temperature,
+            seed=req.seed, max_new=req.max_new, spec=req.spec)
+        h.resumed = True
+        self._slot_handles[slot] = h
+        self.tier_resumes += 1
+        self._tier_event("session_resumed", park_kind="turn", slot=slot,
+                         covered=int(raw["pos"]), trace_id=h.trace_id,
+                         import_s=round(time.monotonic() - t0, 6))
+        return True
+
+    def _maybe_preempt(self) -> None:
+        """Priority preemption: when the queue head outranks a decoding
+        lane and cannot admit (no free slot, or its KV footprint is
+        blocked), the lowest-priority decoding lane (ties: least
+        progress) exports to the host tier mid-block and requeues —
+        byte-identical continuation later, since decode is a pure
+        function of the packaged ``(state, cache)`` and the
+        ``fold_in(key, count)`` stream."""
+        if self._tier is None or not self.config.preempt \
+                or self._draining:
+            return
+        head = self.scheduler.head_info()
+        if head is None:
+            return
+        eng = self.engine
+        if eng.free_slots() and eng.can_admit_kv(
+                head["prompt_len"], head["max_new"],
+                head["prefix_hashes"]):
+            return  # the head can already admit — nothing to preempt for
+        cands = [(slot, h) for slot, h in self._slot_handles.items()
+                 if eng.decoding[slot]
+                 and h.request.priority < head["priority"]
+                 and h.id not in self._skip
+                 and h.id not in self._tier_oversize]
+        if not cands:
+            return
+        slot, victim = min(cands, key=lambda kv: (kv[1].request.priority,
+                                                  len(kv[1].tokens)))
+        self._preempt_slot(slot, victim, head["priority"])
+
+    def _preempt_slot(self, slot: int, h: RequestHandle, by: int) -> None:
+        pkg = self.engine.export_slot(slot)
+        pkg["trace_id"] = h.trace_id
+        stored = self._tier_put(("preempt", h.id), pkg, pinned=True,
+                                kind="preempt")
+        if stored is None:
+            # tier can't hold the lane: admission just waits — and this
+            # lane must not be re-exported every loop spin
+            self._tier_oversize.add(h.id)
+            return
+        self.engine.evict(slot)
+        del self._slot_handles[slot]
+        self._parked[h.id] = h
+        self.preemptions += 1
+        self._tier_event("preempted", id=h.id, slot=slot,
+                         priority=h.request.priority, by_priority=by,
+                         bytes=stored, trace_id=h.trace_id)
+
+    def _resume_preempted(self) -> None:
+        """Parked preempted lanes re-import as capacity frees, oldest
+        first, unless a strictly-higher-priority request is queued (the
+        class that preempted them admits first).  A spilled or corrupt
+        parked package degrades to a full re-prefill through the
+        ``_requeue`` line — already-delivered tokens drop as duplicates,
+        so the stream is still byte-identical."""
+        if self._tier is None or not self._parked:
+            return
+        from tpudist.serve.disagg import HandoffError, deserialize_package
+
+        eng = self.engine
+        while self._parked:
+            free = eng.free_slots()
+            if not free:
+                return
+            hid, h = next(iter(self._parked.items()))
+            head = self.scheduler.head_info()
+            if head is not None and head["priority"] > h.request.priority:
+                return  # the higher class admits first
+            ser = self._tier.peek(("preempt", hid))
+            if ser is None:
+                # spilled under byte pressure: full re-prefill fallback
+                del self._parked[hid]
+                self._skip[h.id] = len(h.tokens)
+                self._requeue.append(h)
+                continue
+            if not eng.can_import(ser):
+                return  # blocks not free yet — parked head-of-line
+            self._tier.get(("preempt", hid))
+            del self._parked[hid]
+            try:
+                raw = deserialize_package(ser)
+            except HandoffError as e:
+                self.tier_corrupt += 1
+                self._tier_event("host_tier_corrupt", kind="preempt",
+                                 error=str(e)[:120], trace_id=h.trace_id)
+                self._skip[h.id] = len(h.tokens)
+                self._requeue.append(h)
+                continue
+            slot = free[0]
+            eng.import_slot(slot, raw, spec=h.request.spec)
+            self._slot_handles[slot] = h
+            self.tier_resumes += 1
+            self._tier_event("session_resumed", park_kind="preempt",
+                             slot=slot, id=h.id, trace_id=h.trace_id)
 
     def _note_finished(self, h: RequestHandle) -> None:
         from tpudist import telemetry
         from tpudist.telemetry import trace
 
+        # one cleanup point for the re-prefill duplicate-drop counter
+        # and the oversize-preempt memo (a lane finishing early must
+        # not leak either entry)
+        self._skip.pop(h.id, None)
+        self._tier_oversize.discard(h.id)
         self.completed += 1
         self._track_tenant(h.request.tenant, -1)
         telemetry.event(

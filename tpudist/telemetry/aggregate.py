@@ -313,6 +313,15 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     # fleet recovery (self-healing disagg): dead workers, lanes replayed
     # onto survivors, and backpressure-driven pool resizes
     workers_lost, lanes_recovered, pool_resizes = 0, 0, 0
+    # host-RAM KV tier + overload control (tpudist.serve.host_tier /
+    # .overload): park/resume/spill/corruption counts, preemptions, and
+    # the shed-state flips — absent entirely from old streams, so the
+    # section below is purely additive
+    tier_parks, tier_spills, tier_corrupt, tier_expired = 0, 0, 0, 0
+    tier_resumes: Dict[str, int] = {}
+    tier_bytes_peak = 0
+    preempted_events, shed_flips = 0, 0
+    shed_last: Optional[dict] = None
     for r in records:
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_kv_config"):
@@ -336,6 +345,33 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         if r.get("kind") == "event" and r.get("name") == "pool_resize":
             pool_resizes += 1
             continue
+        if r.get("kind") == "event":
+            name = r.get("name")
+            if name in ("session_parked", "session_resumed",
+                        "host_tier_spill", "session_expired",
+                        "host_tier_corrupt", "preempted", "shed_state"):
+                if name == "session_parked":
+                    tier_parks += 1
+                elif name == "session_resumed":
+                    kind = str(r.get("park_kind", "turn"))
+                    tier_resumes[kind] = tier_resumes.get(kind, 0) + 1
+                elif name == "host_tier_spill":
+                    tier_spills += int(r.get("entries", 1) or 1)
+                elif name == "session_expired":
+                    tier_expired += int(r.get("entries", 1) or 1)
+                elif name == "host_tier_corrupt":
+                    tier_corrupt += 1
+                elif name == "preempted":
+                    preempted_events += 1
+                elif name == "shed_state":
+                    shed_flips += 1
+                    shed_last = {"active": bool(r.get("active")),
+                                 "target": r.get("target"),
+                                 "attainment": r.get("attainment")}
+                if isinstance(r.get("tier_bytes"), (int, float)):
+                    tier_bytes_peak = max(tier_bytes_peak,
+                                          int(r["tier_bytes"]))
+                continue
         if r.get("kind") != "span":
             continue
         pool = r.get("pool")
@@ -396,8 +432,41 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         reasons[str(r.get("reason"))] = reasons.get(str(r.get("reason")), 0) + 1
     tokens_out = sum(int(r.get("tokens_out", 0)) for r in fins)
     busy = decode_s + prefill_s
+    # host-tier occupancy rides in the kv section (it IS kv — the tier
+    # below the pool); resume-TTFT quotes the no-recompute claim
+    # directly from the finish-reason split
+    tier_any = (tier_parks or tier_resumes or tier_spills or tier_corrupt
+                or tier_expired or preempted_events)
+    host_tier: Optional[dict] = None
+    if tier_any:
+        resumed_ttft = sorted(
+            float(r["ttft_s"]) for r in fins
+            if r.get("reason") == "session_resumed"
+            and isinstance(r.get("ttft_s"), (int, float)))
+        host_tier = {
+            "parks": tier_parks,
+            "resumes": dict(tier_resumes),
+            "spills": tier_spills,
+            "corrupt": tier_corrupt,
+            "expired": tier_expired,
+            "bytes_peak": tier_bytes_peak or None,
+            "preemptions": preempted_events,
+            "resume_ttft": ({
+                "p50_s": round(_percentile(resumed_ttft, 50), 6),
+                "p95_s": round(_percentile(resumed_ttft, 95), 6),
+                "max_s": round(resumed_ttft[-1], 6)}
+                if resumed_ttft else None),
+        }
+    overload: Optional[dict] = None
+    if shed_flips or reasons.get("shed_load"):
+        overload = {
+            "shed_state_changes": shed_flips,
+            "last_shed_state": shed_last,
+            "shed_finished": reasons.get("shed_load", 0),
+        }
     kv: Optional[dict] = None
-    if kv_config is not None or kv_occ_dur > 0 or kv_read_bytes:
+    if kv_config is not None or kv_occ_dur > 0 or kv_read_bytes \
+            or host_tier is not None:
         kv = {
             # static geometry from the serve_kv_config stamp
             **({"paged": kv_config.get("paged"),
@@ -423,6 +492,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             "read_bytes_per_token": (round(kv_read_bytes / decode_tokens, 1)
                                      if decode_tokens and kv_read_bytes
                                      else None),
+            **({"host_tier": host_tier} if host_tier is not None else {}),
         }
     spec: Optional[dict] = None
     if spec_blocks:
@@ -503,6 +573,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         **({"kv": kv} if kv is not None else {}),
         **({"spec": spec} if spec is not None else {}),
         **({"pools": pools} if pools is not None else {}),
+        **({"overload": overload} if overload is not None else {}),
         # SLO section only when targets were declared — old streams (and
         # target-less runs) aggregate byte-identically without it
         **({"slo": _slo_summary(fins, slo_config)}
@@ -771,6 +842,35 @@ def render_markdown(report: dict) -> str:
                             f"{kv['read_bytes_per_token']:,.0f} B/token"
                             f"{via}")
             lines.append("- KV cache: " + "; ".join(bits))
+            if kv.get("host_tier"):
+                ht = kv["host_tier"]
+                res = ht.get("resumes") or {}
+                bits = [f"{ht['parks']} parks",
+                        f"{sum(res.values())} resumes ({res})" if res
+                        else "0 resumes",
+                        f"{ht['spills']} spills",
+                        f"{ht['preemptions']} preemptions"]
+                if ht.get("corrupt"):
+                    bits.append(f"{ht['corrupt']} corrupt (re-prefilled)")
+                if ht.get("expired"):
+                    bits.append(f"{ht['expired']} expired")
+                if ht.get("bytes_peak"):
+                    bits.append(f"peak {ht['bytes_peak']:,} B host RAM")
+                rt = ht.get("resume_ttft")
+                if rt:
+                    bits.append(f"resume TTFT p50 {rt['p50_s'] * 1e3:.1f} "
+                                f"ms / p95 {rt['p95_s'] * 1e3:.1f} ms")
+                lines.append("- KV host tier: " + "; ".join(bits))
+        if sv.get("overload"):
+            ov = sv["overload"]
+            last = ov.get("last_shed_state") or {}
+            state = ("active" if last.get("active") else "inactive")
+            lines.append(
+                f"- overload control: {ov['shed_finished']} shed, "
+                f"{ov['shed_state_changes']} shed-state change(s), "
+                f"last {state}"
+                + (f" at attainment {last.get('attainment')}"
+                   if last.get("attainment") else ""))
     if report.get("telemetry_dropped"):
         td = report["telemetry_dropped"]
         lines += ["", f"**⚠ telemetry dropped records** — ring evictions: "

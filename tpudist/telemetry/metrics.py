@@ -359,6 +359,20 @@ def slo_targets() -> Dict[str, Optional[float]]:
 _SLO: Dict[str, Optional[float]] = {"ttft_s": None, "tpot_s": None}
 
 
+def slo_attainment() -> Dict[Tuple[str, str], float]:
+    """The LIVE per-tenant SLO attainment gauges, as ``(metric, tenant)
+    -> value`` — the read surface the SLO-aware admission controller
+    (:mod:`tpudist.serve.overload`) acts on.  Wait-free like every
+    registry read (one GIL-atomic dict copy, no locks)."""
+    out: Dict[Tuple[str, str], float] = {}
+    for (kind, name, lkey), m in dict(_REGISTRY._metrics).items():
+        if kind == "gauge" and name == "tpudist_slo_attainment":
+            lab = dict(lkey)
+            out[(lab.get("metric", "?"),
+                 lab.get("tenant", "default"))] = m.value
+    return out
+
+
 # -- the span/event → metrics feeder -----------------------------------------
 
 def _pool_label(rec: dict) -> Dict[str, str]:
@@ -482,6 +496,39 @@ def feed_record(rec: dict) -> None:
             v = rec.get(k)
             if isinstance(v, (int, float)) and v:
                 r.counter("tpudist_telemetry_dropped_total", kind=k).inc(v)
+    # host-RAM KV tier + overload control (tpudist.serve.host_tier /
+    # .overload): park/resume/spill/corruption counters, plus the
+    # occupancy gauges riding ON the events (the tier has no feed of
+    # its own — the server stamps tier_bytes/tier_entries into each
+    # park/resume event, so a scrape tracks occupancy with zero new
+    # instrumentation seams)
+    elif name in ("session_parked", "session_resumed", "host_tier_spill",
+                  "session_expired", "host_tier_corrupt", "preempted",
+                  "shed_state"):
+        kind_lab = ({"kind": str(rec["park_kind"])}
+                    if isinstance(rec.get("park_kind"), str) else {})
+        if name == "session_parked":
+            r.counter("tpudist_host_tier_parks_total", **kind_lab).inc()
+        elif name == "session_resumed":
+            r.counter("tpudist_host_tier_resumes_total", **kind_lab).inc()
+        elif name == "host_tier_spill":
+            r.counter("tpudist_host_tier_spills_total").inc(
+                int(rec.get("entries", 1) or 1))
+        elif name == "session_expired":
+            r.counter("tpudist_host_tier_expired_total").inc(
+                int(rec.get("entries", 1) or 1))
+        elif name == "host_tier_corrupt":
+            r.counter("tpudist_host_tier_corrupt_total").inc()
+        elif name == "preempted":
+            r.counter("tpudist_requests_preempted_total").inc()
+        elif name == "shed_state":
+            r.gauge("tpudist_shed_active").set(
+                1.0 if rec.get("active") else 0.0)
+        for key, gname in (("tier_bytes", "tpudist_host_tier_bytes"),
+                           ("tier_entries", "tpudist_host_tier_entries")):
+            v = rec.get(key)
+            if isinstance(v, (int, float)):
+                r.gauge(gname).set(float(v))
 
 
 def set_train_gauges(iteration: int, values: Dict[str, float]) -> None:

@@ -127,6 +127,31 @@ ENV_VARS = {
     "TPUDIST_SERVE_HEALTH_STALE_S":
         "/healthz engine-heartbeat staleness threshold in seconds "
         "(default 300 — must exceed the first-dispatch XLA compile)",
+    # host-RAM KV tier + overload control (serve/host_tier.py, overload.py)
+    "TPUDIST_SERVE_HOST_TIER":
+        "host-RAM KV session tier: park idle/preempted lanes in host "
+        "memory, resume without recompute (default off)",
+    "TPUDIST_HOST_TIER_BYTES":
+        "host-tier byte budget (default 1 GiB; LRU spill beyond it)",
+    "TPUDIST_HOST_TIER_TTL_S":
+        "idle parked-session expiry in seconds (<=0/unset = LRU only)",
+    "TPUDIST_SERVE_PREEMPT":
+        "priority preemption: a higher-priority arrival parks a "
+        "lower-priority decode lane in the host tier (default on; "
+        "effective only with the host tier enabled)",
+    "TPUDIST_SERVE_SHED":
+        "SLO-aware load shedding off the live per-tenant attainment "
+        "gauges (default off; needs TPUDIST_SLO_* targets + metrics)",
+    "TPUDIST_SERVE_SHED_ATTAINMENT":
+        "protected-class attainment floor that trips shedding "
+        "(default 0.9)",
+    "TPUDIST_SERVE_SHED_PRIORITY":
+        "protected priority class: requests at or above it are never "
+        "shed (default 1)",
+    "TPUDIST_SERVE_FAIR_SHARE":
+        "per-tenant token-rate fairness multiplier — reject a tenant "
+        "above this multiple of its equal share once the queue is half "
+        "full (0/unset = off)",
     "TPUDIST_SERVE_SPEC":
         "speculative decoding: draft proposes K, target verifies in one pass",
     "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
