@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""Per-tenant adapter bench: the multi-LoRA decode rungs, frozen per
+round as ``BENCH_ADAPTER_r{NN}.json``.
+
+One rung family, CPU-safe (tiny model; absolute tok/s is interpreter
+mechanics — the RATIOS between arms on one engine are the measurement):
+
+- **adapter_sweep** — the SAME engine, the SAME request schedule (every
+  slot decoding a full budget), swept over adapters-per-batch ∈
+  {0 (base-only), 1, S/2, S}: each arm loads its adapters, binds them
+  round-robin across the slots, decodes to budget, and unloads — so the
+  sweep ALSO drives the load/churn path.  Quotes decode throughput per
+  arm and the min ratio vs the base-only arm: the claim is that batched
+  gathered LoRA decode stays within a stated margin of base decode
+  (the delta is two rank-r matmuls per projection against the full
+  base matmuls + attention).  The artifact freezes:
+
+  - ``outputs_match`` — every arm's every stream byte-identical to its
+    single-adapter sequential ``generate()`` oracle (correctness rides
+    along with the measurement);
+  - ``ratio_min`` / ``within_margin`` — the throughput acceptance;
+  - ``compile_pins_flat`` — jit-cache sizes identical after the whole
+    load/bind/unload churn sweep vs after the first arm (zero
+    recompilation as tenants churn).
+
+Usage: ``python benchmarks/adapter_bench.py [--smoke] [--out PATH]``
+(round_snapshot.py freezes it per round; the tier-1 smoke test asserts
+the rung fields).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CFG = dict(vocab=32, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+           max_len=96)
+
+#: throughput acceptance margin: each adapter arm must keep at least
+#: this fraction of base-only decode tok/s.  The true cost at rank 8 /
+#: d_model 32 is a few percent of FLOPs; 0.4 absorbs CPU-interpreter
+#: noise while still catching a pathological (e.g. per-token re-gather
+#: or recompile) regression.
+MARGIN = 0.4
+
+
+def _model(seed: int = 0):
+    import jax
+
+    from tpudist.models import create_transformer
+
+    return create_transformer(jax.random.PRNGKey(seed), seq_len=16, **CFG)
+
+
+_GEN_CACHE: dict = {}
+
+
+def _oracle(module, params, prompt, max_new, factors, key):
+    """Sequential single-adapter reference.  Generators are CACHED per
+    adapter (``key``) and rank: ``generate()`` builds a fresh jit per
+    call, which across a slots × arms sweep would pay ~16 full scan
+    compiles for the same 5 programs — the cache makes the oracle cost
+    one compile per (adapter, prompt shape)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudist.models import lora, make_generator
+
+    gen = _GEN_CACHE.get((key, max_new))
+    if gen is None:
+        col = (lora.adapter_collection(factors, CFG["n_layers"])
+               if factors is not None else None)
+        mod = module.clone(lora_rank=8) if factors is not None else module
+        gen = make_generator(mod, params, max_new, adapters=col)
+        _GEN_CACHE[(key, max_new)] = gen
+    out = gen(jnp.asarray(prompt)[None])
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _run_arm(eng, prompts, budgets, adapters_by_slot):
+    """Fill every slot, decode everything to budget, return
+    ``(streams, decode_wall_s, decode_tokens)`` — wall measured over the
+    decode blocks only (admission/prefill excluded: the sweep compares
+    DECODE throughput, the hot path the adapter gather sits on)."""
+    items = []
+    for slot, (p, b, name) in enumerate(
+            zip(prompts, budgets, adapters_by_slot)):
+        items.append((slot, p, 0.0, slot, b, (), None, name))
+    streams = {s: [] for s in range(len(prompts))}
+    for slot, tok in eng.start_batch(items).items():
+        if tok is not None:
+            streams[slot].append(tok)
+    while eng.prefilling_slots():
+        for slot, tok in eng.advance_prefill().items():
+            streams[slot].append(tok)
+    wall = 0.0
+    tokens = 0
+    while eng.num_active:
+        t0 = time.perf_counter()
+        _, blocks = eng.decode_block()
+        wall += time.perf_counter() - t0
+        for slot, toks in blocks.items():
+            streams[slot].extend(toks)
+            tokens += len(toks)
+        for slot in list(range(eng.num_slots)):
+            if eng.occupied[slot] and eng.decoding[slot] \
+                    and eng.counts[slot] >= eng.budget[slot]:
+                eng.evict(slot)
+    return streams, wall, tokens
+
+
+def run_sweep(*, slots: int, max_new: int, rank: int,
+              smoke: bool) -> dict:
+    import jax
+    import numpy as np
+
+    from tpudist.models import lora
+    from tpudist.serve import SlotEngine
+
+    module, params = _model()
+    rng = np.random.default_rng(0)
+    # one prompt LENGTH across slots (contents differ): the oracle's
+    # cached generators then compile once per adapter, not per slot
+    prompts = [rng.integers(0, CFG["vocab"], size=6).astype(np.int32)
+               for s in range(slots)]
+    budgets = [max_new] * slots
+    factor_sets = {
+        f"tenant-{i}": lora.make_adapter_factors(
+            jax.random.PRNGKey(100 + i), module, rank, scale=0.2)
+        for i in range(slots)}
+    eng = SlotEngine(module, params, num_slots=slots, prefill_pad=8,
+                     decode_block=8, paged=True, kv_block=8,
+                     adapters=True, adapter_blocks=slots,
+                     adapter_rank=rank)
+
+    def arm(n_adapters: int):
+        names = list(factor_sets)[:n_adapters]
+        for n in names:
+            eng.load_adapter(n, factor_sets[n])
+        bound = [(names[s % n_adapters] if n_adapters else None)
+                 for s in range(slots)]
+        streams, wall, tokens = _run_arm(eng, prompts, budgets, bound)
+        for n in names:
+            eng.unload_adapter(n)
+        return streams, wall, tokens, bound
+
+    # warmup: one full-adapter cycle pays every XLA compile (the
+    # twin-delta discipline — first-compile must not land in any arm)
+    arm(slots)
+    pins0 = dict(eng.compile_counts())
+    ks = sorted({0, 1, max(1, slots // 2), slots})
+    rows = []
+    outputs_match = True
+    for k in ks:
+        streams, wall, tokens, bound = arm(k)
+        for s in range(slots):
+            facs = factor_sets[bound[s]] if bound[s] else None
+            ref = _oracle(module, params, prompts[s], budgets[s], facs,
+                          bound[s] or "<base>")
+            if streams[s] != ref:
+                outputs_match = False
+        rows.append({"adapters_per_batch": k,
+                     "decode_tokens": tokens,
+                     "decode_wall_s": round(wall, 6),
+                     "tokens_per_s": round(tokens / wall, 2) if wall else None})
+    pins1 = dict(eng.compile_counts())
+    base = next(r for r in rows if r["adapters_per_batch"] == 0)
+    ratios = {r["adapters_per_batch"]:
+              round(r["tokens_per_s"] / base["tokens_per_s"], 4)
+              for r in rows if r["adapters_per_batch"] > 0}
+    ratio_min = min(ratios.values()) if ratios else None
+    return {
+        "rung": "adapter_sweep",
+        "regime": "cpu" if jax.devices()[0].platform != "tpu" else "tpu",
+        "note": ("tiny-model CPU mechanics — the cross-arm RATIOS on one "
+                 "engine are the measurement, absolute tok/s is not"),
+        "slots": slots, "max_new": max_new, "rank": rank,
+        "smoke": bool(smoke),
+        "rows": rows,
+        "base_tokens_per_s": base["tokens_per_s"],
+        "ratios_vs_base": ratios,
+        "ratio_min": ratio_min,
+        "margin": MARGIN,
+        "within_margin": (ratio_min is not None and ratio_min >= MARGIN),
+        "outputs_match": outputs_match,
+        "compile_pins_flat": pins0 == pins1,
+        "adapter_stats": {k: v for k, v in eng.adapter_stats().items()
+                          if k in ("blocks_total", "rank", "block_bytes",
+                                   "loads", "evicts", "unloads")},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized sweep (fewer decode tokens)")
+    ap.add_argument("--out", default=None, help="output JSONL path")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--rank", type=int, default=8)
+    args = ap.parse_args(argv)
+    max_new = args.max_new or (16 if args.smoke else 48)
+    row = run_sweep(slots=args.slots, max_new=max_new, rank=args.rank,
+                    smoke=args.smoke)
+    line = json.dumps(row)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

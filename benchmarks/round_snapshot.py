@@ -201,6 +201,24 @@ def main(argv=None) -> None:
             {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
         print(f"{session_out.name}: error {e!r}")
 
+    # Per-tenant adapter rung (paged multi-LoRA pool): adapters-per-
+    # batch decode-throughput sweep vs base-only + oracle byte-identity
+    # + churn compile pins, frozen as BENCH_ADAPTER_r{NN}.json.
+    # Failure-isolated like the serve snapshot.
+    adapter_out = REPO / f"BENCH_ADAPTER_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "adapter_bench.py"),
+             "--out", str(adapter_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{adapter_out.name}: {json.dumps(json.loads(data[-1]))}")
+    except Exception as e:
+        adapter_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{adapter_out.name}: error {e!r}")
+
     # Decode per-op attribution (VERDICT Weak #2): trace the bf16 fused
     # decode loop and freeze the table naming the non-matmul residual.
     # Failure-isolated like the serve snapshot.

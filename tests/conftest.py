@@ -116,6 +116,16 @@ _SLOW_PATTERNS = (
     # oracles stay default in TestServeSpmd)
     "TestServeMeshOracleSweep",
     "TestDisaggServer",
+    # per-tenant adapter matrices: mesh/spec/kernel oracle sweeps, the
+    # sampled stream-independence sweep (2 engines + per-request solo
+    # drives), the cross-engine handoff re-bind drive, and the
+    # disagg/host-tier re-bind e2e (the registry units, the dense/paged
+    # greedy churn oracles, churn compile pins, and the dense-greedy
+    # server representative stay default in test_serve_adapters.py)
+    "TestAdapterMatrix",
+    "TestAdapterDisaggTier",
+    "TestAdapterOracle::test_sampled_streams_layout_independent",
+    "TestAdapterHandoffUnit::test_export_import_rebinds_by_name",
     # serve_bench mesh/disagg/multiproc smokes + the decode trace
     # capture (each builds servers / spawns tpurun workers)
     "TestServeBench::test_smoke_mesh_rung",

@@ -627,6 +627,41 @@ class TestObsBench:
             assert twin[col] is not None, col
 
 
+class TestAdapterBench:
+    def test_sweep_freezes_acceptance_fields(self, tmp_path):
+        """The per-tenant adapter rung's contract: every arm's every
+        stream byte-identical to its single-adapter sequential oracle,
+        adapter decode throughput within the quoted margin of the
+        base-only arm, and jit-cache sizes flat across the whole
+        load/bind/unload churn sweep."""
+        import json as _json
+
+        from benchmarks.adapter_bench import main
+
+        out = tmp_path / "BENCH_ADAPTER.json"
+        rc = main(["--smoke", "--out", str(out)])
+        assert rc == 0
+        row = _json.loads(out.read_text().splitlines()[0])
+        assert row["rung"] == "adapter_sweep"
+        assert row["outputs_match"], "an arm diverged from its oracle"
+        assert row["compile_pins_flat"], "adapter churn recompiled"
+        assert row["within_margin"], (
+            f"ratio_min {row['ratio_min']} below margin {row['margin']}")
+        ks = [r["adapters_per_batch"] for r in row["rows"]]
+        assert 0 in ks and max(ks) == row["slots"]
+        # the frozen per-round artifact (round_snapshot) carries the
+        # same booleans — spot-check the current one when present
+        from pathlib import Path as _P
+
+        frozen = sorted(_P(__file__).resolve().parent.parent.glob(
+            "BENCH_ADAPTER_r*.json"))
+        if frozen:
+            fr = _json.loads(frozen[-1].read_text().splitlines()[0])
+            assert fr.get("error") or (
+                fr["outputs_match"] and fr["within_margin"]
+                and fr["compile_pins_flat"])
+
+
 class TestSessionBench:
     def test_rungs_freeze_degradation_fields(self, tmp_path):
         """The graceful-degradation rung's contract: every later

@@ -1,0 +1,653 @@
+"""Per-tenant adapters (paged multi-LoRA pool): the acceptance suite.
+
+The tentpole contract, pinned here:
+
+- **heterogeneous-adapter churn oracle** — an engine whose slots bind
+  DIFFERENT adapters (plus base-only lanes) streams each request
+  byte-identically to its single-adapter sequential ``generate()``
+  oracle, greedy AND sampled, dense AND paged;
+- **bit-exact base-only path** — a sentinel ``adapter_id`` lane equals
+  a plain (no-adapter-pool) engine bitwise;
+- **zero recompilation under churn** — load/unload/bind cycles and
+  mesh shapes leave every jit-cache size flat;
+- **admission semantics** — an unloaded name rejects ``adapter_missing``
+  at submit, a raced unload finishes with the same reason, an in-use
+  adapter's unload defers (and its block frees+zeroes on last evict);
+- **re-bind by name** — disagg handoff and host-tier session resume
+  carry the adapter NAME and re-bind on the destination pool
+  (wrong/missing name → ``adapter_missing``/fresh-prefill, never
+  silently-wrong bytes).
+
+Fast engine/registry/oracle cases run tier-1; the server-matrix e2e
+(mesh sweep, spec/kernel composition, disagg + host-tier drives) rides
+the slow lane (conftest ``_SLOW_PATTERNS``).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpudist.models import create_transformer, generate  # noqa: E402
+from tpudist.models import lora  # noqa: E402
+from tpudist.serve import InferenceServer, ServeConfig, SlotEngine  # noqa: E402
+from tpudist.serve.adapters import (  # noqa: E402
+    AdapterMissingError,
+    AdapterPoolFull,
+    AdapterRegistry,
+)
+from tpudist.serve.scheduler import FINISH_REASONS, AdmissionError  # noqa: E402
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+RANK = 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+@pytest.fixture(scope="module")
+def factors(model):
+    module, _ = model
+    return {f"t{i}": lora.make_adapter_factors(
+        jax.random.PRNGKey(40 + i), module, RANK, scale=0.3)
+        for i in range(3)}
+
+
+def _prompt(plen, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _oracle(model, factors, prompt, max_new, adapter, *,
+            temperature=0.0, seed=0):
+    """The single-adapter sequential reference each slot's stream must
+    match byte-for-byte."""
+    module, params = model
+    col = (lora.adapter_collection(factors[adapter], CFG["n_layers"])
+           if adapter else None)
+    mod = module.clone(lora_rank=RANK) if adapter else module
+    rng = jax.random.PRNGKey(0)
+    out = generate(mod, params, jnp.asarray(prompt)[None], max_new,
+                   temperature=temperature, adapters=col, rng=rng)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+#: (prompt, max_new, adapter) churn mix: more requests than slots, a
+#: prompt longer than the pad (chunked prefill), mixed adapter/base
+def _requests():
+    return [
+        (_prompt(3, 0), 4, "t0"),
+        (_prompt(5, 1), 6, "t1"),
+        (_prompt(12, 2), 3, None),
+        (_prompt(6, 3), 5, "t2"),
+        (_prompt(4, 4), 4, "t0"),
+    ]
+
+
+def _drive(model, factors, requests, *, num_slots=2, temperature=0.0,
+           load=None, decode="block", **engine_kw):
+    """FIFO continuous-batching drive with per-request adapters (the
+    test_serve oracle driver grown an adapter column).  Sampled lanes
+    use ``seed = rid`` so the oracle can reproduce the stream."""
+    module, params = model
+    eng = SlotEngine(module, params, num_slots=num_slots, prefill_pad=8,
+                     decode_block=4, adapters=True,
+                     adapter_blocks=len(factors), adapter_rank=RANK,
+                     **engine_kw)
+    for name in (load if load is not None else sorted(factors)):
+        eng.load_adapter(name, factors[name])
+    pending = list(enumerate(requests))
+    out = {rid: [] for rid, _ in pending}
+    slot_rid, slot_budget = {}, {}
+
+    def deliver(slot, toks):
+        rid = slot_rid[slot]
+        out[rid].extend(toks)
+        out[rid][:] = out[rid][:slot_budget[slot]]
+        if len(out[rid]) >= slot_budget[slot]:
+            eng.evict(slot)
+            del slot_rid[slot], slot_budget[slot]
+
+    while pending or eng.num_occupied:
+        free = eng.free_slots()
+        items, reserved = [], 0
+        while free and pending:
+            rid, (prompt, max_new, adapter) = pending[0]
+            if not eng.can_admit_kv(len(prompt), max_new, reserve=reserved):
+                break
+            reserved += eng.kv_footprint(len(prompt), max_new)
+            pending.pop(0)
+            slot = free.pop(0)
+            slot_rid[slot], slot_budget[slot] = rid, max_new
+            items.append((slot, prompt, temperature, 0, max_new, (),
+                          None, adapter))
+        for slot, tok in eng.start_batch(items).items():
+            if tok is not None:
+                deliver(slot, [tok])
+        for slot, tok in eng.advance_prefill().items():
+            deliver(slot, [tok])
+        if eng.num_active:
+            _, blocks = (eng.decode_auto() if decode == "auto"
+                         else eng.decode_block())
+            for slot, toks in list(blocks.items()):
+                if slot in slot_rid:
+                    deliver(slot, toks)
+    return out, eng
+
+
+def _assert_oracle(model, factors, requests, out, *, temperature=0.0):
+    for rid, (prompt, max_new, adapter) in enumerate(requests):
+        ref = _oracle(model, factors, prompt, max_new, adapter,
+                      temperature=temperature)
+        assert out[rid] == ref, (
+            f"request {rid} (adapter={adapter}) diverged from its "
+            f"sequential oracle: {out[rid]} vs {ref}")
+
+
+def _load(reg, name):
+    """load + activate — the two-phase sequence the engine runs (the
+    factors land in the device pool between the halves)."""
+    bid, ev = reg.load(name)
+    reg.activate(name)
+    return bid, ev
+
+
+class TestAdapterRegistry:
+    def test_load_bind_unload_refcount(self):
+        reg = AdapterRegistry(2)
+        bid, ev = _load(reg, "a")
+        assert ev is None and reg.has("a")
+        assert reg.acquire("a") == bid
+        assert reg.refcount("a") == 1
+        # in-use unload defers: new binds refuse, block frees on release
+        assert reg.unload("a") == (False, bid)
+        assert not reg.has("a") and reg.acquire("a") is None
+        assert reg.release("a", bid) == bid  # freed NOW -> caller zeroes
+        assert reg.resident == 0 and reg.refcount("a") == 0
+
+    def test_pending_load_not_bindable(self):
+        """Two-phase load (review hardening): a name whose factors are
+        not yet written must not bind — the engine thread could gather
+        a zeroed or evicted-victim block otherwise."""
+        reg = AdapterRegistry(2)
+        reg.load("a")  # no activate yet
+        assert not reg.has("a") and reg.acquire("a") is None
+        reg.activate("a")
+        assert reg.has("a") and reg.acquire("a") is not None
+
+    def test_lru_evicts_cold_only(self):
+        reg = AdapterRegistry(2)
+        _load(reg, "a")
+        _load(reg, "b")
+        reg.acquire("b")  # hot
+        _, ev = _load(reg, "c")  # full: evicts the cold one
+        assert ev is not None and ev[0] == "a"
+        assert reg.has("b") and reg.has("c") and not reg.has("a")
+        reg.acquire("c")
+        with pytest.raises(AdapterPoolFull):
+            reg.load("d")  # both hot — loud, not a silent overwrite
+
+    def test_duplicate_load_rejected(self):
+        reg = AdapterRegistry(2)
+        _load(reg, "a")
+        with pytest.raises(ValueError, match="already loaded"):
+            reg.load("a")
+
+    def test_lru_order_follows_last_use(self):
+        reg = AdapterRegistry(2)
+        _load(reg, "a")
+        _load(reg, "b")
+        # bind+release "a": it becomes the NEWEST cold entry
+        bid_a = reg.acquire("a")
+        reg.release("a", bid_a)
+        _, ev = _load(reg, "c")
+        assert ev[0] == "b"  # the least-recently-used cold adapter
+
+    def test_reload_while_old_generation_bound(self):
+        """Review hardening: unload-then-reload of a name whose OLD
+        factors still serve a live lane works immediately — the old
+        generation retires under its block id, the lane releases by
+        (name, bid), and new binds get the new generation."""
+        reg = AdapterRegistry(2)
+        bid0, _ = _load(reg, "a")
+        assert reg.acquire("a") == bid0  # a long-running lane
+        reg.unload("a")                  # deferred
+        bid1, _ = _load(reg, "a")        # retrained factors, NOW
+        assert bid1 != bid0
+        assert reg.acquire("a") == bid1  # new lanes: new generation
+        # the old lane evicts: ITS block frees (and gets zeroed)
+        assert reg.release("a", bid0) == bid0
+        # the new generation stays resident
+        assert reg.release("a", bid1) is None
+        assert reg.has("a")
+
+
+class TestLoraSeam:
+    def test_off_lane_is_bitwise_base(self, model, factors):
+        """adapter_id=sentinel ⇒ the base-only path is BIT-exact (the
+        where-select contract), even with factors resident."""
+        module, params = model
+        lmod = module.clone(lora_rank=RANK)
+        p = jnp.asarray(_prompt(5, 7))[None]
+        base = np.asarray(generate(module, params, p, 6))
+        off = np.asarray(generate(
+            lmod, params, p, 6,
+            adapters=lora.adapter_collection(factors["t0"],
+                                             CFG["n_layers"], on=False)))
+        assert np.array_equal(off, base)
+
+    def test_adapter_changes_the_stream(self, model, factors):
+        module, params = model
+        lmod = module.clone(lora_rank=RANK)
+        p = jnp.asarray(_prompt(5, 7))[None]
+        base = np.asarray(generate(module, params, p, 8))
+        on = np.asarray(generate(
+            lmod, params, p, 8,
+            adapters=lora.adapter_collection(factors["t0"],
+                                             CFG["n_layers"])))
+        assert not np.array_equal(on, base)
+
+    def test_missing_collection_is_loud(self, model):
+        module, params = model
+        lmod = module.clone(lora_rank=RANK)
+        with pytest.raises(ValueError, match="adapters"):
+            generate(lmod, params, jnp.asarray(_prompt(4, 1))[None], 2)
+
+    def test_factor_shape_validation(self, model, factors):
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=1, adapters=True,
+                         adapter_blocks=2, adapter_rank=RANK)
+        bad = dict(factors["t0"])
+        bad["a_wi"] = bad["a_wi"][:, :, :-1]  # wrong rank
+        with pytest.raises(ValueError, match="a_wi"):
+            eng.load_adapter("bad", bad)
+
+
+class TestAdapterOracle:
+    """The heterogeneous-adapter churn oracle: slots bound to different
+    adapters (+ a base lane), streams byte-identical to each request's
+    single-adapter sequential run."""
+
+    def test_dense_greedy(self, model, factors):
+        out, eng = _drive(model, factors, _requests())
+        _assert_oracle(model, factors, _requests(), out)
+        st = eng.adapter_stats()
+        assert st["enabled"] and st["loads"] == 3
+
+    def test_paged_greedy(self, model, factors):
+        out, _ = _drive(model, factors, _requests(), paged=True, kv_block=8)
+        _assert_oracle(model, factors, _requests(), out)
+
+    def test_sampled_streams_layout_independent(self, model, factors):
+        """temperature > 0: the per-request ``fold_in(key, count)``
+        stream is independent of cache layout and batch neighbors —
+        dense and paged engines with heterogeneous adapters draw
+        byte-identical streams, and each equals its SINGLE-request
+        sequential run on a 1-slot engine (the engine-path sampled
+        oracle, the PR-6 discipline)."""
+        reqs = _requests()
+        dense, _ = _drive(model, factors, reqs, temperature=0.7)
+        paged, _ = _drive(model, factors, reqs, temperature=0.7,
+                          paged=True, kv_block=8)
+        assert dense == paged
+        for rid, (prompt, max_new, adapter) in enumerate(reqs):
+            solo, _ = _drive(model, factors, [(prompt, max_new, adapter)],
+                             num_slots=1, temperature=0.7)
+            assert dense[rid] == solo[0], (
+                f"request {rid} sampled stream depends on its batch "
+                "neighbors")
+
+    def test_churn_compile_pins_flat(self, model, factors):
+        """Load/unload/re-bind churn across full drive cycles compiles
+        NOTHING new — host decisions ride as data."""
+        module, params = model
+        out, eng = _drive(model, factors, _requests(), paged=True,
+                          kv_block=8)
+        pins0 = eng.compile_counts()
+        # churn: unload everything, load fresh names, drive again
+        for n in sorted(factors):
+            eng.unload_adapter(n)
+        for i, n in enumerate(sorted(factors)):
+            eng.load_adapter(f"gen2-{n}", factors[n])
+        reqs2 = [(p, m, f"gen2-{a}" if a else None)
+                 for p, m, a in _requests()]
+        facs2 = {f"gen2-{n}": f for n, f in factors.items()}
+        pending = list(enumerate(reqs2))
+        out2 = {r: [] for r, _ in pending}
+        slot_rid, slot_budget = {}, {}
+
+        def deliver(slot, toks):
+            rid = slot_rid[slot]
+            out2[rid].extend(toks)
+            out2[rid][:] = out2[rid][:slot_budget[slot]]
+            if len(out2[rid]) >= slot_budget[slot]:
+                eng.evict(slot)
+                del slot_rid[slot], slot_budget[slot]
+
+        while pending or eng.num_occupied:
+            free = eng.free_slots()
+            items, reserved = [], 0
+            while free and pending:
+                rid, (prompt, max_new, adapter) = pending[0]
+                if not eng.can_admit_kv(len(prompt), max_new,
+                                        reserve=reserved):
+                    break
+                reserved += eng.kv_footprint(len(prompt), max_new)
+                pending.pop(0)
+                slot = free.pop(0)
+                slot_rid[slot], slot_budget[slot] = rid, max_new
+                items.append((slot, prompt, 0.0, 0, max_new, (), None,
+                              adapter))
+            for slot, tok in eng.start_batch(items).items():
+                if tok is not None:
+                    deliver(slot, [tok])
+            for slot, tok in eng.advance_prefill().items():
+                deliver(slot, [tok])
+            if eng.num_active:
+                _, blocks = eng.decode_block()
+                for slot, toks in list(blocks.items()):
+                    if slot in slot_rid:
+                        deliver(slot, toks)
+        _assert_oracle(model, facs2, reqs2, out2)
+        assert eng.compile_counts() == pins0, (
+            "adapter churn recompiled a program — host decisions must "
+            "ride as data")
+
+    def test_mid_batch_bind_failure_rolls_back(self, model, factors):
+        """Review hardening: a raced unload that fails one lane's bind
+        mid-start_batch releases every earlier pin — the server's retry
+        with the survivors must not double-acquire (a leaked refcount
+        would defer that adapter's unload forever)."""
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         decode_block=4, adapters=True, adapter_blocks=3,
+                         adapter_rank=RANK)
+        eng.load_adapter("t0", factors["t0"])
+        eng.load_adapter("t1", factors["t1"])
+        p = _prompt(4, 6)
+        orig = eng.adapters.acquire
+        eng.adapters.acquire = lambda n: (None if n == "t1" else orig(n))
+        with pytest.raises(AdapterMissingError):
+            eng.start_batch([(0, p, 0.0, 0, 4, (), None, "t0"),
+                             (1, p, 0.0, 1, 4, (), None, "t1")])
+        eng.adapters.acquire = orig
+        assert eng.adapters.refcount("t0") == 0, "pin leaked on rollback"
+        assert eng.slot_adapter == [None, None]
+        assert not eng.occupied.any()
+        # the retry binds exactly once and serves the oracle stream
+        firsts = eng.start_batch([(0, p, 0.0, 0, 4, (), None, "t0")])
+        assert eng.adapters.refcount("t0") == 1
+        stream = [t for t in firsts.values() if t is not None]
+        while len(stream) < 4:
+            _, blocks = eng.decode_block()
+            stream.extend(blocks[0])
+        assert stream[:4] == _oracle(model, factors, p, 4, "t0")
+
+    def test_reload_under_live_lane_keeps_old_generation(self, model,
+                                                         factors):
+        """Review hardening, engine level: unload+reload of a name
+        while a lane decodes the OLD factors — the live lane finishes
+        byte-identically on its generation, a new lane gets the NEW
+        factors, and the old block frees on evict."""
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         decode_block=4, adapters=True, adapter_blocks=3,
+                         adapter_rank=RANK)
+        eng.load_adapter("x", factors["t0"])
+        p = _prompt(4, 9)
+        stream0 = [t for t in eng.start_batch(
+            [(0, p, 0.0, 0, 5, (), None, "x")]).values() if t is not None]
+        eng.unload_adapter("x")          # deferred: lane 0 holds it
+        eng.load_adapter("x", factors["t1"])  # retrained, immediately
+        stream1 = [t for t in eng.start_batch(
+            [(1, p, 0.0, 0, 5, (), None, "x")]).values() if t is not None]
+        while len(stream0) < 5 or len(stream1) < 5:
+            _, blocks = eng.decode_block()
+            stream0.extend(blocks.get(0, []))
+            stream1.extend(blocks.get(1, []))
+        assert stream0[:5] == _oracle(model, factors, p, 5, "t0"), (
+            "the live lane's stream bent under the reload")
+        assert stream1[:5] == _oracle(model, factors, p, 5, "t1")
+        eng.evict(0)
+        eng.evict(1)
+        assert eng.adapters.stats()["retired_blocks"] == 0
+
+    def test_deferred_unload_frees_on_last_evict(self, model, factors):
+        """Unload of an IN-USE adapter defers; the bound lane finishes
+        byte-identically on the old factors and the block zeroes after
+        its evict (a fresh load then reuses it)."""
+        module, params = model
+        eng = SlotEngine(module, params, num_slots=1, prefill_pad=8,
+                         decode_block=4, adapters=True, adapter_blocks=1,
+                         adapter_rank=RANK)
+        eng.load_adapter("t0", factors["t0"])
+        p = _prompt(4, 9)
+        stream = []
+        firsts = eng.start_batch([(0, p, 0.0, 0, 5, (), None, "t0")])
+        stream.extend(t for t in firsts.values() if t is not None)
+        info = eng.unload_adapter("t0")  # mid-flight: must defer
+        assert not info["freed"]
+        assert not eng.has_adapter("t0")
+        while len(stream) < 5:
+            _, blocks = eng.decode_block()
+            for toks in blocks.values():
+                stream.extend(toks)
+        stream = stream[:5]
+        assert stream == _oracle(model, factors, p, 5, "t0")
+        eng.evict(0)  # last lane out: block frees + zeroes
+        assert eng.adapters.resident == 0
+        eng.load_adapter("t1", factors["t1"])  # the block is reusable
+
+
+class TestAdapterHandoffUnit:
+    def test_export_import_rebinds_by_name(self, model, factors):
+        """Engine-level handoff: the exported package carries the
+        adapter NAME; a destination pool with different block ids
+        re-binds and continues byte-identically."""
+        module, params = model
+        src = SlotEngine(module, params, num_slots=1, prefill_pad=8,
+                         decode_block=2, adapters=True, adapter_blocks=3,
+                         adapter_rank=RANK)
+        dst = SlotEngine(module, params, num_slots=1, prefill_pad=8,
+                         decode_block=2, adapters=True, adapter_blocks=3,
+                         adapter_rank=RANK)
+        # different load ORDER → different block ids for "t1"
+        src.load_adapter("t0", factors["t0"])
+        src.load_adapter("t1", factors["t1"])
+        dst.load_adapter("t1", factors["t1"])
+        p = _prompt(4, 3)
+        stream = []
+        firsts = src.start_batch([(0, p, 0.0, 0, 6, (), None, "t1")])
+        stream.extend(t for t in firsts.values() if t is not None)
+        _, blocks = src.decode_block()
+        stream.extend(blocks[0])
+        pkg = src.export_slot(0)
+        assert pkg["adapter"] == "t1"
+        src.evict(0)
+        dst.import_slot(0, pkg)
+        assert dst.slot_adapter[0][0] == "t1"
+        while dst.num_active and len(stream) < 6:
+            _, blocks = dst.decode_block()
+            stream.extend(blocks[0])
+        assert stream[:6] == _oracle(model, factors, p, 6, "t1")
+
+    def test_import_without_name_raises_missing(self, model, factors):
+        module, params = model
+        src = SlotEngine(module, params, num_slots=1, prefill_pad=8,
+                         adapters=True, adapter_blocks=2, adapter_rank=RANK)
+        dst = SlotEngine(module, params, num_slots=1, prefill_pad=8,
+                         adapters=True, adapter_blocks=2, adapter_rank=RANK)
+        src.load_adapter("t0", factors["t0"])
+        firsts = src.start_batch(
+            [(0, _prompt(4, 3), 0.0, 0, 6, (), None, "t0")])
+        assert firsts
+        pkg = src.export_slot(0)
+        with pytest.raises(AdapterMissingError):
+            dst.import_slot(0, pkg)  # dst never loaded "t0"
+        assert not dst.occupied[0]
+
+
+class TestAdapterMatrix:
+    """Slow lane: the churn oracle across mesh shapes and decode arms —
+    shardings and execution paths change, bytes do not."""
+
+    @pytest.mark.parametrize("shape", ["1x2", "2x2"])
+    def test_mesh_oracle_greedy(self, model, factors, shape):
+        from tpudist.serve.spmd import ServeMeshConfig
+
+        out, eng = _drive(model, factors, _requests(),
+                          mesh=ServeMeshConfig(shape), paged=True,
+                          kv_block=8)
+        _assert_oracle(model, factors, _requests(), out)
+        assert eng.spmd_stats()["mesh"] is not None
+
+    def test_mesh_pins_flat_across_shapes(self, model, factors):
+        from tpudist.serve.spmd import ServeMeshConfig
+
+        pins = []
+        for shape in (None, "1x2"):
+            kw = ({} if shape is None
+                  else {"mesh": ServeMeshConfig(shape)})
+            _, eng = _drive(model, factors, _requests(), paged=True,
+                            kv_block=8, **kw)
+            pins.append(eng.compile_counts())
+        assert pins[0] == pins[1], (
+            "mesh shapes change shardings, never programs")
+
+    def test_spec_tied_draft_shares_adapter(self, model, factors):
+        """Spec engine: the tied draft runs its slot's adapter (the
+        pool's first N layers) — greedy output stays the sequential
+        oracle's, full-tie acceptance is perfect."""
+        out, eng = _drive(model, factors, _requests(), paged=True,
+                          kv_block=8, spec_draft=1, spec_k=2,
+                          decode="auto")
+        _assert_oracle(model, factors, _requests(), out)
+        assert eng.n_spec_blocks > 0
+        # full tie (draft == target's whole depth): the adapted draft
+        # must agree with the adapted target on every greedy token
+        out2, eng2 = _drive(model, factors, _requests(), paged=True,
+                            kv_block=8, spec_draft=CFG["n_layers"],
+                            spec_k=2, decode="auto")
+        _assert_oracle(model, factors, _requests(), out2)
+        st = eng2.spec_stats()
+        assert st["acceptance_rate"] == 1.0, (
+            "a full-depth tied draft with the slot's adapter must match "
+            "the target exactly — a lower rate means the draft ran a "
+            "different (base?) parameterization")
+
+    def test_paged_kernel_arm(self, model, factors):
+        out, _ = _drive(model, factors, _requests(), paged=True,
+                        kv_block=8, attn_kernel="paged")
+        _assert_oracle(model, factors, _requests(), out)
+
+
+class TestAdapterDisaggTier:
+    """Slow lane: server e2e — disagg handoff re-bind and host-tier
+    session re-bind (each builds servers)."""
+
+    def test_disagg_serial_handoff_rebinds(self, model, factors,
+                                           tmp_path, monkeypatch):
+        from tpudist.serve import DisaggServer
+
+        monkeypatch.setenv("TPUDIST_TELEMETRY_DIR", str(tmp_path))
+        module, params = model
+        srv = DisaggServer(
+            module, params,
+            ServeConfig(num_slots=2, adapters=True, adapter_blocks=3,
+                        adapter_rank=RANK, disagg=True, handoff="serial"),
+            install_signal_handler=False).start()
+        try:
+            srv.load_adapter("t0", factors["t0"])
+            srv.load_adapter("t1", factors["t1"])
+            p = _prompt(4, 5)
+            hs = [srv.submit(p, max_new=6, adapter=a)
+                  for a in ("t0", "t1", None)]
+            for h in hs:
+                assert h.wait(60)
+            assert srv.handoffs >= 3
+            assert hs[0].tokens == _oracle(model, factors, p, 6, "t0")
+            assert hs[1].tokens == _oracle(model, factors, p, 6, "t1")
+            assert hs[2].tokens == _oracle(model, factors, p, 6, None)
+        finally:
+            srv.close()
+
+    def test_host_tier_session_rebind(self, model, factors, tmp_path,
+                                      monkeypatch):
+        monkeypatch.setenv("TPUDIST_TELEMETRY_DIR", str(tmp_path))
+        module, params = model
+        srv = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=2, adapters=True, adapter_blocks=3,
+                        adapter_rank=RANK, host_tier=True),
+            install_signal_handler=False).start()
+        try:
+            srv.load_adapter("t0", factors["t0"])
+            p = _prompt(4, 5)
+            h1 = srv.submit(p, max_new=4, adapter="t0", session="s",
+                            tenant="t")
+            assert h1.wait(60)
+            turn2 = np.concatenate(
+                [p, np.asarray(h1.tokens, np.int32),
+                 np.asarray([3, 1], np.int32)])
+            h2 = srv.submit(turn2, max_new=4, adapter="t0", session="s",
+                            tenant="t")
+            assert h2.wait(60)
+            # resumed turn: no recompute, and byte-identical to a fresh
+            # serve of the full second-turn prompt through the adapter
+            assert h2.finish_reason == "session_resumed"
+            assert h2.tokens == _oracle(model, factors, turn2, 4, "t0")
+            # a turn binding a DIFFERENT adapter must NOT resume the
+            # parked context (it was written through t0's factors)
+            turn3 = np.concatenate(
+                [turn2, np.asarray(h2.tokens, np.int32),
+                 np.asarray([5], np.int32)])
+            h3 = srv.submit(turn3, max_new=3, session="s", tenant="t")
+            assert h3.wait(60)
+            assert not h3.resumed
+            assert h3.tokens == _oracle(model, factors, turn3, 3, None)
+        finally:
+            srv.close()
+
+
+class TestAdapterServer:
+    """Dense-greedy server representative (the slow lane holds the
+    mesh/spec/disagg/host-tier matrices)."""
+
+    def test_e2e_reject_and_raced_unload(self, model, factors, tmp_path,
+                                         monkeypatch):
+        monkeypatch.setenv("TPUDIST_TELEMETRY_DIR", str(tmp_path))
+        module, params = model
+        srv = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=2, adapters=True, adapter_blocks=3,
+                        adapter_rank=RANK),
+            install_signal_handler=False).start()
+        try:
+            srv.load_adapter("t0", factors["t0"])
+            srv.load_adapter("t1", factors["t1"])
+            p = _prompt(4, 5)
+            h0 = srv.submit(p, max_new=5, adapter="t0")
+            h1 = srv.submit(p, max_new=5, adapter="t1")
+            hb = srv.submit(p, max_new=5)
+            # unknown adapter rejects synchronously with the reason
+            with pytest.raises(AdmissionError) as ei:
+                srv.submit(p, max_new=5, adapter="nope")
+            assert ei.value.reason == "adapter_missing"
+            assert "adapter_missing" in FINISH_REASONS
+            for h in (h0, h1, hb):
+                assert h.wait(60)
+            assert h0.tokens == _oracle(model, factors, p, 5, "t0")
+            assert h1.tokens == _oracle(model, factors, p, 5, "t1")
+            assert hb.tokens == _oracle(model, factors, p, 5, None)
+            # raced unload: queued request's adapter vanishes before
+            # placement → finishes adapter_missing (never base output)
+            srv.unload_adapter("t1")
+            with pytest.raises(AdmissionError):
+                srv.submit(p, max_new=5, adapter="t1")
+            st = srv.stats()["adapters"]
+            assert st["resident"] == 1 and st["loads"] == 2
+        finally:
+            srv.close()

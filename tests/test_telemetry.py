@@ -623,6 +623,9 @@ class TestAggregatorBackCompat:
         report = aggregate_run(tmp_path)
         assert "telemetry_dropped" not in report
         assert "slo" not in report["serving"]
+        # an adapter-less stream gains no adapters section (PR-15
+        # additive discipline)
+        assert "adapters" not in report["serving"]
         assert report["serving"]["requests_finished"] == 1
         # no trace artifacts leak into the report of a trace-less stream
         assert "trace" not in json.dumps(report).lower()
@@ -652,6 +655,31 @@ class TestAggregatorBackCompat:
         for key in ("goodput", "step", "wall_clock_s", "per_rank"):
             assert before[key] == after[key], f"{key} moved"
         for key in ("ttft", "tpot", "finish_reasons", "decode_tokens"):
+            assert before["serving"][key] == after["serving"][key]
+
+    def test_adapter_records_are_purely_additive(self, tmp_path):
+        """Adapter events (PR 15) bolt an `adapters` section on; every
+        pre-existing serving field keeps its exact value."""
+        self._write_old(tmp_path)
+        before = aggregate_run(tmp_path)
+        with open(tmp_path / "rank0_gen0.jsonl", "a") as f:
+            f.write(json.dumps(
+                {"kind": "event", "name": "serve_adapters_config",
+                 "t": 100.0, "dur": 0.0, "rank": 0, "gen": 0,
+                 "blocks": 8, "lora_rank": 8,
+                 "block_bytes": 1024, "pool_bytes": 8192}) + "\n")
+            f.write(json.dumps(
+                {"kind": "event", "name": "adapter_load", "t": 100.05,
+                 "dur": 0.0, "rank": 0, "gen": 0, "adapter": "acme",
+                 "block": 0, "resident": 1}) + "\n")
+        after = aggregate_run(tmp_path)
+        ad = after["serving"]["adapters"]
+        assert ad["loads"] == 1 and ad["rank"] == 8 and ad["blocks"] == 8
+        assert ad["resident_peak"] == 1
+        for key in ("goodput", "step", "wall_clock_s", "per_rank"):
+            assert before[key] == after[key], f"{key} moved"
+        for key in ("ttft", "tpot", "finish_reasons", "decode_tokens",
+                    "tokens_out", "occupancy_mean"):
             assert before["serving"][key] == after["serving"][key]
 
 

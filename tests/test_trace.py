@@ -113,31 +113,35 @@ class TestHandoffSchema:
                 "lane": {"k": np.arange(6, dtype=np.float32).reshape(2, 3)},
                 "state": {"last": np.int32(7)}}
 
-    def test_v3_round_trips_trace_id(self):
+    def test_v4_round_trips_trace_id_and_adapter(self):
         from tpudist.serve.disagg import (HANDOFF_SCHEMA_VERSION,
                                           deserialize_package,
                                           serialize_package)
 
-        ser = serialize_package(self._pkg())
-        assert ser["schema_version"] == HANDOFF_SCHEMA_VERSION == 3
+        ser = serialize_package({**self._pkg(), "adapter": "acme"})
+        assert ser["schema_version"] == HANDOFF_SCHEMA_VERSION == 4
         assert ser["trace_id"] == "cafe0123deadbeef"
+        assert ser["adapter"] == "acme"
         out = deserialize_package(ser)
         assert out["trace_id"] == "cafe0123deadbeef"
+        assert out["adapter"] == "acme"
         np.testing.assert_array_equal(out["lane"]["k"],
                                       self._pkg()["lane"]["k"])
 
     def test_v2_package_still_deserializes(self):
         """BACK-COMPAT (PR-8 discipline): a schema_version-2 package —
-        the pre-trace wire format, no trace_id field — must still
-        import; its trace_id reads back None."""
+        the pre-trace wire format, no trace_id/adapter fields — must
+        still import; both read back None."""
         from tpudist.serve.disagg import (deserialize_package,
                                           serialize_package)
 
         ser = serialize_package(self._pkg())
         ser["schema_version"] = 2
         del ser["trace_id"]  # exactly what a v2 sender puts on the wire
+        del ser["adapter"]
         out = deserialize_package(ser)
         assert out["trace_id"] is None
+        assert out["adapter"] is None
         assert out["pos"] == 3 and out["budget"] == 5
         np.testing.assert_array_equal(out["lane"]["k"],
                                       self._pkg()["lane"]["k"])

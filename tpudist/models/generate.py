@@ -52,10 +52,15 @@ def _cache_cursor(cache):
     return None
 
 
-def make_decode_step(module, params):
+def make_decode_step(module, params, adapters=None):
     """Return ``(init_cache, step)``: ``init_cache(batch)`` builds a fresh
     all-zeros KV cache, ``step(cache, tok[b,1]) -> (cache, logits[b,vocab])``
     is the compiled single-token forward.
+
+    ``adapters``: an ``"adapters"`` collection (:func:`tpudist.models.
+    lora.adapter_collection`) applied on every step — the single-adapter
+    sequential path (required iff ``module.lora_rank > 0``; the slot
+    programs gather per-slot collections themselves instead).
 
     The cache covers ``module.max_len`` positions.  An EAGER call that
     would write past the end raises :class:`CacheFullError` instead of
@@ -78,10 +83,10 @@ def make_decode_step(module, params):
                     f"KV cache full: cursor {int(jnp.max(cur))} + "
                     f"{tok.shape[-1]} token(s) exceeds max_len "
                     f"{module.max_len}")
-        logits, mut = dec.apply(
-            {"params": params["params"], "cache": cache},
-            tok, mutable=["cache"],
-        )
+        variables = {"params": params["params"], "cache": cache}
+        if adapters is not None:
+            variables["adapters"] = adapters
+        logits, mut = dec.apply(variables, tok, mutable=["cache"])
         return mut["cache"], logits[:, -1].astype(jnp.float32)
 
     def init_cache(batch: int):
@@ -201,6 +206,7 @@ def make_generator(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    adapters=None,
 ):
     """Build a reusable compiled sampler: ``gen(prompt, rng=None) ->
     [batch, plen + max_new]``.
@@ -209,8 +215,12 @@ def make_generator(
     + sampling in a single ``lax.scan``), so repeated calls with the same
     prompt shape hit the jit cache — this is the entry for serving/bench
     loops; :func:`generate` is the one-shot convenience wrapper.
+
+    ``adapters``: single-adapter collection for a ``lora_rank > 0``
+    module (:func:`tpudist.models.lora.adapter_collection`) — the
+    sequential oracle the per-slot engine streams are byte-compared to.
     """
-    init_cache, step = make_decode_step(module, params)
+    init_cache, step = make_decode_step(module, params, adapters=adapters)
 
     def pick(logits, key):
         return sample_logits(logits, key, temperature=temperature,
@@ -262,6 +272,7 @@ def generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
+    adapters=None,
 ) -> jax.Array:
     """Sample ``max_new`` tokens after ``prompt [batch, plen]``.
 
@@ -274,7 +285,7 @@ def generate(
     """
     return make_generator(
         module, params, max_new, temperature=temperature, top_k=top_k,
-        top_p=top_p,
+        top_p=top_p, adapters=adapters,
     )(prompt, rng)
 
 
@@ -304,7 +315,16 @@ class SlotState(NamedTuple):
       cursor itself is ``pos`` — the same leaf every path maintains), so
       acceptance telemetry needs no extra device round trips and the
       counters ride KV handoff with the rest of the row.  Zero on
-      non-speculative engines.
+      non-speculative engines;
+    - ``adapter_id [S] int32`` — the slot's per-tenant adapter block in
+      the paged LoRA pool (:mod:`tpudist.models.lora`); the pool's
+      ``num_blocks`` sentinel = base-only (bit-exact base path via the
+      ``on`` select).  The programs gather each slot's factors from
+      this id IN-GRAPH, so tenants churn with zero recompilation; on a
+      non-adapter engine the leaf rides along as zeros.  KV handoff
+      carries it with the row, but ids are POOL-LOCAL — the importing
+      engine re-binds by adapter NAME (the package's ``adapter`` field)
+      before install.
     """
 
     last_tok: jax.Array
@@ -315,6 +335,7 @@ class SlotState(NamedTuple):
     keys: jax.Array
     accepted: jax.Array
     drafted: jax.Array
+    adapter_id: jax.Array
 
 
 class SlotDecode(NamedTuple):
@@ -486,7 +507,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                      state_constraint: Optional[Callable] = None,
                      spec: Optional[Tuple] = None,
                      draft_constraint: Optional[Callable] = None,
-                     attn_kernel: str = "gather"
+                     attn_kernel: str = "gather",
+                     adapters=None
                      ) -> SlotDecode:
     """Build the slot-decode primitive set over ``module``/``params`` —
     see :class:`SlotDecode` for the contract of each callable.  With
@@ -524,7 +546,21 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     prefill/insert/evict programs (compute-bound teacher-forcing and
     surgery, not the bandwidth-bound hot path) and the DRAFT's own
     small pool keep the gather path either way, so the program set and
-    its compile pins are unchanged — only the decode arms swap."""
+    its compile pins are unchanged — only the decode arms swap.
+
+    ``adapters``: an :class:`tpudist.models.lora.AdapterPoolConfig` —
+    enable the per-tenant adapter seam.  Every forward-pass program
+    grows an ``apool`` argument (the :class:`~tpudist.models.lora.
+    AdapterPool`, read-only — host loads/unloads swap the arrays, never
+    the program) and gathers each slot's rank-r factors from
+    ``SlotState.adapter_id`` in-graph (``insert_batch`` additionally
+    takes the admission batch's ``aids``); a sentinel id rides the
+    bit-exact base-only select.  The tied draft shares its slot's
+    adapter (the pool's first ``n_layers`` slices) whenever the draft's
+    projection geometry matches the target's; a geometry-mismatched
+    loaded draft runs base-only — acceptance may drop, output
+    correctness cannot (the adapter'd target verify is the oracle).
+    Without ``adapters`` every signature is byte-identical to before."""
     if attn_kernel not in ("gather", "paged"):
         raise ValueError(
             f"attn_kernel must be 'gather' or 'paged', got {attn_kernel!r}")
@@ -538,9 +574,46 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         raise ValueError(
             f"prefill_pad {prefill_pad} must be in [1, {module.max_len}] "
             "(the KV-cache size)")
-    init_cache, step = make_decode_step(module, params)
+    # -- per-tenant adapter seam (tpudist.models.lora) ----------------------
+    use_lora = adapters is not None
+    if use_lora:
+        from tpudist.models import lora as _lora
+
+        if getattr(module, "n_experts", 0) > 0 \
+                or getattr(module, "mlp_fn", None) is not None:
+            raise ValueError(
+                "adapters wrap the plain qkv/wi/wo Dense path; they "
+                "cannot compose with an MoE FFN or an injected mlp_fn")
+        n_lora_layers = int(module.n_layers)
+        #: the sentinel adapter id = base-only (also what evict resets to)
+        _aid_empty = int(adapters.num_blocks)
+    else:
+        _aid_empty = 0
+
+    def _gather_ads(apool, ids, n_layers: Optional[int] = None):
+        """Per-slot ``"adapters"`` collection from the pool at ``ids``
+        (None when the seam is off — vmap/apply treat it as empty)."""
+        if not use_lora:
+            return None
+        return _lora.gather_collection(
+            apool, ids, n_lora_layers if n_layers is None else n_layers)
+
+    init_cache, _step_base = make_decode_step(module, params)
     vocab = module.vocab
-    vstep = jax.vmap(step, in_axes=(0, 0))
+    if use_lora:
+        _ldec = module.clone(decode=True, moe_fn=None,
+                             lora_rank=adapters.rank)
+
+        def step(cache, tok, ad):
+            logits, mut = _ldec.apply(
+                {"params": params["params"], "cache": cache,
+                 "adapters": ad},
+                tok, mutable=["cache"])
+            return mut["cache"], logits[:, -1].astype(jnp.float32)
+    else:
+        def step(cache, tok, ad):  # noqa: ARG001 - uniform signature
+            return _step_base(cache, tok)
+    vstep = jax.vmap(step, in_axes=(0, 0, 0))
 
     def _constrain(cache):
         return cache if cache_constraint is None else cache_constraint(cache)
@@ -558,7 +631,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             temps=jnp.zeros(s, jnp.float32),
             keys=jnp.zeros((s, 2), jnp.uint32),
             accepted=jnp.zeros(s, jnp.int32),
-            drafted=jnp.zeros(s, jnp.int32))
+            drafted=jnp.zeros(s, jnp.int32),
+            adapter_id=jnp.full(s, _aid_empty, jnp.int32))
 
     def init_slots():
         one = init_cache(1)
@@ -571,13 +645,16 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         every ``clen <= prefill_pad`` shares one program).  Returns the
         advanced cache and the logits after the LAST live token.
         Parameterized over the step so the speculative draft model
-        shares the exact prefill mechanics (same program shape)."""
+        shares the exact prefill mechanics (same program shape).
+        ``ad``: the lane's adapter collection (None when the seam is
+        off) — prefill MUST run the slot's adapter too, the written KV
+        depends on the adapted qkv."""
 
-        def force(cache, chunk, clen):
+        def force(cache, chunk, clen, ad):
             def body(carry, i):
                 cache, last = carry
                 tok = lax.dynamic_index_in_dim(chunk, i, keepdims=False)
-                nc, logits = step_fn(cache, tok[None, None])
+                nc, logits = step_fn(cache, tok[None, None], ad)
                 live = i < clen
                 cache = jax.tree.map(
                     lambda n, o: jnp.where(live, n, o), nc, cache)
@@ -591,14 +668,17 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
     _force_chunk = _make_force(step)
 
-    def _decode_scan(state, cache, k):
+    def _decode_scan(state, cache, k, ads):
         """The K-step fused decode body shared by the dense and paged
         ``decode_block`` programs: in-graph token feedback, inactive
-        lanes' cache writes undone by the ``active`` select."""
+        lanes' cache writes undone by the ``active`` select.  ``ads``
+        (the gathered per-slot adapter collections) is loop-invariant —
+        slot bindings never change mid-dispatch — so XLA hoists the
+        gather out of the scan."""
 
         def body(carry, _):
             state, cache = carry
-            nc, logits = vstep(cache, state.last_tok[:, None, None])
+            nc, logits = vstep(cache, state.last_tok[:, None, None], ads)
 
             def sel(n, o):
                 m = state.active.reshape((-1,) + (1,) * (n.ndim - 1))
@@ -643,10 +723,53 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 f"draft max_len {d_module.max_len} != target max_len "
                 f"{module.max_len} (draft and target cursors move in "
                 "lockstep)")
-        d_init_cache, d_step = make_decode_step(d_module, d_params)
-        d_vstep = jax.vmap(d_step, in_axes=(0, 0))
+        d_init_cache, _d_step_base = make_decode_step(d_module, d_params)
+        # the tied draft shares its slot's adapter: the draft IS the
+        # target's first N blocks, so its factors are the pool's first
+        # N layer slices.  A loaded draft gets them too iff its
+        # projection geometry matches the target's; otherwise it runs
+        # base-only (quality-only — the adapted verify is the oracle).
+        d_lora = use_lora and (
+            int(d_module.d_model) == int(module.d_model)
+            and int(d_module.d_ff) == int(module.d_ff)
+            and int(d_module.n_heads) == int(module.n_heads)
+            and int(d_module.n_kv_heads or d_module.n_heads)
+            == int(module.n_kv_heads or module.n_heads))
+        n_d_layers = int(d_module.n_layers)
+
+        def _d_ads(apool, ids):
+            if not d_lora:
+                return None
+            return _gather_ads(apool, ids, n_d_layers)
+
+        if d_lora:
+            _d_ldec = d_module.clone(decode=True, moe_fn=None,
+                                     lora_rank=adapters.rank)
+
+            def d_step(cache, tok, ad):
+                logits, mut = _d_ldec.apply(
+                    {"params": d_params["params"], "cache": cache,
+                     "adapters": ad},
+                    tok, mutable=["cache"])
+                return mut["cache"], logits[:, -1].astype(jnp.float32)
+        else:
+            def d_step(cache, tok, ad):  # noqa: ARG001 - uniform signature
+                return _d_step_base(cache, tok)
+        d_vstep = jax.vmap(d_step, in_axes=(0, 0, 0))
         d_force = _make_force(d_step)
-        vwindow = jax.vmap(make_decode_window(module, params))
+        if use_lora:
+            def _window1(cache, toks, ad):
+                logits, mut = _ldec.apply(
+                    {"params": params["params"], "cache": cache,
+                     "adapters": ad},
+                    toks[None], mutable=["cache"])
+                return mut["cache"], logits[0].astype(jnp.float32)
+        else:
+            _window_base = make_decode_window(module, params)
+
+            def _window1(cache, toks, ad):  # noqa: ARG001
+                return _window_base(cache, toks)
+        vwindow = jax.vmap(_window1, in_axes=(0, 0, 0))
 
         def _dconstrain(tree_):
             return tree_ if draft_constraint is None \
@@ -667,7 +790,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     out[key] = cur.astype(val.dtype)
             return out
 
-        def _propose_scan(state, dview, k):
+        def _propose_scan(state, dview, k, d_ads):
             """``k + 1`` draft decode steps with in-graph token feedback:
             steps ``0..k-1`` propose ``d_1..d_k`` (greedy argmax, or a
             categorical draw on the per-request ``fold_in(fold_in(key,
@@ -677,7 +800,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
             def body(carry, i):
                 tok, dc = carry
-                nc, logits = d_vstep(dc, tok[:, None, None])
+                nc, logits = d_vstep(dc, tok[:, None, None], d_ads)
                 dc = _sel_active(state.active, nc, dc)
                 lg = logits[:, 0]
                 greedy = jnp.argmax(lg, -1).astype(jnp.int32)
@@ -806,23 +929,44 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                         lambda a: jnp.zeros((num_slots,) + a.shape, a.dtype),
                         one)
 
-                @partial(jax.jit, donate_argnums=(0,))
-                def draft_prefill(dcache, prompts, clens, dsts):
+                def _draft_prefill_impl(dcache, prompts, clens, dsts, ads):
                     lanes = jax.vmap(
-                        lambda p, n: d_force(d_init_cache(1), p, n)[0])(
-                        prompts, clens)
+                        lambda p, n, a: d_force(d_init_cache(1), p, n, a)[0]
+                    )(prompts, clens, ads)
                     return _dconstrain(jax.tree.map(
                         lambda full, b: full.at[dsts].set(b), dcache, lanes))
 
-                @partial(jax.jit, donate_argnums=(0,))
-                def draft_extend(dcache, slot, chunk, clen):
+                def _draft_extend_impl(dcache, slot, chunk, clen, ad):
                     lane = jax.tree.map(
                         lambda full: lax.dynamic_index_in_dim(
                             full, slot, 0, keepdims=False), dcache)
-                    lane, _ = d_force(lane, chunk, clen)
+                    lane, _ = d_force(lane, chunk, clen, ad)
                     return _dconstrain(jax.tree.map(
                         lambda full, lv: lax.dynamic_update_index_in_dim(
                             full, lv, slot, 0), dcache, lane))
+
+                if use_lora:
+                    @partial(jax.jit, donate_argnums=(0,))
+                    def draft_prefill(dcache, prompts, clens, dsts, aids,
+                                      apool):
+                        return _draft_prefill_impl(
+                            dcache, prompts, clens, dsts,
+                            _d_ads(apool, aids))
+
+                    @partial(jax.jit, donate_argnums=(0,))
+                    def draft_extend(dcache, slot, chunk, clen, aid, apool):
+                        return _draft_extend_impl(
+                            dcache, slot, chunk, clen, _d_ads(apool, aid))
+                else:
+                    @partial(jax.jit, donate_argnums=(0,))
+                    def draft_prefill(dcache, prompts, clens, dsts):
+                        return _draft_prefill_impl(dcache, prompts, clens,
+                                                   dsts, None)
+
+                    @partial(jax.jit, donate_argnums=(0,))
+                    def draft_extend(dcache, slot, chunk, clen):
+                        return _draft_extend_impl(dcache, slot, chunk,
+                                                  clen, None)
 
                 @partial(jax.jit, donate_argnums=(0,))
                 def draft_evict(dcache, slot):
@@ -849,29 +993,47 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                                 jnp.asarray(pos, val.dtype))
                     return _dconstrain(out)
 
-                @partial(jax.jit, donate_argnums=(1,))
-                def draft_track(state, dcache, prev_last, toks):
+                def _draft_track_impl(state, dcache, prev_last, toks, d_ads):
                     fed = jnp.concatenate([prev_last[None], toks[:-1]], 0)
 
                     def body(dc, tok):
-                        nc, _ = d_vstep(dc, tok[:, None, None])
+                        nc, _ = d_vstep(dc, tok[:, None, None], d_ads)
                         return _sel_active(state.active, nc, dc), None
 
                     dcache, _ = lax.scan(body, dcache, fed)
                     return _dconstrain(dcache)
 
-                @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
-                def draft_propose(state, dcache, k):
-                    dcache, drafts, dlogits = _propose_scan(state, dcache, k)
-                    return _dconstrain(dcache), drafts, dlogits
+                if use_lora:
+                    @partial(jax.jit, donate_argnums=(1,))
+                    def draft_track(state, dcache, prev_last, toks, apool):
+                        return _draft_track_impl(
+                            state, dcache, prev_last, toks,
+                            _d_ads(apool, state.adapter_id))
 
-                @partial(jax.jit, donate_argnums=(0, 1, 2))
-                def spec_verify(state, cache, dcache, drafts, dlogits,
-                                spec_on, rem):
+                    @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
+                    def draft_propose(state, dcache, k, apool):
+                        dcache, drafts, dlogits = _propose_scan(
+                            state, dcache, k,
+                            _d_ads(apool, state.adapter_id))
+                        return _dconstrain(dcache), drafts, dlogits
+                else:
+                    @partial(jax.jit, donate_argnums=(1,))
+                    def draft_track(state, dcache, prev_last, toks):
+                        return _draft_track_impl(state, dcache, prev_last,
+                                                 toks, None)
+
+                    @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
+                    def draft_propose(state, dcache, k):
+                        dcache, drafts, dlogits = _propose_scan(
+                            state, dcache, k, None)
+                        return _dconstrain(dcache), drafts, dlogits
+
+                def _spec_verify_impl(state, cache, dcache, drafts, dlogits,
+                                      spec_on, rem, ads):
                     pos0 = _cache_cursor(cache)
                     toks = jnp.concatenate(
                         [state.last_tok[None], drafts], 0).T
-                    ncache, logits = vwindow(cache, toks)
+                    ncache, logits = vwindow(cache, toks, ads)
                     x, a, a_raw, inc, out = _accept(state, logits, drafts,
                                                     dlogits, spec_on, rem)
                     cache = _sel_active(state.active, ncache, cache)
@@ -883,6 +1045,21 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                         [inc[:, None], a_raw[:, None], out], 1)
                     return (_constrain_state(state), _constrain(cache),
                             _dconstrain(dcache), packed)
+
+                if use_lora:
+                    @partial(jax.jit, donate_argnums=(0, 1, 2))
+                    def spec_verify(state, cache, dcache, drafts, dlogits,
+                                    spec_on, rem, apool):
+                        return _spec_verify_impl(
+                            state, cache, dcache, drafts, dlogits, spec_on,
+                            rem, _gather_ads(apool, state.adapter_id))
+                else:
+                    @partial(jax.jit, donate_argnums=(0, 1, 2))
+                    def spec_verify(state, cache, dcache, drafts, dlogits,
+                                    spec_on, rem):
+                        return _spec_verify_impl(state, cache, dcache,
+                                                 drafts, dlogits, spec_on,
+                                                 rem, None)
 
                 return dict(init_draft=init_draft,
                             draft_prefill=draft_prefill,
@@ -904,29 +1081,51 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             pg_d = _Paged(d_init_cache(1), num_slots, d_cfg)
             d_meta_template = strip_kv(pg_d.template)
 
-            @partial(jax.jit, donate_argnums=(0,))
-            def draft_prefill(dkv, tables, poss, prompts, clens, dsts):
-                def lane(row, pos0, p, n):
+            def _draft_prefill_impl(dkv, tables, poss, prompts, clens,
+                                    dsts, ads):
+                def lane(row, pos0, p, n, ad):
                     meta1 = jax.tree.map(
                         lambda t: jnp.asarray(pos0, t.dtype),
                         d_meta_template)
-                    return d_force(pg_d.lane_cache(dkv, row, meta1), p, n)[0]
+                    return d_force(pg_d.lane_cache(dkv, row, meta1),
+                                   p, n, ad)[0]
 
-                lanes = jax.vmap(lane)(tables, poss, prompts, clens)
+                lanes = jax.vmap(lane)(tables, poss, prompts, clens, ads)
                 return _dconstrain(pg_d.commit_lanes(
                     dkv, lanes, tables, dsts, poss, prefill_pad))
 
-            @partial(jax.jit, donate_argnums=(0,))
-            def draft_extend(dkv, slot, chunk, clen):
+            def _draft_extend_impl(dkv, slot, chunk, clen, ad):
                 row = dkv.table[slot]
                 meta1 = jax.tree.map(lambda full: full[slot], dkv.meta)
                 pos0 = _cache_cursor(meta1)
                 cache, _ = d_force(pg_d.lane_cache(dkv, row, meta1),
-                                   chunk, clen)
+                                   chunk, clen, ad)
                 return _dconstrain(pg_d.commit_lanes(
                     dkv, jax.tree.map(lambda a: a[None], cache),
                     row[None], jnp.reshape(slot, (1,)),
                     jnp.reshape(pos0, (1,)), prefill_pad))
+
+            if use_lora:
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_prefill(dkv, tables, poss, prompts, clens, dsts,
+                                  aids, apool):
+                    return _draft_prefill_impl(dkv, tables, poss, prompts,
+                                               clens, dsts,
+                                               _d_ads(apool, aids))
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_extend(dkv, slot, chunk, clen, aid, apool):
+                    return _draft_extend_impl(dkv, slot, chunk, clen,
+                                              _d_ads(apool, aid))
+            else:
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_prefill(dkv, tables, poss, prompts, clens, dsts):
+                    return _draft_prefill_impl(dkv, tables, poss, prompts,
+                                               clens, dsts, None)
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_extend(dkv, slot, chunk, clen):
+                    return _draft_extend_impl(dkv, slot, chunk, clen, None)
 
             @partial(jax.jit, donate_argnums=(0,))
             def draft_evict(dkv, slot, free_ids):
@@ -940,32 +1139,53 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 return _dconstrain(dkv._replace(
                     table=dkv.table.at[slot].set(row), meta=meta))
 
-            @partial(jax.jit, donate_argnums=(1,))
-            def draft_track(state, dkv, prev_last, toks):
+            def _draft_track_impl(state, dkv, prev_last, toks, d_ads):
                 k = toks.shape[0]
                 pos0 = _cache_cursor(dkv.meta)
                 view = pg_d.slot_cache(dkv)
                 fed = jnp.concatenate([prev_last[None], toks[:-1]], 0)
 
                 def body(dc, tok):
-                    nc, _ = d_vstep(dc, tok[:, None, None])
+                    nc, _ = d_vstep(dc, tok[:, None, None], d_ads)
                     return _sel_active(state.active, nc, dc), None
 
                 view, _ = lax.scan(body, view, fed)
                 return _dconstrain(pg_d.commit_slots(
                     dkv, view, pos0, k, state.active))
 
-            @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
-            def draft_propose(state, dkv, k):
-                pos0 = _cache_cursor(dkv.meta)
-                view, drafts, dlogits = _propose_scan(
-                    state, pg_d.slot_cache(dkv), k)
-                dkv = pg_d.commit_slots(dkv, view, pos0, k + 1,
-                                        state.active)
-                return _dconstrain(dkv), drafts, dlogits
+            if use_lora:
+                @partial(jax.jit, donate_argnums=(1,))
+                def draft_track(state, dkv, prev_last, toks, apool):
+                    return _draft_track_impl(
+                        state, dkv, prev_last, toks,
+                        _d_ads(apool, state.adapter_id))
 
-            @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on, rem):
+                @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
+                def draft_propose(state, dkv, k, apool):
+                    pos0 = _cache_cursor(dkv.meta)
+                    view, drafts, dlogits = _propose_scan(
+                        state, pg_d.slot_cache(dkv), k,
+                        _d_ads(apool, state.adapter_id))
+                    dkv = pg_d.commit_slots(dkv, view, pos0, k + 1,
+                                            state.active)
+                    return _dconstrain(dkv), drafts, dlogits
+            else:
+                @partial(jax.jit, donate_argnums=(1,))
+                def draft_track(state, dkv, prev_last, toks):
+                    return _draft_track_impl(state, dkv, prev_last, toks,
+                                             None)
+
+                @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
+                def draft_propose(state, dkv, k):
+                    pos0 = _cache_cursor(dkv.meta)
+                    view, drafts, dlogits = _propose_scan(
+                        state, pg_d.slot_cache(dkv), k, None)
+                    dkv = pg_d.commit_slots(dkv, view, pos0, k + 1,
+                                            state.active)
+                    return _dconstrain(dkv), drafts, dlogits
+
+            def _spec_verify_impl(state, pkv, dkv, drafts, dlogits,
+                                  spec_on, rem, ads):
                 k = drafts.shape[0]
                 pos0 = _cache_cursor(pkv.meta)
                 toks = jnp.concatenate([state.last_tok[None], drafts], 0).T
@@ -975,9 +1195,11 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     # mask): one batched K+1-query pass, live blocks
                     # only, window committed via commit_window
                     wview = pg_target.window_view(pkv, k + 1)
-                    nview, logits = _kernel_window(pkv, wview, pos0, toks)
+                    nview, logits = _kernel_window(pkv, wview, pos0, toks,
+                                                   ads)
                 else:
-                    nview, logits = vwindow(pg_target.slot_cache(pkv), toks)
+                    nview, logits = vwindow(pg_target.slot_cache(pkv), toks,
+                                            ads)
                 x, a, a_raw, inc, out = _accept(state, logits, drafts,
                                                 dlogits, spec_on, rem)
                 if attn_kernel == "paged":
@@ -996,6 +1218,20 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     [inc[:, None], a_raw[:, None], out], 1)
                 return (_constrain_state(state), _constrain(pkv),
                         _dconstrain(dkv), packed)
+
+            if use_lora:
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on,
+                                rem, apool):
+                    return _spec_verify_impl(
+                        state, pkv, dkv, drafts, dlogits, spec_on, rem,
+                        _gather_ads(apool, state.adapter_id))
+            else:
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on,
+                                rem):
+                    return _spec_verify_impl(state, pkv, dkv, drafts,
+                                             dlogits, spec_on, rem, None)
 
             return dict(init_draft=pg_d.init, draft_prefill=draft_prefill,
                         draft_extend=draft_extend, draft_evict=draft_evict,
@@ -1018,8 +1254,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             # Runs BATCHED over slots (no vmap): the kernel's grid
             # covers all slots in one call per layer, per-slot cursors
             # ride as vectors.
-            dec_kernel_mod = module.clone(decode=True, moe_fn=None,
-                                          decode_kernel="paged")
+            dec_kernel_mod = module.clone(
+                decode=True, moe_fn=None, decode_kernel="paged",
+                lora_rank=adapters.rank if use_lora else 0)
 
             def _pool_col(pkv, pos0):
                 # one shared entry per layer; the leaves are the SAME
@@ -1032,31 +1269,36 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                            pos0=pos0.astype(jnp.int32))
                 return {name: col for name in pg.layers}
 
-            def _kernel_window(pkv, view, pos0, toks):
+            def _kernel_window(pkv, view, pos0, toks, ads=None):
                 """One batched multi-token pass over a window view:
                 every lane's ``s`` tokens in ONE forward, attention
                 through the paged kernel — ``s == 1`` is the decode
-                scan's body, ``s == K+1`` the spec verify."""
+                scan's body, ``s == K+1`` the spec verify.  ``ads``:
+                the slot batch's gathered adapter collection ([S]-
+                leading leaves — the batched twin of the vmapped
+                path's per-lane collections)."""
+                variables = {"params": params["params"], "cache": view,
+                             "pool": _pool_col(pkv, pos0)}
+                if ads is not None:
+                    variables["adapters"] = ads
                 logits, mut = dec_kernel_mod.apply(
-                    {"params": params["params"], "cache": view,
-                     "pool": _pool_col(pkv, pos0)},
-                    toks, mutable=["cache"])
+                    variables, toks, mutable=["cache"])
                 return mut["cache"], logits.astype(jnp.float32)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
-                               dsts, seeds, temps, last):
+        def _insert_paged_impl(state, pkv, tables, poss, prompts, clens,
+                               dsts, seeds, temps, last, aids, ads):
             # Each lane teacher-forces its first NON-SHARED chunk on top
             # of a dense view gathered through its (host-built) table
             # row: a reused prefix's K/V is already in the pool, so the
             # lane's cursor starts at poss[j] — prefilled once, mapped
             # into every slot that shares it.
-            def lane(row, pos0, p, n):
+            def lane(row, pos0, p, n, ad):
                 meta1 = jax.tree.map(
                     lambda t: jnp.asarray(pos0, t.dtype), meta_template)
-                return _force_chunk(pg.lane_cache(pkv, row, meta1), p, n)
+                return _force_chunk(pg.lane_cache(pkv, row, meta1), p, n, ad)
 
-            lanes, last_logits = jax.vmap(lane)(tables, poss, prompts, clens)
+            lanes, last_logits = jax.vmap(lane)(tables, poss, prompts,
+                                                clens, ads)
             keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
             firsts = _slot_sample(last_logits, keys, temps,
                                   jnp.zeros(num_slots, jnp.int32))
@@ -1072,16 +1314,33 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 temps=state.temps.at[dsts].set(temps),
                 keys=state.keys.at[dsts].set(keys),
                 accepted=state.accepted.at[dsts].set(zero),
-                drafted=state.drafted.at[dsts].set(zero))
+                drafted=state.drafted.at[dsts].set(zero),
+                adapter_id=state.adapter_id.at[dsts].set(aids))
             return _constrain_state(state), pkv, firsts
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def prefill_extend_paged(state, pkv, slot, chunk, clen, is_last):
+        if use_lora:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
+                                   dsts, seeds, temps, last, aids, apool):
+                return _insert_paged_impl(
+                    state, pkv, tables, poss, prompts, clens, dsts, seeds,
+                    temps, last, aids, _gather_ads(apool, aids))
+        else:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
+                                   dsts, seeds, temps, last):
+                aids = jnp.full(num_slots, _aid_empty, jnp.int32)
+                return _insert_paged_impl(
+                    state, pkv, tables, poss, prompts, clens, dsts, seeds,
+                    temps, last, aids, None)
+
+        def _prefill_extend_paged_impl(state, pkv, slot, chunk, clen,
+                                       is_last, ad):
             row = pkv.table[slot]
             meta1 = jax.tree.map(lambda full: full[slot], pkv.meta)
             pos0 = _cache_cursor(meta1)
             cache, last_logits = _force_chunk(
-                pg.lane_cache(pkv, row, meta1), chunk, clen)
+                pg.lane_cache(pkv, row, meta1), chunk, clen, ad)
             pkv = _constrain(pg.commit_lanes(
                 pkv, jax.tree.map(lambda a: a[None], cache),
                 row[None], jnp.reshape(slot, (1,)), jnp.reshape(pos0, (1,)),
@@ -1097,9 +1356,22 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
             return _constrain_state(state), pkv, first
 
+        if use_lora:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def prefill_extend_paged(state, pkv, slot, chunk, clen,
+                                     is_last, apool):
+                return _prefill_extend_paged_impl(
+                    state, pkv, slot, chunk, clen, is_last,
+                    _gather_ads(apool, state.adapter_id[slot]))
+        else:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def prefill_extend_paged(state, pkv, slot, chunk, clen,
+                                     is_last):
+                return _prefill_extend_paged_impl(
+                    state, pkv, slot, chunk, clen, is_last, None)
+
         if use_kernel:
-            @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-            def decode_block_paged(state, pkv, k):
+            def _decode_kernel_impl(state, pkv, k, ads):
                 # The kernel arm: NO dense gather.  The pool is read in
                 # place by the kernel (live blocks only — loop-invariant,
                 # so it stays out of the scan carry); the scan carries
@@ -1112,9 +1384,12 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
                 def body(carry, _):
                     state, view = carry
+                    variables = {"params": params["params"], "cache": view,
+                                 "pool": pool}
+                    if ads is not None:
+                        variables["adapters"] = ads
                     logits, mut = dec_kernel_mod.apply(
-                        {"params": params["params"], "cache": view,
-                         "pool": pool},
+                        variables,
                         state.last_tok[:, None], mutable=["cache"])
                     view = _sel_active(state.active, mut["cache"], view)
                     toks = _slot_sample(
@@ -1132,15 +1407,34 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                                                length=k)
                 pkv = _constrain(pg.commit_window(pkv, view, pos0, k, mask))
                 return _constrain_state(state), pkv, toks
+
+            if use_lora:
+                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+                def decode_block_paged(state, pkv, k, apool):
+                    return _decode_kernel_impl(
+                        state, pkv, k, _gather_ads(apool, state.adapter_id))
+            else:
+                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+                def decode_block_paged(state, pkv, k):
+                    return _decode_kernel_impl(state, pkv, k, None)
         else:
-            @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-            def decode_block_paged(state, pkv, k):
+            def _decode_paged_impl(state, pkv, k, ads):
                 pos0 = _cache_cursor(pkv.meta)
                 mask = state.active
                 (state, cache), toks = _decode_scan(
-                    state, pg.slot_cache(pkv), k)
+                    state, pg.slot_cache(pkv), k, ads)
                 pkv = _constrain(pg.commit_slots(pkv, cache, pos0, k, mask))
                 return _constrain_state(state), pkv, toks
+
+            if use_lora:
+                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+                def decode_block_paged(state, pkv, k, apool):
+                    return _decode_paged_impl(
+                        state, pkv, k, _gather_ads(apool, state.adapter_id))
+            else:
+                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+                def decode_block_paged(state, pkv, k):
+                    return _decode_paged_impl(state, pkv, k, None)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def evict_paged(state, pkv, slot, free_ids):
@@ -1154,14 +1448,25 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
                 keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)),
                 accepted=state.accepted.at[slot].set(zero),
-                drafted=state.drafted.at[slot].set(zero))
+                drafted=state.drafted.at[slot].set(zero),
+                adapter_id=state.adapter_id.at[slot].set(
+                    jnp.asarray(_aid_empty, jnp.int32)))
             return _constrain_state(state), pkv
 
-        @jax.jit
-        def peek_logits_paged(state, pkv):
+        def _peek_paged_impl(state, pkv, ads):
             _, logits = vstep(pg.slot_cache(pkv),
-                              state.last_tok[:, None, None])
+                              state.last_tok[:, None, None], ads)
             return logits[:, 0]
+
+        if use_lora:
+            @jax.jit
+            def peek_logits_paged(state, pkv, apool):
+                return _peek_paged_impl(
+                    state, pkv, _gather_ads(apool, state.adapter_id))
+        else:
+            @jax.jit
+            def peek_logits_paged(state, pkv):
+                return _peek_paged_impl(state, pkv, None)
 
         @jax.jit
         def export_lane_paged(state, pkv, slot):
@@ -1192,10 +1497,11 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     # donation each iteration would copy the whole [num_slots × layers ×
     # max_len] K/V arena into fresh buffers — doubling peak cache memory
     # and paying a full-arena memcpy per decode block.
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def insert_batch(state, cache, prompts, clens, dsts, seeds, temps, last):
+    def _insert_impl(state, cache, prompts, clens, dsts, seeds, temps,
+                     last, aids, ads):
         lanes, last_logits = jax.vmap(
-            lambda p, n: _force_chunk(init_cache(1), p, n))(prompts, clens)
+            lambda p, n, a: _force_chunk(init_cache(1), p, n, a)
+        )(prompts, clens, ads)
         keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
         firsts = _slot_sample(last_logits, keys, temps,
                               jnp.zeros(num_slots, jnp.int32))
@@ -1214,15 +1520,29 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             temps=state.temps.at[dsts].set(temps),
             keys=state.keys.at[dsts].set(keys),
             accepted=state.accepted.at[dsts].set(zero),
-            drafted=state.drafted.at[dsts].set(zero))
+            drafted=state.drafted.at[dsts].set(zero),
+            adapter_id=state.adapter_id.at[dsts].set(aids))
         return _constrain_state(state), cache, firsts
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def prefill_extend(state, cache, slot, chunk, clen, is_last):
+    if use_lora:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_batch(state, cache, prompts, clens, dsts, seeds, temps,
+                         last, aids, apool):
+            return _insert_impl(state, cache, prompts, clens, dsts, seeds,
+                                temps, last, aids, _gather_ads(apool, aids))
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_batch(state, cache, prompts, clens, dsts, seeds, temps,
+                         last):
+            aids = jnp.full(num_slots, _aid_empty, jnp.int32)
+            return _insert_impl(state, cache, prompts, clens, dsts, seeds,
+                                temps, last, aids, None)
+
+    def _prefill_extend_impl(state, cache, slot, chunk, clen, is_last, ad):
         lane = jax.tree.map(
             lambda full: lax.dynamic_index_in_dim(
                 full, slot, 0, keepdims=False), cache)
-        lane, last_logits = _force_chunk(lane, chunk, clen)
+        lane, last_logits = _force_chunk(lane, chunk, clen, ad)
         cache = _constrain(jax.tree.map(
             lambda full, l: lax.dynamic_update_index_in_dim(full, l, slot, 0),
             cache, lane))
@@ -1237,10 +1557,28 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
         return _constrain_state(state), cache, first
 
-    @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-    def decode_block(state, cache, k):
-        (state, cache), toks = _decode_scan(state, cache, k)
-        return _constrain_state(state), _constrain(cache), toks
+    if use_lora:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def prefill_extend(state, cache, slot, chunk, clen, is_last, apool):
+            return _prefill_extend_impl(
+                state, cache, slot, chunk, clen, is_last,
+                _gather_ads(apool, state.adapter_id[slot]))
+
+        @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+        def decode_block(state, cache, k, apool):
+            (state, cache), toks = _decode_scan(
+                state, cache, k, _gather_ads(apool, state.adapter_id))
+            return _constrain_state(state), _constrain(cache), toks
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def prefill_extend(state, cache, slot, chunk, clen, is_last):
+            return _prefill_extend_impl(state, cache, slot, chunk, clen,
+                                        is_last, None)
+
+        @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+        def decode_block(state, cache, k):
+            (state, cache), toks = _decode_scan(state, cache, k, None)
+            return _constrain_state(state), _constrain(cache), toks
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def evict(state, cache, slot):
@@ -1257,13 +1595,24 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             temps=state.temps.at[slot].set(jnp.zeros((), jnp.float32)),
             keys=state.keys.at[slot].set(jnp.zeros(2, jnp.uint32)),
             accepted=state.accepted.at[slot].set(zero),
-            drafted=state.drafted.at[slot].set(zero))
+            drafted=state.drafted.at[slot].set(zero),
+            adapter_id=state.adapter_id.at[slot].set(
+                jnp.asarray(_aid_empty, jnp.int32)))
         return _constrain_state(state), cache
 
-    @jax.jit
-    def peek_logits(state, cache):
-        _, logits = vstep(cache, state.last_tok[:, None, None])
+    def _peek_impl(state, cache, ads):
+        _, logits = vstep(cache, state.last_tok[:, None, None], ads)
         return logits[:, 0]
+
+    if use_lora:
+        @jax.jit
+        def peek_logits(state, cache, apool):
+            return _peek_impl(state, cache,
+                              _gather_ads(apool, state.adapter_id))
+    else:
+        @jax.jit
+        def peek_logits(state, cache):
+            return _peek_impl(state, cache, None)
 
     @jax.jit
     def export_lane(state, cache, slot):

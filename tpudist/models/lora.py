@@ -1,0 +1,222 @@
+"""Paged multi-LoRA adapter pool: the device half of per-tenant adapters.
+
+One base model serving thousands of customer fine-tunes is the
+production shape (S-LoRA / Punica): every tenant's delta is a rank-r
+LoRA factor pair per projection, tiny next to the base weights, and the
+slot engine's core invariant — fixed-shape compiled programs with host
+decisions riding in as data — extends to them exactly the way it
+extended to paged KV (:mod:`tpudist.models.paged`):
+
+- **storage** is a pool of ``num_blocks`` adapter blocks shared by all
+  layers (:class:`AdapterPool`: six ``[L, num_blocks, ...]`` arrays —
+  A/B factors for the ``qkv``, ``wi``, and ``wo`` projections; one
+  block id holds one adapter's whole factor set, so the host registry
+  is layer-oblivious like the KV allocator);
+- **indirection** is a per-slot ``adapter_id`` in
+  :class:`~tpudist.models.generate.SlotState` (sentinel ``num_blocks``
+  = base-only): the compiled programs gather each slot's factors from
+  the pool IN-GRAPH (:func:`gather_collection`) and compute the
+  batched ``base(x) + (x·A_s)·B_s`` delta — shapes never depend on
+  which adapters are live, so tenants churn with ZERO recompilation;
+- **the base-only contract**: a sentinel id gathers clamped garbage
+  (like a sentinel KV block), but the per-slot ``on`` mask selects the
+  UNMODIFIED base projection output — ``jnp.where(on, y + Δ, y)``, a
+  select, not an add — so a base-only lane is BIT-EXACT against a
+  plain engine and the existing oracle suite keeps its teeth;
+- **loading** is a host-initiated ``.at[:, bid].set`` per factor array
+  (:func:`load_factors`), and freed blocks are zeroed
+  (:func:`zero_block`) — no cross-tenant weight leakage, mirroring the
+  KV pool's evict hygiene.
+
+The indirection seam itself lives in ``Block.__call__``
+(``lora_rank``, the ``"adapters"`` collection): the same per-slot
+parameter-indirection later serves multi-model and MoE routing.  The
+host half — name → block id, refcounts, LRU eviction of cold adapters,
+whole-footprint admission — is :mod:`tpudist.serve.adapters`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: factor-pair keys, in the order the Block seam consumes them
+FACTOR_KEYS = ("a_qkv", "b_qkv", "a_wi", "b_wi", "a_wo", "b_wo")
+
+
+class AdapterPoolConfig(NamedTuple):
+    """Static geometry of an adapter pool.
+
+    - ``num_blocks``: resident adapter capacity (the sentinel id);
+    - ``rank``: the LoRA rank r shared by every factor pair (one rank
+      per pool keeps the programs fixed-shape; heterogeneous ranks
+      would be a second pool).
+    """
+
+    num_blocks: int
+    rank: int
+
+
+class AdapterPool(NamedTuple):
+    """The device-resident factor pool: ``a_*`` are ``[L, num_blocks,
+    d_in, r]``, ``b_*`` ``[L, num_blocks, r, d_out]`` (f32 masters,
+    cast to the compute dtype at apply like every flax param)."""
+
+    a_qkv: jax.Array
+    b_qkv: jax.Array
+    a_wi: jax.Array
+    b_wi: jax.Array
+    a_wo: jax.Array
+    b_wo: jax.Array
+
+
+def adapter_dims(module) -> Dict[str, tuple]:
+    """``(d_in, d_out)`` of each adapted projection for ``module`` (a
+    TransformerLM): ``qkv`` covers the fused q/k/v output (GQA-aware),
+    ``wi``/``wo`` the dense FFN halves."""
+    d = int(module.d_model)
+    n_kv = int(module.n_kv_heads or module.n_heads)
+    dh = d // int(module.n_heads)
+    kv_dim = n_kv * dh
+    return {
+        "qkv": (d, d + 2 * kv_dim),
+        "wi": (d, int(module.d_ff)),
+        "wo": (int(module.d_ff), d),
+    }
+
+
+def init_adapter_pool(module, cfg: AdapterPoolConfig) -> AdapterPool:
+    """All-zeros pool over ``module``'s geometry (a zero factor pair is
+    a no-op delta, so a freshly-allocated block is harmless even before
+    its ``on`` mask gates it)."""
+    if cfg.num_blocks < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {cfg.num_blocks}")
+    if cfg.rank < 1:
+        raise ValueError(f"rank must be >= 1, got {cfg.rank}")
+    L, B, r = int(module.n_layers), cfg.num_blocks, cfg.rank
+    dims = adapter_dims(module)
+    return AdapterPool(
+        a_qkv=jnp.zeros((L, B, dims["qkv"][0], r), jnp.float32),
+        b_qkv=jnp.zeros((L, B, r, dims["qkv"][1]), jnp.float32),
+        a_wi=jnp.zeros((L, B, dims["wi"][0], r), jnp.float32),
+        b_wi=jnp.zeros((L, B, r, dims["wi"][1]), jnp.float32),
+        a_wo=jnp.zeros((L, B, dims["wo"][0], r), jnp.float32),
+        b_wo=jnp.zeros((L, B, r, dims["wo"][1]), jnp.float32))
+
+
+def adapter_block_bytes(module, cfg: AdapterPoolConfig) -> int:
+    """Resident bytes of ONE adapter block across all layers and
+    projections — the unit the registry and serving report account in."""
+    L, r = int(module.n_layers), cfg.rank
+    total = 0
+    for din, dout in adapter_dims(module).values():
+        total += L * r * (din + dout) * 4  # f32 masters
+    return total
+
+
+def make_adapter_factors(rng, module, rank: int, *,
+                         scale: float = 0.05) -> Dict[str, jax.Array]:
+    """Random factor set for ``module`` at ``rank`` (tests/benches; a
+    real fine-tune loads its trained factors through the same dict).
+    Both halves are non-zero (classic LoRA inits B to zero, which is a
+    no-op — useless for exercising the delta path)."""
+    L = int(module.n_layers)
+    dims = adapter_dims(module)
+    out: Dict[str, jax.Array] = {}
+    for proj, (din, dout) in dims.items():
+        rng, ka, kb = jax.random.split(rng, 3)
+        out[f"a_{proj}"] = (jax.random.normal(ka, (L, din, rank), jnp.float32)
+                            * (din ** -0.5))
+        out[f"b_{proj}"] = (jax.random.normal(kb, (L, rank, dout), jnp.float32)
+                            * scale)
+    return out
+
+
+def check_factors(module, cfg: AdapterPoolConfig,
+                  factors: Dict[str, Any]) -> None:
+    """Loud shape validation before a load touches the pool."""
+    import numpy as np
+
+    L, r = int(module.n_layers), cfg.rank
+    dims = adapter_dims(module)
+    for proj, (din, dout) in dims.items():
+        for key, want in ((f"a_{proj}", (L, din, r)),
+                          (f"b_{proj}", (L, r, dout))):
+            if key not in factors:
+                raise ValueError(f"adapter factors missing {key!r}")
+            got = tuple(np.shape(factors[key]))
+            if got != want:
+                raise ValueError(
+                    f"adapter factor {key} shape {got} != expected {want} "
+                    f"(module geometry × pool rank {r})")
+
+
+def load_factors(pool: AdapterPool, bid: int,
+                 factors: Dict[str, Any]) -> AdapterPool:
+    """Write one adapter's factor set into block ``bid`` (host-initiated,
+    eager — loads are rare next to decode dispatches)."""
+    return AdapterPool(**{
+        key: getattr(pool, key).at[:, bid].set(
+            jnp.asarray(factors[key], getattr(pool, key).dtype))
+        for key in FACTOR_KEYS})
+
+
+def zero_block(pool: AdapterPool, bid: int) -> AdapterPool:
+    """Zero block ``bid`` — a freed block must not leak a tenant's
+    fine-tune into a later gather (the KV pool's evict hygiene)."""
+    return AdapterPool(**{
+        key: getattr(pool, key).at[:, bid].set(0.0)
+        for key in FACTOR_KEYS})
+
+
+def gather_collection(pool: AdapterPool, ids, n_layers: int,
+                      layer_prefix: str = "block_") -> Dict[str, Any]:
+    """The ``"adapters"`` flax collection for a slot batch: per layer
+    ``{a_qkv .. b_wo, on}`` gathered at ``ids`` (scalar for one lane,
+    ``[S]`` for a batched/vmapped program).  Sentinel ids clamp into a
+    real block — harmless, because ``on = ids < num_blocks`` routes
+    those lanes onto the bit-exact base path (the select in
+    ``Block.__call__``)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    B = pool.a_qkv.shape[1]
+    on = ids < B
+    out: Dict[str, Any] = {}
+    for i in range(n_layers):
+        col = {key: getattr(pool, key)[i, ids] for key in FACTOR_KEYS}
+        col["on"] = on
+        out[f"{layer_prefix}{i}"] = col
+    return out
+
+
+def adapter_collection(factors: Dict[str, Any], n_layers: int,
+                       on: bool = True,
+                       layer_prefix: str = "block_") -> Dict[str, Any]:
+    """The ``"adapters"`` collection for a SINGLE adapter applied to a
+    whole batch — the sequential-oracle path (:func:`tpudist.models.
+    generate.generate` ``adapters=``): unbatched factor leaves broadcast
+    over the batch, ``on`` a scalar."""
+    out: Dict[str, Any] = {}
+    for i in range(n_layers):
+        col = {key: jnp.asarray(factors[key])[i] for key in FACTOR_KEYS}
+        col["on"] = jnp.asarray(bool(on))
+        out[f"{layer_prefix}{i}"] = col
+    return out
+
+
+def slice_factor_layers(collection_or_factors: Dict[str, Any],
+                        n_layers: int) -> Dict[str, Any]:
+    """First ``n_layers`` layers of a factor dict — the weight-tied
+    draft's share of its slot's adapter (the draft IS the target's
+    first N blocks, so its factors are the pool's first N layer
+    slices)."""
+    return {key: jnp.asarray(collection_or_factors[key])[:n_layers]
+            for key in FACTOR_KEYS}
+
+
+def pool_bytes(pool: Optional[AdapterPool]) -> int:
+    if pool is None:
+        return 0
+    return sum(int(getattr(pool, k).size) * getattr(pool, k).dtype.itemsize
+               for k in FACTOR_KEYS)

@@ -244,6 +244,18 @@ class Block(nn.Module):
     # ``max_len`` K/V cache carried in the flax "cache" collection.
     decode: bool = False
     max_len: int = 2048  # cache length (decode only)
+    # Per-slot parameter indirection (per-tenant adapters,
+    # tpudist.models.lora): rank of the LoRA factor pairs applied
+    # around the qkv/wi/wo projections.  0 = the seam is compiled out
+    # (byte-identical program to the pre-adapter Block).  > 0: apply()
+    # must supply an "adapters" collection — per layer the factor
+    # leaves {a_qkv, b_qkv, a_wi, b_wi, a_wo, b_wo} plus the ``on``
+    # mask (scalar for one lane, [batch] for a slot-batched program);
+    # the projection output becomes ``where(on, y + (x·A)·B, y)`` — a
+    # SELECT, so an off lane is bit-exact base.  The same seam later
+    # serves multi-model and MoE routing: anything per-slot that picks
+    # parameters rides in as gathered data, never as a new program.
+    lora_rank: int = 0
     # Decode-attention execution (decode mode only) — the third arm of
     # the attention dispatch (reference / flash are the training arms):
     #   None      — the dense cached softmax below (the gather path:
@@ -271,11 +283,46 @@ class Block(nn.Module):
                 f"n_kv_heads {n_kv} must be in [1, {self.n_heads}] and "
                 f"divide n_heads {self.n_heads}")
         kv_dim = n_kv * dh
+        # -- per-tenant adapter seam (lora_rank > 0): the gathered
+        # factor collection rides in through apply() like the paged
+        # kernel's pool — never flax-initialized (is_initializing skips
+        # it: the seam adds no params and no cache).
+        ad = None
+        if self.lora_rank > 0 and not self.is_initializing():
+            if self.n_experts > 0 or self.mlp_fn is not None:
+                raise ValueError(
+                    "lora_rank adapters wrap the plain qkv/wi/wo Dense "
+                    "path; they cannot compose with an MoE FFN or an "
+                    "injected mlp_fn (the fused MLP hides the wi/wo seam)")
+            ad = {k: self.get_variable("adapters", k)
+                  for k in ("a_qkv", "b_qkv", "a_wi", "b_wi",
+                            "a_wo", "b_wo", "on")}
+            if ad["a_qkv"] is None:
+                raise ValueError(
+                    "lora_rank > 0 requires an 'adapters' collection "
+                    "(tpudist.models.lora.gather_collection / "
+                    "adapter_collection) supplied with apply()")
+
+        def _ad(y, h_in, a_key, b_key):
+            """``where(on, y + (h·A)·B, y)`` — the adapter delta as a
+            SELECT: an off lane's output is the base tensor bit-exactly
+            (clamped-gather garbage in A/B is deselected, the KV-mask
+            discipline applied to parameters)."""
+            if ad is None:
+                return y
+            a = ad[a_key].astype(self.dtype)
+            bm = ad[b_key].astype(self.dtype)
+            delta = (h_in.astype(self.dtype) @ a) @ bm
+            on = jnp.asarray(ad["on"])
+            m = on.reshape(on.shape + (1,) * (y.ndim - on.ndim))
+            return jnp.where(m, y + delta, y)
+
         # LayerNorm statistics in f32 for stability; projections compute in
         # ``dtype`` (flax casts inputs + the f32 master params at apply).
         h = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
         qkv = nn.Dense(self.d_model + 2 * kv_dim, use_bias=False,
                        name="qkv", dtype=self.dtype)(h)
+        qkv = _ad(qkv, h, "a_qkv", "b_qkv")
         q = qkv[..., : self.d_model]
         k = qkv[..., self.d_model : self.d_model + kv_dim]
         v = qkv[..., self.d_model + kv_dim :]
@@ -342,11 +389,14 @@ class Block(nn.Module):
                 {"wi": wi.astype(self.dtype), "wo": wo.astype(self.dtype)},
                 h.astype(self.dtype))
             return x + y
+        hin = h
         h = nn.Dense(self.d_ff, use_bias=False, name="wi",
-                     dtype=self.dtype)(h)
+                     dtype=self.dtype)(hin)
+        h = _ad(h, hin, "a_wi", "b_wi")
         h = nn.gelu(h)
-        return x + nn.Dense(self.d_model, use_bias=False, name="wo",
-                            dtype=self.dtype)(h)
+        y = nn.Dense(self.d_model, use_bias=False, name="wo",
+                     dtype=self.dtype)(h)
+        return x + _ad(y, h, "a_wo", "b_wo")
 
     def _decode_attention(self, q, k, v):
         """Cached attention over a decode WINDOW of ``s >= 1`` tokens:
@@ -500,6 +550,10 @@ class TransformerLM(nn.Module):
     # paged-attention kernel walking the block pool in place (the
     # slot-batched path — cursors become [batch] vectors).
     decode_kernel: Optional[str] = None
+    # Per-tenant adapter seam in every block (see Block.lora_rank):
+    # 0 compiles it out; > 0 makes apply() take an "adapters"
+    # collection of gathered rank-r LoRA factors (tpudist.models.lora).
+    lora_rank: int = 0
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activation memory drops from O(layers × per-block internals) to the
     # block boundaries, at ~1 extra forward of FLOPs — the lever that fits
@@ -602,6 +656,7 @@ class TransformerLM(nn.Module):
                 n_kv_heads=self.n_kv_heads, decode=self.decode,
                 max_len=self.max_len, sliding_window=self.sliding_window,
                 decode_kernel=self.decode_kernel, layer_idx=i,
+                lora_rank=self.lora_rank,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)

@@ -41,9 +41,22 @@ byte-budgeted host-RAM store and resume without recompute
 turns the live per-tenant SLO-attainment gauges into load-shedding
 decisions.
 
+``ServeConfig(adapters=True)`` adds per-tenant adapters
+(:mod:`tpudist.serve.adapters`, :mod:`tpudist.models.lora`): a paged
+multi-LoRA factor pool next to the KV pool — ``load_adapter(name,
+factors)`` + ``submit(adapter=name)`` decode ``base(x) +
+gather(B)·gather(A)·x`` with each slot's rank-r factors gathered
+in-graph, zero recompilation as tenants churn, bit-exact base path for
+adapter-less lanes.
+
 ``python -m tpudist.serve`` runs a self-contained CPU demo.
 """
 
+from tpudist.serve.adapters import (  # noqa: F401
+    AdapterMissingError,
+    AdapterPoolFull,
+    AdapterRegistry,
+)
 from tpudist.serve.disagg import DisaggServer  # noqa: F401
 from tpudist.serve.engine import SlotEngine  # noqa: F401
 from tpudist.serve.host_tier import HostKVTier, HostTierError  # noqa: F401

@@ -102,8 +102,12 @@ _IDLE_WAIT_S = 0.01
 #: plus the blob integrity digest; v3 added the per-request ``trace_id``
 #: (the cross-pool tracing join key).  v2 packages still DESERIALIZE
 #: (their trace_id reads back ``None``) — the new field is additive and
-#: outside the digested blob, so the old wire format stays valid.
-HANDOFF_SCHEMA_VERSION = 3
+#: outside the digested blob, so the old wire format stays valid.  v4
+#: added the per-tenant ``adapter`` NAME (tpudist.serve.adapters) and a
+#: ninth SlotState leaf (``adapter_id``) in the blob: pool block ids
+#: are local, so the importing pool re-binds by NAME — v2/v3 packages
+#: still deserialize (adapter reads back ``None``, the base-only path).
+HANDOFF_SCHEMA_VERSION = 4
 
 #: Oldest wire format :func:`deserialize_package` accepts.
 HANDOFF_SCHEMA_MIN = 2
@@ -167,6 +171,7 @@ def serialize_package(pkg: dict) -> dict:
            "paged": pkg["paged"], "pos": pkg["pos"],
            "counts": pkg["counts"], "budget": pkg["budget"],
            "trace_id": pkg.get("trace_id"),
+           "adapter": pkg.get("adapter"),
            "blob": blob, "tree": tree,
            "digest": _blob_digest(blob),
            "bytes": sum(len(b) for b, _, _ in blob)}
@@ -217,6 +222,7 @@ def deserialize_package(ser: dict) -> dict:
     return {"paged": ser["paged"], "pos": ser["pos"],
             "counts": ser["counts"], "budget": ser["budget"],
             "trace_id": ser.get("trace_id"),  # None on a v2 package
+            "adapter": ser.get("adapter"),  # None on a v2/v3 package
             "lane": lane, "state": state}
 
 
@@ -237,7 +243,13 @@ class DisaggServer(_Observability):
         shared = dict(
             prefill_pad=cfg.prefill_pad, paged=cfg.paged,
             kv_block=cfg.kv_block, kv_blocks=cfg.kv_blocks,
-            kv_int8=cfg.kv_int8, mesh=cfg.mesh_config())
+            kv_int8=cfg.kv_int8, mesh=cfg.mesh_config(),
+            # every pool engine carries the adapter pool: prefill
+            # teacher-forces THROUGH the adapter (the exported KV
+            # depends on it) and the decode pool re-binds by name on
+            # import; load_adapter broadcasts to all of them
+            adapters=cfg.adapters, adapter_blocks=cfg.adapter_blocks,
+            adapter_rank=cfg.adapter_rank)
         p_slots = cfg.prefill_slots or cfg.num_slots
         # prefill workers keep the prefix cache (reuse saves prefill
         # compute — that is this pool's whole job); decode workers get
@@ -287,7 +299,9 @@ class DisaggServer(_Observability):
         self.scheduler = Scheduler(
             queue_limit=cfg.queue_limit, check_budget=check_budget,
             default_max_new=cfg.max_new, default_deadline_s=cfg.deadline_s,
-            prefix_hasher=hasher)
+            prefix_hasher=hasher,
+            check_adapter=lambda name: (
+                None if pe.has_adapter(name) else "adapter_missing"))
         self._install_signal = install_signal_handler
         self._installed_preemption = False
         self._thread: Optional[threading.Thread] = None
@@ -356,6 +370,7 @@ class DisaggServer(_Observability):
             decode_slots=self.decode_pool[0].num_slots,
             handoff=self.handoff_mode,
             mesh=self.decode_pool[0].spmd_stats().get("mesh"))
+        self._stamp_adapter_config()
         self._start_observability()
         if self._install_signal:
             self._installed_preemption = preemption.install()
@@ -369,7 +384,8 @@ class DisaggServer(_Observability):
                seed: Optional[int] = None, eos_id: Optional[int] = None,
                on_token=None, spec: Optional[bool] = None,
                tenant: Optional[str] = None, priority: int = 0,
-               session: Optional[str] = None) -> RequestHandle:
+               session: Optional[str] = None,
+               adapter: Optional[str] = None) -> RequestHandle:
         from tpudist import telemetry
 
         # +1 BEFORE the handle is visible to the engine thread (see
@@ -382,7 +398,7 @@ class DisaggServer(_Observability):
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
                 on_token=on_token, spec=spec, tenant=tenant,
-                priority=priority, session=session)
+                priority=priority, session=session, adapter=adapter)
         except BaseException as e:
             self._track_tenant(tkey, -1)  # never admitted (ANY failure)
             if isinstance(e, AdmissionError):
@@ -410,6 +426,9 @@ class DisaggServer(_Observability):
             preemption.reset()
             self._installed_preemption = False
         return ok
+
+    def _adapter_engines(self) -> list:
+        return list(self.prefill_pool) + list(self.decode_pool)
 
     def _observability_gauges(self) -> dict:
         return {
@@ -476,6 +495,8 @@ class DisaggServer(_Observability):
             "completed": self.completed,
             "tokens_out": self.tokens_out,
             "tenants_in_flight": dict(self._tenant_inflight),
+            **({"adapters": self.decode_pool[0].adapter_stats()}
+               if self.decode_pool[0].adapters is not None else {}),
             "world": env_int("TPUDIST_NUM_PROCESSES", None),
             "generation": env_int("TPUDIST_RESTART_COUNT", 0),
             "draining": self._draining,
@@ -540,6 +561,7 @@ class DisaggServer(_Observability):
                 "kv": self.decode_pool[0].kv_stats(),
             },
             "spmd": self.decode_pool[0].spmd_stats(),
+            "adapters": self.decode_pool[0].adapter_stats(),
         }
 
     # -- the engine loop ----------------------------------------------------
@@ -979,6 +1001,12 @@ class DisaggServer(_Observability):
             for h in batch:
                 if h.done:
                     self._note_finished(h)
+                elif not eng.has_adapter(h.request.adapter):
+                    # admitted, but the named adapter was unloaded while
+                    # it queued: finish loudly (never serve base output
+                    # for an adapter request)
+                    h._finish("adapter_missing")
+                    self._note_finished(h)
                 else:
                     alive.append(h)
             if not alive:
@@ -1004,18 +1032,42 @@ class DisaggServer(_Observability):
                     continue
                 items.append((slot, h.request.prompt, h.request.temperature,
                               h.request.seed, h.request.max_new,
-                              h.request.prefix_hashes))
+                              h.request.prefix_hashes, None,
+                              h.request.adapter))
                 self._slot_handles[("prefill", w, slot)] = h
             if not items:
                 continue
-            try:
-                self._tick("prefill", w)
-                with telemetry.span("prefill", n=len(items), pool="prefill",
-                                    worker=w):
-                    firsts = eng.start_batch(items)
-            except Exception as e:  # worker died admitting: the lanes
-                # just registered recover through the standard path
-                self._lose_worker("prefill", w, e)
+            from tpudist.serve.adapters import AdapterMissingError
+
+            firsts = {}
+            while items:
+                try:
+                    self._tick("prefill", w)
+                    with telemetry.span("prefill", n=len(items),
+                                        pool="prefill", worker=w):
+                        firsts = eng.start_batch(items)
+                    break
+                except AdapterMissingError as e:
+                    # a user thread unloaded the adapter between the
+                    # recheck and the dispatch (whole-batch validation —
+                    # nothing mutated): finish ITS requests, keep the
+                    # rest.  NOT a worker death.
+                    keep = []
+                    for it in items:
+                        if it[7] == e.adapter:
+                            h2 = self._slot_handles.pop(
+                                ("prefill", w, it[0]))
+                            h2._finish("adapter_missing")
+                            self._note_finished(h2)
+                        else:
+                            keep.append(it)
+                    items = keep
+                except Exception as e:  # worker died admitting: the lanes
+                    # just registered recover through the standard path
+                    self._lose_worker("prefill", w, e)
+                    items = None
+                    break
+            if items is None:
                 continue
             for slot, tok in firsts.items():
                 if tok is not None:
@@ -1043,12 +1095,24 @@ class DisaggServer(_Observability):
             self._tier_event("host_tier_corrupt", kind="session",
                              error=str(e)[:120], trace_id=h.trace_id)
             return False
+        if raw.get("adapter") != req.adapter:
+            # the parked KV was written THROUGH its turn's adapter; a
+            # turn binding a different adapter (or none) re-prefills
+            # fresh — resuming would continue the wrong fine-tune's cache
+            return False
         t0 = time.monotonic()
+        from tpudist.serve.adapters import AdapterMissingError
+
         try:
             self._tick("prefill", w)
             eng.resume_slot(slot, raw, req.prompt,
                             temperature=req.temperature, seed=req.seed,
                             max_new=req.max_new, spec=req.spec)
+        except AdapterMissingError:
+            # unloaded while parked: fall back to a fresh prefill (the
+            # admission recheck then finishes it adapter_missing) — NOT
+            # a worker death
+            return False
         except Exception as e:
             # the worker died importing: register the lane first so the
             # standard recovery requeues it for a full re-prefill on a
@@ -1244,9 +1308,21 @@ class DisaggServer(_Observability):
                     raw = pkg
                 slot = free[0]
                 t0 = time.monotonic()
+                from tpudist.serve.adapters import AdapterMissingError
+
                 try:
                     self._tick("decode", w)
                     eng.import_slot(slot, raw, spec=h.request.spec)
+                except AdapterMissingError:
+                    # the decode pool cannot re-bind the package's
+                    # adapter name (unloaded while the lane crossed the
+                    # queue): ITS request finishes loudly — the lane's
+                    # KV is the fine-tune's, continuing base would be
+                    # wrong bytes.  NOT a worker death.
+                    h._finish("adapter_missing")
+                    self._note_finished(h)
+                    placed = worked = True
+                    break
                 except Exception as e:
                     # the worker died importing: the package is intact —
                     # back to the queue head, a survivor takes it
@@ -1439,7 +1515,8 @@ class DisaggServer(_Observability):
             ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s,
             pool="disagg", handoff_wait_s=h.handoff_wait_s,
             trace_id=h.trace_id,
-            **({"tenant": h.request.tenant} if h.request.tenant else {}))
+            **({"tenant": h.request.tenant} if h.request.tenant else {}),
+            **({"adapter": h.request.adapter} if h.request.adapter else {}))
         # per-request lifeline (req_queue → req_prefill → req_handoff →
         # one req_decode per residency segment): the cross-pool trace
         trace.emit_request_lifeline(h)

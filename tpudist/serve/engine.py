@@ -101,7 +101,9 @@ class SlotEngine:
                  prefix_cache_blocks: int = 0,
                  mesh=None,
                  spec_draft=None, spec_k: int = 4,
-                 attn_kernel: Optional[str] = None):
+                 attn_kernel: Optional[str] = None,
+                 adapters: bool = False, adapter_blocks: int = 8,
+                 adapter_rank: int = 8):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         # -- decode attention path: "gather" (dense view per dispatch)
@@ -122,6 +124,39 @@ class SlotEngine:
         self.attn_kernel = attn_kernel
         self.module = module
         self.max_len = int(module.max_len)
+        # -- per-tenant adapters (tpudist.models.lora + serve.adapters):
+        # a paged rank-r LoRA factor pool next to the KV pool, per-slot
+        # adapter ids in SlotState, host registry deciding which block
+        # holds which named adapter.  Env-free like every engine knob
+        # (TPUDIST_SERVE_ADAPTERS* parse once in ServeConfig.from_env).
+        self.adapters = None
+        self.apool = None
+        self.adapter_cfg = None
+        acfg = None
+        if adapters:
+            from tpudist.models import lora as _lora
+            from tpudist.serve.adapters import AdapterRegistry
+
+            if getattr(module, "n_experts", 0) > 0 \
+                    or getattr(module, "mlp_fn", None) is not None:
+                raise ValueError(
+                    "adapters wrap the plain qkv/wi/wo Dense path — they "
+                    "cannot compose with an MoE FFN or an injected mlp_fn")
+            acfg = _lora.AdapterPoolConfig(
+                num_blocks=max(1, int(adapter_blocks)),
+                rank=max(1, int(adapter_rank)))
+            self.adapter_cfg = acfg
+            self.apool = _lora.init_adapter_pool(module, acfg)
+            self._lora = _lora
+            self.adapters = AdapterRegistry(acfg.num_blocks)
+            #: host shadow: slot → bound ``(name, block_id)`` (None =
+            #: base-only).  The NAME is what export_slot stamps into
+            #: handoff/host-tier packages (ids are pool-local); the BID
+            #: pins the exact factor GENERATION — a deferred-unload
+            #: reload retires the old block, and this lane keeps
+            #: decoding (and releasing) the one it bound
+            self.slot_adapter: List[Optional[Tuple[str, int]]] = \
+                [None] * num_slots
         # -- SPMD serving mesh (tpudist.serve.spmd): params + KV storage
         # get NamedShardings, SlotState/tables stay replicated, and the
         # SAME four programs run partitioned — shardings change, code
@@ -142,6 +177,12 @@ class SlotEngine:
 
             self.tp_overlap = spmd.resolve_serve_overlap(self._mesh_cfg)
             overlap_on = self.tp_overlap != "off"
+            if overlap_on and adapters:
+                # the fused overlapped MLP hides the wi/wo seam the
+                # adapter delta wraps — adapters keep the plain
+                # column-sharded path (same rule as the MoE FFN below)
+                overlap_on = False
+                self.tp_overlap = "off"
             if overlap_on and getattr(module, "n_experts", 0) == 0:
                 mlp_fn = spmd.serve_overlap_mlp_fn(
                     self.mesh, mode=self.tp_overlap)
@@ -225,7 +266,8 @@ class SlotEngine:
                                         state_constraint=state_constraint,
                                         spec=spec_pair,
                                         draft_constraint=cache_constraint,
-                                        attn_kernel=attn_kernel)
+                                        attn_kernel=attn_kernel,
+                                        adapters=acfg)
             self.alloc = BlockAllocator(
                 self.paged_cfg.num_blocks, kv_block, self.max_len,
                 prefix_cache_blocks=prefix_cache_blocks)
@@ -236,7 +278,8 @@ class SlotEngine:
                                         cache_constraint=cache_constraint,
                                         state_constraint=state_constraint,
                                         spec=spec_pair,
-                                        draft_constraint=cache_constraint)
+                                        draft_constraint=cache_constraint,
+                                        adapters=acfg)
         self.num_slots = num_slots
         self.prefill_pad = prefill_pad
         self.block = max(1, int(decode_block if decode_block else 8))
@@ -264,6 +307,13 @@ class SlotEngine:
                     spmd.serve_paged_sharding(self.mesh, self.dcache)
                     if self.alloc is not None
                     else spmd.serve_cache_sharding(self.mesh, self.dcache))
+            if self.apool is not None:
+                # factor pool sharded over `model` where its output
+                # dims divide, else replicated — output byte-identical
+                # at every mesh shape (serve_adapter_sharding's rule)
+                self.apool = _jax.device_put(
+                    self.apool,
+                    spmd.serve_adapter_sharding(self.mesh, self.apool))
         self.occupied = np.zeros(num_slots, bool)
         self.decoding = np.zeros(num_slots, bool)
         self.pos = np.zeros(num_slots, np.int32)
@@ -530,6 +580,117 @@ class SlotEngine:
                 "tp_overlap": self.tp_overlap,
                 **self._spmd_param_stats}
 
+    # -- per-tenant adapters ------------------------------------------------
+
+    def has_adapter(self, name: Optional[str]) -> bool:
+        """Would a NEW request naming ``name`` bind right now?  (None =
+        base-only, always true on any engine; a named adapter needs an
+        adapter pool holding it and not marked for unload.)"""
+        if name is None:
+            return True
+        return self.adapters is not None and self.adapters.has(name)
+
+    def load_adapter(self, name: str, factors) -> Dict[str, object]:
+        """Load ``factors`` (:func:`tpudist.models.lora.
+        make_adapter_factors`-shaped dict) under ``name``: reserves a
+        pool block (LRU-evicting a cold adapter if full — its block is
+        zeroed first), writes the factor set, and returns ``{"block",
+        "evicted", "resident"}`` for the caller's telemetry.  Thread-
+        safe against the engine thread: the pool swap is one atomic
+        rebind, and only NOT-in-use blocks are ever written."""
+        if self.adapters is None:
+            raise RuntimeError("engine built without adapters=True")
+        self._lora.check_factors(self.module, self.adapter_cfg, factors)
+        # two-phase load: the registry keeps the name PENDING (not
+        # bindable) until the factors are actually in the device pool —
+        # a racing admission must never gather a zeroed (or, after an
+        # LRU evict, the victim's) block under the new name
+        bid, evicted = self.adapters.load(name)
+        pool = self.apool
+        if evicted is not None:
+            pool = self._lora.zero_block(pool, evicted[1])
+        self.apool = self._lora.load_factors(pool, bid, factors)
+        self.adapters.activate(name)
+        return {"block": bid,
+                "evicted": None if evicted is None else evicted[0],
+                "resident": self.adapters.resident}
+
+    def unload_adapter(self, name: str) -> Dict[str, object]:
+        """Unload ``name``: frees (and zeroes) its block now when no
+        lane holds it, else defers — new requests reject
+        ``adapter_missing`` immediately, the block frees when the last
+        bound lane evicts.  Returns ``{"freed", "resident"}``."""
+        if self.adapters is None:
+            raise RuntimeError("engine built without adapters=True")
+        res = self.adapters.unload(name)
+        if res is None:
+            return {"freed": False, "resident": self.adapters.resident,
+                    "known": False}
+        freed_now, bid = res
+        if freed_now:
+            self.apool = self._lora.zero_block(self.apool, bid)
+        return {"freed": freed_now, "resident": self.adapters.resident,
+                "known": True}
+
+    def adapter_stats(self) -> Dict[str, object]:
+        """Adapter-pool accounting for reports/statusz: registry
+        counters plus pool geometry/bytes (all trivial when off)."""
+        if self.adapters is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "rank": self.adapter_cfg.rank,
+            "block_bytes": self._lora.adapter_block_bytes(
+                self.module, self.adapter_cfg),
+            "pool_bytes": self._lora.pool_bytes(self.apool),
+            "slots_bound": sum(1 for a in self.slot_adapter
+                               if a is not None),
+            **self.adapters.stats(),
+        }
+
+    def _acquire_adapter(self, slot: int, name: Optional[str]) -> int:
+        """Bind ``name`` to ``slot`` (refcount pin) and return its pool
+        block id — the sentinel for a base-only lane.  Raises
+        :class:`~tpudist.serve.adapters.AdapterMissingError` when the
+        name is not resident (a raced unload, or a re-bind onto a pool
+        that never loaded it)."""
+        if name is None:
+            return self._aid_sentinel()
+        from tpudist.serve.adapters import AdapterMissingError
+
+        if self.adapters is None:
+            raise AdapterMissingError(name)
+        bid = self.adapters.acquire(name)
+        if bid is None:
+            raise AdapterMissingError(name)
+        self.slot_adapter[slot] = (name, bid)
+        return bid
+
+    def _release_adapter(self, slot: int) -> None:
+        if self.adapters is None:
+            return
+        bound = self.slot_adapter[slot]
+        if bound is None:
+            return
+        self.slot_adapter[slot] = None
+        freed = self.adapters.release(*bound)
+        if freed is not None:
+            # a deferred unload / retired generation just completed:
+            # zero the block before the free list hands it on
+            self.apool = self._lora.zero_block(self.apool, freed)
+
+    def _aid_sentinel(self) -> int:
+        return (self.adapter_cfg.num_blocks
+                if self.adapter_cfg is not None else 0)
+
+    def _slot_aid(self, slot: int) -> int:
+        """The pool block id bound to ``slot`` (sentinel = base-only) —
+        the bid captured at acquire, so a reload retiring the name's
+        current generation cannot redirect a live lane."""
+        bound = (self.slot_adapter[slot] if self.adapters is not None
+                 else None)
+        return self._aid_sentinel() if bound is None else bound[1]
+
     # -- KV handoff (prefill/decode disaggregation) -------------------------
 
     def export_slot(self, slot: int) -> Dict[str, object]:
@@ -551,7 +712,14 @@ class SlotEngine:
                 "lane": lane, "state": lane_state,
                 "pos": int(self.pos[slot]),
                 "counts": int(self.counts[slot]),
-                "budget": int(self.budget[slot])}
+                "budget": int(self.budget[slot]),
+                # adapter binding travels by NAME: pool block ids are
+                # local, so the importing engine re-binds in its own
+                # registry (AdapterMissingError → "adapter_missing")
+                "adapter": (self.slot_adapter[slot][0]
+                            if self.adapters is not None
+                            and self.slot_adapter[slot] is not None
+                            else None)}
 
     def can_import(self, package: Dict[str, object]) -> bool:
         """Would this engine's KV budget take the package right now
@@ -585,7 +753,8 @@ class SlotEngine:
         pos, counts = int(package["pos"]), int(package["counts"])
         budget = int(package["budget"])
         self._install_lane(slot, package["lane"], package["state"], pos,
-                           admit_span=(pos, budget))
+                           admit_span=(pos, budget),
+                           adapter=package.get("adapter"))
         self.occupied[slot] = True
         self.decoding[slot] = True
         self.pos[slot] = pos
@@ -595,7 +764,8 @@ class SlotEngine:
         self.peak_occupied = max(self.peak_occupied, self.num_occupied)
 
     def _install_lane(self, slot: int, lane, row_state, pos: int, *,
-                      admit_span: Tuple[int, int]) -> None:
+                      admit_span: Tuple[int, int],
+                      adapter: Optional[str] = None) -> None:
         """The ONE import dispatch both :meth:`import_slot` (handoff /
         preemption resume) and :meth:`resume_slot` (session resume)
         ride: paged engines reserve ``admit_span`` (admission args for
@@ -603,9 +773,20 @@ class SlotEngine:
         table row, then ``import_lane`` installs the lane + state row
         and ``draft_arm`` cold-starts the draft cursor at ``pos`` — a
         package-layout or draft-signature change lands in both resume
-        flavors by construction."""
+        flavors by construction.  ``adapter``: the package's adapter
+        NAME — re-bound in THIS pool's registry before install (ids are
+        pool-local; a name this pool never loaded raises
+        ``AdapterMissingError`` BEFORE any state mutates)."""
+        import numpy as _np
+
         import jax.numpy as jnp
 
+        if adapter is not None or self.adapters is not None:
+            # re-bind by name: the row's adapter_id leaf is the SOURCE
+            # pool's id (or a foreign sentinel) — overwrite with ours
+            aid = self._acquire_adapter(slot, adapter)
+            row_state = row_state._replace(
+                adapter_id=_np.asarray(aid, _np.int32))
         if self.alloc is not None:
             row, _ = self.alloc.admit(slot, admit_span[0], admit_span[1],
                                       ())
@@ -683,7 +864,8 @@ class SlotEngine:
         # full prompt + max_new reservation (no prefix sharing on a
         # resumed lane), then the same install dispatch imports ride
         self._install_lane(slot, package["lane"], row_state, pos,
-                           admit_span=(len(prompt), max_new))
+                           admit_span=(len(prompt), max_new),
+                           adapter=package.get("adapter"))
         self.occupied[slot] = True
         self.decoding[slot] = False
         self.pos[slot] = pos
@@ -817,11 +999,21 @@ class SlotEngine:
         norm = []
         taken = set()
         spec_flags = {}
+        adapter_names: Dict[int, Optional[str]] = {}
         for item in items:
             slot, prompt, temperature, seed, max_new = item[:5]
             hashes = tuple(item[5]) if len(item) > 5 else ()
             spec_flags[int(slot)] = (bool(item[6]) if len(item) > 6
                                      and item[6] is not None else True)
+            adapter = item[7] if len(item) > 7 else None
+            if adapter is not None and not self.has_adapter(adapter):
+                # whole-batch validation: a vanished adapter (raced
+                # unload) must not leak half-admitted neighbors — the
+                # server finishes the request "adapter_missing"
+                from tpudist.serve.adapters import AdapterMissingError
+
+                raise AdapterMissingError(str(adapter))
+            adapter_names[int(slot)] = adapter
             if self.occupied[slot] or slot in taken:
                 raise ValueError(f"slot {slot} is occupied")
             taken.add(slot)
@@ -831,6 +1023,29 @@ class SlotEngine:
                 raise ValueError(reason)
             norm.append((int(slot), prompt, temperature, seed, int(max_new),
                          hashes))
+        ad_args = ()
+        if self.adapters is not None:
+            # bind each lane's adapter FIRST (before any KV reservation
+            # — a failed bind must leave no alloc state behind); the
+            # compiled programs take the resolved ids as data and
+            # gather the factors in-graph.  TRANSACTIONAL: a mid-batch
+            # AdapterMissingError (a user thread unloaded between
+            # validation and here) rolls every earlier pin back — the
+            # server retries the surviving items through this same
+            # path, and a double-acquire would leak a refcount (and
+            # its block) forever
+            aids = np.full(self.num_slots, self._aid_sentinel(), np.int32)
+            bound_slots: List[int] = []
+            try:
+                for j, (slot, *_rest) in enumerate(norm):
+                    aids[j] = self._acquire_adapter(slot,
+                                                    adapter_names[slot])
+                    bound_slots.append(slot)
+            except BaseException:
+                for slot in bound_slots:
+                    self._release_adapter(slot)
+                raise
+            ad_args = (jnp.asarray(aids), self.apool)
         reused_len = np.zeros(self.num_slots, np.int32)
         if self.alloc is not None:
             M = self.max_len // self.paged_cfg.block_size
@@ -856,8 +1071,11 @@ class SlotEngine:
             except RuntimeError:
                 # a half-admitted batch must not leak reservations; the
                 # caller gates on can_admit_kv, so this is the defense
+                # (adapter pins acquired above roll back with it)
                 for slot in admitted:
                     self.alloc.release(slot)
+                for slot, *_rest in norm:
+                    self._release_adapter(slot)
                 raise
         for j, (slot, prompt, temperature, seed, max_new, _) in \
                 enumerate(norm):
@@ -876,7 +1094,7 @@ class SlotEngine:
                 self.state, self.cache, jnp.asarray(tables),
                 jnp.asarray(reused_len), jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
-                jnp.asarray(temps), jnp.asarray(last))
+                jnp.asarray(temps), jnp.asarray(last), *ad_args)
             if self.spec:
                 # same chunks, same (host-built) table rows: the draft's
                 # pool blocks mirror the target's ids, so a reused
@@ -884,16 +1102,16 @@ class SlotEngine:
                 self.dcache = self.fns.draft_prefill(
                     self.dcache, jnp.asarray(tables),
                     jnp.asarray(reused_len), jnp.asarray(prompts),
-                    jnp.asarray(clens), jnp.asarray(dsts))
+                    jnp.asarray(clens), jnp.asarray(dsts), *ad_args)
         else:
             self.state, self.cache, firsts = self.fns.insert_batch(
                 self.state, self.cache, jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
-                jnp.asarray(temps), jnp.asarray(last))
+                jnp.asarray(temps), jnp.asarray(last), *ad_args)
             if self.spec:
                 self.dcache = self.fns.draft_prefill(
                     self.dcache, jnp.asarray(prompts), jnp.asarray(clens),
-                    jnp.asarray(dsts))
+                    jnp.asarray(dsts), *ad_args)
         firsts_h = np.asarray(firsts) if last.any() else None
         out: Dict[int, Optional[int]] = {}
         for j, (slot, prompt, temperature, seed, max_new, _) in \
@@ -933,14 +1151,19 @@ class SlotEngine:
             chunk = np.zeros(pad, np.int32)
             chunk[:clen] = prompt[off:off + clen]
             is_last = off + clen >= len(prompt)
+            ad_tail = () if self.adapters is None else (self.apool,)
             self.state, self.cache, first = self.fns.prefill_extend(
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
-                jnp.asarray(is_last))
+                jnp.asarray(is_last), *ad_tail)
             if self.spec:
+                d_tail = () if self.adapters is None else (
+                    jnp.asarray(self._slot_aid(slot), jnp.int32),
+                    self.apool)
                 self.dcache = self.fns.draft_extend(
                     self.dcache, jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(chunk), jnp.asarray(clen, jnp.int32))
+                    jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
+                    *d_tail)
             self.pos[slot] += clen
             if self.alloc is not None:
                 # prompt blocks now fully written become shareable
@@ -990,9 +1213,10 @@ class SlotEngine:
         headroom = int((self.max_len - self.pos[dec]).min())
         k = _pow2_floor(min(cap, int(remaining.min()), headroom))
         pos0 = self.pos[dec].copy()  # dispatch-start cursors (accounting)
+        ad_tail = () if self.adapters is None else (self.apool,)
         t0 = time.perf_counter()
         self.state, self.cache, blocks = self.fns.decode_block(
-            self.state, self.cache, k)
+            self.state, self.cache, k, *ad_tail)
         t1 = time.perf_counter()
         arr = np.asarray(blocks)  # ONE host sync for K×num_slots tokens
         t2 = time.perf_counter()
@@ -1076,14 +1300,15 @@ class SlotEngine:
         rem = np.zeros(self.num_slots, np.int32)
         rem[dec] = remaining
         pos0 = self.pos[dec].copy()  # dispatch-start cursors (accounting)
+        ad_tail = () if self.adapters is None else (self.apool,)
         t0 = time.perf_counter()
         self.dcache, drafts, dlogits = self.fns.draft_propose(
-            self.state, self.dcache, k)
+            self.state, self.dcache, k, *ad_tail)
         jax.block_until_ready(drafts)
         t1 = time.perf_counter()
         self.state, self.cache, self.dcache, packed = self.fns.spec_verify(
             self.state, self.cache, self.dcache, drafts, dlogits,
-            jnp.asarray(self.spec_on), jnp.asarray(rem))
+            jnp.asarray(self.spec_on), jnp.asarray(rem), *ad_tail)
         t2 = time.perf_counter()
         pk = np.asarray(packed)  # ONE host sync: counts + token block
         t3 = time.perf_counter()
@@ -1144,8 +1369,10 @@ class SlotEngine:
             toks = np.zeros((k, self.num_slots), np.int32)
             for s, ts in blocks.items():
                 toks[:, s] = ts
+            ad_tail = () if self.adapters is None else (self.apool,)
             self.dcache = self.fns.draft_track(
-                self.state, self.dcache, prev_last, jnp.asarray(toks))
+                self.state, self.dcache, prev_last, jnp.asarray(toks),
+                *ad_tail)
         if info is not None:
             info = {**info, "spec": False}
         return info, blocks
@@ -1193,6 +1420,7 @@ class SlotEngine:
             if self.spec:
                 self.dcache = self.fns.draft_evict(
                     self.dcache, jnp.asarray(slot, jnp.int32))
+        self._release_adapter(slot)
         self.occupied[slot] = False
         self.decoding[slot] = False
         self.pos[slot] = 0

@@ -80,6 +80,14 @@ FINISH_REASONS = {
                        "from the host KV tier without recompute (the "
                        "multi-turn no-recompute path; eos/deadline "
                        "still win when they fire first)",
+    "adapter_missing": "named a per-tenant adapter no longer resident in "
+                       "the pool when its lane had to (re-)bind — a "
+                       "raced unload between admission and placement, or "
+                       "a handoff/host-tier re-bind onto a pool that "
+                       "never loaded the name (submit-time misses reject "
+                       "with the same reason instead; the engine NEVER "
+                       "silently serves base-model output for an "
+                       "adapter request)",
 }
 
 
@@ -87,7 +95,8 @@ class AdmissionError(RuntimeError):
     """A request the scheduler refused; ``reason`` is machine-readable
     (``queue_full``, ``draining``, ``budget_exceeded: ...``,
     ``empty_prompt``, ``kv_exhausted: ...`` — a paged-KV footprint no
-    empty pool could ever hold)."""
+    empty pool could ever hold —, ``adapter_missing`` — the named
+    per-tenant adapter is not loaded in the pool)."""
 
     def __init__(self, reason: str):
         super().__init__(f"request rejected: {reason}")
@@ -131,6 +140,14 @@ class Request:
     #: context token-for-token) resumes it without recompute.  None =
     #: stateless request, never parked.
     session: Optional[str] = None
+    #: per-tenant adapter NAME (tpudist.serve.adapters): the request
+    #: decodes through ``base(x) + gather(B)·gather(A)·x`` with this
+    #: adapter's rank-r factors gathered per slot from the paged
+    #: adapter pool.  None = base model (the bit-exact base-only
+    #: path).  Admission rejects ``adapter_missing`` when the name is
+    #: not loaded; a lane that must re-bind on another pool (handoff /
+    #: host-tier resume) carries the name in its package.
+    adapter: Optional[str] = None
 
 
 class RequestHandle:
@@ -248,7 +265,8 @@ class Scheduler:
                  check_budget: Callable[[int, int], Optional[str]],
                  default_max_new: int = 64,
                  default_deadline_s: Optional[float] = None,
-                 prefix_hasher: Optional[Callable] = None):
+                 prefix_hasher: Optional[Callable] = None,
+                 check_adapter: Optional[Callable] = None):
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         self.queue_limit = queue_limit
@@ -259,6 +277,11 @@ class Scheduler:
         #: server passes ``paged_alloc.hash_chain`` at its block size;
         #: None stamps an empty chain — no sharing, no hashing cost)
         self.prefix_hasher = prefix_hasher
+        #: adapter-name admission gate (the serving layer passes the
+        #: engine's ``has_adapter``): ``name -> Optional[reason]`` — a
+        #: request naming an unloaded adapter rejects ``adapter_missing``
+        #: NOW instead of occupying queue+slot just to fail binding
+        self.check_adapter = check_adapter
         self._q: "collections.deque[RequestHandle]" = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -279,11 +302,14 @@ class Scheduler:
                on_token: Optional[Callable[[int, int], None]] = None,
                spec: Optional[bool] = None, tenant: Optional[str] = None,
                priority: int = 0, session: Optional[str] = None,
+               adapter: Optional[str] = None,
                ) -> RequestHandle:
         """Admit a request or raise :class:`AdmissionError` (backpressure
         is synchronous — the caller learns NOW, not after a timeout).
         ``priority`` orders the queue (FIFO within a class; higher wins);
-        ``session`` keys the host-tier multi-turn resume."""
+        ``session`` keys the host-tier multi-turn resume; ``adapter``
+        names the per-tenant LoRA adapter the lane decodes through
+        (must be loaded — else ``adapter_missing``)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # Deadline convention matches TPUDIST_SERVE_DEADLINE_S: ``None``
         # inherits the server default, ``<= 0`` means explicitly NO
@@ -320,6 +346,7 @@ class Scheduler:
             tenant=None if tenant is None else str(tenant),
             priority=int(priority),
             session=None if session is None else str(session),
+            adapter=None if adapter is None else str(adapter),
         )
         with self._lock:
             reason = self._refuse_reason
@@ -327,6 +354,9 @@ class Scheduler:
                 reason = "queue_full"
             if reason is None:
                 reason = self.check_budget(len(prompt), req.max_new)
+            if reason is None and req.adapter is not None \
+                    and self.check_adapter is not None:
+                reason = self.check_adapter(req.adapter)
             if reason is None and self.admission_gate is not None:
                 # the overload controller's reject-with-reason gate
                 # (SLO-aware shedding, per-tenant fair share) — cheap

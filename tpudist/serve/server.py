@@ -121,6 +121,12 @@ class ServeConfig:
     # this multiple of its equal share once the queue is half full
     # (0 = off)
     fair_share: float = 0.0
+    # -- per-tenant adapters (tpudist/serve/adapters.py) -------------------
+    # paged multi-LoRA pool: per-request `adapter=` names decode through
+    # base(x) + gather(B)·gather(A)·x, zero recompilation under churn
+    adapters: bool = False
+    adapter_blocks: int = 8  # resident-adapter capacity (one block each)
+    adapter_rank: int = 8  # LoRA rank r shared by the pool
     # -- speculative decoding (draft-propose / batched target-verify) ------
     spec: bool = False  # draft proposes K, target verifies in one pass
     spec_k: int = 4  # drafted tokens per speculative block
@@ -198,6 +204,9 @@ class ServeConfig:
             shed_priority=env_int("TPUDIST_SERVE_SHED_PRIORITY", 1),
             fair_share=env_positive_float(
                 "TPUDIST_SERVE_FAIR_SHARE", None) or 0.0,
+            adapters=env_flag("TPUDIST_SERVE_ADAPTERS", False),
+            adapter_blocks=env_int("TPUDIST_SERVE_ADAPTER_BLOCKS", 8) or 8,
+            adapter_rank=env_int("TPUDIST_SERVE_ADAPTER_RANK", 8) or 8,
             spec=env_flag("TPUDIST_SERVE_SPEC", False),
             spec_k=env_int("TPUDIST_SERVE_SPEC_K", 4) or 4,
             spec_draft_layers=env_int(
@@ -477,6 +486,92 @@ class _Observability:
     def _note_finished(self, h) -> None:  # per-flavor
         raise NotImplementedError
 
+    # -- per-tenant adapters (tpudist.serve.adapters) ------------------------
+    # Shared by both server flavors: one load/unload surface, one event
+    # vocabulary (adapter_load / adapter_evict feed the live gauges and
+    # the serving report's `adapters` section).
+
+    def _adapter_engines(self) -> list:  # per-flavor
+        raise NotImplementedError
+
+    def load_adapter(self, name: str, factors) -> dict:
+        """Load ``factors`` under ``name`` into EVERY pool engine (a
+        disagg server broadcasts — prefill writes the adapted KV the
+        decode pool continues from, and a handoff re-bind must find the
+        name on the destination).  ALL-OR-NOTHING: a failure on any
+        engine (pool full there) unloads the name from the ones already
+        loaded — divergent residency would admit requests (the gate
+        consults one engine) that then die ``adapter_missing`` at the
+        other pool forever.  Emits one ``adapter_load`` (+ one
+        ``adapter_evict`` per FIRST-engine LRU victim — the broadcast
+        keeps the pools' load/unload sequences in lockstep, so their
+        LRU lines match; per-engine events would inflate the counters
+        by the engine count)."""
+        from tpudist import telemetry
+
+        engines = self._adapter_engines()
+        if not engines or engines[0].adapters is None:
+            raise RuntimeError(
+                "server built without adapters (ServeConfig.adapters / "
+                "TPUDIST_SERVE_ADAPTERS)")
+        info = {}
+        loaded = []
+        try:
+            for i, eng in enumerate(engines):
+                ei = eng.load_adapter(name, factors)
+                loaded.append(eng)
+                if i == 0:
+                    info = ei
+        except BaseException:
+            for eng in loaded:
+                eng.unload_adapter(name)
+            raise
+        if info.get("evicted"):
+            telemetry.event("adapter_evict", adapter=info["evicted"],
+                            evict_kind="lru", resident=info["resident"])
+        telemetry.event("adapter_load", adapter=name,
+                        block=info.get("block"),
+                        resident=info.get("resident"))
+        return info
+
+    def unload_adapter(self, name: str) -> dict:
+        """Unload ``name`` from every pool engine: frees now when no
+        lane holds it, else defers (new requests already reject
+        ``adapter_missing``).  Emits ``adapter_evict``."""
+        from tpudist import telemetry
+
+        engines = self._adapter_engines()
+        if not engines or engines[0].adapters is None:
+            raise RuntimeError(
+                "server built without adapters (ServeConfig.adapters / "
+                "TPUDIST_SERVE_ADAPTERS)")
+        info = {}
+        for eng in engines:
+            info = eng.unload_adapter(name)
+        if info.get("known"):
+            telemetry.event("adapter_evict", adapter=name,
+                            evict_kind="unload",
+                            freed=bool(info.get("freed")),
+                            resident=info.get("resident"))
+        return info
+
+    def _stamp_adapter_config(self) -> None:
+        """One ``serve_adapters_config`` event at server start (like
+        ``serve_kv_config``): the static pool geometry the aggregator
+        pairs with the load/evict stream."""
+        engines = self._adapter_engines()
+        if not engines or engines[0].adapters is None:
+            return
+        from tpudist import telemetry
+
+        st = engines[0].adapter_stats()
+        # "rank" is a RESERVED telemetry key (process rank) — the LoRA
+        # rank travels as lora_rank
+        telemetry.event("serve_adapters_config",
+                        blocks=st["blocks_total"], lora_rank=st["rank"],
+                        block_bytes=st["block_bytes"],
+                        pool_bytes=st["pool_bytes"])
+
 
 class InferenceServer(_Observability):
     """Continuous-batching server over a ``TransformerLM`` decode path.
@@ -503,7 +598,10 @@ class InferenceServer(_Observability):
             attn_kernel=self.config.attn_kernel,
             mesh=self.config.mesh_config(),
             spec_draft=self.config.resolve_spec_draft(module),
-            spec_k=self.config.spec_k)
+            spec_k=self.config.spec_k,
+            adapters=self.config.adapters,
+            adapter_blocks=self.config.adapter_blocks,
+            adapter_rank=self.config.adapter_rank)
         hasher = None
         if self.config.paged and self.config.prefix_cache_blocks > 0:
             from tpudist.serve.paged_alloc import hash_chain
@@ -515,7 +613,10 @@ class InferenceServer(_Observability):
             check_budget=self.engine.check_budget,
             default_max_new=self.config.max_new,
             default_deadline_s=self.config.deadline_s,
-            prefix_hasher=hasher)
+            prefix_hasher=hasher,
+            check_adapter=lambda name: (
+                None if self.engine.has_adapter(name)
+                else "adapter_missing"))
         self._install_signal = install_signal_handler
         self._installed_preemption = False
         self._thread: Optional[threading.Thread] = None
@@ -563,6 +664,7 @@ class InferenceServer(_Observability):
             block_size=kv["block_size"], blocks_total=kv["blocks_total"],
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)
+        self._stamp_adapter_config()
         self._start_observability()
         if self._install_signal:
             # SIGTERM → drain: the same preemption flag the training loop
@@ -581,6 +683,7 @@ class InferenceServer(_Observability):
                on_token: Optional[Callable[[int, int], None]] = None,
                spec: Optional[bool] = None, tenant: Optional[str] = None,
                priority: int = 0, session: Optional[str] = None,
+               adapter: Optional[str] = None,
                ) -> RequestHandle:
         """Thread-safe ingestion; raises :class:`AdmissionError` on
         backpressure/budget rejection (reason stamped into telemetry).
@@ -591,7 +694,9 @@ class InferenceServer(_Observability):
         orders the queue and (host tier on) can preempt a lower class's
         decode lane; ``session`` keys the host-tier multi-turn resume —
         a prompt extending a parked session's context token-for-token
-        re-imports its KV instead of re-prefilling it."""
+        re-imports its KV instead of re-prefilling it.  ``adapter``
+        names the per-tenant LoRA the lane decodes through (must be
+        loaded via :meth:`load_adapter`; else ``adapter_missing``)."""
         from tpudist import telemetry
 
         # count the in-flight BEFORE the handle becomes visible to the
@@ -605,7 +710,7 @@ class InferenceServer(_Observability):
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
                 on_token=on_token, spec=spec, tenant=tenant,
-                priority=priority, session=session)
+                priority=priority, session=session, adapter=adapter)
         except BaseException as e:
             # never admitted — ANY failure (bad prompt included, not
             # just AdmissionError) must give the +1 back or the tenant
@@ -647,6 +752,9 @@ class InferenceServer(_Observability):
             self._installed_preemption = False
         return ok
 
+    def _adapter_engines(self) -> list:
+        return [self.engine]
+
     def _observability_gauges(self) -> Dict[str, float]:
         kv = self.engine.kv_stats()
         return {
@@ -685,6 +793,9 @@ class InferenceServer(_Observability):
             "completed": self.completed,
             "tokens_out": self.tokens_out,
             "tenants_in_flight": dict(self._tenant_inflight),
+            # per-tenant adapter pool (absent when off)
+            **({"adapters": self.engine.adapter_stats()}
+               if self.engine.adapters is not None else {}),
             # host-tier occupancy + overload state (None-free when off)
             **({"host_tier": {**self._tier.stats(),
                               "parked_requests": len(self._parked),
@@ -715,6 +826,7 @@ class InferenceServer(_Observability):
             "spec": self.engine.spec_stats(),
             "kv": self.engine.kv_stats(),
             "spmd": self.engine.spmd_stats(),
+            "adapters": self.engine.adapter_stats(),
             "preemptions": self.preemptions,
             "parked": len(self._parked),
             "host_tier": (None if self._tier is None
@@ -870,6 +982,12 @@ class InferenceServer(_Observability):
                 for h in batch:
                     if h.done:  # finished in-queue (deadline expired)
                         self._note_finished(h)
+                    elif not eng.has_adapter(h.request.adapter):
+                        # admitted, but the named adapter was unloaded
+                        # while it queued — finish loudly, never serve
+                        # base-model output for an adapter request
+                        h._finish("adapter_missing")
+                        self._note_finished(h)
                     else:
                         alive.append(h)
                 if alive:
@@ -887,16 +1005,40 @@ class InferenceServer(_Observability):
                             continue
                         fresh.append((h, slot))
                     if fresh:
+                        from tpudist.serve.adapters import \
+                            AdapterMissingError
+
                         for h, slot in fresh:
                             items.append((slot, h.request.prompt,
                                           h.request.temperature,
                                           h.request.seed,
                                           h.request.max_new,
                                           h.request.prefix_hashes,
-                                          h.request.spec))
+                                          h.request.spec,
+                                          h.request.adapter))
                             self._slot_handles[slot] = h
-                        with telemetry.span("prefill", n=len(items)):
-                            firsts = eng.start_batch(items)
+                        firsts = {}
+                        while items:
+                            try:
+                                with telemetry.span("prefill",
+                                                    n=len(items)):
+                                    firsts = eng.start_batch(items)
+                                break
+                            except AdapterMissingError as e:
+                                # a user thread unloaded the adapter
+                                # between the admission recheck and the
+                                # dispatch (whole-batch validation, so
+                                # nothing mutated): finish ITS requests
+                                # loudly, admit the rest
+                                keep = []
+                                for it in items:
+                                    if it[7] == e.adapter:
+                                        h2 = self._slot_handles.pop(it[0])
+                                        h2._finish("adapter_missing")
+                                        self._note_finished(h2)
+                                    else:
+                                        keep.append(it)
+                                items = keep
                         for slot, tok in firsts.items():
                             if tok is not None:
                                 self._deliver_block(slot, [tok])
@@ -1031,10 +1173,21 @@ class InferenceServer(_Observability):
             self._tier_event("host_tier_corrupt", kind="session",
                              error=str(e)[:120], trace_id=h.trace_id)
             return False
+        if raw.get("adapter") != req.adapter:
+            # the parked KV was written THROUGH its turn's adapter; a
+            # turn binding a different adapter (or none) must re-prefill
+            # — resuming would continue from the wrong fine-tune's cache
+            return False
         t0 = time.monotonic()
-        self.engine.resume_slot(
-            slot, raw, req.prompt, temperature=req.temperature,
-            seed=req.seed, max_new=req.max_new, spec=req.spec)
+        from tpudist.serve.adapters import AdapterMissingError
+
+        try:
+            self.engine.resume_slot(
+                slot, raw, req.prompt, temperature=req.temperature,
+                seed=req.seed, max_new=req.max_new, spec=req.spec)
+        except AdapterMissingError:
+            return False  # unloaded mid-iteration: the caller's fresh
+            # prefill then finishes adapter_missing via the same race
         h.resumed = True
         self._slot_handles[slot] = h
         self.tier_resumes += 1
@@ -1132,7 +1285,17 @@ class InferenceServer(_Observability):
                 self._requeue.append(h)
                 continue
             slot = free[0]
-            eng.import_slot(slot, raw, spec=h.request.spec)
+            from tpudist.serve.adapters import AdapterMissingError
+
+            try:
+                eng.import_slot(slot, raw, spec=h.request.spec)
+            except AdapterMissingError:
+                # the adapter was unloaded while the lane sat parked —
+                # its KV is the fine-tune's, a base re-prefill would be
+                # wrong bytes: finish loudly instead
+                h._finish("adapter_missing")
+                self._note_finished(h)
+                continue
             self._slot_handles[slot] = h
             self.tier_resumes += 1
             self._tier_event("session_resumed", park_kind="preempt",
@@ -1154,7 +1317,8 @@ class InferenceServer(_Observability):
             prompt_len=int(len(h.request.prompt)), tokens_out=len(h.tokens),
             ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s,
             trace_id=h.trace_id,
-            **({"tenant": h.request.tenant} if h.request.tenant else {}))
+            **({"tenant": h.request.tenant} if h.request.tenant else {}),
+            **({"adapter": h.request.adapter} if h.request.adapter else {}))
         # per-request lifeline spans (req_queue/req_prefill/req_decode)
         # for the cross-pool trace join + Chrome export
         trace.emit_request_lifeline(h)

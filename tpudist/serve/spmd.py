@@ -216,6 +216,31 @@ def serve_paged_sharding(mesh, pkv):
         table=repl, meta=jax.tree.map(lambda _: repl, pkv.meta))
 
 
+def serve_adapter_sharding(mesh, apool):
+    """Sharding pytree for an :class:`tpudist.models.lora.AdapterPool`:
+    the B factors whose OUTPUT dim aligns with a column-parallel kernel
+    (``b_qkv`` with ``qkv``, ``b_wi`` with ``wi``) shard that dim over
+    ``model`` where it divides — the same byte-identity-safe column
+    rule as :func:`serve_param_sharding` (slices and gathers only,
+    never a split contraction).  The tiny A factors (rank-r outputs)
+    and ``b_wo`` (output feeds the replicated residual, like ``proj``)
+    replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+
+    def col(leaf):
+        axis = _axis_or_none(mesh, AXIS_MODEL, leaf.shape[-1])
+        if axis is None:
+            return repl
+        return NamedSharding(mesh, P(None, None, None, axis))
+
+    return type(apool)(
+        a_qkv=repl, b_qkv=col(apool.b_qkv),
+        a_wi=repl, b_wi=col(apool.b_wi),
+        a_wo=repl, b_wo=repl)
+
+
 def serve_state_sharding(mesh, state):
     """SlotState replicates everywhere: it is tiny (a handful of [S]
     vectors) and the host's admission/budget logic must read it the same

@@ -83,6 +83,7 @@ _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
                     "serve_disagg_config", "restart_exhausted",
                     "world_resized", "worker_lost", "lane_recovered",
                     "handoff_rejected", "pool_resize",
+                    "adapter_load", "adapter_evict",
                     "telemetry_dropped")
 
 
@@ -322,10 +323,33 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     tier_bytes_peak = 0
     preempted_events, shed_flips = 0, 0
     shed_last: Optional[dict] = None
+    # per-tenant adapters (tpudist.serve.adapters): pool geometry stamp,
+    # load/evict churn, peak residency — absent entirely from old
+    # streams, so the section below is purely additive
+    ad_config: Optional[dict] = None
+    ad_loads, ad_evicts = 0, 0
+    ad_evict_kinds: Dict[str, int] = {}
+    ad_resident_peak = 0
     for r in records:
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_kv_config"):
             kv_config = r  # last one wins (restart/regeneration)
+            continue
+        if (r.get("kind") == "event"
+                and r.get("name") == "serve_adapters_config"):
+            ad_config = r
+            continue
+        if r.get("kind") == "event" \
+                and r.get("name") in ("adapter_load", "adapter_evict"):
+            if r.get("name") == "adapter_load":
+                ad_loads += 1
+            else:
+                ad_evicts += 1
+                k = str(r.get("evict_kind", "?"))
+                ad_evict_kinds[k] = ad_evict_kinds.get(k, 0) + 1
+            if isinstance(r.get("resident"), (int, float)):
+                ad_resident_peak = max(ad_resident_peak,
+                                       int(r["resident"]))
             continue
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_disagg_config"):
@@ -494,6 +518,32 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
                                      else None),
             **({"host_tier": host_tier} if host_tier is not None else {}),
         }
+    adapters: Optional[dict] = None
+    if ad_config is not None or ad_loads or ad_evicts \
+            or any(r.get("adapter") for r in fins):
+        by_adapter: Dict[str, int] = {}
+        for r in fins:
+            a = r.get("adapter")
+            if isinstance(a, str) and a:
+                by_adapter[a] = by_adapter.get(a, 0) + 1
+        adapters = {
+            **({"blocks": ad_config.get("blocks"),
+                # "rank" is reserved on the wire (process rank); the
+                # LoRA rank rides as lora_rank
+                "rank": ad_config.get("lora_rank"),
+                "block_bytes": ad_config.get("block_bytes"),
+                "pool_bytes": ad_config.get("pool_bytes")}
+               if ad_config is not None else {}),
+            "loads": ad_loads,
+            "evicts": ad_evicts,
+            **({"evict_kinds": ad_evict_kinds} if ad_evict_kinds else {}),
+            "resident_peak": ad_resident_peak or None,
+            # per-adapter served-request split (the multi-tenant story:
+            # which fine-tunes the traffic actually hit)
+            "requests": by_adapter,
+            "base_only_requests": len(fins) - sum(by_adapter.values()),
+            "missing_finished": reasons.get("adapter_missing", 0),
+        }
     spec: Optional[dict] = None
     if spec_blocks:
         pp = sorted(spec_per_pass)
@@ -571,6 +621,7 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         "occupancy_mean": round(occ_w / occ_dur, 4) if occ_dur > 0 else None,
         "occupancy_max": round(occ_max, 4) if occ_dur > 0 else None,
         **({"kv": kv} if kv is not None else {}),
+        **({"adapters": adapters} if adapters is not None else {}),
         **({"spec": spec} if spec is not None else {}),
         **({"pools": pools} if pools is not None else {}),
         **({"overload": overload} if overload is not None else {}),
@@ -777,6 +828,23 @@ def render_markdown(report: dict) -> str:
                     bits.append(f"{t}: {row['attainment'] * 100:.1f}% "
                                 f"({row['requests']} reqs)")
             lines.append("- SLO: " + "; ".join(bits))
+        if sv.get("adapters"):
+            ad = sv["adapters"]
+            bits = []
+            if ad.get("blocks") is not None:
+                bits.append(f"pool {ad['blocks']} blocks × rank "
+                            f"{ad['rank']}")
+            bits.append(f"{ad['loads']} loads / {ad['evicts']} evicts")
+            if ad.get("resident_peak"):
+                bits.append(f"peak resident {ad['resident_peak']}")
+            if ad.get("requests"):
+                served = ", ".join(f"{n}: {c}" for n, c in
+                                   sorted(ad["requests"].items()))
+                bits.append(f"requests by adapter ({served}; base "
+                            f"{ad['base_only_requests']})")
+            if ad.get("missing_finished"):
+                bits.append(f"{ad['missing_finished']} adapter_missing")
+            lines.append("- adapters: " + "; ".join(bits))
         if sv.get("spec"):
             sp = sv["spec"]
             app = sp.get("accepted_per_pass") or {}

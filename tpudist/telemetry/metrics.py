@@ -279,6 +279,7 @@ class MetricsRegistry:
         with self._lock:
             self._metrics = {}
         _TENANTS_SEEN.clear()
+        _ADAPTERS_SEEN.clear()
 
     # -- readers (wait-free) ------------------------------------------------
 
@@ -399,6 +400,23 @@ def _tenant_label(rec: dict) -> Dict[str, str]:
     return {"tenant": t}
 
 
+#: Distinct-adapter label bound (the TENANT_LABEL_CAP rule applied to
+#: adapter names): resident adapters are bounded by the pool, but the
+#: set of names EVER loaded is not — overflow pools under "other".
+ADAPTER_LABEL_CAP = 64
+_ADAPTERS_SEEN: set = set()
+
+
+def _adapter_label(rec: dict) -> Dict[str, str]:
+    a = rec.get("adapter")
+    a = a if isinstance(a, str) and a else "?"
+    if a not in _ADAPTERS_SEEN:
+        if len(_ADAPTERS_SEEN) >= ADAPTER_LABEL_CAP:
+            return {"adapter": "other"}
+        _ADAPTERS_SEEN.add(a)
+    return {"adapter": a}
+
+
 def _num(v) -> Optional[float]:
     return float(v) if isinstance(v, (int, float)) else None
 
@@ -485,6 +503,25 @@ def feed_record(rec: dict) -> None:
         imp = rec.get("import_s")
         if isinstance(imp, (int, float)):
             r.histogram("tpudist_handoff_import_seconds").observe(float(imp))
+    elif name in ("adapter_load", "adapter_evict"):
+        # per-tenant adapter pool (tpudist.serve.adapters): load/evict
+        # counters, a per-adapter residency gauge, and the total-
+        # resident gauge riding ON the events.  The per-adapter label
+        # is CAPPED like tenants: only the pool's RESIDENT set is
+        # bounded — a long-lived server churning thousands of names
+        # through load→evict would otherwise grow one dead 0-gauge per
+        # historical name without limit
+        alab = _adapter_label(rec)
+        if name == "adapter_load":
+            r.counter("tpudist_adapter_loads_total").inc()
+            r.gauge("tpudist_adapter_resident", **alab).set(1.0)
+        else:
+            r.counter("tpudist_adapter_evicts_total",
+                      kind=str(rec.get("evict_kind", "?"))).inc()
+            r.gauge("tpudist_adapter_resident", **alab).set(0.0)
+        v = rec.get("resident")
+        if isinstance(v, (int, float)):
+            r.gauge("tpudist_serve_adapters_resident").set(float(v))
     elif name == "worker_lost":
         r.counter("tpudist_workers_lost_total", **_pool_label(rec)).inc()
     elif name == "lane_recovered":

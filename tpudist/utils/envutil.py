@@ -152,6 +152,15 @@ ENV_VARS = {
         "per-tenant token-rate fairness multiplier — reject a tenant "
         "above this multiple of its equal share once the queue is half "
         "full (0/unset = off)",
+    # per-tenant adapters (serve/adapters.py + models/lora.py)
+    "TPUDIST_SERVE_ADAPTERS":
+        "per-tenant adapters: paged multi-LoRA factor pool + per-slot "
+        "adapter ids, batched gathered decode (default off)",
+    "TPUDIST_SERVE_ADAPTER_BLOCKS":
+        "adapter-pool capacity in blocks — one resident adapter each "
+        "(default 8; LRU-evicts cold adapters on load)",
+    "TPUDIST_SERVE_ADAPTER_RANK":
+        "LoRA rank r shared by every adapter in the pool (default 8)",
     "TPUDIST_SERVE_SPEC":
         "speculative decoding: draft proposes K, target verifies in one pass",
     "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
