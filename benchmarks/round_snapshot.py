@@ -219,6 +219,24 @@ def main(argv=None) -> None:
             {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
         print(f"{adapter_out.name}: error {e!r}")
 
+    # Fleet-router rung (PR 16): affinity vs round-robin on resume-TTFT
+    # and prefix-cache hit rate, plus the replica-kill migration
+    # booleans — frozen as BENCH_ROUTER_r{NN}.json.  Failure-isolated
+    # like the serve snapshot.
+    router_out = REPO / f"BENCH_ROUTER_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "router_bench.py"),
+             "--smoke", "--out", str(router_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{router_out.name}: {json.dumps(data[-1])}")
+    except Exception as e:
+        router_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{router_out.name}: error {e!r}")
+
     # Decode per-op attribution (VERDICT Weak #2): trace the bf16 fused
     # decode loop and freeze the table naming the non-matmul residual.
     # Failure-isolated like the serve snapshot.

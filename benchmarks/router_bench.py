@@ -1,0 +1,364 @@
+#!/usr/bin/env python3
+"""Fleet-router bench: affinity routing vs round-robin, plus the
+replica-death migration rung, frozen per round as
+``BENCH_ROUTER_r{NN}.json``.
+
+Two rungs, CPU-safe (tiny model; absolute times are interpreter
+mechanics — the TWIN DELTAS and the booleans are the measurements):
+
+- **router_affinity_twin** — the same deterministic workload (S
+  sessions x T turns at odd S, plus G sessionless prefix groups of M
+  members sharing a 16-token base) served through a 2-replica fleet
+  twice: ``policy="affinity"`` vs ``policy="rr"``.  Session affinity
+  keeps every later turn on the replica holding its parked KV
+  (teacher-forcing only the new suffix); round-robin ping-pongs the
+  session, paying a fresh or stale-prefix prefill of the whole grown
+  context.  Prefix affinity rendezvous-hashes same-base requests onto
+  the same replica so its paged prefix cache actually hits; rr
+  scatters the group.  Quotes later-turn resume-TTFT and the fleet
+  prefix-hit block rate per arm, and asserts both arms' outputs are
+  byte-equal — routing is a latency lever, never a numerics one.
+
+- **router_failover** — sessions homed across the fleet, stash
+  populated, then ``TPUDIST_FAULT=replica_kill@nth:V`` kills the
+  majority replica from the router's own tick thread.  Quotes the
+  probe-detection latency, the migration count, and whether every
+  session's next turn still RESUMED (the stash adoption landed the
+  parked KV on the survivor) — fleet keeps serving, nothing finishes
+  ``replica_lost``.
+
+Usage: ``python benchmarks/router_bench.py [--smoke] [--out PATH]``
+(round_snapshot.py freezes it per round; the tier-1 smoke test asserts
+the rung fields).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CFG = dict(vocab=32, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+           max_len=64)
+
+
+def _model(seed: int = 0):
+    import jax
+
+    from tpudist.models import create_transformer
+
+    return create_transformer(jax.random.PRNGKey(seed), seq_len=16, **CFG)
+
+
+def _mean(vals):
+    vals = [v for v in vals if v is not None]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _p50(vals):
+    vals = sorted(v for v in vals if v is not None)
+    return vals[len(vals) // 2] if vals else None
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+def _build_fleet(model, *, policy: str, n: int = 2):
+    """N warmed replicas behind a router.  Each replica is warmed
+    DIRECTLY (before the router fronts it) through the same session
+    park/resume cycle the workload uses, so every XLA compile —
+    insert/prefill/decode/evict and export/import — is paid on BOTH
+    replicas regardless of where the policy would route; the twin
+    delta then measures recompute, not first-compile."""
+    import numpy as np
+
+    from tpudist.serve import FleetRouter, InferenceServer, RouterConfig
+    from tpudist.serve import ServeConfig
+
+    cfg = ServeConfig(num_slots=2, max_new=6, prefill_pad=4,
+                      queue_limit=32, paged=True, kv_block=8,
+                      prefix_cache_blocks=16, host_tier=True)
+    reps = [InferenceServer(*model, cfg,
+                            install_signal_handler=False).start()
+            for _ in range(n)]
+    warm = np.arange(12, dtype=np.int32) % CFG["vocab"]
+    for r in reps:
+        w = r.submit(warm[:8], max_new=2, session="warm")
+        assert w.wait(600)
+        w2 = r.submit(np.concatenate([warm[:8],
+                                      np.asarray(w.tokens, np.int32),
+                                      warm[:4]]),
+                      max_new=2, session="warm")
+        assert w2.wait(600)
+    router = FleetRouter(reps, RouterConfig(policy=policy,
+                                            probe_s=0.02)).start()
+    return router, reps
+
+
+def _tier_parks(reps) -> int:
+    return sum(r._tier.parks for r in reps)
+
+
+# ---------------------------------------------------------------------------
+# router_affinity_twin
+
+
+def _run_twin_arm(model, *, policy: str, sessions: int, turns: int,
+                  groups: int, members: int) -> dict:
+    import numpy as np
+
+    router, reps = _build_fleet(model, policy=policy)
+    rng = np.random.default_rng(0)
+    contexts = {s: rng.integers(0, CFG["vocab"], size=24).astype(np.int32)
+                for s in range(sessions)}
+    ttft_by_turn: dict = {t: [] for t in range(turns)}
+    reasons: dict = {}
+    outputs = []
+    try:
+        parks0 = _tier_parks(reps)
+        for t in range(turns):
+            handles = []
+            for s in range(sessions):
+                if t > 0:
+                    new = rng.integers(0, CFG["vocab"],
+                                       size=4).astype(np.int32)
+                    contexts[s] = np.concatenate([contexts[s], new])
+                handles.append(
+                    (s, router.submit(contexts[s], max_new=6,
+                                      session=f"s{s}", tenant="bench")))
+            for s, h in handles:
+                assert h.wait(600), "session turn timed out"
+                ttft_by_turn[t].append(h.ttft_s)
+                reasons[h.finish_reason] = reasons.get(h.finish_reason,
+                                                       0) + 1
+                outputs.append(("s", t, s, list(h.tokens)))
+                contexts[s] = np.concatenate(
+                    [contexts[s], np.asarray(h.tokens, np.int32)])
+            # the next wave's resume needs this wave's parks landed
+            want = parks0 + sessions * (t + 1)
+            assert _wait(lambda: _tier_parks(reps) >= want), \
+                "session parks did not land"
+        # prefix phase: G groups sharing a 16-token base (= the
+        # router-side digest window AND two full KV blocks), distinct
+        # 4-token tails; sequential so a member's cached blocks are
+        # releasable before its siblings arrive.  The hit rate is the
+        # kv-counter DIFF over this phase only.
+        hits0 = sum(reps[i].engine.kv_stats()["prefix_hit_blocks"]
+                    for i in range(len(reps)))
+        miss0 = sum(reps[i].engine.kv_stats()["prefix_miss_blocks"]
+                    for i in range(len(reps)))
+        for g in range(groups):
+            base = rng.integers(0, CFG["vocab"], size=16).astype(np.int32)
+            for m in range(members):
+                tail = rng.integers(0, CFG["vocab"],
+                                    size=4).astype(np.int32)
+                h = router.submit(np.concatenate([base, tail]), max_new=4)
+                assert h.wait(600), "prefix request timed out"
+                outputs.append(("p", g, m, list(h.tokens)))
+        hits = sum(reps[i].engine.kv_stats()["prefix_hit_blocks"]
+                   for i in range(len(reps))) - hits0
+        misses = sum(reps[i].engine.kv_stats()["prefix_miss_blocks"]
+                     for i in range(len(reps))) - miss0
+        stats = router.stats()
+    finally:
+        router.close(60)
+    later = [v for t in range(1, turns) for v in ttft_by_turn[t]]
+    return {
+        "ttft_turn1_s": _mean(ttft_by_turn[0]),
+        "ttft_later_mean_s": _mean(later),
+        "ttft_later_p50_s": _p50(later),
+        "turns_resumed": reasons.get("session_resumed", 0),
+        "finish_reasons": reasons,
+        "prefix_hit_blocks": int(hits),
+        "prefix_miss_blocks": int(misses),
+        "prefix_hit_rate": (hits / (hits + misses)
+                            if (hits + misses) else None),
+        "routes_by_kind": stats["routes_by_kind"],
+        "outputs": outputs,
+    }
+
+
+def run_affinity_twin(sessions: int, turns: int, groups: int,
+                      members: int) -> dict:
+    model = _model()
+    aff = _run_twin_arm(model, policy="affinity", sessions=sessions,
+                        turns=turns, groups=groups, members=members)
+    rr = _run_twin_arm(model, policy="rr", sessions=sessions,
+                       turns=turns, groups=groups, members=members)
+    return {
+        "rung": "router_affinity_twin",
+        "regime": "cpu-smoke",
+        "replicas": 2,
+        "sessions": sessions,
+        "turns": turns,
+        "prefix_groups": groups,
+        "prefix_members": members,
+        "resume_ttft_affinity_s": aff["ttft_later_mean_s"],
+        "resume_ttft_rr_s": rr["ttft_later_mean_s"],
+        "resume_ttft_affinity_p50_s": aff["ttft_later_p50_s"],
+        "resume_ttft_rr_p50_s": rr["ttft_later_p50_s"],
+        "affinity_resume_speedup": (rr["ttft_later_mean_s"]
+                                    / aff["ttft_later_mean_s"]
+                                    if aff["ttft_later_mean_s"] else None),
+        "turns_resumed_affinity": aff["turns_resumed"],
+        "turns_resumed_rr": rr["turns_resumed"],
+        "turns_expected_resumed": sessions * (turns - 1),
+        "prefix_hit_rate_affinity": aff["prefix_hit_rate"],
+        "prefix_hit_rate_rr": rr["prefix_hit_rate"],
+        "routes_by_kind_affinity": aff["routes_by_kind"],
+        "routes_by_kind_rr": rr["routes_by_kind"],
+        "affinity_beats_rr_resume": (
+            aff["ttft_later_mean_s"] is not None
+            and rr["ttft_later_mean_s"] is not None
+            and aff["ttft_later_mean_s"] < rr["ttft_later_mean_s"]),
+        "affinity_beats_rr_prefix": (
+            aff["prefix_hit_rate"] is not None
+            and rr["prefix_hit_rate"] is not None
+            and aff["prefix_hit_rate"] > rr["prefix_hit_rate"]),
+        # the correctness half: identical greedy outputs across arms —
+        # routing must be a latency lever, never a numerics one
+        "outputs_match": aff["outputs"] == rr["outputs"],
+        "finish_reasons_affinity": aff["finish_reasons"],
+        "finish_reasons_rr": rr["finish_reasons"],
+        "note": ("same deterministic workload both arms; CPU absolute "
+                 "TTFT is interpreter mechanics — the affinity/rr "
+                 "deltas are the recompute and cache misses routing "
+                 "avoids"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# router_failover
+
+
+def run_failover(sessions: int) -> dict:
+    import numpy as np
+
+    from tpudist.runtime import faults
+
+    model = _model()
+    router, reps = _build_fleet(model, policy="affinity")
+    rng = np.random.default_rng(3)
+    contexts = {s: rng.integers(0, CFG["vocab"], size=24).astype(np.int32)
+                for s in range(sessions)}
+    try:
+        parks0 = _tier_parks(reps)
+        handles = [(s, router.submit(contexts[s], max_new=6,
+                                     session=f"f{s}", tenant="bench"))
+                   for s in range(sessions)]
+        for s, h in handles:
+            assert h.wait(600)
+            contexts[s] = np.concatenate(
+                [contexts[s], np.asarray(h.tokens, np.int32)])
+        assert _wait(lambda: _tier_parks(reps) >= parks0 + sessions)
+        assert _wait(lambda: router.stats()["stash_entries"] >= sessions), \
+            "router stash did not fill"
+        homes = [router._session_home[("bench", f"f{s}")]
+                 for s in range(sessions)]
+        victim = max(set(homes), key=homes.count)
+        on_victim = homes.count(victim)
+        faults.arm(f"replica_kill@nth:{victim}")
+        t_kill = time.monotonic()
+        assert _wait(lambda: router.stats()["replicas_up"] == 1, 10.0), \
+            "router never detected the killed replica"
+        detect_s = time.monotonic() - t_kill
+        faults.disarm()
+        ttfts, resumed = [], 0
+        for s in range(sessions):
+            new = rng.integers(0, CFG["vocab"], size=4).astype(np.int32)
+            h = router.submit(np.concatenate([contexts[s], new]),
+                              max_new=6, session=f"f{s}", tenant="bench")
+            assert h.wait(600), "post-kill turn timed out"
+            ttfts.append(h.ttft_s)
+            resumed += h.finish_reason == "session_resumed"
+        stats = router.stats()
+    finally:
+        faults.disarm()
+        router.close(60)
+    return {
+        "rung": "router_failover",
+        "regime": "cpu-smoke",
+        "replicas": 2,
+        "sessions": sessions,
+        "sessions_on_victim": on_victim,
+        "detect_latency_s": detect_s,
+        "migrations": stats["migrations"],
+        "replica_deaths": stats["replica_deaths"],
+        "turns_resumed_after_kill": resumed,
+        "all_resumed_after_kill": resumed == sessions,
+        "post_kill_ttft_mean_s": _mean(ttfts),
+        "lost": stats["lost"],
+        "fleet_kept_serving": stats["lost"] == 0,
+        "note": ("replica_kill@nth fires from the router's own tick "
+                 "thread; every session homed on the victim migrates "
+                 "via the stash and its next turn still resumes on the "
+                 "survivor — no request finishes replica_lost"),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI scale (tiny counts; same rung structure)")
+    p.add_argument("--sessions", type=int, default=None,
+                   help="sessions per twin arm (kept ODD so round-robin "
+                        "actually ping-pongs each session every turn)")
+    p.add_argument("--turns", type=int, default=None)
+    p.add_argument("--groups", type=int, default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    sessions = args.sessions or (5 if args.smoke else 9)
+    if sessions % 2 == 0:
+        sessions += 1  # odd, so rr flips every session's replica per turn
+    turns = args.turns or (3 if args.smoke else 4)
+    groups = args.groups or (3 if args.smoke else 6)
+    members = 3 if args.smoke else 4
+
+    # hermetic in-process (the tier-1 smoke test calls main() directly):
+    # silence the post-hoc stream unless the caller routed it somewhere
+    saved_tel = os.environ.get("TPUDIST_TELEMETRY")
+    if "TPUDIST_TELEMETRY_DIR" not in os.environ:
+        os.environ["TPUDIST_TELEMETRY"] = "0"
+    rows = []
+    try:
+        rows.append(run_affinity_twin(sessions, turns, groups, members))
+        print(json.dumps(rows[-1]))
+        rows.append(run_failover(4 if args.smoke else 6))
+        print(json.dumps(rows[-1]))
+    finally:
+        if saved_tel is None:
+            os.environ.pop("TPUDIST_TELEMETRY", None)
+        else:
+            os.environ["TPUDIST_TELEMETRY"] = saved_tel
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        with out.open("w") as f:
+            for r in rows:
+                # the artifact drops the per-token output dump (it is
+                # only for the cross-arm equality check)
+                slim = {k: v for k, v in r.items() if k != "outputs"}
+                f.write(json.dumps(slim) + "\n")
+        print(json.dumps({"wrote": str(out)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

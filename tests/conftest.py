@@ -126,6 +126,16 @@ _SLOW_PATTERNS = (
     "TestAdapterDisaggTier",
     "TestAdapterOracle::test_sampled_streams_layout_independent",
     "TestAdapterHandoffUnit::test_export_import_rebinds_by_name",
+    # fleet-router heavies: the twin-arm bench smoke (two 2-replica
+    # fleets per arm), the sampled chaos-kill twin, the stash-off
+    # degrade drive, and the live drain migration (the routing/probe/
+    # spill units, the routed byte-identity reference, the greedy
+    # chaos kill + corrupt-stash degrade, and the whole-fleet death
+    # drive stay default in test_router.py)
+    "TestRouterBench",
+    "test_mid_serve_kill_rehomes_byte_identical[sampled]",
+    "TestReplicaDeathChaos::test_missing_stash",
+    "TestRoutedServing::test_drain_replica_migrates_sessions_live",
     # serve_bench mesh/disagg/multiproc smokes + the decode trace
     # capture (each builds servers / spawns tpurun workers)
     "TestServeBench::test_smoke_mesh_rung",

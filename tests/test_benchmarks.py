@@ -701,6 +701,41 @@ class TestSessionBench:
         assert pt["gold_ttft_preempt_s"] < pt["gold_ttft_wait_s"]
 
 
+class TestRouterBench:
+    def test_rungs_freeze_fleet_fields(self, tmp_path):
+        """The fleet-router rung's contract: on the same deterministic
+        workload, affinity routing beats round-robin on later-turn
+        resume-TTFT (session stickiness keeps the no-recompute path)
+        and on prefix-cache hit rate (rendezvous keeps same-base
+        requests on one replica's cache) with byte-equal outputs; and
+        a mid-fleet replica kill migrates every victim-homed session
+        via the stash — the next turn still resumes, nothing finishes
+        replica_lost."""
+        import json as _json
+
+        from benchmarks.router_bench import main
+
+        out = tmp_path / "BENCH_ROUTER.json"
+        rc = main(["--smoke", "--out", str(out)])
+        assert rc == 0
+        rows = {_json.loads(line)["rung"]: _json.loads(line)
+                for line in out.read_text().splitlines()}
+        assert set(rows) == {"router_affinity_twin", "router_failover"}
+        tw = rows["router_affinity_twin"]
+        assert tw["affinity_beats_rr_resume"]
+        assert tw["affinity_beats_rr_prefix"]
+        assert tw["outputs_match"]
+        # every later turn rode the no-recompute path under affinity;
+        # round-robin ping-pongs (odd session count) and loses some
+        assert tw["turns_resumed_affinity"] == tw["turns_expected_resumed"]
+        assert tw["turns_resumed_rr"] < tw["turns_expected_resumed"]
+        fo = rows["router_failover"]
+        assert fo["replica_deaths"] == 1
+        assert fo["migrations"] == fo["sessions_on_victim"] >= 1
+        assert fo["all_resumed_after_kill"]
+        assert fo["fleet_kept_serving"]
+
+
 class TestLossParity:
     def test_all_entry_points_match(self):
         from benchmarks.loss_parity import main

@@ -1,0 +1,521 @@
+"""Fleet routing & replica failover (tpudist.serve.router).
+
+Fast lane: the routing policy against fake replicas (session → prefix
+rendezvous → least-loaded, round-robin arm, saturation yield), the
+probe/backoff health state machine, spill-not-reject placement with
+whole-fleet passthrough, and the aggregator's additive fleet section
+(in test_telemetry.py).  Real-server lane: routed streams byte-identical
+to the single-server reference, session turns resuming on their home
+replica, queue-overflow spill, and the replica-death chaos drive —
+mid-serve kill via the ``replica_kill`` fault, in-flight lanes re-homed
+onto the survivor byte-identically (greedy AND sampled), parked
+sessions migrated through the package stash, corrupt/missing stash
+degrading to a full re-prefill, survivor compile pins flat throughout."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist.models import create_transformer, generate
+from tpudist.runtime import faults
+from tpudist.serve import (AdmissionError, FleetRouter, InferenceServer,
+                           RouterConfig, ServeConfig)
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+def _prompt(plen, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _reference(model, prompt, max_new):
+    module, params = model
+    out = generate(module, params, np.asarray(prompt)[None], max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _fleet(model, n, cfg=None, **router_kw):
+    cfg = cfg or ServeConfig(num_slots=2, max_new=8, prefill_pad=8,
+                             host_tier=True)
+    reps = [InferenceServer(*model, cfg, install_signal_handler=False)
+            .start() for _ in range(n)]
+    router_kw.setdefault("probe_s", 0.02)
+    return reps, FleetRouter(reps, RouterConfig(**router_kw)).start()
+
+
+def _wait_for(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.005)
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.n = 0
+
+    def pending(self):
+        return self.n
+
+
+class _FakeConfig:
+    queue_limit = 4
+
+
+class _FakeServer:
+    """Just the surface the router touches — health, gauges, submit."""
+
+    def __init__(self):
+        self.healthy = True
+        self.scheduler = _FakeScheduler()
+        self.config = _FakeConfig()
+        self.load = 0.0
+        self.reject: "str | None" = None
+        self.submitted = []
+
+    def _health_check(self):
+        return self.healthy, {}
+
+    def _statusz_doc(self):
+        return {"queue": {"pending": self.scheduler.n,
+                          "limit": self.config.queue_limit},
+                "slots": {"occupancy": self.load}}
+
+    def submit(self, prompt, **kw):
+        if self.reject:
+            raise AdmissionError(self.reject)
+        self.submitted.append(kw)
+
+        class _H:
+            done = False
+            finish_reason = None
+            resumed = False
+            trace_id = "fake"
+        return _H()
+
+    def parked_sessions(self):
+        return []
+
+    def export_session(self, tenant, session):
+        return None
+
+    def adopt_session(self, tenant, session, stash):
+        return True
+
+    def kill(self, reason="killed"):
+        self.healthy = False
+
+    def close(self, timeout=None):
+        return True
+
+
+def _fake_router(n=3, **kw):
+    # never .start()ed: no thread, no telemetry session required —
+    # _pick/_probe/_route_and_submit are exercised synchronously
+    return FleetRouter([_FakeServer() for _ in range(n)],
+                       RouterConfig(**kw))
+
+
+class TestRoutingPolicy:
+    def test_config_from_env_reads_router_knobs(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_ROUTER_REPLICAS", "5")
+        monkeypatch.setenv("TPUDIST_ROUTER_PROBE_FAILURES", "7")
+        monkeypatch.setenv("TPUDIST_ROUTER_SPILL", "0")
+        monkeypatch.setenv("TPUDIST_ROUTER_POLICY", "rr")
+        cfg = RouterConfig.from_env()
+        assert (cfg.replicas, cfg.probe_failures, cfg.spill, cfg.policy) \
+            == (5, 7, False, "rr")
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError):
+            FleetRouter([_FakeServer()], RouterConfig(policy="random"))
+
+    def test_session_home_wins_over_everything(self):
+        r = _fake_router()
+        r._session_home[("t", "s")] = 2
+        rep, kind = r._pick(("t", "s"), "deadbeef")
+        assert (rep.index, kind) == (2, "session")
+
+    def test_prefix_rendezvous_is_stable_and_minimal(self):
+        # same key → same replica every time; removing one replica only
+        # moves the keys IT owned (the cache-warmth property)
+        r = _fake_router(4)
+        keys = [f"{i:08x}" for i in range(64)]
+        home = {k: r._pick(None, k)[0].index for k in keys}
+        assert home == {k: r._pick(None, k)[0].index for k in keys}
+        dead = home[keys[0]]
+        r._replicas[dead].up = False
+        moved = [k for k in keys if r._pick(None, k)[0].index != home[k]]
+        assert all(home[k] == dead for k in moved)
+        assert any(home[k] == dead for k in keys)
+
+    def test_saturated_prefix_target_yields_to_least_loaded(self):
+        r = _fake_router(2)
+        key = "cafecafe"
+        target = r._pick(None, key)[0]
+        target.server.scheduler.n = _FakeConfig.queue_limit  # full queue
+        other = r._replicas[1 - target.index]
+        other.server.load = 0.1
+        rep, kind = r._pick(None, key)
+        assert (rep.index, kind) == (other.index, "spill")
+
+    def test_rr_policy_rotates(self):
+        r = _fake_router(3, policy="rr")
+        seen = []
+        for _ in range(6):
+            rep, kind = r._pick(None, "abcd1234")
+            assert kind == "rr"
+            seen.append(rep.index)
+            r.routed += 1
+        assert seen == [0, 1, 2, 0, 1, 2]
+
+    def test_no_healthy_replica_picks_none(self):
+        r = _fake_router(2)
+        for rep in r._replicas:
+            rep.up = False
+        assert r._pick(None, None) == (None, None)
+
+
+class TestProbeStateMachine:
+    def test_marks_dead_after_threshold_and_backs_off(self):
+        r = _fake_router(1, probe_s=0.1, probe_failures=3)
+        rep = r._replicas[0]
+        rep.server.healthy = False
+        now = 100.0
+        assert not r._probe(rep, now) and rep.up
+        assert not r._probe(rep, now) and rep.up
+        assert not r._probe(rep, now) and not rep.up  # third strike
+        # dead: re-probe interval doubles per failure, capped
+        gaps = []
+        for _ in range(8):
+            r._probe(rep, now)
+            gaps.append(rep.next_probe - now)
+        assert gaps == sorted(gaps)
+        assert gaps[0] > 0.1 and gaps[-1] <= 40.0 * 0.1 + 1e-9
+
+    def test_recovery_reprobes_up_and_resets(self):
+        r = _fake_router(1, probe_failures=1)
+        rep = r._replicas[0]
+        rep.server.healthy = False
+        r._probe(rep, 0.0)
+        assert not rep.up
+        rep.server.healthy = True
+        assert r._probe(rep, 1.0) and rep.up and rep.fails == 0
+        assert rep.backoff_s is None
+
+    def test_one_transient_failure_does_not_kill(self):
+        r = _fake_router(1, probe_failures=3)
+        rep = r._replicas[0]
+        rep.server.healthy = False
+        r._probe(rep, 0.0)
+        rep.server.healthy = True
+        r._probe(rep, 1.0)
+        assert rep.up and rep.fails == 0
+
+
+def _fake_outer(pkey=None):
+    from tpudist.serve.router import RouterHandle
+
+    h = RouterHandle(np.zeros(4, np.int32), {"deadline_s": None},
+                     on_token=None, skey=None, pkey=pkey)
+    h.id = 0
+    return h
+
+
+class TestSpillPlacement:
+    def test_rejecting_target_spills_to_sibling(self):
+        r = _fake_router(2)
+        h = _fake_outer("cafecafe")
+        target = r._pick(None, "cafecafe")[0]
+        target.server.reject = "queue_full"
+        r._route_and_submit(h, skip=0)
+        assert h.replica == 1 - target.index
+        assert r.spills == 1 and h.spilled
+
+    def test_whole_fleet_rejection_passes_shed_through(self):
+        r = _fake_router(2)
+        for rep in r._replicas:
+            rep.server.reject = "queue_full"
+        r._replicas[0].server.reject = "shed_load: tenant over share"
+        h = _fake_outer()
+        with pytest.raises(AdmissionError) as ei:
+            r._route_and_submit(h, skip=0)
+        assert ei.value.reason == "shed_load"
+
+    def test_spill_off_propagates_first_rejection(self):
+        r = _fake_router(2, spill=False)
+        target = r._pick(None, "cafecafe")[0]
+        target.server.reject = "queue_full"
+        h = _fake_outer("cafecafe")
+        with pytest.raises(AdmissionError) as ei:
+            r._route_and_submit(h, skip=0)
+        assert ei.value.reason == "queue_full"
+
+
+class TestRoutedServing:
+    def test_routed_streams_byte_identical_to_reference(self, model):
+        reps, router = _fleet(model, 2)
+        try:
+            hs = [router.submit(_prompt(6, i), max_new=8, seed=i)
+                  for i in range(4)]
+            for i, h in enumerate(hs):
+                assert h.wait(120)
+                assert h.finish_reason == "length"
+                assert h.tokens == _reference(model, _prompt(6, i), 8)
+            assert sum(router.stats()["per_replica"]) == 4
+        finally:
+            router.close(30)
+
+    def test_same_prefix_routes_to_same_replica(self, model):
+        reps, router = _fleet(model, 3)
+        try:
+            # the router's prefix digest covers the first 16 tokens —
+            # the shared base must span the whole window
+            base = _prompt(16, 1)
+            hs = [router.submit(np.concatenate([base, _prompt(2, 10 + i)]),
+                                max_new=4) for i in range(4)]
+            for h in hs:
+                assert h.wait(120)
+            assert len({h.replica for h in hs}) == 1
+        finally:
+            router.close(30)
+
+    def test_session_turn2_resumes_on_home_replica(self, model):
+        reps, router = _fleet(model, 2)
+        try:
+            p1 = _prompt(5, 30)
+            h1 = router.submit(p1, max_new=4, session="aff", tenant="t")
+            assert h1.wait(120)
+            _wait_for(lambda: router.stats()["stash_entries"] >= 1,
+                      msg="stash export")
+            p2 = np.concatenate([p1, np.asarray(h1.tokens, np.int32)])
+            h2 = router.submit(p2, max_new=4, session="aff", tenant="t")
+            assert h2.wait(120)
+            assert h2.replica == h1.replica
+            assert h2.resumed
+        finally:
+            router.close(30)
+
+    def test_queue_overflow_spills_and_everyone_finishes(self, model):
+        # 1 slot + 1 queue entry per replica, slow decodes: the
+        # identical prompts share one affinity target, so admitting
+        # four of them REQUIRES spilling to the sibling; a whole-fleet
+        # rejection surfaces as AdmissionError and is retried (the
+        # bounded-queue contract, unchanged at fleet scope)
+        cfg = ServeConfig(num_slots=1, queue_limit=1, max_new=48,
+                          prefill_pad=8, decode_block=1, host_tier=True)
+        reps, router = _fleet(model, 2, cfg=cfg)
+        try:
+            p = _prompt(6, 5)
+            hs = []
+            for _ in range(4):
+                while True:
+                    try:
+                        hs.append(router.submit(p, max_new=24))
+                        break
+                    except AdmissionError as e:
+                        assert e.reason == "queue_full"
+                        time.sleep(0.01)
+            for h in hs:
+                assert h.wait(180)
+                assert h.finish_reason == "length"
+                assert h.tokens == _reference(model, p, 24)
+            assert router.stats()["spills"] >= 1
+            assert len({h.replica for h in hs}) == 2
+        finally:
+            router.close(30)
+
+    def test_drain_replica_migrates_sessions_live(self, model):
+        reps, router = _fleet(model, 2)
+        try:
+            p1 = _prompt(5, 40)
+            h1 = router.submit(p1, max_new=4, session="mv")
+            assert h1.wait(120)
+            home = h1.replica
+            _wait_for(lambda: ("default", "mv") in router._session_home
+                      and router._session_home[("default", "mv")] == home,
+                      msg="session homed")
+            _wait_for(
+                lambda: reps[home].parked_sessions(), msg="park landed")
+            assert router.drain_replica(home, timeout=30)
+            assert router.stats()["migrations"] >= 1
+            p2 = np.concatenate([p1, np.asarray(h1.tokens, np.int32)])
+            h2 = router.submit(p2, max_new=4, session="mv")
+            assert h2.wait(120)
+            assert h2.replica != home
+            assert h2.resumed  # adopted package, not a re-prefill
+            assert h2.tokens == _reference(model, p2, 4)
+        finally:
+            router.close(30)
+
+
+class TestReplicaDeathChaos:
+    """The acceptance drive: kill a replica mid-serve through the fault
+    grammar; in-flight lanes finish on the survivor with streams
+    byte-identical to an unkilled twin, parked sessions resume there,
+    and the survivor's compile pins never move."""
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("temperature", [0.0, 0.8],
+                             ids=["greedy", "sampled"])
+    def test_mid_serve_kill_rehomes_byte_identical(self, model,
+                                                   temperature):
+        cfg = ServeConfig(num_slots=2, max_new=48, prefill_pad=8,
+                          decode_block=1, host_tier=True)
+        reps, router = _fleet(model, 2, cfg=cfg, retry_backoff_s=0.01)
+        try:
+            p_sess = _prompt(5, 60)
+            hs1 = router.submit(p_sess, max_new=4, session="ch",
+                                temperature=temperature, seed=9)
+            assert hs1.wait(120)
+            _wait_for(lambda: router.stats()["stash_entries"] >= 1,
+                      msg="stash export")
+            victim = router._session_home[("default", "ch")]
+            survivor = 1 - victim
+            # a long decode pinned to the victim via session affinity
+            # (home pre-seeded, so placement is forced, not a
+            # rendezvous coincidence) — THIS is the lane the kill
+            # re-homes mid-stream
+            p_long = _prompt(6, 61)
+            with router._lock:
+                router._session_home[("default", "pin")] = victim
+            # the on_token throttle runs on the serving engine's thread
+            # (decode_block=1 → per token), pacing the lane so the kill
+            # below is guaranteed to land MID-stream on any machine
+            hl = router.submit(p_long, max_new=48, session="pin",
+                               temperature=temperature, seed=7,
+                               on_token=lambda tok, i: time.sleep(0.005))
+            assert hl.replica == victim
+            # arm NOW: the kill fires on the next router tick (~20 ms),
+            # a few tokens into the ~250 ms throttled decode
+            faults.arm(f"replica_kill@nth:{victim}")
+            try:
+                assert hl.wait(180), "in-flight lane hung after kill"
+                _wait_for(lambda: router.stats()["replica_deaths"] >= 1,
+                          timeout=60, msg="death detected")
+            finally:
+                faults.disarm()
+            assert hl.finish_reason == "length"
+            assert hl.replica == survivor  # it DID re-home
+            assert hl.attempts >= 2
+            assert router.stats()["retries"] >= 1
+            # parked session resumes ON THE SURVIVOR from the migrated
+            # package
+            p2 = np.concatenate([p_sess, np.asarray(hs1.tokens, np.int32)])
+            h2 = router.submit(p2, max_new=4, session="ch",
+                               temperature=temperature, seed=10)
+            assert h2.wait(120)
+            assert h2.replica == survivor
+            assert h2.resumed
+            st = router.stats()
+            assert st["replicas_up"] == 1
+            assert st["migrations"] >= 1
+            # compile pins flat under further routing churn: the
+            # failover above compiled the survivor's full program set
+            # (prefill/decode/park/import/resume); another session
+            # cycle + plain wave through the router must add ZERO
+            pins0 = reps[survivor].engine.compile_counts()
+            p3 = np.concatenate([p2, np.asarray(h2.tokens, np.int32)])
+            h3 = router.submit(p3, max_new=4, session="ch",
+                               temperature=temperature, seed=11)
+            h4 = router.submit(_prompt(6, 62), max_new=4,
+                               temperature=temperature, seed=12)
+            assert h3.wait(120) and h4.wait(120)
+            assert h3.resumed
+            assert reps[survivor].engine.compile_counts() == pins0
+        finally:
+            router.close(30)
+        # unkilled twin: one plain server, same requests, same seeds
+        twin_cfg = ServeConfig(num_slots=2, max_new=48, prefill_pad=8,
+                               decode_block=1)
+        twin = InferenceServer(*model, twin_cfg,
+                               install_signal_handler=False).start()
+        try:
+            tl = twin.submit(p_long, max_new=48, temperature=temperature,
+                             seed=7)
+            t2 = twin.submit(p2, max_new=4, temperature=temperature,
+                             seed=10)
+            assert tl.wait(180) and t2.wait(120)
+        finally:
+            twin.close(30)
+        assert hl.tokens == tl.tokens, "re-homed stream diverged"
+        assert h2.tokens == t2.tokens, "migrated session diverged"
+
+    @pytest.mark.chaos
+    def test_corrupt_stash_degrades_to_full_reprefill(self, model):
+        reps, router = _fleet(model, 2, retry_backoff_s=0.01)
+        try:
+            p1 = _prompt(5, 70)
+            h1 = router.submit(p1, max_new=4, session="bad")
+            assert h1.wait(120)
+            _wait_for(lambda: router.stats()["stash_entries"] >= 1,
+                      msg="stash export")
+            skey = ("default", "bad")
+            with router._lock:
+                stash = router._stash[skey]
+                ser = dict(stash["ser"])
+                # garble every blob leaf, keep the stamped digest: the
+                # survivor's resume-path deserialize must catch it
+                ser["blob"] = [(bytes(len(b)), dt, shp)
+                               for b, dt, shp in ser["blob"]]
+                router._stash[skey] = dict(stash, ser=ser)
+            victim = router._session_home[skey]
+            reps[victim].kill("test")
+            _wait_for(lambda: router.stats()["replica_deaths"] >= 1,
+                      timeout=60, msg="death detected")
+            # the corrupt package was adopted; the resume path's digest
+            # check rejects it and the turn re-prefills fresh — correct
+            # bytes, no hang, just no shortcut
+            p2 = np.concatenate([p1, np.asarray(h1.tokens, np.int32)])
+            h2 = router.submit(p2, max_new=4, session="bad")
+            assert h2.wait(120)
+            assert not h2.resumed
+            assert h2.tokens == _reference(model, p2, 4)
+        finally:
+            router.close(30)
+
+    @pytest.mark.chaos
+    def test_missing_stash_degrades_to_full_reprefill(self, model):
+        reps, router = _fleet(model, 2, stash=False, retry_backoff_s=0.01)
+        try:
+            p1 = _prompt(5, 80)
+            h1 = router.submit(p1, max_new=4, session="nostash")
+            assert h1.wait(120)
+            victim = h1.replica
+            reps[victim].kill("test")
+            _wait_for(lambda: router.stats()["replicas_up"] == 1,
+                      timeout=60, msg="death detected")
+            p2 = np.concatenate([p1, np.asarray(h1.tokens, np.int32)])
+            h2 = router.submit(p2, max_new=4, session="nostash")
+            assert h2.wait(120)
+            assert h2.replica != victim
+            assert not h2.resumed
+            assert h2.tokens == _reference(model, p2, 4)
+        finally:
+            router.close(30)
+
+    @pytest.mark.chaos
+    def test_whole_fleet_death_finishes_replica_lost(self, model):
+        cfg = ServeConfig(num_slots=1, max_new=48, prefill_pad=8,
+                          decode_block=1, host_tier=True)
+        reps, router = _fleet(model, 2, cfg=cfg, retry_backoff_s=0.01)
+        try:
+            h = router.submit(_prompt(6, 90), max_new=32)
+            while len(h.tokens) < 2:
+                time.sleep(0.005)
+            for rep in reps:
+                rep.kill("test")
+            assert h.wait(120), "fleet collapse must not hang the handle"
+            assert h.finish_reason in ("replica_lost", "shutdown")
+            assert router.stats()["replicas_up"] == 0
+        finally:
+            router.close(30)

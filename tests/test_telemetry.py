@@ -626,6 +626,9 @@ class TestAggregatorBackCompat:
         # an adapter-less stream gains no adapters section (PR-15
         # additive discipline)
         assert "adapters" not in report["serving"]
+        # a router-less stream gains no fleet section (PR-16 additive
+        # discipline — every single-replica stream is router-less)
+        assert "fleet" not in report["serving"]
         assert report["serving"]["requests_finished"] == 1
         # no trace artifacts leak into the report of a trace-less stream
         assert "trace" not in json.dumps(report).lower()
@@ -676,6 +679,47 @@ class TestAggregatorBackCompat:
         ad = after["serving"]["adapters"]
         assert ad["loads"] == 1 and ad["rank"] == 8 and ad["blocks"] == 8
         assert ad["resident_peak"] == 1
+        for key in ("goodput", "step", "wall_clock_s", "per_rank"):
+            assert before[key] == after[key], f"{key} moved"
+        for key in ("ttft", "tpot", "finish_reasons", "decode_tokens",
+                    "tokens_out", "occupancy_mean"):
+            assert before["serving"][key] == after["serving"][key]
+
+    def test_router_records_are_purely_additive(self, tmp_path):
+        """Fleet-router events (PR 16) bolt a `fleet` section on; every
+        pre-existing serving field keeps its exact value."""
+        self._write_old(tmp_path)
+        before = aggregate_run(tmp_path)
+        with open(tmp_path / "rank0_gen0.jsonl", "a") as f:
+            for rec in (
+                {"kind": "event", "name": "router_config", "t": 100.0,
+                 "dur": 0.0, "rank": 0, "gen": 0, "replicas": 2,
+                 "policy": "affinity", "probe_s": 0.05},
+                {"kind": "event", "name": "router_route", "t": 100.1,
+                 "dur": 0.0, "rank": 0, "gen": 0, "replica": 1,
+                 "route_kind": "prefix", "id": 0},
+                {"kind": "event", "name": "router_spill", "t": 100.15,
+                 "dur": 0.0, "rank": 0, "gen": 0, "replica": 0,
+                 "rejected": [1], "reason": "queue_full"},
+                {"kind": "event", "name": "replica_health", "t": 100.2,
+                 "dur": 0.0, "rank": 0, "gen": 0, "replica": 1,
+                 "up": False, "fails": 3, "ups": 1},
+                {"kind": "event", "name": "router_retry", "t": 100.25,
+                 "dur": 0.0, "rank": 0, "gen": 0, "id": 0, "replica": 0,
+                 "skip": 3, "attempt": 2},
+                {"kind": "event", "name": "session_migrated", "t": 100.3,
+                 "dur": 0.0, "rank": 0, "gen": 0, "to_replica": 0,
+                 "migrate_reason": "death", "ok": True},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        after = aggregate_run(tmp_path)
+        fl = after["serving"]["fleet"]
+        assert fl["replicas"] == 2 and fl["policy"] == "affinity"
+        assert fl["routes"] == {"prefix": 1}
+        assert fl["spills"] == 1 and fl["retries"] == 1
+        assert fl["replica_deaths"] == 1
+        assert fl["migrations"] == {"ok": 1}
+        assert "fleet router" in render_markdown(after)
         for key in ("goodput", "step", "wall_clock_s", "per_rank"):
             assert before[key] == after[key], f"{key} moved"
         for key in ("ttft", "tpot", "finish_reasons", "decode_tokens",

@@ -21,6 +21,9 @@ changes::
                                             # KV-handoff package in flight
     TPUDIST_FAULT=host_tier_corrupt@nth:1   # garble the 1st package PARKED
                                             # in the host-RAM KV tier
+    TPUDIST_FAULT=replica_kill@nth:1        # kill fleet replica 1's engine
+                                            # loop at the router's next
+                                            # probe tick (tick:K delays it)
 
 Grammar: ``kind@key:int[,key:int][;kind@...]``.  Common keys: ``rank``
 restricts the fault to one process (default: all); ``attempt`` fires only
@@ -62,6 +65,15 @@ _SCHEMA: Dict[str, tuple] = {
     # degrade to a full re-prefill (host_tier_corrupt event), never
     # crash and never import wrong bytes.
     "host_tier_corrupt": ({"nth"}, {"nth", "rank"}),
+    # fleet router (tpudist.serve.router): kill the Nth replica's engine
+    # loop at router scope — the router's probe tick consults this and
+    # hard-stops that replica (its loop raises, in-flight work aborts,
+    # /healthz goes 503), driving the SAME failover path a real replica
+    # crash would: re-home in-flight lanes onto survivors, resume parked
+    # sessions from the router-side stash.  `tick` delays the kill to
+    # the router's Nth probe tick (default 1 = the first tick after
+    # arming).
+    "replica_kill": ({"nth"}, {"nth", "tick", "rank"}),
 }
 
 
@@ -375,6 +387,32 @@ def inject_host_tier(ser: dict) -> bool:
                             nth=spec.seen)
             return True
     return False
+
+
+def inject_replica_kill(tick: int) -> Optional[int]:
+    """Fleet-router injection point, consulted once per router probe
+    tick (``tick`` = the router's cumulative tick count).  A due
+    ``replica_kill`` fires once and returns the replica index to
+    hard-stop (``nth``); ``None`` otherwise.  The router responds by
+    killing that replica's engine loop — the in-process twin of a
+    replica host dying — and its probe/failover machinery takes it from
+    there with zero test-only seams."""
+    if _PLAN is None:
+        return None
+    for spec in _PLAN:
+        if (spec.kind == "replica_kill" and spec.fired == 0
+                and tick >= spec.param("tick", 1)
+                and _rank_matches(spec)):
+            spec.fired += 1
+            idx = spec.params["nth"]
+            _log(f"injecting replica_kill: replica {idx} at router "
+                 f"tick {tick}")
+            from tpudist import telemetry
+
+            telemetry.event("fault_injected", fault="replica_kill",
+                            replica=idx, tick=tick)
+            return idx
+    return None
 
 
 def corrupt_checkpoint(step_dir: os.PathLike) -> int:

@@ -49,7 +49,17 @@ gather(B)·gather(A)·x`` with each slot's rank-r factors gathered
 in-graph, zero recompilation as tenants churn, bit-exact base path for
 adapter-less lanes.
 
-``python -m tpudist.serve`` runs a self-contained CPU demo.
+:class:`FleetRouter` (:mod:`tpudist.serve.router`) is the layer above
+one server: a fleet front door over N replicas routing by session
+affinity (resumes land where the KV parked), prefix-cache affinity
+(rendezvous hashing on a prompt-prefix digest), then least-loaded
+placement — with health-probed failover, spill-not-reject overflow, a
+bounded duplicate-dropping retry path that keeps re-homed streams
+byte-identical, and parked-session migration over the
+``serialize_package`` wire format when a replica drains or dies.
+
+``python -m tpudist.serve`` runs a self-contained CPU demo
+(``--replicas N`` runs it through the fleet router).
 """
 
 from tpudist.serve.adapters import (  # noqa: F401
@@ -61,6 +71,11 @@ from tpudist.serve.disagg import DisaggServer  # noqa: F401
 from tpudist.serve.engine import SlotEngine  # noqa: F401
 from tpudist.serve.host_tier import HostKVTier, HostTierError  # noqa: F401
 from tpudist.serve.overload import OverloadController  # noqa: F401
+from tpudist.serve.router import (  # noqa: F401
+    FleetRouter,
+    RouterConfig,
+    RouterHandle,
+)
 from tpudist.serve.spmd import ServeMeshConfig  # noqa: F401
 from tpudist.serve.scheduler import (  # noqa: F401
     AdmissionError,

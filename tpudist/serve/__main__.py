@@ -6,6 +6,12 @@ heterogeneous prompt/output lengths through it, streams tokens, drains,
 and prints a JSON summary (per-request TTFT/latency + server stats).
 Runs on CPU in seconds — the quick-start for the serving subsystem; the
 real measurement harness is ``benchmarks/serve_bench.py``.
+
+``--replicas N`` (N >= 2) runs the same burst through the fleet router
+instead: N replica servers behind :class:`tpudist.serve.FleetRouter`,
+with the routing/failover stats in the summary — the multi-replica
+quick-start (``benchmarks/router_bench.py`` is the measurement
+harness).
 """
 
 from __future__ import annotations
@@ -37,6 +43,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--max-len", type=int, default=128)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="run N replica servers behind the fleet router "
+                        "(1 = single server, no router)")
     p.add_argument("--telemetry-dir", default=None,
                    help="where serving spans land (default: "
                         "TPUDIST_TELEMETRY_DIR or runs/telemetry)")
@@ -47,7 +56,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from tpudist import telemetry
     from tpudist.models import create_transformer
-    from tpudist.serve import InferenceServer, ServeConfig
+    from tpudist.serve import (FleetRouter, InferenceServer, RouterConfig,
+                               ServeConfig)
 
     if args.telemetry_dir:
         telemetry.start(args.telemetry_dir)
@@ -60,12 +70,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     # just the chunk size — half the prompt ceiling, so the demo's longer
     # prompts actually exercise the chunked-prefill path
     prefill_pad = max(1, min(args.prompt_len // 2, args.max_len // 2))
-    server = InferenceServer(
-        module, params,
-        ServeConfig(num_slots=args.slots, queue_limit=args.queue,
-                    max_new=args.max_new, prefill_pad=prefill_pad,
-                    decode_block=args.decode_block))
-    server.start()
+    cfg = ServeConfig(num_slots=args.slots, queue_limit=args.queue,
+                      max_new=args.max_new, prefill_pad=prefill_pad,
+                      decode_block=args.decode_block,
+                      host_tier=args.replicas > 1)
+    if args.replicas > 1:
+        # the multi-replica rig: N servers sharing the (tiny random)
+        # weights, the router in front — sessions park in each
+        # replica's host tier so death/drain can migrate them
+        replicas = [InferenceServer(module, params, cfg,
+                                    install_signal_handler=False).start()
+                    for _ in range(args.replicas)]
+        front = FleetRouter(replicas, RouterConfig()).start()
+    else:
+        front = InferenceServer(module, params, cfg)
+        front.start()
 
     import time
 
@@ -83,7 +102,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         stop_burst = False
         while True:
             try:
-                handles.append(server.submit(
+                handles.append(front.submit(
                     prompt, max_new=max_new, temperature=args.temperature,
                     seed=i))
                 break
@@ -100,15 +119,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             break
     for h in handles:
         h.wait()
-    stats = server.stats()
-    server.close()
+    stats = front.stats()
+    front.close()
     report = telemetry.finish()
 
     rows = [{
-        "id": h.id,
-        "prompt_len": int(len(h.request.prompt)),
+        "id": getattr(h, "id", None),
+        "prompt_len": int(len(getattr(h, "prompt", None)
+                              if args.replicas > 1 else h.request.prompt)),
         "tokens_out": len(h.tokens),
         "reason": h.finish_reason,
+        **({"replica": h.replica} if args.replicas > 1 else {}),
         "ttft_ms": round(h.ttft_s * 1e3, 2) if h.ttft_s else None,
         "tpot_ms": round(h.tpot_s * 1e3, 2) if h.tpot_s else None,
     } for h in handles]

@@ -90,7 +90,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tpudist.serve.engine import SlotEngine
 from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
-from tpudist.serve.server import _Observability
+from tpudist.serve.server import ReplicaKilled, _Observability
 
 _IDLE_WAIT_S = 0.01
 
@@ -715,7 +715,8 @@ class DisaggServer(_Observability):
             # a dying pool worker must not strand waiters (module doc)
             self.loop_error = repr(e)  # /healthz goes 503 on this
             telemetry.event("serve_loop_error", error=repr(e))
-            raise
+            if not isinstance(e, ReplicaKilled):
+                raise
         finally:
             self.scheduler.refuse_new("draining")
             self._abort_outstanding()
@@ -731,6 +732,7 @@ class DisaggServer(_Observability):
         sched = self.scheduler
         while True:
             self._beat = time.monotonic()  # /healthz heartbeat
+            self._check_die()  # hard-stop poison (kill / replica_kill)
             if not self._draining and self._should_drain():
                 self._draining = True
                 sched.refuse_new("draining")

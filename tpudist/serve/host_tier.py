@@ -31,8 +31,21 @@ device and come back without recompute:
   wrong bytes (the ``TPUDIST_FAULT=host_tier_corrupt@nth:N`` chaos kind
   garbles the Nth parked package post-digest to prove exactly that).
 
+Fleet re-homing (the router PR): a parked session is also the unit of
+MIGRATION between replicas — :meth:`export_entry` hands out a copy of
+the serialized entry (the same schema-versioned wire format), and
+:meth:`adopt` installs one that was parked on ANOTHER replica's tier.
+Integrity still travels with the blob: adopt stores the bytes verbatim,
+and the adopting replica's resume path verifies the digest exactly as
+if it had parked the package itself — a corrupt migrated blob degrades
+to a full re-prefill there, never imports.
+
 Thread contract: same as the engine — exactly one caller (the serving
-loop's engine thread); ``stats()`` reads are GIL-atomic counters.
+loop's engine thread) for the put/get/match mutation paths; ``stats()``
+reads are GIL-atomic counters.  :meth:`export_entry`,
+:meth:`session_keys` and :meth:`adopt` are additionally safe to call
+from a router thread: each is a single GIL-atomic dict operation (plus
+reads of immutable entry fields), the same contract ``stats()`` rides.
 """
 
 from __future__ import annotations
@@ -123,6 +136,13 @@ class HostKVTier:
         if context is not None:
             context = np.asarray(context, np.int32).reshape(-1)
             nbytes += context.nbytes
+        return self._store(key, ser, nbytes, context, pinned, kind, now)
+
+    def _store(self, key: tuple, ser: dict, nbytes: int, context,
+               pinned: bool, kind: str, now: float) -> Optional[int]:
+        """Budget-checked insert of an already-serialized entry — the
+        shared tail of :meth:`put` (fresh park) and :meth:`adopt`
+        (migrated park)."""
         if nbytes > self.byte_budget:
             self.rejected_oversize += 1
             return None
@@ -132,6 +152,41 @@ class HostKVTier:
         self.bytes_resident += nbytes
         self.parks += 1
         return nbytes
+
+    def adopt(self, key: tuple, ser: dict, *, context=None,
+              kind: str = "turn", now: Optional[float] = None
+              ) -> Optional[int]:
+        """Install a package serialized ELSEWHERE (another replica's
+        tier, a router-side stash) under ``key`` — the migration half of
+        :meth:`export_entry`.  The bytes are stored verbatim, digest and
+        all: integrity is still checked by the resume path's
+        deserialize, so a blob corrupted in transit degrades to a full
+        re-prefill on THIS replica instead of importing.  Same budget
+        rules as :meth:`put` (LRU spill, oversize → ``None``)."""
+        now = time.monotonic() if now is None else now
+        nbytes = int(ser["bytes"])
+        if context is not None:
+            context = np.asarray(context, np.int32).reshape(-1)
+            nbytes += context.nbytes
+        return self._store(key, ser, nbytes, context, False, kind, now)
+
+    def export_entry(self, key: tuple) -> Optional[dict]:
+        """A stashable copy of the entry under ``key`` WITHOUT popping
+        it: the serialized package plus the covered context — everything
+        :meth:`adopt` needs to re-home the session on another replica.
+        ``None`` when not resident.  The package dict is returned as-is
+        (entries are never mutated in place), so the copy is O(1)."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        return {"ser": e.ser, "context": e.context, "kind": e.kind}
+
+    def session_keys(self) -> List[tuple]:
+        """Keys of every parked SESSION entry (``("sess", tenant,
+        session)`` — preempted mid-stream lanes excluded: they belong to
+        a live handle, not to the migratable idle-session set)."""
+        return [k for k in list(self._entries)
+                if isinstance(k, tuple) and k and k[0] == "sess"]
 
     def _spill(self, incoming: int) -> None:
         """Free room for ``incoming`` bytes: least-recently-touched

@@ -80,6 +80,17 @@ FINISH_REASONS = {
                        "from the host KV tier without recompute (the "
                        "multi-turn no-recompute path; eos/deadline "
                        "still win when they fire first)",
+    "replica_lost": "its fleet replica died mid-serve and the router "
+                    "could not re-home it — no healthy sibling had "
+                    "headroom within the bounded retry budget (with a "
+                    "survivor available the lane re-homes and finishes "
+                    "normally, duplicates dropped)",
+    "router_spill": "an inner per-replica attempt the fleet router "
+                    "ABANDONED when it re-homed the request onto a "
+                    "sibling (replica marked dead, or rejected/timed "
+                    "out mid-admission) — the caller-facing handle "
+                    "lives on and finishes with the sibling's reason; "
+                    "this reason only ever marks the orphaned attempt",
     "adapter_missing": "named a per-tenant adapter no longer resident in "
                        "the pool when its lane had to (re-)bind — a "
                        "raced unload between admission and placement, or "

@@ -214,6 +214,16 @@ class ServeConfig:
         )
 
 
+class ReplicaKilled(RuntimeError):
+    """The engine loop stopped because :meth:`_Observability.kill` told
+    it to — an INTENTIONAL hard stop (operator kill / the
+    ``replica_kill`` chaos fault), not an unexpected error.  The loop
+    records it exactly like any other death (``loop_error`` set,
+    ``/healthz`` 503, outstanding work aborted ``shutdown``) but does
+    not re-raise it into the threading excepthook: a deliberate stop is
+    not a stack trace."""
+
+
 class _Observability:
     """Shared live-observability wiring for both server flavors
     (:class:`InferenceServer` here, ``DisaggServer`` in
@@ -246,6 +256,9 @@ class _Observability:
         #: first-deadline slack) is the aggressive stall detector.
         self.health_stale_s = env_positive_float(
             "TPUDIST_SERVE_HEALTH_STALE_S", 300.0)
+        #: hard-stop poison (:meth:`kill`): the engine loop raises on
+        #: its next iteration when set — the crash twin of drain
+        self._die: Optional[str] = None
         self._statusz_names: list = []
         #: tenant → in-flight count (submitted minus finished) for
         #: /statusz; mutated under _tenant_lock (ingestion + engine
@@ -305,6 +318,64 @@ class _Observability:
             "heartbeat_stale": stale,
             "draining": self._draining,
         }
+
+    def kill(self, reason: str = "killed") -> None:
+        """Hard-stop the engine loop NOW — the crash twin of
+        :meth:`drain` (an operator's kill-9 equivalent, and what the
+        ``replica_kill`` chaos fault drives at fleet scope).  The loop
+        raises on its next iteration: in-flight and queued work aborts
+        with reason ``"shutdown"``, ``loop_error`` is set, ``/healthz``
+        goes 503.  Nothing is parked, nothing drains — recovery is the
+        CALLER's job (the fleet router re-homes onto survivors)."""
+        self._die = reason
+
+    def _check_die(self) -> None:
+        """Engine-loop poison check (one attribute load when alive) —
+        both flavors call this at the top of every iteration."""
+        if self._die:
+            raise ReplicaKilled(f"replica killed: {self._die}")
+
+    # -- fleet session migration (tpudist.serve.router) ----------------------
+    # Drain-handoff hooks shared by both server flavors: a parked
+    # session is also the unit of migration between replicas.  All
+    # three are GIL-atomic tier reads/inserts (HostKVTier's cross-
+    # thread contract), so a router thread may call them while the
+    # engine loop runs.
+
+    def parked_sessions(self) -> list:
+        """``(tenant, session)`` pairs of every idle session currently
+        parked in this replica's host tier (empty without a tier)."""
+        if self._tier is None:
+            return []
+        return [(k[1], k[2]) for k in self._tier.session_keys()]
+
+    def export_session(self, tenant, session) -> Optional[dict]:
+        """A stashable copy of the parked package under ``(tenant,
+        session)`` — the serialized wire-format blob plus its covered
+        context — or ``None`` when nothing is parked there.  The copy
+        is what a router re-homes onto a sibling replica when this one
+        drains or dies."""
+        if self._tier is None or session is None:
+            return None
+        key = ("sess", tenant if tenant else "default", str(session))
+        return self._tier.export_entry(key)
+
+    def adopt_session(self, tenant, session, stash: Optional[dict]) -> bool:
+        """Install a session package exported from ANOTHER replica into
+        this tier, so the session's next turn resumes here instead of
+        re-prefilling.  Digest verification stays where it always was —
+        the resume path's deserialize — so adopting a corrupt stash
+        degrades to a full re-prefill, never imports wrong bytes.
+        False when this replica has no tier, the stash is empty, or the
+        package alone exceeds the tier budget (the turn re-prefills)."""
+        if self._tier is None or session is None or not stash \
+                or not isinstance(stash.get("ser"), dict):
+            return False
+        key = ("sess", tenant if tenant else "default", str(session))
+        stored = self._tier.adopt(key, stash["ser"],
+                                  context=stash.get("context"),
+                                  kind=stash.get("kind", "turn"))
+        return stored is not None
 
     def _track_tenant(self, tenant, delta: int) -> None:
         # submit threads race the engine thread here — one tiny lock
@@ -874,7 +945,8 @@ class InferenceServer(_Observability):
             # submit() keeps admitting doomed work.
             self.loop_error = repr(e)  # /healthz goes 503 on this
             telemetry.event("serve_loop_error", error=repr(e))
-            raise  # threading excepthook still reports the traceback
+            if not isinstance(e, ReplicaKilled):
+                raise  # threading excepthook still reports the traceback
         finally:
             self.scheduler.refuse_new("draining")
             self._abort_outstanding()
@@ -885,6 +957,7 @@ class InferenceServer(_Observability):
         eng, sched = self.engine, self.scheduler
         while True:
             self._beat = time.monotonic()  # /healthz heartbeat
+            self._check_die()  # hard-stop poison (kill / replica_kill)
             if not self._draining and self._should_drain():
                 self._draining = True
                 sched.refuse_new("draining")

@@ -84,6 +84,7 @@ _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
                     "world_resized", "worker_lost", "lane_recovered",
                     "handoff_rejected", "pool_resize",
                     "adapter_load", "adapter_evict",
+                    "replica_health", "session_migrated", "router_error",
                     "telemetry_dropped")
 
 
@@ -330,6 +331,14 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     ad_loads, ad_evicts = 0, 0
     ad_evict_kinds: Dict[str, int] = {}
     ad_resident_peak = 0
+    # fleet router (tpudist.serve.router): routing split, spills,
+    # re-home retries, replica deaths, session migrations — absent
+    # entirely from single-replica streams, so the section below is
+    # purely additive
+    rt_config: Optional[dict] = None
+    rt_routes: Dict[str, int] = {}
+    rt_spills, rt_retries, rt_deaths, rt_errors = 0, 0, 0, 0
+    rt_migrations: Dict[str, int] = {}
     for r in records:
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_kv_config"):
@@ -368,6 +377,29 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             continue
         if r.get("kind") == "event" and r.get("name") == "pool_resize":
             pool_resizes += 1
+            continue
+        if r.get("kind") == "event" and r.get("name") in (
+                "router_config", "router_route", "router_spill",
+                "router_retry", "replica_health", "session_migrated",
+                "router_error"):
+            name = r.get("name")
+            if name == "router_config":
+                rt_config = r  # last one wins (restart/regeneration)
+            elif name == "router_route":
+                k = str(r.get("route_kind", "?"))
+                rt_routes[k] = rt_routes.get(k, 0) + 1
+            elif name == "router_spill":
+                rt_spills += 1
+            elif name == "router_retry":
+                rt_retries += 1
+            elif name == "replica_health":
+                if not r.get("up"):
+                    rt_deaths += 1
+            elif name == "session_migrated":
+                k = "ok" if r.get("ok") else "degraded"
+                rt_migrations[k] = rt_migrations.get(k, 0) + 1
+            elif name == "router_error":
+                rt_errors += 1
             continue
         if r.get("kind") == "event":
             name = r.get("name")
@@ -601,6 +633,27 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             "lanes_recovered": lanes_recovered,
             "pool_resizes": pool_resizes,
         }
+    fleet: Optional[dict] = None
+    if rt_config is not None or rt_routes or rt_spills or rt_retries \
+            or rt_deaths or rt_migrations:
+        fleet = {
+            **({"replicas": rt_config.get("replicas"),
+                "policy": rt_config.get("policy")}
+               if rt_config is not None else {}),
+            # routing split by affinity kind (session/prefix/
+            # least_loaded/spill/rr) — the affinity-hit headline
+            "routes": dict(rt_routes),
+            "spills": rt_spills,
+            "retries": rt_retries,
+            "replica_deaths": rt_deaths,
+            # re-home retries that replayed a stream: the per-request
+            # failover count (replica_lost in finish_reasons is the
+            # budget-exhausted tail)
+            "lost_finished": reasons.get("replica_lost", 0),
+            **({"migrations": dict(rt_migrations)}
+               if rt_migrations else {}),
+            **({"router_errors": rt_errors} if rt_errors else {}),
+        }
     return {
         "requests_finished": len(fins),
         "requests_rejected": rejects,
@@ -625,6 +678,9 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         **({"spec": spec} if spec is not None else {}),
         **({"pools": pools} if pools is not None else {}),
         **({"overload": overload} if overload is not None else {}),
+        # fleet section only when a router ran — single-replica streams
+        # (every pre-router run) aggregate byte-identically without it
+        **({"fleet": fleet} if fleet is not None else {}),
         # SLO section only when targets were declared — old streams (and
         # target-less runs) aggregate byte-identically without it
         **({"slo": _slo_summary(fins, slo_config)}
@@ -939,6 +995,24 @@ def render_markdown(report: dict) -> str:
                 f"last {state}"
                 + (f" at attainment {last.get('attainment')}"
                    if last.get("attainment") else ""))
+        if sv.get("fleet"):
+            fl = sv["fleet"]
+            routes = ", ".join(f"{k}: {c}" for k, c in
+                               sorted(fl.get("routes", {}).items()))
+            bits = []
+            if fl.get("replicas") is not None:
+                bits.append(f"{fl['replicas']} replicas "
+                            f"({fl.get('policy', '?')})")
+            if routes:
+                bits.append(f"routes by kind ({routes})")
+            bits.append(f"{fl['spills']} spill(s), {fl['retries']} "
+                        f"re-home retry(ies)")
+            if fl.get("replica_deaths"):
+                mig = fl.get("migrations", {})
+                bits.append(f"{fl['replica_deaths']} replica death(s), "
+                            f"{mig.get('ok', 0)} session(s) migrated, "
+                            f"{fl.get('lost_finished', 0)} lost")
+            lines.append("- fleet router: " + "; ".join(bits))
     if report.get("telemetry_dropped"):
         td = report["telemetry_dropped"]
         lines += ["", f"**⚠ telemetry dropped records** — ring evictions: "

@@ -566,6 +566,30 @@ def feed_record(rec: dict) -> None:
             v = rec.get(key)
             if isinstance(v, (int, float)):
                 r.gauge(gname).set(float(v))
+    # fleet router (tpudist.serve.router): routing/failover counters +
+    # the replicas-up gauge riding ON the replica_health events (the
+    # router has no feed of its own — same zero-new-seams discipline as
+    # the host tier above)
+    elif name == "router_config":
+        v = rec.get("replicas")
+        if isinstance(v, (int, float)):
+            r.gauge("tpudist_router_replicas").set(float(v))
+    elif name == "router_route":
+        r.counter("tpudist_router_routed_total",
+                  kind=str(rec.get("route_kind", "?"))).inc()
+    elif name == "router_spill":
+        r.counter("tpudist_router_spills_total").inc()
+    elif name == "router_retry":
+        r.counter("tpudist_router_retries_total").inc()
+    elif name == "replica_health":
+        if not rec.get("up"):
+            r.counter("tpudist_router_replica_deaths_total").inc()
+        v = rec.get("ups")
+        if isinstance(v, (int, float)):
+            r.gauge("tpudist_router_replicas_up").set(float(v))
+    elif name == "session_migrated":
+        r.counter("tpudist_router_sessions_migrated_total",
+                  ok=str(bool(rec.get("ok"))).lower()).inc()
 
 
 def set_train_gauges(iteration: int, values: Dict[str, float]) -> None:

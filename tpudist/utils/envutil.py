@@ -152,6 +152,32 @@ ENV_VARS = {
         "per-tenant token-rate fairness multiplier — reject a tenant "
         "above this multiple of its equal share once the queue is half "
         "full (0/unset = off)",
+    # fleet router (serve/router.py — RouterConfig.from_env)
+    "TPUDIST_ROUTER_REPLICAS":
+        "fleet size for env-driven multi-replica rigs (default 2; the "
+        "router itself takes an explicit replica list)",
+    "TPUDIST_ROUTER_PROBE_S":
+        "per-replica health-probe interval in seconds (default 0.05)",
+    "TPUDIST_ROUTER_PROBE_FAILURES":
+        "consecutive probe failures before a replica is marked dead "
+        "(default 3; dead replicas re-probe on exponential backoff)",
+    "TPUDIST_ROUTER_RETRIES":
+        "per-request re-home budget after a replica dies mid-serve "
+        "(default 2; exhaustion finishes the request replica_lost)",
+    "TPUDIST_ROUTER_RETRY_BACKOFF_S":
+        "re-home retry backoff base in seconds (default 0.05; doubles "
+        "per failed attempt)",
+    "TPUDIST_ROUTER_SPILL":
+        "overflow spills to a sibling replica (paying a re-prefill) "
+        "instead of rejecting while any replica has headroom "
+        "(default on; 0 = reject on the affinity target's answer)",
+    "TPUDIST_ROUTER_STASH":
+        "router-side parked-package stash: finished session turns are "
+        "exported so replica death migrates the session to a survivor "
+        "(default on; 0 = death degrades sessions to full re-prefill)",
+    "TPUDIST_ROUTER_POLICY":
+        "routing policy: affinity (session -> prefix -> least-loaded, "
+        "default) | rr (round-robin comparison arm)",
     # per-tenant adapters (serve/adapters.py + models/lora.py)
     "TPUDIST_SERVE_ADAPTERS":
         "per-tenant adapters: paged multi-LoRA factor pool + per-slot "
