@@ -1,0 +1,251 @@
+#!/usr/bin/env python3
+"""Online draft-distillation bench: the distribution-shift flywheel
+story, frozen per round as ``BENCH_DISTILL_r{NN}.json``.
+
+One scenario, CPU-safe (tiny model; CROSS-ARM acceptance on one
+schedule is the measurement, absolute tok/s is not):
+
+- Traffic mix A (prompts from the low half of the vocab) serves for a
+  warm phase, then the mix FLIPS to B (high half) mid-run — the drift
+  that decays any frozen draft's acceptance.
+- **frozen** arm: a draft distilled offline on mix A
+  (``tpudist.distill.distill_draft`` — the same path
+  ``serve_bench --spec-distill`` uses) serves the whole schedule
+  unchanged.  Its per-window acceptance timeline shows the decay.
+- **flywheel** arm: the SAME initial draft plus the online loop —
+  live capture ring (``TPUDIST_DISTILL_CAPTURE`` armed
+  programmatically), ``DistillLoop.run_once()`` driven at controlled
+  points after the flip, gated hot-swap on a measured held-out win.
+  Its timeline shows acceptance RECOVER after the swap while the
+  frozen twin stays decayed.
+
+The artifact freezes:
+
+- ``acceptance_timeline`` — per-window acceptance for both arms, the
+  decay-and-recovery picture;
+- ``swap_timeline`` — every distillation round's gate verdict +
+  acceptance numbers, and each applied swap's latency;
+- ``outputs_match`` — every flywheel stream byte-identical to the
+  frozen arm's (greedy; the draft only proposes, the target decides —
+  hot-swaps must never move bytes);
+- ``compile_pins_flat`` — jit-cache sizes identical across the swaps
+  (the dparams-as-argument contract);
+- ``frozen_decayed`` / ``flywheel_recovered`` — the headline claims.
+
+Usage: ``python benchmarks/distill_bench.py [--smoke] [--out PATH]``
+(round_snapshot.py freezes it per round; the tier-1 smoke test asserts
+the rung fields).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CFG = dict(vocab=32, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+           max_len=64)
+
+
+def _model(seed: int = 0):
+    import jax
+
+    from tpudist.models import create_transformer
+
+    return create_transformer(jax.random.PRNGKey(seed), seq_len=16, **CFG)
+
+
+def _pool(lo: int, hi: int, n: int, plens, seed: int):
+    """A repeat-prompt pool drawn from one vocab band — the two bands
+    are the two traffic mixes (acceptance is a property of
+    (draft, workload); flipping the band flips the workload)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(lo, hi, size=int(rng.integers(
+        plens[0], plens[1] + 1))).astype(np.int32) for _ in range(n)]
+
+
+def _server(module, params, draft, spec_k: int):
+    from tpudist.serve import InferenceServer, ServeConfig
+
+    return InferenceServer(
+        module, params,
+        ServeConfig(num_slots=2, queue_limit=16, prefill_pad=8,
+                    spec=True, spec_draft=draft, spec_k=spec_k),
+        install_signal_handler=False).start()
+
+
+def _drive_window(srv, pool, max_new: int, outputs: dict) -> dict:
+    """One traffic window: the whole pool once, greedy.  Returns the
+    WINDOW's acceptance (cumulative-counter deltas — each window is its
+    own measurement, not a running average)."""
+    st0 = srv.engine.spec_stats()
+    for i, p in enumerate(pool):
+        h = srv.submit(p, max_new=max_new)
+        assert h.wait(300), "request stalled"
+        key = (p.tobytes(), max_new)
+        if key in outputs:
+            assert outputs[key] == h.tokens, \
+                "greedy stream moved across arms/swaps"
+        else:
+            outputs[key] = h.tokens
+    st1 = srv.engine.spec_stats()
+    acc = st1["accepted"] - st0["accepted"]
+    dra = st1["drafted"] - st0["drafted"]
+    return {
+        "accepted": acc, "drafted": dra,
+        "acceptance": round(acc / dra, 4) if dra else None,
+    }
+
+
+def run_shift(*, smoke: bool, max_new: int, spec_k: int = 4,
+              windows_a: int = 2, windows_b: int = 3,
+              distill_steps: int = 60, seed: int = 0) -> dict:
+    from tpudist.distill import CaptureBuffer, DistillLoop, distill_draft
+
+    module, params = _model(seed)
+    pool_n = 4
+    plens = (4, 7)
+    v = CFG["vocab"]
+    pool_a = _pool(0, v // 2, pool_n, plens, seed + 1)
+    pool_b = _pool(v // 2, v, pool_n, plens, seed + 2)
+
+    # the cold-start draft both arms begin with: distilled OFFLINE on
+    # mix A — the deployment that trained for yesterday's traffic
+    draft_mod, draft_params, loss0 = distill_draft(
+        module, params, 1, pool_a, distill_steps, max_new)
+
+    timeline = []
+    swap_timeline = []
+    outputs: dict = {}  # (prompt bytes, max_new) -> tokens; shared
+    # across arms AND windows: greedy bytes must never move
+
+    # -- frozen arm ---------------------------------------------------------
+    srv = _server(module, params, (draft_mod, draft_params), spec_k)
+    frozen_a = []
+    frozen_b = []
+    try:
+        for w in range(windows_a):
+            row = _drive_window(srv, pool_a, max_new, outputs)
+            frozen_a.append(row["acceptance"])
+            timeline.append({"arm": "frozen", "phase": "A", "window": w,
+                             **row})
+        for w in range(windows_b):
+            row = _drive_window(srv, pool_b, max_new, outputs)
+            frozen_b.append(row["acceptance"])
+            timeline.append({"arm": "frozen", "phase": "B", "window": w,
+                             **row})
+    finally:
+        srv.close(60)
+
+    # -- flywheel arm -------------------------------------------------------
+    srv = _server(module, params, (draft_mod, draft_params), spec_k)
+    # small ring: phase-A streams evict as B traffic arrives, so the
+    # post-flip rounds train on (mostly) the CURRENT mix
+    srv.attach_capture(CaptureBuffer(
+        budget_tokens=pool_n * (plens[1] + max_new) * (windows_b + 1)))
+    loop = DistillLoop(srv, srv.capture, steps=distill_steps,
+                       min_tokens=32, holdout=0.25, margin=0.01)
+    fly_a = []
+    fly_b = []
+    pins0 = None
+    try:
+        for w in range(windows_a):
+            row = _drive_window(srv, pool_a, max_new, outputs)
+            fly_a.append(row["acceptance"])
+            timeline.append({"arm": "flywheel", "phase": "A", "window": w,
+                             **row})
+        for w in range(windows_b):
+            row = _drive_window(srv, pool_b, max_new, outputs)
+            fly_b.append(row["acceptance"])
+            timeline.append({"arm": "flywheel", "phase": "B", "window": w,
+                             "swaps": srv.engine.draft_swaps, **row})
+            if pins0 is None:
+                # baseline AFTER every traffic shape has been seen once
+                # (both pools' prompt-length buckets) — any growth from
+                # here is the swaps', and the claim is: none
+                pins0 = dict(srv.engine.compile_counts())
+            if loop.swaps == 0:
+                # the controlled flywheel turn (the background thread's
+                # cadence, driven synchronously for determinism)
+                r = loop.run_once()
+                swap_timeline.append({k: r.get(k) for k in (
+                    "round", "swapped", "reason", "loss",
+                    "candidate_acceptance", "serving_holdout_acceptance",
+                    "live_acceptance", "baseline", "swap_s",
+                    "lanes_rearmed", "round_s")})
+        pins1 = dict(srv.engine.compile_counts())
+        draft_swaps = srv.engine.draft_swaps
+        capture_stats = srv.capture.stats()
+    finally:
+        srv.close(60)
+
+    def _mean(xs):
+        xs = [x for x in xs if x is not None]
+        return round(sum(xs) / len(xs), 4) if xs else None
+
+    # decay: the frozen draft's phase-B acceptance vs its phase-A
+    # acceptance; recovery: the flywheel's POST-SWAP windows vs the
+    # frozen arm's same windows
+    post_swap = [a for a, t in zip(
+        fly_b, [r["swaps"] > 0 for r in timeline
+                if r["arm"] == "flywheel" and r["phase"] == "B"]) if t]
+    frozen_a_mean = _mean(frozen_a)
+    frozen_b_mean = _mean(frozen_b)
+    post_swap_mean = _mean(post_swap)
+    return {
+        "bench": "distill_shift",
+        "note": ("tiny-model CPU mechanics — cross-arm acceptance on one "
+                 "schedule is the measurement, absolute tok/s is not"),
+        "smoke": bool(smoke),
+        "spec_k": spec_k, "max_new": max_new,
+        "distill_steps": distill_steps,
+        "offline_distill_loss": round(float(loss0), 5),
+        "acceptance_timeline": timeline,
+        "swap_timeline": swap_timeline,
+        "swaps": draft_swaps,
+        "rounds": loop.rounds,
+        "frozen_phase_a_acceptance": frozen_a_mean,
+        "frozen_phase_b_acceptance": frozen_b_mean,
+        "flywheel_post_swap_acceptance": post_swap_mean,
+        "frozen_decayed": (frozen_a_mean is not None
+                           and frozen_b_mean is not None
+                           and frozen_b_mean < frozen_a_mean),
+        "flywheel_recovered": (post_swap_mean is not None
+                               and frozen_b_mean is not None
+                               and post_swap_mean > frozen_b_mean),
+        "outputs_match": True,  # _drive_window asserted per stream
+        "compile_pins_flat": pins0 == pins1,
+        "capture": {k: capture_stats[k] for k in
+                    ("streams", "tokens", "evicted", "captured")},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run (fewer windows / steps)")
+    ap.add_argument("--out", default=None, help="output JSONL path")
+    ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args(argv)
+    max_new = args.max_new or (6 if args.smoke else 12)
+    steps = args.steps or (60 if args.smoke else 200)
+    row = run_shift(smoke=args.smoke, max_new=max_new,
+                    distill_steps=steps,
+                    windows_b=3 if args.smoke else 4)
+    line = json.dumps(row)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -237,6 +237,25 @@ def main(argv=None) -> None:
             {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
         print(f"{router_out.name}: error {e!r}")
 
+    # Online draft-distillation rung (PR 17): the distribution-shift
+    # flywheel — frozen-draft acceptance decay vs gated-hot-swap
+    # recovery, swap-latency + gate timelines, byte-identity and
+    # compile-pin booleans — frozen as BENCH_DISTILL_r{NN}.json.
+    # Failure-isolated like the serve snapshot.
+    distill_out = REPO / f"BENCH_DISTILL_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "distill_bench.py"),
+             "--smoke", "--out", str(distill_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{distill_out.name}: {json.dumps(json.loads(data[-1]))}")
+    except Exception as e:
+        distill_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{distill_out.name}: error {e!r}")
+
     # Decode per-op attribution (VERDICT Weak #2): trace the bf16 fused
     # decode loop and freeze the table naming the non-matmul residual.
     # Failure-isolated like the serve snapshot.

@@ -314,50 +314,15 @@ def _handoff_cols(h0, h1, handles) -> dict:
 
 def _distill_draft(module, params, layers: int, prompt_pool, steps: int,
                    max_new: int):
-    """Build a TRAINED draft the way production does: distill the
-    target's own greedy continuations of the serving prompt pool into a
-    shallow student (cross-entropy on next-token, the sequence-level
-    distillation objective).  Random-weight targets ship no pre-trained
-    draft pair, so the bench trains one from the serving distribution —
-    acceptance is a property of (draft, workload), and this rung
-    measures the workload a real deployment would train for.  Returns
-    ``(draft_module, draft_params, final_loss)``."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
+    """Build a TRAINED draft the way production does — now delegates to
+    ``tpudist.distill.distill_draft``, the same distillation path the
+    online flywheel uses (see Online draft distillation in
+    docs/ARCHITECTURE.md).  Returns ``(draft_module, draft_params,
+    final_loss)``."""
+    from tpudist.distill import distill_draft
 
-    from tpudist.models import make_generator, tied_draft
-    from tpudist.models.transformer import lm_loss_with_targets
-
-    draft_mod, _ = tied_draft(module, params, layers)
-    dp = draft_mod.init(jax.random.PRNGKey(11), jnp.zeros((1, 8), jnp.int32))
-    gen = make_generator(module, params, max_new)
-    T = max(len(p) for p in prompt_pool) + max_new
-    toks = np.zeros((len(prompt_pool), T), np.int32)
-    tgts = np.full((len(prompt_pool), T - 1), -1, np.int32)
-    for i, p in enumerate(prompt_pool):
-        out = np.asarray(gen(jnp.asarray(p)[None]))[0]
-        toks[i, :len(out)] = out
-        tgts[i, :len(out) - 1] = out[1:]
-    opt = optax.adam(3e-3)
-    ost = opt.init(dp)
-
-    @jax.jit
-    def train_step(dp, ost, toks, tgts):
-        def loss_fn(dp):
-            return lm_loss_with_targets(draft_mod.apply(dp, toks[:, :-1]),
-                                        tgts)
-
-        loss, g = jax.value_and_grad(loss_fn)(dp)
-        up, ost = opt.update(g, ost)
-        return optax.apply_updates(dp, up), ost, loss
-
-    tj, gj = jnp.asarray(toks), jnp.asarray(tgts)
-    loss = None
-    for _ in range(max(1, steps)):
-        dp, ost, loss = train_step(dp, ost, tj, gj)
-    return draft_mod, dp, float(loss)
+    return distill_draft(module, params, layers, prompt_pool, steps,
+                         max_new)
 
 
 def run_spec_sweep(*, module, params, make_server, vocab, requests, plens,

@@ -1,0 +1,517 @@
+"""Online draft distillation (tpudist.distill): the capture ring's
+never-silent ledger, the permutation train/holdout split, the measured
+swap gate, engine hot-swap geometry + compile pins, swap-under-churn
+greedy byte-identity (both server flavors), the ``draft_swap_corrupt``
+chaos rejection, and the flywheel loop e2e.  The sampled twin of the
+churn test rides the slow lane."""
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist.distill import (
+    CaptureBuffer,
+    CapturedStream,
+    DistillLoop,
+    distill_draft,
+    distill_streams,
+    gate_swap,
+    pack_streams,
+    score_holdout,
+)
+from tpudist.models import create_transformer, generate, tied_draft
+from tpudist.serve import DisaggServer, InferenceServer, ServeConfig
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+           max_len=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+def _prompt(plen, seed, lo=0, hi=None):
+    rng = np.random.default_rng(seed)
+    return rng.integers(lo, hi if hi is not None else CFG["vocab"],
+                        size=plen).astype(np.int32)
+
+
+def _stream(tokens, plen, *, greedy=True, tenant=None, adapter=None):
+    return CapturedStream(
+        tokens=np.asarray(tokens, np.int32), prompt_len=plen,
+        greedy=greedy, tenant=tenant, adapter=adapter)
+
+
+def _server(model, *, flavor="server", spec_k=4, **cfg_kw):
+    module, params = model
+    cfg = ServeConfig(num_slots=2, queue_limit=16, prefill_pad=8,
+                      spec=True, spec_draft_layers=1, spec_k=spec_k,
+                      **cfg_kw)
+    if flavor == "disagg":
+        return DisaggServer(module, params, cfg,
+                            install_signal_handler=False).start()
+    return InferenceServer(module, params, cfg,
+                           install_signal_handler=False).start()
+
+
+class TestCaptureBuffer:
+    def test_budget_eviction_oldest_first_and_counted(self):
+        buf = CaptureBuffer(budget_tokens=20)
+        for i in range(4):
+            assert buf.offer([i] * 4, [i] * 4, greedy=True)  # 8 tokens
+        st = buf.stats()
+        # 4 offers * 8 tokens > 20: the oldest fell out, counted
+        assert st["captured"] == 4 and st["evicted"] == 2
+        assert st["tokens"] <= 20
+        firsts = [int(s.tokens[0]) for s in buf.snapshot()]
+        assert firsts == [2, 3]  # oldest-first eviction
+
+    def test_sampling_and_drops_counted_never_silent(self):
+        buf = CaptureBuffer(budget_tokens=64, sample_every=2)
+        kept = [buf.offer([1, 2], [3], greedy=True) for _ in range(4)]
+        assert kept == [False, True, False, True]
+        assert buf.stats()["sampled_out"] == 2
+        buf = CaptureBuffer(budget_tokens=64)
+        assert buf.offer([1, 2], [3], greedy=True)
+        assert not buf.offer([1], [], greedy=True)       # empty emit
+        assert not buf.offer([0] * 100, [1, 2], greedy=True)  # oversize
+        st = buf.stats()
+        assert st["seen"] == 3
+        # every offer lands in exactly one ledger bucket
+        assert st["seen"] == (st["captured"] + st["sampled_out"]
+                              + st["dropped_empty"]
+                              + st["dropped_oversize"])
+        assert st["dropped_empty"] == 1 and st["dropped_oversize"] == 1
+
+    def test_split_holdout_partitions_and_is_deterministic(self):
+        streams = [_stream([i] * 4, 2) for i in range(12)]
+        train, hold = CaptureBuffer.split_holdout(streams, 0.25)
+        train2, hold2 = CaptureBuffer.split_holdout(streams, 0.25)
+        assert [s.tokens[0] for s in train] == \
+            [s.tokens[0] for s in train2]
+        assert len(hold) == 3 and len(train) == 9
+        ids = sorted(int(s.tokens[0]) for s in train + hold)
+        assert ids == list(range(12))  # a partition, nothing dropped
+
+    def test_split_holdout_not_aliased_with_pool_period(self):
+        """A strided every-k-th split aligned with a repeat-prompt
+        pool's period would hold out the SAME prompts every round
+        (scoring unseen-prompt generalization, not fit to the live
+        mix).  The permutation split's picks must not collapse onto
+        one residue class."""
+        streams = [_stream([i] * 4, 2) for i in range(16)]
+        _, hold = CaptureBuffer.split_holdout(streams, 0.25)
+        residues = {int(s.tokens[0]) % 4 for s in hold}
+        assert len(hold) == 4
+        assert len(residues) > 1
+
+    def test_split_holdout_edges(self):
+        assert CaptureBuffer.split_holdout([], 0.25) == ([], [])
+        one = [_stream([1, 2, 3], 1)]
+        train, hold = CaptureBuffer.split_holdout(one, 0.25)
+        assert train and hold  # a single stream lands on both sides
+        two = [_stream([1], 1), _stream([2], 1)]
+        train, hold = CaptureBuffer.split_holdout(two, 0.25)
+        assert len(train) == 1 and len(hold) == 1
+
+    def test_heaviest_adapter(self):
+        buf = CaptureBuffer(budget_tokens=4096)
+        for _ in range(2):
+            buf.offer([1] * 2, [2] * 2, greedy=True, adapter="light")
+        for _ in range(3):
+            buf.offer([1] * 8, [2] * 8, greedy=True, adapter="heavy")
+        buf.offer([1] * 30, [2] * 30, greedy=True, adapter="single")
+        assert buf.heaviest_adapter() == "heavy"
+        assert buf.heaviest_adapter(min_streams=4) is None
+
+    def test_adapter_snapshot_filter_and_stats_labels(self):
+        buf = CaptureBuffer(budget_tokens=4096)
+        buf.offer([1], [2], greedy=True, adapter="a", tenant="t0")
+        buf.offer([1], [2], greedy=False)
+        only = buf.snapshot("a", only_adapter=True)
+        assert len(only) == 1 and only[0].adapter == "a"
+        st = buf.stats()
+        assert st["by_adapter"] == {"a": 1}
+        assert st["by_tenant"] == {"t0": 1, "default": 1}
+        assert st["greedy_streams"] == 1
+
+    def test_from_env_gating(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_DISTILL_CAPTURE", raising=False)
+        assert CaptureBuffer.from_env() is None  # disarmed default
+        monkeypatch.setenv("TPUDIST_DISTILL_CAPTURE", "1")
+        monkeypatch.setenv("TPUDIST_DISTILL_BUFFER_TOKENS", "123")
+        monkeypatch.setenv("TPUDIST_DISTILL_SAMPLE", "3")
+        buf = CaptureBuffer.from_env()
+        assert buf.budget_tokens == 123 and buf.sample_every == 3
+
+
+class TestPackStreams:
+    def test_pads_with_minus_one(self):
+        toks = pack_streams([_stream([1, 2, 3], 1), _stream([4, 5], 1)])
+        assert toks.shape == (2, 3) and toks.dtype == np.int32
+        assert toks[1, 2] == -1
+
+    def test_pad_to_and_pad_rows_to(self):
+        toks = pack_streams([_stream([1, 2], 1)], pad_to=5, pad_rows_to=4)
+        assert toks.shape == (4, 5)
+        assert np.all(toks[1:] == -1)  # padded rows fully masked
+        with pytest.raises(ValueError):
+            pack_streams([_stream([1, 2, 3], 1)], pad_to=2)
+        with pytest.raises(ValueError):
+            pack_streams([])
+
+
+class TestScoreAndGate:
+    def test_self_draft_scores_perfect_acceptance(self, model):
+        """The target scored as its own draft on its own greedy
+        continuation: teacher-forced argmax agreement is exact, so
+        match and windowed acceptance both hit 1.0 — the scorer's
+        oracle calibration."""
+        module, params = model
+        import jax.numpy as jnp
+
+        p = _prompt(4, 7)
+        out = np.asarray(generate(module, params,
+                                  jnp.asarray(p)[None], 8))[0]
+        s = _stream(out, len(p))
+        res = score_holdout(module, params, [s], spec_k=4)
+        assert res["match"] == 1.0 and res["acceptance"] == 1.0
+        assert res["accepted_per_pass"] == 5.0  # k + the bonus token
+
+    def test_score_empty_streams(self, model):
+        module, params = model
+        res = score_holdout(module, params, [], spec_k=4)
+        assert res["acceptance"] is None and res["streams"] == 0
+
+    def test_gate_measured_win_and_hysteresis(self):
+        win = gate_swap({"acceptance": 0.8}, {"acceptance": 0.5}, 0.6,
+                        margin=0.1)
+        assert win["swap"] and win["reason"] == "measured_win"
+        assert win["baseline"] == 0.6  # max(holdout re-score, live)
+        flap = gate_swap({"acceptance": 0.65}, {"acceptance": 0.5}, 0.6,
+                         margin=0.1)
+        assert not flap["swap"] and flap["reason"] == "below_margin"
+
+    def test_gate_missing_measurements(self):
+        no_hold = gate_swap({"acceptance": None}, {"acceptance": 0.5},
+                            None)
+        assert not no_hold["swap"] and no_hold["reason"] == "no_holdout"
+        cold = gate_swap({"acceptance": 0.4}, {"acceptance": None}, None)
+        assert cold["swap"] and cold["reason"] == "no_baseline"
+
+
+class TestDistillStreams:
+    def test_candidate_keeps_geometry_and_serving_params_survive(
+            self, model):
+        """One Trainer round returns a same-geometry candidate AND the
+        warm-start params stay alive (the train step donates its state
+        buffers — a shallow warm start would delete the serving draft
+        out from under the dispatcher)."""
+        module, params = model
+        dmod, dparams = tied_draft(module, params, 1)
+        streams = [_stream(_prompt(8, i), 4) for i in range(4)]
+        cand, loss = distill_streams(dmod, dparams, streams, steps=2)
+        assert loss is not None
+        ref_l, ref_def = jax.tree.flatten(dparams)
+        new_l, new_def = jax.tree.flatten(cand)
+        assert new_def == ref_def
+        for r, n in zip(ref_l, new_l):
+            assert tuple(r.shape) == tuple(n.shape)
+            np.asarray(r)  # raises if the warm start was donated away
+
+
+class TestEngineSwap:
+    def _spec_server(self, model):
+        return _server(model)
+
+    def test_swap_geometry_mismatch_raises(self, model):
+        srv = self._spec_server(model)
+        try:
+            _, dparams = srv.draft_ref()
+            bad_shape = jax.tree.map(
+                lambda a: np.zeros(tuple(d + 1 for d in a.shape),
+                                   a.dtype), dparams)
+            with pytest.raises(ValueError, match="geometry"):
+                srv.swap_draft(bad_shape)
+            leaves, treedef = jax.tree.flatten(dparams)
+            with pytest.raises(ValueError, match="geometry"):
+                srv.swap_draft({"not": {"the": leaves[0]}})
+            assert srv.engine.draft_swaps == 0  # nothing landed
+        finally:
+            srv.close(60)
+
+    def test_swap_on_non_spec_server_raises(self, model):
+        module, params = model
+        srv = InferenceServer(
+            module, params,
+            ServeConfig(num_slots=2, queue_limit=8, prefill_pad=8),
+            install_signal_handler=False).start()
+        try:
+            assert srv.draft_ref() is None
+            with pytest.raises(RuntimeError):
+                srv.swap_draft({})
+        finally:
+            srv.close(60)
+
+
+class TestSwapUnderChurn:
+    """The tentpole invariants: ≥ 2 hot-swaps under live admissions,
+    greedy output byte-identical throughout, compile pins flat across
+    the swaps (dparams are a runtime argument, not a compile constant)."""
+
+    def _pool(self, n=4):
+        return [_prompt(3 + i, 20 + i) for i in range(n)]
+
+    def test_two_swaps_byte_identical_pins_flat(self, model):
+        module, params = model
+        srv = _server(model)
+        pool = self._pool()
+        ref = {}
+        try:
+            for p in pool:  # warm every shape once, record the oracle
+                h = srv.submit(p, max_new=6)
+                assert h.wait(120)
+                ref[p.tobytes()] = h.tokens
+            pins0 = dict(srv.engine.compile_counts())
+            dmod, dparams = srv.draft_ref()
+            rng = jax.random.PRNGKey(99)
+            for swap_i in range(2):
+                # a same-geometry candidate with genuinely different
+                # weights each time (byte identity must hold for ANY
+                # legal draft — the target verify is the oracle)
+                rng, sub = jax.random.split(rng)
+                noise = jax.tree.map(
+                    lambda a: np.asarray(
+                        a) + 0.05 * np.asarray(jax.random.normal(
+                            sub, a.shape, a.dtype)) if np.issubdtype(
+                        np.asarray(a).dtype, np.floating) else a,
+                    dparams)
+                # swap with requests IN FLIGHT: the loop lands it
+                # between decode blocks
+                handles = [srv.submit(p, max_new=6) for p in pool]
+                info = srv.swap_draft(noise)
+                assert info["swapped"]
+                for p, h in zip(pool, handles):
+                    assert h.wait(120)
+                    assert h.tokens == ref[p.tobytes()], \
+                        f"greedy bytes moved across swap {swap_i}"
+            assert srv.engine.draft_swaps == 2
+            # another full pool after the last swap — still identical
+            for p in pool:
+                h = srv.submit(p, max_new=6)
+                assert h.wait(120)
+                assert h.tokens == ref[p.tobytes()]
+            pins1 = dict(srv.engine.compile_counts())
+            assert pins1 == pins0, f"compile pins moved: {pins0} -> {pins1}"
+        finally:
+            srv.close(60)
+
+    def test_disagg_decode_pool_swap_e2e(self, model):
+        """Disagg flavor: the gated swap broadcasts across the decode
+        pool (lockstep counters), bytes identical, statusz blocks
+        present."""
+        srv = _server(model, flavor="disagg", decode_workers=2,
+                      handoff="serial")
+        pool = self._pool()
+        ref = {}
+        try:
+            for p in pool:
+                h = srv.submit(p, max_new=5)
+                assert h.wait(120)
+                ref[p.tobytes()] = h.tokens
+            dmod, dparams = srv.draft_ref()
+            noise = jax.tree.map(
+                lambda a: np.asarray(a) * 0.9 if np.issubdtype(
+                    np.asarray(a).dtype, np.floating) else a, dparams)
+            info = srv.swap_draft(noise)
+            assert info["swapped"] and info["engines"] == 2
+            assert all(e.draft_swaps == 1 for e in srv.decode_pool)
+            sp = srv.stats()["decode_pool"]["spec"]
+            assert sp["draft_swaps"] == 1  # logical count: lockstep max
+            for p in pool:
+                h = srv.submit(p, max_new=5)
+                assert h.wait(120)
+                assert h.tokens == ref[p.tobytes()], \
+                    "bytes moved across the disagg swap"
+        finally:
+            srv.close(60)
+
+    @pytest.mark.slow
+    def test_sampled_twin_across_swap(self, model):
+        """The sampled lane's twin.  Unlike greedy, a sampled stream is
+        NOT draft-independent (the accept tests and residual draws
+        consume the draft's proposals — speculative sampling preserves
+        the DISTRIBUTION, not the realized stream), so the invariants
+        are: (a) a swap landing IDENTICAL params moves nothing — the
+        swap mechanics (placement, lane re-arm) are invisible to the
+        sampled key schedule; (b) after a real swap, sampled streams
+        stay valid and the greedy oracle stays pinned."""
+        import jax.numpy as jnp
+
+        srv = _server(model)
+        pool = self._pool()
+        sampled_ref, greedy_ref = {}, {}
+        try:
+            for i, p in enumerate(pool):
+                h = srv.submit(p, max_new=6, temperature=0.8, seed=i)
+                assert h.wait(120)
+                sampled_ref[p.tobytes()] = h.tokens
+                g = srv.submit(p, max_new=6)
+                assert g.wait(120)
+                greedy_ref[p.tobytes()] = g.tokens
+            _, dparams = srv.draft_ref()
+            same = jax.tree.map(lambda a: jnp.array(a), dparams)
+            assert srv.swap_draft(same)["swapped"]
+            for i, p in enumerate(pool):
+                h = srv.submit(p, max_new=6, temperature=0.8, seed=i)
+                assert h.wait(120)
+                assert h.tokens == sampled_ref[p.tobytes()], \
+                    "identical-params swap moved a sampled stream"
+            noise = jax.tree.map(
+                lambda a: np.asarray(a) * 1.1 if np.issubdtype(
+                    np.asarray(a).dtype, np.floating) else a, dparams)
+            assert srv.swap_draft(noise)["swapped"]
+            for i, p in enumerate(pool):
+                h = srv.submit(p, max_new=6, temperature=0.8, seed=i)
+                assert h.wait(120)
+                assert len(h.tokens) <= 6
+                assert all(0 <= t < CFG["vocab"] for t in h.tokens)
+                g = srv.submit(p, max_new=6)
+                assert g.wait(120)
+                assert g.tokens == greedy_ref[p.tobytes()], \
+                    "greedy oracle moved across the real swap"
+        finally:
+            srv.close(60)
+
+
+class TestDistillLoop:
+    def _loaded_server(self, model, *, n_requests=6, max_new=6):
+        srv = _server(model)
+        srv.attach_capture(CaptureBuffer(budget_tokens=4096))
+        for i in range(n_requests):
+            h = srv.submit(_prompt(4, 30 + i), max_new=max_new)
+            assert h.wait(120)
+        return srv
+
+    def test_round_skips_below_min_tokens(self, model):
+        srv = _server(model)
+        srv.attach_capture(CaptureBuffer(budget_tokens=4096))
+        loop = DistillLoop(srv, srv.capture, steps=1, min_tokens=10_000)
+        try:
+            r = loop.run_once()
+            assert not r["swapped"] and r["reason"] == "min_tokens"
+            assert loop.rounds == 1 and loop.swaps == 0
+        finally:
+            srv.close(60)
+
+    def test_full_round_swaps_and_is_audited(self, model):
+        srv = self._loaded_server(model)
+        loop = DistillLoop(srv, srv.capture, steps=4, min_tokens=16,
+                           holdout=0.25, margin=-1.0)  # always-win gate
+        try:
+            r = loop.run_once()
+            assert r["swapped"] and srv.engine.draft_swaps == 1
+            # the round record carries the gate's full input (the
+            # distill_round event is this dict — auditable stream)
+            for key in ("candidate_acceptance", "baseline", "loss",
+                        "swap_s", "capture_tokens", "round_s"):
+                assert key in r, key
+            assert loop.stats()["swaps"] == 1
+            sz = srv._statusz_doc()
+            assert "distill" in sz and "spec" in sz
+            assert sz["distill"]["capture"]["captured"] == 6
+        finally:
+            srv.close(60)
+
+    def test_round_preserves_host_telemetry_session(self, model, tmp_path):
+        """The flywheel trains through the repo Trainer INSIDE a live
+        serving process — the embedded loop must not finish the host's
+        telemetry session (ownership rule in ``finalize_run``), or every
+        event/metric feed dies after the first background round.  The
+        ``draft_swap`` event landing in the live counter is the proof."""
+        from tpudist import telemetry
+        from tpudist.telemetry import metrics
+
+        srv = self._loaded_server(model)
+        telemetry.start(tmp_path)
+        try:
+            before = metrics.registry().counter(
+                "tpudist_draft_swaps_total").value
+            loop = DistillLoop(srv, srv.capture, steps=2, min_tokens=16,
+                               margin=-1.0)
+            r = loop.run_once()
+            assert r["swapped"]
+            # session survived the embedded Trainer.fit ...
+            assert telemetry.active() is not None
+            # ... so the swap event fed the scrapeable counter
+            after = metrics.registry().counter(
+                "tpudist_draft_swaps_total").value
+            assert after == before + 1
+        finally:
+            telemetry.finish(write_report=False)
+            srv.close(60)
+
+    def test_capture_autowired_from_env(self, model, monkeypatch):
+        monkeypatch.setenv("TPUDIST_DISTILL_CAPTURE", "1")
+        srv = _server(model)
+        try:
+            assert srv.capture is not None
+            h = srv.submit(_prompt(4, 3), max_new=4)
+            assert h.wait(120)
+            assert srv.capture.stats()["captured"] == 1
+        finally:
+            srv.close(60)
+
+    def test_background_thread_runs_rounds(self, model):
+        srv = self._loaded_server(model, n_requests=4)
+        loop = DistillLoop(srv, srv.capture, interval_s=0.05, steps=1,
+                           min_tokens=10_000)  # skip-fast rounds
+        try:
+            loop.start()
+            with pytest.raises(RuntimeError):
+                loop.start()  # double-start refused
+            deadline = time.monotonic() + 30
+            while loop.rounds < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert loop.rounds >= 2
+            assert loop.stop(10)
+        finally:
+            srv.close(60)
+
+
+class TestChaosDraftSwapCorrupt:
+    def test_corrupt_candidate_rejected_serving_untouched(
+            self, model, monkeypatch):
+        from tpudist.runtime import faults
+
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        faults.disarm()
+        faults.arm("draft_swap_corrupt@nth:1")
+        srv = _server(model)
+        srv.attach_capture(CaptureBuffer(budget_tokens=4096))
+        try:
+            for i in range(6):
+                h = srv.submit(_prompt(4, 40 + i), max_new=6)
+                assert h.wait(120)
+            before = [np.asarray(x).copy()
+                      for x in jax.tree.leaves(srv.engine.draft_params)]
+            loop = DistillLoop(srv, srv.capture, steps=2, min_tokens=16,
+                               margin=0.0)
+            r = loop.run_once()
+            # the garbled candidate must lose the held-out eval
+            assert r.get("fault") == "draft_swap_corrupt"
+            assert not r["swapped"]
+            assert loop.corrupt_rejected == 1
+            assert srv.engine.draft_swaps == 0
+            after = [np.asarray(x)
+                     for x in jax.tree.leaves(srv.engine.draft_params)]
+            assert all(np.array_equal(a, b)
+                       for a, b in zip(before, after)), \
+                "serving draft moved under a corrupt candidate"
+        finally:
+            faults.disarm()
+            srv.close(60)

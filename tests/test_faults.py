@@ -132,6 +132,31 @@ class TestServeInjection:
         third = mk()
         assert not faults.inject_handoff(third)  # one-shot
 
+    def test_draft_swap_corrupt_grammar_and_nth_gating(self):
+        plan = faults.parse("draft_swap_corrupt@nth:2")
+        assert plan[0].kind == "draft_swap_corrupt"
+        assert plan[0].params == {"nth": 2}
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse("draft_swap_corrupt")  # nth is required
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse("draft_swap_corrupt@step:1")  # wrong param
+        faults.arm("draft_swap_corrupt@nth:2")
+        assert not faults.inject_draft_swap(1)  # 1st candidate passes
+        assert faults.inject_draft_swap(2)      # 2nd garbled
+        assert not faults.inject_draft_swap(3)  # one-shot
+
+    def test_draft_swap_corrupt_garbles_candidate_leaves(self):
+        from tpudist.distill.swap import maybe_corrupt_candidate
+
+        cand = {"w": np.zeros(4, np.float32), "b": np.ones(2, np.float32)}
+        out, corrupted = maybe_corrupt_candidate(cand, 1)
+        assert not corrupted and out is cand  # disarmed: pass-through
+        faults.arm("draft_swap_corrupt@nth:1")
+        out, corrupted = maybe_corrupt_candidate(cand, 1)
+        assert corrupted
+        assert np.all(np.asarray(out["w"]) == 1000.0)
+        assert np.all(cand["w"] == 0.0)  # original candidate untouched
+
 
 class TestGating:
     def test_sigterm_fires_at_step_and_only_once(self):

@@ -629,6 +629,8 @@ class TestAggregatorBackCompat:
         # a router-less stream gains no fleet section (PR-16 additive
         # discipline — every single-replica stream is router-less)
         assert "fleet" not in report["serving"]
+        # a flywheel-less stream gains no distill section (PR-17)
+        assert "distill" not in report["serving"]
         assert report["serving"]["requests_finished"] == 1
         # no trace artifacts leak into the report of a trace-less stream
         assert "trace" not in json.dumps(report).lower()
@@ -720,6 +722,45 @@ class TestAggregatorBackCompat:
         assert fl["replica_deaths"] == 1
         assert fl["migrations"] == {"ok": 1}
         assert "fleet router" in render_markdown(after)
+        for key in ("goodput", "step", "wall_clock_s", "per_rank"):
+            assert before[key] == after[key], f"{key} moved"
+        for key in ("ttft", "tpot", "finish_reasons", "decode_tokens",
+                    "tokens_out", "occupancy_mean"):
+            assert before["serving"][key] == after["serving"][key]
+
+    def test_distill_records_are_purely_additive(self, tmp_path):
+        """Draft-distillation events (PR 17) bolt a `distill` section
+        on; every pre-existing serving field keeps its exact value."""
+        self._write_old(tmp_path)
+        before = aggregate_run(tmp_path)
+        with open(tmp_path / "rank0_gen0.jsonl", "a") as f:
+            for rec in (
+                {"kind": "event", "name": "distill_round", "t": 100.1,
+                 "dur": 0.0, "rank": 0, "gen": 0, "round": 1,
+                 "swapped": False, "reason": "below_margin",
+                 "candidate_acceptance": 0.4, "baseline": 0.5,
+                 "capture_streams": 6, "capture_tokens": 120,
+                 "capture_evicted": 2},
+                {"kind": "event", "name": "distill_round", "t": 100.2,
+                 "dur": 0.0, "rank": 0, "gen": 0, "round": 2,
+                 "swapped": True, "reason": "measured_win",
+                 "candidate_acceptance": 0.9, "baseline": 0.5,
+                 "swap_s": 0.004, "capture_streams": 8,
+                 "capture_tokens": 160, "capture_evicted": 4},
+                {"kind": "event", "name": "draft_swap", "t": 100.2,
+                 "dur": 0.0, "rank": 0, "gen": 0, "swap_s": 0.004,
+                 "lanes_rearmed": 2, "draft_swaps": 1},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        after = aggregate_run(tmp_path)
+        di = after["serving"]["distill"]
+        assert di["rounds"] == 2 and di["swaps"] == 1
+        assert di["round_reasons"] == {"below_margin": 1,
+                                       "measured_win": 1}
+        assert di["acceptance_gain"]["max"] == pytest.approx(0.4)
+        assert di["swap_s"]["p50"] == pytest.approx(0.004)
+        assert di["capture"]["capture_streams"] == 8
+        assert "draft distillation" in render_markdown(after)
         for key in ("goodput", "step", "wall_clock_s", "per_rank"):
             assert before[key] == after[key], f"{key} moved"
         for key in ("ttft", "tpot", "finish_reasons", "decode_tokens",

@@ -1,0 +1,38 @@
+"""Online draft distillation (the serving↔training flywheel).
+
+Three pieces wired end to end: :mod:`capture` taps finished-request
+streams off the serving loop into a bounded ring, :mod:`loop` drives
+the repo's own Trainer on that ring in a background thread, and
+:mod:`swap` gates the resulting candidate on a held-out slice before
+the server lands it between decode blocks as a pure same-shape param
+update (compile pins flat, greedy bytes identical — speculation's
+correctness never depended on the draft).
+
+Import surface is deliberately lazy-light: :class:`CaptureBuffer` is
+numpy+stdlib (the serving tap must not drag jax), the trainer/scorer
+halves import jax only when a round runs.
+"""
+
+from tpudist.distill.capture import CaptureBuffer, CapturedStream
+from tpudist.distill.loop import DistillLoop
+from tpudist.distill.swap import gate_swap, score_holdout
+from tpudist.distill.train import (
+    DraftDistillModule,
+    continuations_from_target,
+    distill_draft,
+    distill_streams,
+    pack_streams,
+)
+
+__all__ = [
+    "CaptureBuffer",
+    "CapturedStream",
+    "DistillLoop",
+    "DraftDistillModule",
+    "continuations_from_target",
+    "distill_draft",
+    "distill_streams",
+    "gate_swap",
+    "pack_streams",
+    "score_holdout",
+]

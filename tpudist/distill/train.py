@@ -1,0 +1,199 @@
+"""The one draft-distillation code path.
+
+Two entries share the same objective (next-token cross entropy on the
+target's own continuations — sequence-level distillation):
+
+- :func:`distill_draft` — the offline/bench form previously inlined in
+  ``benchmarks/serve_bench.py --spec-distill``: GENERATE the target's
+  greedy continuations of a prompt pool, then fit a fresh tied draft to
+  them.  ``serve_bench`` now imports it from here (dedup satellite —
+  one distillation implementation, no drift).
+- :func:`DraftDistillModule` + :func:`pack_streams` — the online form:
+  the capture ring already holds the continuations the target emitted
+  in production, so the flywheel skips generation and drives the
+  repo's own :class:`~tpudist.trainer.trainer.Trainer` (the training
+  stack finally running TOGETHER with serving) on the packed streams,
+  warm-started from the serving draft's current params.
+
+Padding contract: packed batches pad with ``-1``.  The apply shim
+clamps tokens to ``>= 0`` before the embed (a ``-1`` through
+``jnp.take`` would read garbage rows) and the loss masks every
+position whose TARGET is ``-1`` (``lm_loss_with_targets``), so pad
+positions contribute exactly zero gradient.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def pack_streams(streams, pad_to: Optional[int] = None,
+                 pad_rows_to: Optional[int] = None) -> np.ndarray:
+    """Pack captured streams into one ``[N, T]`` int32 matrix padded
+    with ``-1`` — ONE shape per round, so the train step and the
+    holdout scorer each compile once.  ``pad_to`` forces the time dim
+    (rounds with growing rings can pin a shape across rounds);
+    ``pad_rows_to`` pads N with all-``-1`` rows (fully masked → zero
+    loss) so the batch divides a data-parallel mesh."""
+    if not streams:
+        raise ValueError("pack_streams: no streams")
+    T = max(len(s) for s in streams)
+    if pad_to is not None:
+        if pad_to < T:
+            raise ValueError(f"pad_to={pad_to} < longest stream {T}")
+        T = int(pad_to)
+    N = len(streams)
+    if pad_rows_to is not None and pad_rows_to > N:
+        N = int(pad_rows_to)
+    toks = np.full((N, T), -1, np.int32)
+    for i, s in enumerate(streams):
+        t = s.tokens if hasattr(s, "tokens") else np.asarray(s, np.int32)
+        toks[i, :len(t)] = t
+    return toks
+
+
+class DraftDistillModule:
+    """The :class:`~tpudist.trainer.trainer.LMTrainerModule` the
+    flywheel feeds to ``Trainer.fit``: one tied/loaded draft, warm-
+    started from the SERVING params (same geometry by construction —
+    the swap-gate invariant), pad-aware apply + loss."""
+
+    def __init__(self, draft_module, draft_params, lr: float = 3e-3):
+        from tpudist.trainer.trainer import LMTrainerModule
+
+        # subclass-at-init keeps this module importable without jax
+        # until a round actually runs
+        self._base = LMTrainerModule
+        self._module = draft_module
+        self._params = draft_params
+        self._lr = float(lr)
+
+    def build(self):
+        import jax.numpy as jnp
+        import optax
+
+        from tpudist.models.transformer import lm_loss_with_targets
+        from tpudist.trainer.trainer import LMTrainerModule
+
+        draft_module, draft_params, lr = (
+            self._module, self._params, self._lr)
+
+        class _Shim:
+            """``flax_mod.apply``-shaped wrapper clamping pad tokens
+            before the embed (the LM trainer path only calls
+            ``.apply``)."""
+
+            def apply(self, p, toks):
+                return draft_module.apply(p, jnp.maximum(toks, 0))
+
+        class _Module(LMTrainerModule):
+            def configure_lm(self, rng):
+                # deep-copy the warm start: the LM train step DONATES
+                # its state buffers, and these are the ENGINE's live
+                # serving params — donating them would delete the
+                # serving draft out from under the dispatcher
+                import jax
+
+                return _Shim(), jax.tree.map(jnp.array, draft_params)
+
+            def configure_optimizers(self):
+                return optax.adam(lr)
+
+            def loss(self, logits, tokens):
+                # next-token targets; pad (and the position BEFORE a
+                # pad run's start) masked via the -1 convention
+                return lm_loss_with_targets(logits[:, :-1], tokens[:, 1:])
+
+        return _Module()
+
+
+def distill_streams(draft_module, draft_params, streams, *,
+                    steps: int = 40, lr: float = 3e-3,
+                    max_steps_cap: int = 1000) -> Tuple[object, float]:
+    """One distillation round through the repo Trainer: fit the draft
+    (warm-started from ``draft_params``) to the captured streams and
+    return ``(candidate_params, final_loss)``.  Runs on whatever mesh
+    the process holds (``strategy='dp'`` — replicated draft state, the
+    serving-compatible layout)."""
+    import jax
+
+    from tpudist.trainer.trainer import Trainer
+
+    steps = max(1, min(int(steps), max_steps_cap))
+    toks = pack_streams(
+        streams, pad_rows_to=-(-len(streams) // jax.device_count())
+        * jax.device_count())
+    trainer = Trainer(max_steps=steps, strategy="dp", dry_run=True,
+                      progress_bar=False, log_every=steps)
+    losses = trainer.fit(
+        DraftDistillModule(draft_module, draft_params, lr).build(),
+        [toks])
+    state = trainer.final_states
+    cand = state.params if hasattr(state, "params") else state
+    return cand, (losses or {}).get("lm")
+
+
+def distill_draft(module, params, layers: int, prompt_pool,
+                  steps: int, max_new: int, *, lr: float = 3e-3,
+                  seed: int = 11):
+    """Build a TRAINED draft the way production does: distill the
+    target's own greedy continuations of the serving prompt pool into a
+    shallow student (cross-entropy on next-token, the sequence-level
+    distillation objective).  Random-weight targets ship no pre-trained
+    draft pair, so benches (and cold-start deployments) train one from
+    the serving distribution — acceptance is a property of
+    (draft, workload), and this trains for the workload.  Returns
+    ``(draft_module, draft_params, final_loss)``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudist.models import make_generator, tied_draft
+    from tpudist.models.transformer import lm_loss_with_targets
+
+    draft_mod, _ = tied_draft(module, params, layers)
+    dp = draft_mod.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))
+    gen = make_generator(module, params, max_new)
+    T = max(len(p) for p in prompt_pool) + max_new
+    toks = np.zeros((len(prompt_pool), T), np.int32)
+    tgts = np.full((len(prompt_pool), T - 1), -1, np.int32)
+    for i, p in enumerate(prompt_pool):
+        out = np.asarray(gen(jnp.asarray(p)[None]))[0]
+        toks[i, :len(out)] = out
+        tgts[i, :len(out) - 1] = out[1:]
+    opt = optax.adam(lr)
+    ost = opt.init(dp)
+
+    @jax.jit
+    def train_step(dp, ost, toks, tgts):
+        def loss_fn(dp):
+            return lm_loss_with_targets(draft_mod.apply(dp, toks[:, :-1]),
+                                        tgts)
+
+        loss, g = jax.value_and_grad(loss_fn)(dp)
+        up, ost = opt.update(g, ost)
+        return optax.apply_updates(dp, up), ost, loss
+
+    tj, gj = jnp.asarray(toks), jnp.asarray(tgts)
+    loss = None
+    for _ in range(max(1, steps)):
+        dp, ost, loss = train_step(dp, ost, tj, gj)
+    return draft_mod, dp, float(loss)
+
+
+def continuations_from_target(module, params, prompt_pool, max_new: int,
+                              ) -> List[np.ndarray]:
+    """The target's greedy continuations of a prompt pool as plain
+    ``[T_i]`` arrays (prompt + emitted) — the offline twin of what the
+    capture ring collects from live traffic (benches use it to seed a
+    flywheel without a serving warmup phase)."""
+    import jax.numpy as jnp
+
+    from tpudist.models import make_generator
+
+    gen = make_generator(module, params, max_new)
+    return [np.asarray(gen(jnp.asarray(p)[None]))[0]
+            for p in prompt_pool]

@@ -444,11 +444,16 @@ class SlotDecode(NamedTuple):
     #   ``init_slots``; paged engines get a PagedKV over the DRAFT
     #   template sharing the target pool's block ids — "its own smaller
     #   block pool": same allocator decisions, draft-sized bytes);
-    # - ``draft_prefill(dcache, [tables, poss,] prompts, clens, dsts)``
-    #   → teacher-force each admission lane's prompt chunk through the
-    #   draft (the draft twin of ``insert_batch``'s cache half);
-    # - ``draft_extend(dcache, slot, chunk, clen)`` → one chunked-prefill
-    #   append (twin of ``prefill_extend``);
+    # - ``draft_prefill(dcache, [tables, poss,] prompts, clens, dsts,
+    #   dparams)`` → teacher-force each admission lane's prompt chunk
+    #   through the draft (the draft twin of ``insert_batch``'s cache
+    #   half).  Every draft FORWARD program (``draft_prefill`` /
+    #   ``draft_extend`` / ``draft_track`` / ``draft_propose``) takes the
+    #   draft's parameter pytree as its LAST argument instead of closing
+    #   over it — a same-geometry replacement hot-swaps as pure data
+    #   through the SAME compiled programs (``SlotEngine.swap_draft``);
+    # - ``draft_extend(dcache, slot, chunk, clen, dparams)`` → one
+    #   chunked-prefill append (twin of ``prefill_extend``);
     # - ``draft_evict(dcache, slot[, free_ids])`` → zero the lane (and
     #   recycled pool blocks);
     # - ``draft_arm(dcache, slot, [row,] pos)`` → cold-start a lane at
@@ -723,7 +728,14 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 f"draft max_len {d_module.max_len} != target max_len "
                 f"{module.max_len} (draft and target cursors move in "
                 "lockstep)")
-        d_init_cache, _d_step_base = make_decode_step(d_module, d_params)
+        # The draft's params are NOT baked into the compiled draft
+        # programs: every draft forward program takes them as its LAST
+        # runtime argument (``dparams``), so a distilled replacement with
+        # identical tree/shape/dtype geometry hot-swaps as a pure data
+        # update — same jit cache entries, every compile pin holds
+        # (SlotEngine.swap_draft / tpudist.distill).  ``d_params`` here
+        # only seeds the engine's initial copy and cache geometry.
+        d_init_cache, _ = make_decode_step(d_module, d_params)
         # the tied draft shares its slot's adapter: the draft IS the
         # target's first N blocks, so its factors are the pool's first
         # N layer slices.  A loaded draft gets them too iff its
@@ -746,17 +758,24 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             _d_ldec = d_module.clone(decode=True, moe_fn=None,
                                      lora_rank=adapters.rank)
 
-            def d_step(cache, tok, ad):
+            def d_step(dp, cache, tok, ad):
                 logits, mut = _d_ldec.apply(
-                    {"params": d_params["params"], "cache": cache,
+                    {"params": dp["params"], "cache": cache,
                      "adapters": ad},
                     tok, mutable=["cache"])
                 return mut["cache"], logits[:, -1].astype(jnp.float32)
         else:
-            def d_step(cache, tok, ad):  # noqa: ARG001 - uniform signature
-                return _d_step_base(cache, tok)
-        d_vstep = jax.vmap(d_step, in_axes=(0, 0, 0))
-        d_force = _make_force(d_step)
+            _d_dec = d_module.clone(decode=True, moe_fn=None)
+
+            def d_step(dp, cache, tok, ad):  # noqa: ARG001 - uniform sig
+                logits, mut = _d_dec.apply(
+                    {"params": dp["params"], "cache": cache},
+                    tok, mutable=["cache"])
+                return mut["cache"], logits[:, -1].astype(jnp.float32)
+        d_vstep = jax.vmap(d_step, in_axes=(None, 0, 0, 0))
+
+        def d_force(dp, cache, chunk, clen, ad):
+            return _make_force(partial(d_step, dp))(cache, chunk, clen, ad)
         if use_lora:
             def _window1(cache, toks, ad):
                 logits, mut = _ldec.apply(
@@ -790,7 +809,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     out[key] = cur.astype(val.dtype)
             return out
 
-        def _propose_scan(state, dview, k, d_ads):
+        def _propose_scan(state, dview, k, d_ads, dp):
             """``k + 1`` draft decode steps with in-graph token feedback:
             steps ``0..k-1`` propose ``d_1..d_k`` (greedy argmax, or a
             categorical draw on the per-request ``fold_in(fold_in(key,
@@ -800,7 +819,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
             def body(carry, i):
                 tok, dc = carry
-                nc, logits = d_vstep(dc, tok[:, None, None], d_ads)
+                nc, logits = d_vstep(dp, dc, tok[:, None, None], d_ads)
                 dc = _sel_active(state.active, nc, dc)
                 lg = logits[:, 0]
                 greedy = jnp.argmax(lg, -1).astype(jnp.int32)
@@ -929,18 +948,20 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                         lambda a: jnp.zeros((num_slots,) + a.shape, a.dtype),
                         one)
 
-                def _draft_prefill_impl(dcache, prompts, clens, dsts, ads):
+                def _draft_prefill_impl(dcache, prompts, clens, dsts, ads,
+                                        dp):
                     lanes = jax.vmap(
-                        lambda p, n, a: d_force(d_init_cache(1), p, n, a)[0]
+                        lambda p, n, a: d_force(dp, d_init_cache(1), p, n,
+                                                a)[0]
                     )(prompts, clens, ads)
                     return _dconstrain(jax.tree.map(
                         lambda full, b: full.at[dsts].set(b), dcache, lanes))
 
-                def _draft_extend_impl(dcache, slot, chunk, clen, ad):
+                def _draft_extend_impl(dcache, slot, chunk, clen, ad, dp):
                     lane = jax.tree.map(
                         lambda full: lax.dynamic_index_in_dim(
                             full, slot, 0, keepdims=False), dcache)
-                    lane, _ = d_force(lane, chunk, clen, ad)
+                    lane, _ = d_force(dp, lane, chunk, clen, ad)
                     return _dconstrain(jax.tree.map(
                         lambda full, lv: lax.dynamic_update_index_in_dim(
                             full, lv, slot, 0), dcache, lane))
@@ -948,25 +969,27 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 if use_lora:
                     @partial(jax.jit, donate_argnums=(0,))
                     def draft_prefill(dcache, prompts, clens, dsts, aids,
-                                      apool):
+                                      apool, dparams):
                         return _draft_prefill_impl(
                             dcache, prompts, clens, dsts,
-                            _d_ads(apool, aids))
+                            _d_ads(apool, aids), dparams)
 
                     @partial(jax.jit, donate_argnums=(0,))
-                    def draft_extend(dcache, slot, chunk, clen, aid, apool):
+                    def draft_extend(dcache, slot, chunk, clen, aid, apool,
+                                     dparams):
                         return _draft_extend_impl(
-                            dcache, slot, chunk, clen, _d_ads(apool, aid))
+                            dcache, slot, chunk, clen, _d_ads(apool, aid),
+                            dparams)
                 else:
                     @partial(jax.jit, donate_argnums=(0,))
-                    def draft_prefill(dcache, prompts, clens, dsts):
+                    def draft_prefill(dcache, prompts, clens, dsts, dparams):
                         return _draft_prefill_impl(dcache, prompts, clens,
-                                                   dsts, None)
+                                                   dsts, None, dparams)
 
                     @partial(jax.jit, donate_argnums=(0,))
-                    def draft_extend(dcache, slot, chunk, clen):
+                    def draft_extend(dcache, slot, chunk, clen, dparams):
                         return _draft_extend_impl(dcache, slot, chunk,
-                                                  clen, None)
+                                                  clen, None, dparams)
 
                 @partial(jax.jit, donate_argnums=(0,))
                 def draft_evict(dcache, slot):
@@ -993,11 +1016,12 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                                 jnp.asarray(pos, val.dtype))
                     return _dconstrain(out)
 
-                def _draft_track_impl(state, dcache, prev_last, toks, d_ads):
+                def _draft_track_impl(state, dcache, prev_last, toks, d_ads,
+                                      dp):
                     fed = jnp.concatenate([prev_last[None], toks[:-1]], 0)
 
                     def body(dc, tok):
-                        nc, _ = d_vstep(dc, tok[:, None, None], d_ads)
+                        nc, _ = d_vstep(dp, dc, tok[:, None, None], d_ads)
                         return _sel_active(state.active, nc, dc), None
 
                     dcache, _ = lax.scan(body, dcache, fed)
@@ -1005,27 +1029,28 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
                 if use_lora:
                     @partial(jax.jit, donate_argnums=(1,))
-                    def draft_track(state, dcache, prev_last, toks, apool):
+                    def draft_track(state, dcache, prev_last, toks, apool,
+                                    dparams):
                         return _draft_track_impl(
                             state, dcache, prev_last, toks,
-                            _d_ads(apool, state.adapter_id))
+                            _d_ads(apool, state.adapter_id), dparams)
 
                     @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
-                    def draft_propose(state, dcache, k, apool):
+                    def draft_propose(state, dcache, k, apool, dparams):
                         dcache, drafts, dlogits = _propose_scan(
                             state, dcache, k,
-                            _d_ads(apool, state.adapter_id))
+                            _d_ads(apool, state.adapter_id), dparams)
                         return _dconstrain(dcache), drafts, dlogits
                 else:
                     @partial(jax.jit, donate_argnums=(1,))
-                    def draft_track(state, dcache, prev_last, toks):
+                    def draft_track(state, dcache, prev_last, toks, dparams):
                         return _draft_track_impl(state, dcache, prev_last,
-                                                 toks, None)
+                                                 toks, None, dparams)
 
                     @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
-                    def draft_propose(state, dcache, k):
+                    def draft_propose(state, dcache, k, dparams):
                         dcache, drafts, dlogits = _propose_scan(
-                            state, dcache, k, None)
+                            state, dcache, k, None, dparams)
                         return _dconstrain(dcache), drafts, dlogits
 
                 def _spec_verify_impl(state, cache, dcache, drafts, dlogits,
@@ -1082,23 +1107,23 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             d_meta_template = strip_kv(pg_d.template)
 
             def _draft_prefill_impl(dkv, tables, poss, prompts, clens,
-                                    dsts, ads):
+                                    dsts, ads, dp):
                 def lane(row, pos0, p, n, ad):
                     meta1 = jax.tree.map(
                         lambda t: jnp.asarray(pos0, t.dtype),
                         d_meta_template)
-                    return d_force(pg_d.lane_cache(dkv, row, meta1),
+                    return d_force(dp, pg_d.lane_cache(dkv, row, meta1),
                                    p, n, ad)[0]
 
                 lanes = jax.vmap(lane)(tables, poss, prompts, clens, ads)
                 return _dconstrain(pg_d.commit_lanes(
                     dkv, lanes, tables, dsts, poss, prefill_pad))
 
-            def _draft_extend_impl(dkv, slot, chunk, clen, ad):
+            def _draft_extend_impl(dkv, slot, chunk, clen, ad, dp):
                 row = dkv.table[slot]
                 meta1 = jax.tree.map(lambda full: full[slot], dkv.meta)
                 pos0 = _cache_cursor(meta1)
-                cache, _ = d_force(pg_d.lane_cache(dkv, row, meta1),
+                cache, _ = d_force(dp, pg_d.lane_cache(dkv, row, meta1),
                                    chunk, clen, ad)
                 return _dconstrain(pg_d.commit_lanes(
                     dkv, jax.tree.map(lambda a: a[None], cache),
@@ -1108,24 +1133,27 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             if use_lora:
                 @partial(jax.jit, donate_argnums=(0,))
                 def draft_prefill(dkv, tables, poss, prompts, clens, dsts,
-                                  aids, apool):
+                                  aids, apool, dparams):
                     return _draft_prefill_impl(dkv, tables, poss, prompts,
                                                clens, dsts,
-                                               _d_ads(apool, aids))
+                                               _d_ads(apool, aids), dparams)
 
                 @partial(jax.jit, donate_argnums=(0,))
-                def draft_extend(dkv, slot, chunk, clen, aid, apool):
+                def draft_extend(dkv, slot, chunk, clen, aid, apool,
+                                 dparams):
                     return _draft_extend_impl(dkv, slot, chunk, clen,
-                                              _d_ads(apool, aid))
+                                              _d_ads(apool, aid), dparams)
             else:
                 @partial(jax.jit, donate_argnums=(0,))
-                def draft_prefill(dkv, tables, poss, prompts, clens, dsts):
+                def draft_prefill(dkv, tables, poss, prompts, clens, dsts,
+                                  dparams):
                     return _draft_prefill_impl(dkv, tables, poss, prompts,
-                                               clens, dsts, None)
+                                               clens, dsts, None, dparams)
 
                 @partial(jax.jit, donate_argnums=(0,))
-                def draft_extend(dkv, slot, chunk, clen):
-                    return _draft_extend_impl(dkv, slot, chunk, clen, None)
+                def draft_extend(dkv, slot, chunk, clen, dparams):
+                    return _draft_extend_impl(dkv, slot, chunk, clen, None,
+                                              dparams)
 
             @partial(jax.jit, donate_argnums=(0,))
             def draft_evict(dkv, slot, free_ids):
@@ -1139,14 +1167,14 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 return _dconstrain(dkv._replace(
                     table=dkv.table.at[slot].set(row), meta=meta))
 
-            def _draft_track_impl(state, dkv, prev_last, toks, d_ads):
+            def _draft_track_impl(state, dkv, prev_last, toks, d_ads, dp):
                 k = toks.shape[0]
                 pos0 = _cache_cursor(dkv.meta)
                 view = pg_d.slot_cache(dkv)
                 fed = jnp.concatenate([prev_last[None], toks[:-1]], 0)
 
                 def body(dc, tok):
-                    nc, _ = d_vstep(dc, tok[:, None, None], d_ads)
+                    nc, _ = d_vstep(dp, dc, tok[:, None, None], d_ads)
                     return _sel_active(state.active, nc, dc), None
 
                 view, _ = lax.scan(body, view, fed)
@@ -1155,31 +1183,31 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
             if use_lora:
                 @partial(jax.jit, donate_argnums=(1,))
-                def draft_track(state, dkv, prev_last, toks, apool):
+                def draft_track(state, dkv, prev_last, toks, apool, dparams):
                     return _draft_track_impl(
                         state, dkv, prev_last, toks,
-                        _d_ads(apool, state.adapter_id))
+                        _d_ads(apool, state.adapter_id), dparams)
 
                 @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
-                def draft_propose(state, dkv, k, apool):
+                def draft_propose(state, dkv, k, apool, dparams):
                     pos0 = _cache_cursor(dkv.meta)
                     view, drafts, dlogits = _propose_scan(
                         state, pg_d.slot_cache(dkv), k,
-                        _d_ads(apool, state.adapter_id))
+                        _d_ads(apool, state.adapter_id), dparams)
                     dkv = pg_d.commit_slots(dkv, view, pos0, k + 1,
                                             state.active)
                     return _dconstrain(dkv), drafts, dlogits
             else:
                 @partial(jax.jit, donate_argnums=(1,))
-                def draft_track(state, dkv, prev_last, toks):
+                def draft_track(state, dkv, prev_last, toks, dparams):
                     return _draft_track_impl(state, dkv, prev_last, toks,
-                                             None)
+                                             None, dparams)
 
                 @partial(jax.jit, static_argnums=2, donate_argnums=(1,))
-                def draft_propose(state, dkv, k):
+                def draft_propose(state, dkv, k, dparams):
                     pos0 = _cache_cursor(dkv.meta)
                     view, drafts, dlogits = _propose_scan(
-                        state, pg_d.slot_cache(dkv), k, None)
+                        state, pg_d.slot_cache(dkv), k, None, dparams)
                     dkv = pg_d.commit_slots(dkv, view, pos0, k + 1,
                                             state.active)
                     return _dconstrain(dkv), drafts, dlogits

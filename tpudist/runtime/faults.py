@@ -24,6 +24,9 @@ changes::
     TPUDIST_FAULT=replica_kill@nth:1        # kill fleet replica 1's engine
                                             # loop at the router's next
                                             # probe tick (tick:K delays it)
+    TPUDIST_FAULT=draft_swap_corrupt@nth:1  # garble the 1st distillation
+                                            # candidate's params pre-gate
+                                            # (held-out eval must reject)
 
 Grammar: ``kind@key:int[,key:int][;kind@...]``.  Common keys: ``rank``
 restricts the fault to one process (default: all); ``attempt`` fires only
@@ -74,6 +77,12 @@ _SCHEMA: Dict[str, tuple] = {
     # the router's Nth probe tick (default 1 = the first tick after
     # arming).
     "replica_kill": ({"nth"}, {"nth", "tick", "rank"}),
+    # online draft distillation (tpudist.distill): garble the Nth
+    # distillation round's CANDIDATE params pre-gate — the held-out
+    # eval must reject it and the serving draft stays untouched (a
+    # wrong draft can only cost speed, never bytes, but the gate
+    # letting one through would quietly regress acceptance).
+    "draft_swap_corrupt": ({"nth"}, {"nth", "rank"}),
 }
 
 
@@ -413,6 +422,33 @@ def inject_replica_kill(tick: int) -> Optional[int]:
                             replica=idx, tick=tick)
             return idx
     return None
+
+
+def inject_draft_swap(round_idx: int) -> bool:
+    """Distillation-round injection point (:func:`tpudist.distill.swap.
+    maybe_corrupt_candidate`), consulted once per round with the
+    candidate in hand: a due ``draft_swap_corrupt`` fires on its
+    ``nth`` offered candidate and returns True — the CALLER garbles
+    the candidate's params (this module stays jax-free), and the
+    held-out gate must then reject it (the chaos test's assertion).
+    ``round_idx`` is informational (logged)."""
+    if _PLAN is None:
+        return False
+    for spec in _PLAN:
+        if (spec.kind == "draft_swap_corrupt" and spec.fired == 0
+                and _rank_matches(spec)):
+            spec.seen += 1
+            if spec.seen < spec.params["nth"]:
+                continue
+            spec.fired += 1
+            _log(f"corrupting draft-swap candidate #{spec.seen} "
+                 f"(distill round {round_idx})")
+            from tpudist import telemetry
+
+            telemetry.event("fault_injected", fault="draft_swap_corrupt",
+                            nth=spec.seen, round=round_idx)
+            return True
+    return False
 
 
 def corrupt_checkpoint(step_dir: os.PathLike) -> int:

@@ -371,6 +371,12 @@ class DisaggServer(_Observability):
             handoff=self.handoff_mode,
             mesh=self.decode_pool[0].spmd_stats().get("mesh"))
         self._stamp_adapter_config()
+        if self._capture is None:
+            # TPUDIST_DISTILL_CAPTURE arms the live-traffic tap at the
+            # same entry the faults grammar arms at — no code changes
+            from tpudist.distill.capture import CaptureBuffer
+
+            self._capture = CaptureBuffer.from_env()
         self._start_observability()
         if self._install_signal:
             self._installed_preemption = preemption.install()
@@ -429,6 +435,79 @@ class DisaggServer(_Observability):
 
     def _adapter_engines(self) -> list:
         return list(self.prefill_pool) + list(self.decode_pool)
+
+    # -- online draft distillation (decode pool owns the spec drafts) --------
+
+    def draft_ref(self):
+        alive = self._alive("decode")
+        if not alive:
+            return None
+        eng = self.decode_pool[alive[0]]
+        if eng.draft_module is None:
+            return None
+        return (eng.draft_module, eng.draft_params)
+
+    def _swap_now(self, new_params) -> dict:
+        """Broadcast the gated swap across every ALIVE decode worker —
+        all-or-error like the adapter broadcast: the first engine
+        validates geometry (same trees on every worker, so a pass there
+        is a pass everywhere), and a divergent pool can never decode
+        two different drafts (the handoff re-bind would make acceptance
+        unattributable)."""
+        alive = self._alive("decode")
+        if not alive:
+            raise RuntimeError("no alive decode worker to swap into")
+        t0 = time.monotonic()
+        rearmed = 0
+        swaps = 0
+        for w in alive:
+            info = self.decode_pool[w].swap_draft(new_params)
+            rearmed += int(info.get("lanes_rearmed", 0))
+            swaps = info.get("draft_swaps", swaps)
+        out = {"swapped": True, "lanes_rearmed": rearmed,
+               "swap_s": round(time.monotonic() - t0, 6),
+               "draft_swaps": swaps, "engines": len(alive)}
+        self._note_swap(out)
+        return out
+
+    def _agg_spec_stats(self) -> dict:
+        """Decode-pool-aggregated ``spec_stats()`` (the pool owns the
+        drafts): counter sums, recomputed rates, per-adapter label
+        merge, swap count — one shape for ``stats()`` and
+        ``/statusz``."""
+        spec = {"enabled": self.decode_pool[0].spec, "blocks": 0,
+                "lane_passes": 0, "tokens": 0, "accepted": 0,
+                "drafted": 0, "rollbacks": 0,
+                "draft_s": 0.0, "verify_s": 0.0, "sync_s": 0.0,
+                "draft_swaps": 0}
+        by_adapter: dict = {}
+        for eng in self.decode_pool:
+            st = eng.spec_stats()
+            for k in ("blocks", "lane_passes", "tokens", "accepted",
+                      "drafted", "rollbacks", "draft_s", "verify_s",
+                      "sync_s"):
+                spec[k] += st.get(k, 0) or 0
+            # broadcast keeps per-engine swap counters in lockstep: the
+            # pool's LOGICAL swap count is the max, not the sum
+            spec["draft_swaps"] = max(spec["draft_swaps"],
+                                      int(st.get("draft_swaps", 0) or 0))
+            for name, row in (st.get("by_adapter") or {}).items():
+                tot = by_adapter.setdefault(
+                    name, {"accepted": 0, "drafted": 0})
+                tot["accepted"] += row["accepted"]
+                tot["drafted"] += row["drafted"]
+        spec["spec_k"] = self.decode_pool[0].spec_stats().get("spec_k")
+        spec["accepted_per_pass"] = (spec["tokens"] / spec["lane_passes"]
+                                     if spec["lane_passes"] else None)
+        spec["acceptance_rate"] = (spec["accepted"] / spec["drafted"]
+                                   if spec["drafted"] else None)
+        if by_adapter:
+            spec["by_adapter"] = {
+                name: {**row, "acceptance_rate":
+                       (row["accepted"] / row["drafted"]
+                        if row["drafted"] else None)}
+                for name, row in sorted(by_adapter.items())}
+        return spec
 
     def _observability_gauges(self) -> dict:
         return {
@@ -497,6 +576,12 @@ class DisaggServer(_Observability):
             "tenants_in_flight": dict(self._tenant_inflight),
             **({"adapters": self.decode_pool[0].adapter_stats()}
                if self.decode_pool[0].adapters is not None else {}),
+            # pool-aggregated speculation + distillation flywheel
+            # (absent when off) — the swap gate's numbers, per operator
+            **({"spec": self._spec_status(self._agg_spec_stats())}
+               if self.decode_pool[0].spec else {}),
+            **({"distill": self._distill_status()}
+               if self._capture is not None else {}),
             "world": env_int("TPUDIST_NUM_PROCESSES", None),
             "generation": env_int("TPUDIST_RESTART_COUNT", 0),
             "draining": self._draining,
@@ -509,20 +594,7 @@ class DisaggServer(_Observability):
         for eng in self.decode_pool:
             for k, v in eng.decode_stats().items():
                 dec[k] += v
-        spec = {"enabled": self.decode_pool[0].spec, "blocks": 0,
-                "lane_passes": 0, "tokens": 0, "accepted": 0,
-                "drafted": 0, "rollbacks": 0,
-                "draft_s": 0.0, "verify_s": 0.0, "sync_s": 0.0}
-        for eng in self.decode_pool:
-            st = eng.spec_stats()
-            for k in ("blocks", "lane_passes", "tokens", "accepted",
-                      "drafted", "rollbacks", "draft_s", "verify_s",
-                      "sync_s"):
-                spec[k] += st[k]
-        spec["accepted_per_pass"] = (spec["tokens"] / spec["lane_passes"]
-                                     if spec["lane_passes"] else None)
-        spec["acceptance_rate"] = (spec["accepted"] / spec["drafted"]
-                                   if spec["drafted"] else None)
+        spec = self._agg_spec_stats()
         return {
             "completed": self.completed,
             "rejected": self.scheduler.rejected,
@@ -733,6 +805,11 @@ class DisaggServer(_Observability):
         while True:
             self._beat = time.monotonic()  # /healthz heartbeat
             self._check_die()  # hard-stop poison (kill / replica_kill)
+            # gated draft hot-swap lands HERE — the coordinator loop is
+            # the only dispatcher into the decode pool, so a broadcast
+            # between iterations lands between decode blocks on every
+            # worker at once (no half-swapped pool is ever observable)
+            self._apply_pending_swap()
             if not self._draining and self._should_drain():
                 self._draining = True
                 sched.refuse_new("draining")
@@ -1412,6 +1489,12 @@ class DisaggServer(_Observability):
                                 rollbacks=info["rollbacks"],
                                 draft_s=round(info["draft_s"], 9),
                                 verify_s=round(info["verify_s"], 9))
+                    if info.get("accept_by_adapter"):
+                        # per-adapter accept labels ride the span —
+                        # the metrics feeder turns them into the
+                        # labeled acceptance gauges
+                        tags["accept_by_adapter"] = \
+                            info["accept_by_adapter"]
                     tele.record_span("spec_verify", t0,
                                      time.monotonic() - t0, tags)
                 else:
@@ -1508,6 +1591,10 @@ class DisaggServer(_Observability):
         self._tier_oversize.discard(h.id)
         self.completed += 1
         self._track_tenant(h.request.tenant, -1)
+        if self._capture is not None:
+            # the distillation flywheel's tap: the finished stream is
+            # the training example (bounded ring, drops counted)
+            self._capture.offer_handle(h)
         # close the last decode residency segment at the request's end
         if h.decode_segments and h.decode_segments[-1][2] is None:
             h.decode_segments[-1][2] = h.t_done

@@ -246,6 +246,23 @@ class SlotEngine:
                         d_par, spmd.serve_spec_param_sharding(
                             self.mesh, d_par))
                 spec_pair = (d_mod, d_par)
+        #: the SERVING draft (module, params).  The params are runtime
+        #: DATA to the compiled draft programs (passed as their last
+        #: argument every dispatch), so :meth:`swap_draft` can replace
+        #: them with a same-geometry distilled candidate without
+        #: touching a single compiled program.
+        self.draft_module = spec_pair[0] if spec_pair is not None else None
+        self.draft_params = spec_pair[1] if spec_pair is not None else None
+        #: completed hot-swaps (spec_stats surfaces it; the telemetry
+        #: counter tpudist_draft_swaps_total is fed off the draft_swap
+        #: event, not this shadow)
+        self.draft_swaps = 0
+        #: per-adapter acceptance accounting accumulated on the host
+        #: from numbers spec_decode_block already syncs: adapter name →
+        #: [accepted, drafted] (only lanes BOUND to a named adapter
+        #: contribute; the per-adapter twin of the engine-wide
+        #: n_spec_accepted / n_spec_drafted counters)
+        self.spec_adapter_counts: Dict[str, List[int]] = {}
         self.alloc: Optional[BlockAllocator] = None
         if paged:
             kv_block = min(int(kv_block), self.max_len)
@@ -453,7 +470,13 @@ class SlotEngine:
             "verify_s": self.t_spec_verify_s,
             "sync_s": self.t_spec_sync_s,
             "spec_k": self.spec_k if self.spec else None,
+            "draft_swaps": self.draft_swaps,
         }
+        if self.spec and self.spec_adapter_counts:
+            out["by_adapter"] = {
+                name: {"accepted": a, "drafted": d,
+                       "acceptance_rate": (a / d if d else None)}
+                for name, (a, d) in sorted(self.spec_adapter_counts.items())}
         if self.spec:
             # draft KV residency: the "smaller pool" claim, quantified
             if self.fns.draft_paged is not None:
@@ -579,6 +602,90 @@ class SlotEngine:
                 "n_devices": self._mesh_cfg.n_devices,
                 "tp_overlap": self.tp_overlap,
                 **self._spmd_param_stats}
+
+    # -- speculative draft hot-swap (tpudist.distill) -----------------------
+
+    def swap_draft(self, new_params) -> Dict[str, object]:
+        """Replace the serving draft's parameters with a same-geometry
+        candidate — a PURE data update: the draft programs take their
+        params as a runtime argument, so nothing recompiles and every
+        compile pin holds (:meth:`compile_counts` is flat across swaps).
+
+        The geometry invariant is ASSERTED, not assumed: tree structure,
+        leaf shapes, and dtypes must match the serving copy exactly (the
+        jit cache key — a mismatch would silently compile a second
+        program set).  Each leaf is placed on the serving copy's exact
+        sharding, then every OCCUPIED lane's draft context is re-armed
+        via the existing ``draft_arm`` program (cursor at the lane's
+        target position over cold context — the import_slot precedent:
+        a cold draft context can only lower acceptance, never
+        correctness, and it warms with every token decoded from here).
+        Greedy output is byte-identical across swaps because the target
+        verify is the oracle; acceptance only moves speed.
+
+        Single-threaded by the engine contract — callers on another
+        thread go through ``InferenceServer.swap_draft``, which lands
+        the swap between decode blocks."""
+        if not self.spec:
+            raise RuntimeError("engine built without spec_draft")
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        ref = self.draft_params
+        ref_leaves, ref_def = jax.tree.flatten(ref)
+        new_leaves, new_def = jax.tree.flatten(new_params)
+        if new_def != ref_def:
+            raise ValueError(
+                "draft swap geometry mismatch: candidate param tree "
+                f"structure != serving draft's ({new_def} vs {ref_def})")
+        for r, n in zip(ref_leaves, new_leaves):
+            if tuple(n.shape) != tuple(r.shape) \
+                    or np.dtype(n.dtype) != np.dtype(r.dtype):
+                raise ValueError(
+                    "draft swap geometry mismatch: leaf "
+                    f"{tuple(n.shape)}/{np.dtype(n.dtype)} != serving "
+                    f"{tuple(r.shape)}/{np.dtype(r.dtype)}")
+        # place every leaf EXACTLY like the serving copy: the jit cache
+        # keys on sharding AND committedness, so a candidate carrying
+        # e.g. the trainer mesh's NamedSharding — or merely a COMMITTED
+        # copy where the original was uncommitted — would silently
+        # recompile every draft program on first use (and committedness
+        # is contagious through jit outputs: the lane state coming back
+        # from those dispatches would recompile insert/evict/verify
+        # too).  Committed ref → device_put pins the same placement;
+        # uncommitted ref → host round-trip lands an uncommitted copy
+        # on the default device, same as the original.
+        new_params = jax.tree.map(
+            lambda r, n: (jax.device_put(n, r.sharding)
+                          if getattr(r, "committed", True)
+                          else jnp.asarray(np.asarray(n))),
+            ref, new_params)
+        self.draft_params = new_params
+        # re-warm: cursor re-arm for every occupied lane (paged lanes
+        # keep their table row — ONE D2H table fetch per swap, off the
+        # per-block hot path)
+        rearmed = 0
+        table_h = (np.asarray(self.dcache.table)
+                   if self.alloc is not None else None)
+        for slot in range(self.num_slots):
+            if not self.occupied[slot]:
+                continue
+            pos = int(self.pos[slot])
+            if self.alloc is not None:
+                self.dcache = self.fns.draft_arm(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(table_h[slot]),
+                    jnp.asarray(pos, jnp.int32))
+            else:
+                self.dcache = self.fns.draft_arm(
+                    self.dcache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(pos, jnp.int32))
+            rearmed += 1
+        self.draft_swaps += 1
+        return {"swapped": True, "lanes_rearmed": rearmed,
+                "swap_s": time.perf_counter() - t0,
+                "draft_swaps": self.draft_swaps}
 
     # -- per-tenant adapters ------------------------------------------------
 
@@ -1102,7 +1209,8 @@ class SlotEngine:
                 self.dcache = self.fns.draft_prefill(
                     self.dcache, jnp.asarray(tables),
                     jnp.asarray(reused_len), jnp.asarray(prompts),
-                    jnp.asarray(clens), jnp.asarray(dsts), *ad_args)
+                    jnp.asarray(clens), jnp.asarray(dsts), *ad_args,
+                    self.draft_params)
         else:
             self.state, self.cache, firsts = self.fns.insert_batch(
                 self.state, self.cache, jnp.asarray(prompts),
@@ -1111,7 +1219,7 @@ class SlotEngine:
             if self.spec:
                 self.dcache = self.fns.draft_prefill(
                     self.dcache, jnp.asarray(prompts), jnp.asarray(clens),
-                    jnp.asarray(dsts), *ad_args)
+                    jnp.asarray(dsts), *ad_args, self.draft_params)
         firsts_h = np.asarray(firsts) if last.any() else None
         out: Dict[int, Optional[int]] = {}
         for j, (slot, prompt, temperature, seed, max_new, _) in \
@@ -1163,7 +1271,7 @@ class SlotEngine:
                 self.dcache = self.fns.draft_extend(
                     self.dcache, jnp.asarray(slot, jnp.int32),
                     jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
-                    *d_tail)
+                    *d_tail, self.draft_params)
             self.pos[slot] += clen
             if self.alloc is not None:
                 # prompt blocks now fully written become shareable
@@ -1303,7 +1411,7 @@ class SlotEngine:
         ad_tail = () if self.adapters is None else (self.apool,)
         t0 = time.perf_counter()
         self.dcache, drafts, dlogits = self.fns.draft_propose(
-            self.state, self.dcache, k, *ad_tail)
+            self.state, self.dcache, k, *ad_tail, self.draft_params)
         jax.block_until_ready(drafts)
         t1 = time.perf_counter()
         self.state, self.cache, self.dcache, packed = self.fns.spec_verify(
@@ -1336,6 +1444,22 @@ class SlotEngine:
         self.t_spec_draft_s += t1 - t0
         self.t_spec_verify_s += t2 - t1
         self.t_spec_sync_s += t3 - t2
+        # per-adapter acceptance (the labeled twin of the engine-wide
+        # counters): host-side bookkeeping off the SAME packed fetch —
+        # no extra D2H (slot→adapter is a host shadow)
+        by_adapter: Dict[str, List[int]] = {}
+        if self.adapters is not None:
+            for j, s in enumerate(dec):
+                bound = self.slot_adapter[s]
+                if bound is None or not self.spec_on[s]:
+                    continue
+                d = by_adapter.setdefault(bound[0], [0, 0])
+                d[0] += int(a_raw[j])
+                d[1] += k
+            for name, (acc, dr) in by_adapter.items():
+                tot = self.spec_adapter_counts.setdefault(name, [0, 0])
+                tot[0] += acc
+                tot[1] += dr
         out = {int(s): [int(t) for t in pk[s, 2:2 + pk[s, 0]]] for s in dec
                if pk[s, 0] > 0}
         # the verify is ONE attention sweep over each lane's prefix +
@@ -1348,7 +1472,10 @@ class SlotEngine:
                 "rollbacks": rollbacks,
                 "draft_s": t1 - t0, "verify_s": t2 - t1,
                 "dispatch_s": t2 - t0, "sync_s": t3 - t2,
-                "kv_read_bytes": int(kv_read)}
+                "kv_read_bytes": int(kv_read),
+                **({"accept_by_adapter": {
+                    n: [int(a), int(d)] for n, (a, d) in
+                    by_adapter.items()}} if by_adapter else {})}
         return info, out
 
     def decode_auto_plain(self, max_k: Optional[int] = None
@@ -1372,7 +1499,7 @@ class SlotEngine:
             ad_tail = () if self.adapters is None else (self.apool,)
             self.dcache = self.fns.draft_track(
                 self.state, self.dcache, prev_last, jnp.asarray(toks),
-                *ad_tail)
+                *ad_tail, self.draft_params)
         if info is not None:
             info = {**info, "spec": False}
         return info, blocks

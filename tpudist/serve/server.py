@@ -265,6 +265,16 @@ class _Observability:
         #: threads both write)
         self._tenant_inflight: Dict[str, int] = {}
         self._tenant_lock = threading.Lock()
+        #: live-traffic capture ring (tpudist.distill) — both flavors'
+        #: ``_note_finished`` offer through it when attached; None =
+        #: disarmed (one attribute load + None check on the finish seam)
+        self._capture = None
+        #: pending draft hot-swap (tpudist.distill): a cross-thread
+        #: ``swap_draft`` posts here and the ENGINE loop applies it
+        #: between decode blocks — the compiled programs only ever see
+        #: a consistent dparams tree
+        self._swap_req: Optional[dict] = None
+        self._swap_lock = threading.Lock()
 
     def _start_observability(self) -> None:
         from tpudist import telemetry
@@ -394,6 +404,114 @@ class _Observability:
 
     def _observability_gauges(self) -> Dict[str, float]:  # per-flavor
         return {}
+
+    # -- online draft distillation (tpudist.distill) -------------------------
+    # Shared by both server flavors: one capture tap, one hot-swap
+    # surface.  The swap itself is per-flavor (_swap_now): one engine
+    # here, a decode-pool broadcast on the disagg coordinator.
+
+    def attach_capture(self, capture) -> None:
+        """Attach a :class:`tpudist.distill.CaptureBuffer`: every
+        finished request's (prompt, emitted) stream is offered to it
+        from ``_note_finished`` (greedy and sampled lanes, tenant/
+        adapter tags riding along).  ``start()`` attaches one
+        automatically when ``TPUDIST_DISTILL_CAPTURE`` is on."""
+        self._capture = capture
+
+    @property
+    def capture(self):
+        return self._capture
+
+    def draft_ref(self) -> Optional[tuple]:
+        """``(draft_module, current_draft_params)`` of the serving
+        draft, or ``None`` on a non-spec server — what the
+        distillation lane warm-starts from and scores against."""
+        raise NotImplementedError
+
+    def _swap_now(self, new_params) -> dict:  # per-flavor
+        raise NotImplementedError
+
+    def swap_draft(self, new_params,
+                   timeout: Optional[float] = 60.0) -> dict:
+        """Hot-swap the speculative draft's params — the gated landing.
+
+        Same geometry required (the engine raises on any tree/shape/
+        dtype mismatch — every compile pin survives a legal swap).
+        Thread-safe: with the engine loop running, the request parks in
+        ``_swap_req`` and the LOOP applies it at its next iteration top
+        — between decode blocks by construction, so no compiled
+        program ever runs half-swapped — and this caller blocks until
+        it lands (``TimeoutError`` past ``timeout``).  Without a live
+        loop (engine idle before ``start()``, or tests driving
+        ``step()`` by hand) the swap applies directly."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            return self._swap_now(new_params)
+        req = {"params": new_params, "done": threading.Event(),
+               "result": None, "error": None}
+        with self._swap_lock:
+            if self._swap_req is not None:
+                raise RuntimeError("a draft swap is already pending")
+            self._swap_req = req
+        if not req["done"].wait(timeout):
+            with self._swap_lock:
+                if self._swap_req is req:
+                    self._swap_req = None
+            raise TimeoutError(
+                f"draft swap not applied within {timeout}s (engine loop "
+                "stalled?)")
+        if req["error"] is not None:
+            raise req["error"]
+        return req["result"]
+
+    def _apply_pending_swap(self) -> None:
+        """Engine-loop seam (iteration top — between decode blocks):
+        apply a parked swap and wake its poster.  One attribute load +
+        None check when idle, like every other loop tax."""
+        req = self._swap_req
+        if req is None:
+            return
+        try:
+            req["result"] = self._swap_now(req["params"])
+        except BaseException as e:  # the poster gets the error, the
+            req["error"] = e        # serving loop survives it
+        finally:
+            with self._swap_lock:
+                self._swap_req = None
+            req["done"].set()
+
+    def _note_swap(self, info: dict) -> None:
+        """The ``draft_swap`` event + counter feed, emitted by the
+        flavor ``_swap_now`` implementations on an APPLIED swap."""
+        from tpudist import telemetry
+
+        telemetry.event("draft_swap",
+                        lanes_rearmed=info.get("lanes_rearmed"),
+                        swap_s=info.get("swap_s"),
+                        draft_swaps=info.get("draft_swaps"),
+                        **({"engines": info["engines"]}
+                           if "engines" in info else {}))
+
+    def _distill_status(self) -> dict:
+        """The ``/statusz`` ``distill`` block (capture attached only):
+        the capture ledger — drops counted, never silent."""
+        return {"capture": self._capture.stats()}
+
+    @staticmethod
+    def _spec_status(st: dict) -> dict:
+        """The ``/statusz`` ``spec`` block from ``spec_stats()`` — the
+        same numbers the swap gate reads (acceptance, per-pass, swap
+        count, per-adapter labels where bound)."""
+        return {
+            "spec_k": st.get("spec_k"),
+            "blocks": st.get("blocks"),
+            "acceptance_rate": st.get("acceptance_rate"),
+            "accepted_per_pass": st.get("accepted_per_pass"),
+            "rollbacks": st.get("rollbacks"),
+            "draft_swaps": st.get("draft_swaps"),
+            **({"by_adapter": st["by_adapter"]}
+               if st.get("by_adapter") else {}),
+        }
 
     # -- graceful degradation under overload (host tier + shedding) ---------
     # Shared by both server flavors, like the observability fields above:
@@ -736,6 +854,12 @@ class InferenceServer(_Observability):
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)
         self._stamp_adapter_config()
+        if self._capture is None:
+            # TPUDIST_DISTILL_CAPTURE arms the live-traffic tap at the
+            # same entry the faults grammar arms at — no code changes
+            from tpudist.distill.capture import CaptureBuffer
+
+            self._capture = CaptureBuffer.from_env()
         self._start_observability()
         if self._install_signal:
             # SIGTERM → drain: the same preemption flag the training loop
@@ -826,6 +950,16 @@ class InferenceServer(_Observability):
     def _adapter_engines(self) -> list:
         return [self.engine]
 
+    def draft_ref(self) -> Optional[tuple]:
+        if self.engine.draft_module is None:
+            return None
+        return (self.engine.draft_module, self.engine.draft_params)
+
+    def _swap_now(self, new_params) -> dict:
+        info = self.engine.swap_draft(new_params)
+        self._note_swap(info)
+        return info
+
     def _observability_gauges(self) -> Dict[str, float]:
         kv = self.engine.kv_stats()
         return {
@@ -867,6 +1001,12 @@ class InferenceServer(_Observability):
             # per-tenant adapter pool (absent when off)
             **({"adapters": self.engine.adapter_stats()}
                if self.engine.adapters is not None else {}),
+            # speculative decode + distillation flywheel (absent when
+            # off) — the swap gate reads the SAME numbers shown here
+            **({"spec": self._spec_status(self.engine.spec_stats())}
+               if self.engine.spec else {}),
+            **({"distill": self._distill_status()}
+               if self._capture is not None else {}),
             # host-tier occupancy + overload state (None-free when off)
             **({"host_tier": {**self._tier.stats(),
                               "parked_requests": len(self._parked),
@@ -958,6 +1098,9 @@ class InferenceServer(_Observability):
         while True:
             self._beat = time.monotonic()  # /healthz heartbeat
             self._check_die()  # hard-stop poison (kill / replica_kill)
+            # gated draft hot-swap lands HERE — between decode blocks
+            # by construction (the loop is the only decode dispatcher)
+            self._apply_pending_swap()
             if not self._draining and self._should_drain():
                 self._draining = True
                 sched.refuse_new("draining")
@@ -1156,6 +1299,12 @@ class InferenceServer(_Observability):
                             rollbacks=info["rollbacks"],
                             draft_s=round(info["draft_s"], 9),
                             verify_s=round(info["verify_s"], 9))
+                        if info.get("accept_by_adapter"):
+                            # per-adapter accept labels ride the span —
+                            # the metrics feeder turns them into the
+                            # labeled acceptance gauges
+                            tags["accept_by_adapter"] = \
+                                info["accept_by_adapter"]
                         tele.record_span("spec_verify", t0,
                                          time.monotonic() - t0, tags)
                     else:
@@ -1385,6 +1534,10 @@ class InferenceServer(_Observability):
         self._tier_oversize.discard(h.id)
         self.completed += 1
         self._track_tenant(h.request.tenant, -1)
+        if self._capture is not None:
+            # the distillation flywheel's tap: the finished stream is
+            # the training example (bounded ring, drops counted)
+            self._capture.offer_handle(h)
         telemetry.event(
             "request_finished", id=h.id, reason=h.finish_reason,
             prompt_len=int(len(h.request.prompt)), tokens_out=len(h.tokens),

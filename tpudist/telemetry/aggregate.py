@@ -85,6 +85,7 @@ _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
                     "handoff_rejected", "pool_resize",
                     "adapter_load", "adapter_evict",
                     "replica_health", "session_migrated", "router_error",
+                    "distill_round", "draft_swap",
                     "telemetry_dropped")
 
 
@@ -339,6 +340,14 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     rt_routes: Dict[str, int] = {}
     rt_spills, rt_retries, rt_deaths, rt_errors = 0, 0, 0, 0
     rt_migrations: Dict[str, int] = {}
+    # online draft distillation (tpudist.distill): distill_round /
+    # draft_swap events — absent entirely from old streams, so the
+    # section below is purely additive
+    di_rounds, di_swaps = 0, 0
+    di_reasons: Dict[str, int] = {}
+    di_swap_s: List[float] = []
+    di_gain: List[float] = []
+    di_last: Optional[dict] = None
     for r in records:
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_kv_config"):
@@ -368,6 +377,22 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             handoffs += 1
             if isinstance(r.get("import_s"), (int, float)):
                 handoff_import_s.append(float(r["import_s"]))
+            continue
+        if r.get("kind") == "event" \
+                and r.get("name") in ("distill_round", "draft_swap"):
+            if r.get("name") == "distill_round":
+                di_rounds += 1
+                k = str(r.get("reason", "?"))
+                di_reasons[k] = di_reasons.get(k, 0) + 1
+                ca, b = r.get("candidate_acceptance"), r.get("baseline")
+                if (r.get("swapped") and isinstance(ca, (int, float))
+                        and isinstance(b, (int, float))):
+                    di_gain.append(float(ca) - float(b))
+                di_last = r
+            else:
+                di_swaps += 1
+                if isinstance(r.get("swap_s"), (int, float)):
+                    di_swap_s.append(float(r["swap_s"]))
             continue
         if r.get("kind") == "event" and r.get("name") == "worker_lost":
             workers_lost += 1
@@ -598,6 +623,28 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             "draft_s": round(spec_draft_s, 6),
             "verify_s": round(spec_verify_s, 6),
         }
+    distill: Optional[dict] = None
+    if di_rounds or di_swaps:
+        sw = sorted(di_swap_s)
+        distill = {
+            "rounds": di_rounds,
+            "swaps": di_swaps,
+            # why each round did / didn't swap — "measured_win" is the
+            # happy path, everything else is the gate holding the line
+            "round_reasons": di_reasons,
+            # holdout acceptance gain of APPLIED candidates over the
+            # gate baseline (max(serving-on-holdout, live rate))
+            "acceptance_gain": ({
+                "mean": round(sum(di_gain) / len(di_gain), 4),
+                "max": round(max(di_gain), 4)} if di_gain else None),
+            "swap_s": ({
+                "p50": round(_percentile(sw, 50), 6),
+                "max": round(sw[-1], 6)} if sw else None),
+            **({"capture": {
+                k: di_last[k] for k in
+                ("capture_streams", "capture_tokens", "capture_evicted")
+                if k in di_last}} if di_last is not None else {}),
+        }
     pools: Optional[dict] = None
     if (pool_s or disagg_config is not None or handoffs
             or workers_lost or lanes_recovered):
@@ -676,6 +723,9 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         **({"kv": kv} if kv is not None else {}),
         **({"adapters": adapters} if adapters is not None else {}),
         **({"spec": spec} if spec is not None else {}),
+        # distill section only when the flywheel ran — old streams (and
+        # capture-off runs) aggregate byte-identically without it
+        **({"distill": distill} if distill is not None else {}),
         **({"pools": pools} if pools is not None else {}),
         **({"overload": overload} if overload is not None else {}),
         # fleet section only when a router ran — single-replica streams
@@ -915,6 +965,19 @@ def render_markdown(report: dict) -> str:
             bits.append(f"draft {sp['draft_s']:.3f} s vs verify "
                         f"{sp['verify_s']:.3f} s")
             lines.append("- speculative decode: " + "; ".join(bits))
+        if sv.get("distill"):
+            di = sv["distill"]
+            bits = [f"{di['rounds']} rounds", f"{di['swaps']} swaps"]
+            if di.get("round_reasons"):
+                why = ", ".join(f"{k}: {c}" for k, c in
+                                sorted(di["round_reasons"].items()))
+                bits.append(f"gate ({why})")
+            if di.get("acceptance_gain"):
+                bits.append("acceptance gain mean "
+                            f"{di['acceptance_gain']['mean']:+.3f}")
+            if di.get("swap_s"):
+                bits.append(f"swap p50 {di['swap_s']['p50'] * 1e3:.1f} ms")
+            lines.append("- draft distillation: " + "; ".join(bits))
         if sv.get("pools"):
             pp = sv["pools"]
             bits = [f"prefill {pp['prefill']['span_s']:.3f} s "

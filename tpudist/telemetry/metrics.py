@@ -456,6 +456,38 @@ def feed_record(rec: dict) -> None:
                 acc = rec.get("accepted")
                 if isinstance(acc, (int, float)):
                     r.counter("tpudist_spec_accepted_total", **lab).inc(int(acc))
+                drafted = rec.get("drafted")
+                if isinstance(drafted, (int, float)) and drafted:
+                    r.counter("tpudist_spec_drafted_total",
+                              **lab).inc(int(drafted))
+                    # live acceptance — the SAME number the distill
+                    # swap gate reads, cumulative over the counters so
+                    # a scrape and the gate can never disagree
+                    a = r.counter("tpudist_spec_accepted_total",
+                                  **lab).value
+                    d = r.counter("tpudist_spec_drafted_total",
+                                  **lab).value
+                    if d:
+                        r.gauge("tpudist_spec_accept_rate",
+                                **lab).set(a / d)
+                by_ad = rec.get("accept_by_adapter")
+                if isinstance(by_ad, dict):
+                    # per-adapter labeled acceptance (bounded like the
+                    # adapter residency gauges — the label-cap rule)
+                    for ad, pair in by_ad.items():
+                        if not (isinstance(pair, (list, tuple))
+                                and len(pair) == 2):
+                            continue
+                        alab = _adapter_label({"adapter": ad})
+                        ca = r.counter("tpudist_spec_accepted_total",
+                                       **alab)
+                        cd = r.counter("tpudist_spec_drafted_total",
+                                       **alab)
+                        ca.inc(int(pair[0]))
+                        cd.inc(int(pair[1]))
+                        if cd.value:
+                            r.gauge("tpudist_spec_accept_rate",
+                                    **alab).set(ca.value / cd.value)
         elif name == "prefill":
             lab = _pool_label(rec)
             r.counter("tpudist_prefill_dispatches_total", **lab).inc()
@@ -522,6 +554,11 @@ def feed_record(rec: dict) -> None:
         v = rec.get("resident")
         if isinstance(v, (int, float)):
             r.gauge("tpudist_serve_adapters_resident").set(float(v))
+    elif name == "draft_swap":
+        # online draft distillation: one count per APPLIED gated swap
+        # (rejected candidates never get here — the distill_round
+        # event stream carries those)
+        r.counter("tpudist_draft_swaps_total").inc()
     elif name == "worker_lost":
         r.counter("tpudist_workers_lost_total", **_pool_label(rec)).inc()
     elif name == "lane_recovered":

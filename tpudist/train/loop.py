@@ -106,7 +106,7 @@ def preemption_scope(enabled: bool):
 
 
 def finalize_run(states, *, iteration, epoch, preempted, ckpt, logger,
-                 flush=None) -> None:
+                 flush=None, own_telemetry: bool = True) -> None:
     """The run-teardown ordering CONTRACT (shared by every loop; parity
     with demo.py:130-136 — metrics finish before the end barrier):
 
@@ -119,7 +119,12 @@ def finalize_run(states, *, iteration, epoch, preempted, ckpt, logger,
     4. the end-of-training barrier;
     5. the telemetry session finished — rank 0 merges every rank's and
        generation's JSONL into ``report.json``/``report.md`` so *every*
-       run ends with a goodput report.
+       run ends with a goodput report — but ONLY when this loop started
+       the session (``own_telemetry``).  A loop embedded in a live
+       process (the distillation flywheel training inside a serving
+       process) must not tear down the host's session: that would
+       silently stop every event/metric feed the moment the first
+       background round completed.
     """
     if ckpt is not None:
         ckpt.save(iteration, states,
@@ -136,7 +141,8 @@ def finalize_run(states, *, iteration, epoch, preempted, ckpt, logger,
     if logger is not None:
         logger.finish()
     barrier("end_of_training")
-    telemetry.finish()
+    if own_telemetry:
+        telemetry.finish()
 
 
 def _data_wait_iter(source, tele):
@@ -253,6 +259,10 @@ def run_training(
     from tpudist.runtime import faults, watchdog
 
     faults.arm_from_env()  # chaos harness: TPUDIST_FAULT grammar, no code changes
+    # Session OWNERSHIP: a pre-existing session belongs to the caller
+    # (a serving process running the distill flywheel, a test, a larger
+    # job) — this loop records into it but must not finish it.
+    owns_telemetry = telemetry.active() is None
     telemetry.ensure_started()  # goodput accounting: TPUDIST_TELEMETRY=0 disarms
     # live observability: scrape endpoint (TPUDIST_METRICS_PORT gates it)
     # — step-time/goodput gauges flow from the step spans via the metrics
@@ -272,14 +282,16 @@ def run_training(
         try:
             return _dispatch_training(
                 states, step_fn, loader, mesh, logger, config,
-                ckpt, start_iteration, chunk_step_fn, wd)
+                ckpt, start_iteration, chunk_step_fn, wd,
+                own_telemetry=owns_telemetry)
         finally:
             if wd is not None:
                 wd.stop()
 
 
 def _dispatch_training(states, step_fn, loader, mesh, logger, config,
-                       ckpt, start_iteration, chunk_step_fn, wd=None):
+                       ckpt, start_iteration, chunk_step_fn, wd=None,
+                       own_telemetry=True):
     from tpudist.runtime import faults
 
     if (
@@ -292,7 +304,7 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
     ):
         return _run_scanned(
             states, chunk_step_fn, loader, mesh, logger, config, ckpt,
-            start_iteration, wd
+            start_iteration, wd, own_telemetry=own_telemetry
         )
     sharding = batch_sharding(mesh)
     # resume fast-forward: whole epochs are skipped arithmetically; only the
@@ -359,7 +371,8 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
         pbar.close()
     finalize_run(states, iteration=iteration, epoch=epoch,
                  preempted=preempted, ckpt=ckpt, logger=logger,
-                 flush=deferred.flush if deferred is not None else None)
+                 flush=deferred.flush if deferred is not None else None,
+                 own_telemetry=own_telemetry)
     final_losses = (
         {k: float(jax.device_get(v)) for k, v in last_losses.items()}
         if last_losses is not None
@@ -370,7 +383,7 @@ def _dispatch_training(states, step_fn, loader, mesh, logger, config,
 
 def _run_scanned(
     states, chunk_step_fn, loader, mesh, logger, config, ckpt,
-    start_iteration, wd=None
+    start_iteration, wd=None, own_telemetry=True
 ):
     """Device-cached scan loop (see ``run_training``).
 
@@ -486,7 +499,8 @@ def _run_scanned(
                  preempted=preempted, ckpt=ckpt, logger=logger,
                  flush=(lambda: _flush_scanned(pending_losses, logger,
                                                config))
-                 if logger is not None else None)
+                 if logger is not None else None,
+                 own_telemetry=own_telemetry)
     final_losses = {}
     if last_losses is not None:
         fetched = jax.device_get(last_losses)

@@ -386,6 +386,10 @@ class Trainer:
             preemption_scope,
         )
 
+        # session ownership: only finish a session this loop started —
+        # a pre-existing one belongs to the embedding process (e.g. a
+        # serving process whose distill flywheel trains through here)
+        owns_telemetry = telemetry.active() is None
         telemetry.ensure_started()
         # live observability: scrape endpoint + step-time gauges flow
         # from the step spans via the metrics feed (TPUDIST_METRICS_PORT
@@ -473,7 +477,8 @@ class Trainer:
             if pbar is not None:
                 pbar.close()
             finalize_run(state, iteration=iteration, epoch=epoch,
-                         preempted=preempted, ckpt=ckpt, logger=logger)
+                         preempted=preempted, ckpt=ckpt, logger=logger,
+                         own_telemetry=owns_telemetry)
         return state, {"lm": float(loss) if loss is not None else None}
 
     @staticmethod

@@ -192,6 +192,33 @@ ENV_VARS = {
     "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
     "TPUDIST_SERVE_SPEC_DRAFT_LAYERS":
         "tied-draft depth (target's first N layers; 0 = half the depth)",
+    # online draft distillation (tpudist/distill/)
+    "TPUDIST_DISTILL_CAPTURE":
+        "live-traffic capture ring for draft distillation (default off; "
+        "1 = tap finished streams into the bounded buffer)",
+    "TPUDIST_DISTILL_BUFFER_TOKENS":
+        "capture-ring token budget — oldest streams evict past it "
+        "(default 65536)",
+    "TPUDIST_DISTILL_SAMPLE":
+        "capture every Nth finished stream (default 1 = all; sampled-out "
+        "streams are counted, never silently dropped)",
+    "TPUDIST_DISTILL_INTERVAL_S":
+        "background distillation round cadence in seconds (default 30)",
+    "TPUDIST_DISTILL_STEPS":
+        "trainer steps per distillation round (default 40)",
+    "TPUDIST_DISTILL_MIN_TOKENS":
+        "captured-token floor before a round will train (default 256)",
+    "TPUDIST_DISTILL_HOLDOUT":
+        "held-out fraction of captured streams reserved for the swap "
+        "gate's acceptance eval (default 0.25)",
+    "TPUDIST_DISTILL_SWAP_MARGIN":
+        "hysteresis: candidate must beat the serving draft's measured "
+        "acceptance by this margin to hot-swap (default 0.02)",
+    "TPUDIST_DISTILL_LR":
+        "distillation learning rate (default 3e-3)",
+    "TPUDIST_DISTILL_PER_ADAPTER":
+        "bias rounds toward the heaviest captured adapter when it is "
+        "resident in the adapter registry (default off)",
     # telemetry & goodput
     "TPUDIST_TELEMETRY": "telemetry arm switch (default on; 0/false = off)",
     "TPUDIST_TELEMETRY_DIR": "where per-rank telemetry JSONL + reports land",
