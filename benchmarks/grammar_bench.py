@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Structured-output bench: the grammar-constrained decode rungs,
+frozen per round as ``BENCH_GRAMMAR_r{NN}.json``.
+
+One rung family, CPU-safe (tiny model; absolute tok/s is interpreter
+mechanics — the RATIOS between arms on one engine are the measurement):
+
+- **grammar_mixed_batch** — the SAME engine, the SAME request schedule
+  (every slot decoding a full budget), swept over constrained lanes per
+  batch ∈ {0 (free), S/2 (mixed), S (all constrained)}: each arm binds
+  its grammars, decodes to budget, and evicts — so the sweep ALSO
+  drives the registry bind/release churn path (more distinct grammars
+  than pool blocks forces LRU eviction between arms).  Quotes decode
+  throughput per arm and the constrained-vs-free per-token overhead:
+  the claim is that the in-graph mask gather costs a bounded, flat
+  per-token increment (one ``[S, V]`` row gather + a ``where`` on the
+  logits), not a per-token host round-trip.  The artifact freezes:
+
+  - ``streams_in_grammar`` — every constrained stream (truncated at
+    eos) walks its automaton to a live state (correctness rides along
+    with the measurement);
+  - ``free_lanes_unperturbed`` — the free lanes of the mixed arm are
+    byte-identical to the same slots of the all-free arm: sharing a
+    batch with constrained neighbours must not perturb free sampling;
+  - ``constrained_vs_free`` / ``overhead_per_token_us`` — the
+    throughput quote, with a two-probe ``noise_floor`` for context
+    (the arms are CPU-timed; the floor says how much of the delta is
+    run-to-run jitter);
+  - ``compile_pins_flat`` — jit-cache sizes identical after the whole
+    bind/decode/evict grammar churn vs after warmup (zero
+    recompilation as grammars churn — constraint state is DATA).
+
+Usage: ``python benchmarks/grammar_bench.py [--smoke] [--out PATH]``
+(round_snapshot.py freezes it per round; the tier-1 smoke test asserts
+the rung fields).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re as _re
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CFG = dict(vocab=32, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+           max_len=96)
+EOS = 1
+
+
+def _model(seed: int = 0):
+    import jax
+
+    from tpudist.models import create_transformer
+
+    return create_transformer(jax.random.PRNGKey(seed), seq_len=16, **CFG)
+
+
+def _grammars(vocab, n: int, max_states: int):
+    """``n`` DISTINCT single-char-class grammars over the synthetic
+    vocab (distinct keys → distinct registry entries → real churn)."""
+    from tpudist.constrain import compile_grammar
+
+    chars = sorted({w for w in vocab if w})
+    out = []
+    for i in range(n):
+        cls = "".join(_re.escape(c)
+                      for c in chars[3 * i:3 * i + 3] or chars[:3])
+        out.append(compile_grammar(regex="[%s]{2,12}" % cls, vocab=vocab,
+                                   eos_id=EOS, max_states=max_states))
+    return out
+
+
+def _run_arm(eng, prompts, budgets, grammars_by_slot):
+    """Fill every slot, decode everything to budget, return
+    ``(streams, decode_wall_s, decode_tokens)`` — wall measured over the
+    decode blocks only (admission/prefill excluded: the sweep compares
+    DECODE throughput, the hot path the mask gather sits on)."""
+    items = []
+    for slot, (p, b, tg) in enumerate(
+            zip(prompts, budgets, grammars_by_slot)):
+        items.append((slot, p, 0.8, slot, b, (), True, None, tg))
+    streams = {s: [] for s in range(len(prompts))}
+    for slot, tok in eng.start_batch(items).items():
+        if tok is not None:
+            streams[slot].append(tok)
+    while eng.prefilling_slots():
+        for slot, tok in eng.advance_prefill().items():
+            streams[slot].append(tok)
+    wall = 0.0
+    tokens = 0
+    while eng.num_active:
+        t0 = time.perf_counter()
+        _, blocks = eng.decode_block()
+        wall += time.perf_counter() - t0
+        for slot, toks in blocks.items():
+            streams[slot].extend(toks)
+            tokens += len(toks)
+        for slot in list(range(eng.num_slots)):
+            if eng.occupied[slot] and eng.decoding[slot] \
+                    and eng.counts[slot] >= eng.budget[slot]:
+                eng.evict(slot)
+    return streams, wall, tokens
+
+
+def run_sweep(*, slots: int, max_new: int, smoke: bool) -> dict:
+    import jax
+    import numpy as np
+
+    from tpudist.constrain import (ConstrainConfig, compile_cache_stats,
+                                   default_vocab)
+    from tpudist.serve import SlotEngine
+
+    module, params = _model()
+    vocab = default_vocab(CFG["vocab"], EOS)
+    max_states = 16
+    # more distinct grammars than pool blocks: the constrained and
+    # mixed arms then cannot coexist in the pool, so the sweep drives
+    # the LRU release/evict path, not just first-bind
+    n_grammars = 4
+    tgs = _grammars(vocab, n_grammars, max_states)
+    ccfg = ConstrainConfig(vocab=vocab, num_blocks=2,
+                           max_states=max_states)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(2, CFG["vocab"], size=6).astype(np.int32)
+               for s in range(slots)]
+    budgets = [max_new] * slots
+    eng = SlotEngine(module, params, num_slots=slots, prefill_pad=8,
+                     decode_block=8, paged=True, kv_block=8,
+                     constrain=ccfg)
+
+    def arm(n_constrained: int, pair: int):
+        # each arm round-robins its constrained lanes over ONE pair of
+        # grammars (a pair fills the 2-block pool exactly); successive
+        # arms use different pairs, so the pool must evict between arms
+        bound = [(tgs[(2 * pair + (s % 2)) % n_grammars]
+                  if s < n_constrained else None) for s in range(slots)]
+        return (*_run_arm(eng, prompts, budgets, bound), bound)
+
+    # warmup: one mixed arm pays every XLA compile (the twin-delta
+    # discipline — first-compile must not land in any measured arm; the
+    # grammar tail rides every program whenever constrain= is set, so
+    # free and constrained arms share the SAME compiled code)
+    arm(max(1, slots // 2), 0)
+    pins0 = dict(eng.compile_counts())
+    # noise probe: the free arm run twice back-to-back — the ratio of
+    # the two runs is pure run-to-run jitter at this shape, quoted next
+    # to the overhead so a CPU-noise delta can't be misread as mask cost
+    _, nw, nt, _ = arm(0, 0)
+    probe_tps = (nt / nw) if nw else None
+
+    arms = []
+    streams_by_arm = {}
+    for name, k, pair in (("free", 0, 0),
+                          ("mixed", max(1, slots // 2), 1),
+                          ("constrained", slots, 0)):
+        streams, wall, tokens, bound = arm(k, pair)
+        streams_by_arm[name] = (streams, bound)
+        arms.append({"arm": name, "constrained_lanes": k,
+                     "decode_tokens": tokens,
+                     "decode_wall_s": round(wall, 6),
+                     "tokens_per_s":
+                         round(tokens / wall, 2) if wall else None})
+    pins1 = dict(eng.compile_counts())
+
+    # correctness rides along: every constrained stream, truncated at
+    # eos, walks its automaton to a live state
+    streams_in_grammar = True
+    for name, (streams, bound) in streams_by_arm.items():
+        for s, tg in enumerate(bound):
+            if tg is None:
+                continue
+            ts = streams[s]
+            ts = ts[:ts.index(EOS)] if EOS in ts else ts
+            if tg.walk(ts) is None:
+                streams_in_grammar = False
+    free_streams = streams_by_arm["free"][0]
+    mixed_streams, mixed_bound = streams_by_arm["mixed"]
+    free_lanes_unperturbed = all(
+        mixed_streams[s] == free_streams[s]
+        for s, tg in enumerate(mixed_bound) if tg is None)
+
+    by = {a["arm"]: a["tokens_per_s"] for a in arms}
+    free_tps, con_tps = by["free"], by["constrained"]
+    noise_floor = (round(min(free_tps, probe_tps)
+                         / max(free_tps, probe_tps), 4)
+                   if free_tps and probe_tps else 1.0)
+    return {
+        "rung": "grammar_mixed_batch",
+        "regime": "cpu" if jax.devices()[0].platform != "tpu" else "tpu",
+        "note": ("tiny-model CPU mechanics — the cross-arm RATIOS on one "
+                 "engine are the measurement, absolute tok/s is not"),
+        "slots": slots, "max_new": max_new,
+        "grammar_states": max_states, "n_grammars": n_grammars,
+        "pool_blocks": 2,
+        "smoke": bool(smoke),
+        "arms": arms,
+        "free_tokens_per_s": free_tps,
+        "constrained_tokens_per_s": con_tps,
+        "constrained_vs_free":
+            round(con_tps / free_tps, 4) if free_tps else None,
+        "overhead_per_token_us":
+            (round((1.0 / con_tps - 1.0 / free_tps) * 1e6, 3)
+             if free_tps and con_tps else None),
+        "noise_floor": noise_floor,
+        "streams_in_grammar": streams_in_grammar,
+        "free_lanes_unperturbed": free_lanes_unperturbed,
+        "compile_pins_flat": pins0 == pins1,
+        "constrain_stats": {
+            k: v for k, v in eng.constrain_stats().items()
+            if k in ("blocks", "max_states", "pool_bytes", "binds",
+                     "evictions", "resident", "pinned")},
+        "compile_cache": compile_cache_stats(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized sweep (fewer decode tokens)")
+    ap.add_argument("--out", default=None, help="output JSONL path")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=None)
+    args = ap.parse_args(argv)
+    max_new = args.max_new or (16 if args.smoke else 48)
+    row = run_sweep(slots=args.slots, max_new=max_new, smoke=args.smoke)
+    line = json.dumps(row)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -256,6 +256,25 @@ def main(argv=None) -> None:
             {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
         print(f"{distill_out.name}: error {e!r}")
 
+    # Structured-output rung (PR 18): mixed constrained/unconstrained
+    # batch — constrained-vs-free per-token overhead, free-lane
+    # byte-identity, grammar-churn compile pins — frozen as
+    # BENCH_GRAMMAR_r{NN}.json.  Failure-isolated like the serve
+    # snapshot.
+    grammar_out = REPO / f"BENCH_GRAMMAR_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "grammar_bench.py"),
+             "--out", str(grammar_out)],
+            timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        data = [r for r in rows if "wrote" not in r] or rows
+        print(f"{grammar_out.name}: {json.dumps(data[-1])}")
+    except Exception as e:
+        grammar_out.write_text(json.dumps(
+            {"regime": "cpu-smoke", "error": repr(e)}) + "\n")
+        print(f"{grammar_out.name}: error {e!r}")
+
     # Decode per-op attribution (VERDICT Weak #2): trace the bf16 fused
     # decode loop and freeze the table naming the non-matmul residual.
     # Failure-isolated like the serve snapshot.

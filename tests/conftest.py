@@ -126,6 +126,13 @@ _SLOW_PATTERNS = (
     "TestAdapterDisaggTier",
     "TestAdapterOracle::test_sampled_streams_layout_independent",
     "TestAdapterHandoffUnit::test_export_import_rebinds_by_name",
+    # structured-output oracle twins: the paged / speculative / adapter
+    # arms each rebuild+recompile an engine (the dense mixed-batch
+    # oracle, the registry refcount drive, the carry drives, and the
+    # whole server surface stay default in test_constrain.py)
+    "TestConstrainedDecodeOracle::test_mixed_batch_walks_and_free_lane_bit_exact[paged]",
+    "TestConstrainedDecodeOracle::test_spec_arm_walks_with_logprobs",
+    "TestConstrainedDecodeOracle::test_adapter_arm_walks",
     # fleet-router heavies: the twin-arm bench smoke (two 2-replica
     # fleets per arm), the sampled chaos-kill twin, the stash-off
     # degrade drive, and the live drain migration (the routing/probe/

@@ -646,7 +646,12 @@ class TestAdapterBench:
         assert row["outputs_match"], "an arm diverged from its oracle"
         assert row["compile_pins_flat"], "adapter churn recompiled"
         assert row["within_margin"], (
-            f"ratio_min {row['ratio_min']} below margin {row['margin']}")
+            f"ratio_min {row['ratio_min']} below margin_used "
+            f"{row['margin_used']} (static margin {row['margin']}, "
+            f"noise_floor {row['noise_floor']})")
+        # the applied margin is noise-scaled but never below the hard
+        # floor and never above the static margin
+        assert 0.15 <= row["margin_used"] <= row["margin"]
         ks = [r["adapters_per_batch"] for r in row["rows"]]
         assert 0 in ks and max(ks) == row["slots"]
         # the frozen per-round artifact (round_snapshot) carries the
@@ -659,6 +664,46 @@ class TestAdapterBench:
             fr = _json.loads(frozen[-1].read_text().splitlines()[0])
             assert fr.get("error") or (
                 fr["outputs_match"] and fr["within_margin"]
+                and fr["compile_pins_flat"])
+
+
+class TestGrammarBench:
+    def test_sweep_freezes_structured_output_fields(self, tmp_path):
+        """The structured-output rung's contract: every constrained
+        stream stays inside its grammar, free lanes sharing a batch
+        with constrained neighbours are byte-identical to the all-free
+        arm, and jit-cache sizes stay flat across the whole grammar
+        bind/decode/evict churn sweep (constraint state is DATA)."""
+        import json as _json
+
+        from benchmarks.grammar_bench import main
+
+        out = tmp_path / "BENCH_GRAMMAR.json"
+        rc = main(["--smoke", "--out", str(out)])
+        assert rc == 0
+        row = _json.loads(out.read_text().splitlines()[0])
+        assert row["rung"] == "grammar_mixed_batch"
+        assert row["streams_in_grammar"], "a constrained stream escaped"
+        assert row["free_lanes_unperturbed"], (
+            "constrained neighbours perturbed a free lane")
+        assert row["compile_pins_flat"], "grammar churn recompiled"
+        # the sweep must actually have churned the pool: more distinct
+        # grammars than blocks, with evictions between arms
+        assert row["n_grammars"] > row["pool_blocks"]
+        assert row["constrain_stats"]["evictions"] > 0
+        assert {a["arm"] for a in row["arms"]} == {
+            "free", "mixed", "constrained"}
+        assert row["constrained_vs_free"] is not None
+        # the frozen per-round artifact (round_snapshot) carries the
+        # same booleans — spot-check the current one when present
+        from pathlib import Path as _P
+
+        frozen = sorted(_P(__file__).resolve().parent.parent.glob(
+            "BENCH_GRAMMAR_r*.json"))
+        if frozen:
+            fr = _json.loads(frozen[-1].read_text().splitlines()[0])
+            assert fr.get("error") or (
+                fr["streams_in_grammar"] and fr["free_lanes_unperturbed"]
                 and fr["compile_pins_flat"])
 
 

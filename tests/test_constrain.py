@@ -1,0 +1,515 @@
+"""Structured output (grammar-constrained decoding): the acceptance
+suite.
+
+The tentpole contract, pinned here:
+
+- **compiler oracle** — the regex subset compiler agrees with
+  ``re.fullmatch`` over every string up to a length bound, and the
+  token-table compiler's shadow automaton agrees with the character
+  DFA over decoded token strings;
+- **constrained-decode oracle** — a constrained lane's stream
+  (truncated at eos) always walks its automaton to a live state, on
+  dense AND paged AND speculative AND adapter-bound engines, while an
+  unconstrained lane sharing the batch stays byte-identical to a
+  constrain-less engine (the sentinel lane is bit-exact);
+- **carry** — export/import (the disagg handoff package) moves the
+  automaton state by source + state index and continues in-grammar;
+  a constrain-less importer refuses rather than decodes unmasked;
+- **registry semantics** — bind/release refcounts, LRU eviction of
+  cold grammars, ``GrammarPoolFull`` only when every block is pinned;
+- **server surface** — ``submit(grammar=/json_schema=/stop=/
+  logprobs=)`` with synchronous rejection of uncompilable grammars,
+  over-width logprobs and malformed stops; stop sequences match across
+  block boundaries; sessions with a grammar on either side degrade to
+  a fresh prefill (never resume into a stale automaton).
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tpudist.constrain import (ConstrainConfig, GrammarError,  # noqa: E402
+                               GrammarPoolFull, GrammarRegistry,
+                               SchemaError, compile_cache_stats,
+                               compile_grammar, compile_regex_dfa,
+                               default_vocab, schema_to_regex)
+from tpudist.models import create_transformer, lora  # noqa: E402
+from tpudist.serve import InferenceServer, ServeConfig, SlotEngine  # noqa: E402
+from tpudist.serve.scheduler import FINISH_REASONS, AdmissionError  # noqa: E402
+
+CFG = dict(vocab=16, d_model=32, n_layers=2, n_heads=2, d_ff=64, max_len=32)
+EOS = 1
+VOCAB = default_vocab(CFG["vocab"], EOS)
+#: the decodable characters of the synthetic vocab, in token order
+CHARS = sorted({w for w in VOCAB if w})
+
+
+def _cls(chars):
+    return "".join("\\" + c if c in set("\\^$.|?*+()[]{}-") else c
+                   for c in chars)
+
+
+#: a small grammar every constrained test shares: 2..5 repetitions of
+#: the first three decodable characters
+PAT = "[%s]{2,5}" % _cls(CHARS[:3])
+
+
+@pytest.fixture(scope="module")
+def model():
+    return create_transformer(jax.random.PRNGKey(0), seq_len=16, **CFG)
+
+
+@pytest.fixture(scope="module")
+def tg():
+    return compile_grammar(regex=PAT, vocab=VOCAB, eos_id=EOS,
+                           max_states=16)
+
+
+def _prompt(seed=0, plen=5):
+    rng = np.random.default_rng(seed)
+    return rng.integers(2, CFG["vocab"], size=plen).astype(np.int32)
+
+
+def _trim(toks):
+    return toks[:toks.index(EOS)] if EOS in toks else toks
+
+
+def _drive(eng, items, steps=40):
+    """Engine-level decode loop: admit, finish prefill, decode until
+    every lane hits its budget (no server; returns slot → stream)."""
+    toks = {}
+    info = None
+    for s, t in eng.start_batch(items).items():
+        if t is not None:
+            toks.setdefault(s, []).append(t)
+    while eng.prefilling_slots():
+        for s, t in eng.advance_prefill().items():
+            toks.setdefault(s, []).append(t)
+    for _ in range(steps):
+        if not eng.num_active:
+            break
+        info, out = eng.decode_auto()
+        for s, ts in out.items():
+            toks.setdefault(s, []).extend(ts)
+        for s in range(eng.num_slots):
+            if eng.occupied[s] and eng.decoding[s] \
+                    and eng.counts[s] >= eng.budget[s]:
+                eng.evict(s)
+    return toks, info
+
+
+# ---------------------------------------------------------------------------
+# compiler oracles
+
+
+class TestRegexOracle:
+    #: pattern, alphabet, max enumerated length — every string in
+    #: alphabet^<=L is checked against re.fullmatch
+    CASES = [
+        ("a*b", "ab", 5),
+        ("(ab|ba)+", "ab", 6),
+        ("a?b{2,3}", "ab", 5),
+        ("[ab]c|c[ab]", "abc", 3),
+        ("a[^a]a", "abc", 4),
+        ("(a|b)*abb", "ab", 6),
+        ("a.c", "abc", 3),
+    ]
+
+    @pytest.mark.parametrize("pat,alphabet,maxlen",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_agrees_with_re_fullmatch(self, pat, alphabet, maxlen):
+        dfa = compile_regex_dfa(pat)
+        ref = re.compile(pat)
+
+        def strings(n):
+            if n == 0:
+                yield ""
+                return
+            for s in strings(n - 1):
+                for ch in alphabet:
+                    yield s + ch
+
+        for n in range(maxlen + 1):
+            for s in strings(n):
+                assert dfa.fullmatch(s) == bool(ref.fullmatch(s)), (pat, s)
+
+    def test_malformed_patterns_reject(self):
+        for bad in ("[unclosed", "a{3,1}", "(", "a{99}", "\\q"):
+            with pytest.raises(GrammarError):
+                compile_grammar(regex=bad, vocab=VOCAB, eos_id=EOS)
+
+    def test_state_budget_enforced(self):
+        with pytest.raises(GrammarError):
+            compile_grammar(regex="[%s]{40,50}" % _cls(CHARS[:3]),
+                            vocab=VOCAB, eos_id=EOS, max_states=4)
+
+
+class TestSchemaLowering:
+    #: schema, accepted canonical JSON values, rejected strings
+    CASES = [
+        ({"const": 7}, ["7"], ["8", ""]),
+        ({"enum": ["a", 1]}, ['"a"', "1"], ['"b"', "a"]),
+        ({"type": "boolean"}, ["true", "false"], ["True", "1"]),
+        ({"type": "null"}, ["null"], ["nil", ""]),
+        ({"type": "integer"}, ["0", "-3", "42"], ["007", "1.5", "-"]),
+        ({"type": "number"}, ["0", "-3.25", "2e8"], [".5", "1."]),
+        ({"type": "string"}, ['"hi"', '""'], ["hi", '"']),
+        ({"type": "string", "pattern": "ab+"}, ['"abb"'], ['"a"']),
+        ({"type": "array", "items": {"type": "boolean"}},
+         ["[]", "[true]", "[true,false]"], ["[true,]", "[,]"]),
+        ({"type": "object",
+          "properties": {"ok": {"type": "boolean"}},
+          "required": ["ok"]},
+         ['{"ok":true}'], ["{}", '{"ok":1}']),
+    ]
+
+    @pytest.mark.parametrize("schema,good,bad", CASES,
+                             ids=[json.dumps(c[0]) for c in CASES])
+    def test_lowering_matches_canonical_json(self, schema, good, bad):
+        pat = schema_to_regex(schema)
+        dfa = compile_regex_dfa(pat, max_states=512)
+        for s in good:
+            assert dfa.fullmatch(s), (schema, s, pat)
+        for s in bad:
+            assert not dfa.fullmatch(s), (schema, s, pat)
+
+    def test_unsupported_schema_rejects(self):
+        for bad in ({"type": "martian"}, {"allOf": []}):
+            with pytest.raises(SchemaError):
+                schema_to_regex(bad)
+
+
+class TestTokenTables:
+    def test_shadow_agrees_with_char_dfa(self, tg):
+        """Every token path the tables allow decodes to a character
+        string the DFA is still alive on; eos is allowed exactly at
+        accept states."""
+        dfa = compile_regex_dfa(PAT)
+        frontier = [(0, "")]
+        seen = 0
+        for _ in range(6):
+            nxt = []
+            for st, text in frontier:
+                assert tg.token_allowed(st, EOS) == tg.is_accept(st) \
+                    == dfa.fullmatch(text)
+                for tok in range(len(VOCAB)):
+                    if tok == EOS or not tg.token_allowed(st, tok):
+                        continue
+                    t2 = text + VOCAB[tok]
+                    st2 = tg.advance(st, tok)
+                    nxt.append((st2, t2))
+                    seen += 1
+            frontier = nxt[:64]
+        assert seen > 0
+
+    def test_compile_cache_hits_by_source(self):
+        before = compile_cache_stats()
+        a = compile_grammar(regex=PAT, vocab=VOCAB, eos_id=EOS,
+                            max_states=16)
+        b = compile_grammar(regex=PAT, vocab=VOCAB, eos_id=EOS,
+                            max_states=16)
+        after = compile_cache_stats()
+        assert a is b
+        assert after["hits"] > before["hits"]
+
+    def test_unsatisfiable_grammar_rejects(self):
+        # the 16-token synthetic vocab decodes to punctuation only —
+        # "true"/"false" are unspellable, so a boolean schema is
+        # token-dead at the start state and must reject at COMPILE
+        # time, not decode garbage
+        with pytest.raises(GrammarError):
+            compile_grammar(json_schema={"type": "boolean"},
+                            vocab=VOCAB, eos_id=EOS)
+
+    def test_source_exclusivity(self):
+        with pytest.raises(GrammarError):
+            compile_grammar(regex=PAT, json_schema={"const": 1},
+                            vocab=VOCAB, eos_id=EOS)
+        with pytest.raises(GrammarError):
+            compile_grammar(vocab=VOCAB, eos_id=EOS)
+
+
+class TestRegistry:
+    def _g(self, i):
+        return compile_grammar(regex="[%s]{1,%d}" % (_cls(CHARS[:2]),
+                                                     2 + i),
+                               vocab=VOCAB, eos_id=EOS, max_states=16)
+
+    def test_bind_release_lru_and_pool_full(self):
+        reg = GrammarRegistry(2)
+        b0, fresh0 = reg.bind(self._g(0))
+        b1, _ = reg.bind(self._g(1))
+        assert fresh0 and b0 != b1
+        # same key re-binds the SAME block without a fresh write
+        b0b, fresh0b = reg.bind(self._g(0))
+        assert b0b == b0 and not fresh0b
+        with pytest.raises(GrammarPoolFull):
+            reg.bind(self._g(2))  # both blocks pinned
+        reg.release(b1)
+        b2, fresh2 = reg.bind(self._g(2))  # evicts the cold g1
+        assert b2 == b1 and fresh2
+        st = reg.stats()
+        assert st["evictions"] == 1 and st["blocks"] == 2
+        reg.release(b0)
+        reg.release(b0)  # refs from bind + re-bind
+        reg.release(b2)
+        assert reg.stats()["pinned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# constrained-decode oracle across engine arms
+
+
+class TestConstrainedDecodeOracle:
+    @pytest.mark.parametrize("paged", [False, True],
+                             ids=["dense", "paged"])
+    def test_mixed_batch_walks_and_free_lane_bit_exact(self, model, tg,
+                                                       paged):
+        module, params = model
+        ccfg = ConstrainConfig(vocab=VOCAB, num_blocks=2, max_states=16)
+        kw = dict(num_slots=2, prefill_pad=8, decode_block=4,
+                  constrain=ccfg)
+        if paged:
+            kw.update(paged=True, kv_block=8)
+        eng = SlotEngine(module, params, **kw)
+        p = _prompt()
+        toks, _ = _drive(eng, [
+            (0, p, 0.9, 7, 10, (), True, None, tg),
+            (1, p, 0.9, 7, 10, (), True, None, None),
+        ])
+        st = tg.walk(_trim(toks[0]))
+        assert st is not None, toks[0]
+        # the free lane is bit-exact vs a constrain-less engine: the
+        # sentinel gidx lane gathers the identity block, nothing else
+        del kw["constrain"]
+        eng2 = SlotEngine(module, params, **kw)
+        toks2, _ = _drive(eng2, [(1, p, 0.9, 7, 10)])
+        assert toks[1] == toks2[1]
+
+    def test_spec_arm_walks_with_logprobs(self, model, tg):
+        module, params = model
+        ccfg = ConstrainConfig(vocab=VOCAB, num_blocks=2, max_states=16)
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         decode_block=4, spec_draft=1, spec_k=2,
+                         constrain=ccfg, logprobs=3)
+        p = _prompt()
+        toks, info = _drive(eng, [
+            (0, p, 0.9, 7, 10, (), True, None, tg),
+            (1, p, 0.9, 7, 10, (), True, None, None),
+        ])
+        assert tg.walk(_trim(toks[0])) is not None, toks[0]
+        # logprobs ride the decode info for every lane: n_lp-wide
+        # (id, logprob) rows, all log-domain
+        rows = (info or {}).get("logprobs")
+        assert rows
+        for s, rs in rows.items():
+            for ids, vals in rs:
+                assert len(ids) == 3 and len(vals) == 3
+                assert all(v <= 0.0 for v in vals)
+
+    def test_adapter_arm_walks(self, model, tg):
+        """A lane bound to BOTH an adapter and a grammar masks through
+        the adapted logits (tail order composes)."""
+        module, params = model
+        ccfg = ConstrainConfig(vocab=VOCAB, num_blocks=2, max_states=16)
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         decode_block=4, adapters=True, adapter_blocks=2,
+                         adapter_rank=4, constrain=ccfg)
+        eng.load_adapter("acme", lora.make_adapter_factors(
+            jax.random.PRNGKey(40), module, 4, scale=0.3))
+        p = _prompt()
+        toks, _ = _drive(eng, [
+            (0, p, 0.9, 7, 10, (), True, "acme", tg),
+            (1, p, 0.9, 7, 10, (), True, None, None),
+        ])
+        assert tg.walk(_trim(toks[0])) is not None, toks[0]
+
+    def test_registry_refcounts_follow_slots(self, model, tg):
+        module, params = model
+        ccfg = ConstrainConfig(vocab=VOCAB, num_blocks=2, max_states=16)
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         decode_block=4, constrain=ccfg)
+        p = _prompt()
+        eng.start_batch([(0, p, 0.9, 7, 10, (), True, None, tg)])
+        assert eng.constrain_stats()["pinned"] == 1
+        eng.evict(0)
+        assert eng.constrain_stats()["pinned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# carry: handoff export/import
+
+
+class TestCarry:
+    def test_export_import_continues_in_grammar(self, model, tg):
+        module, params = model
+        ccfg = ConstrainConfig(vocab=VOCAB, num_blocks=2, max_states=16)
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         decode_block=4, constrain=ccfg)
+        p = _prompt()
+        first = eng.start_batch([(0, p, 0.9, 7, 10, (), True, None, tg)])
+        toks = [first[0]]
+        _, out = eng.decode_block(max_k=2)
+        toks.extend(out[0])
+        pkg = eng.export_slot(0)
+        assert pkg["grammar"]["source"]["kind"] == "regex"
+        eng.evict(0)
+        # importer: a DIFFERENT engine, its own pool — the grammar
+        # travels by source and re-binds locally
+        eng2 = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                          decode_block=4, constrain=ccfg)
+        eng2.import_slot(1, pkg)
+        _, out = eng2.decode_block(max_k=2)
+        toks.extend(out[1])
+        assert tg.walk(_trim(toks)) is not None, toks
+
+    def test_constrainless_importer_refuses(self, model, tg):
+        module, params = model
+        ccfg = ConstrainConfig(vocab=VOCAB, num_blocks=2, max_states=16)
+        eng = SlotEngine(module, params, num_slots=2, prefill_pad=8,
+                         constrain=ccfg)
+        eng.start_batch([(0, _prompt(), 0.9, 7, 10, (), True, None, tg)])
+        pkg = eng.export_slot(0)
+        eng3 = SlotEngine(module, params, num_slots=2, prefill_pad=8)
+        with pytest.raises(Exception):
+            # decoding UNMASKED after a constrained handoff would be
+            # silently-wrong bytes — refusal is the only safe answer
+            eng3.import_slot(0, pkg)
+
+
+# ---------------------------------------------------------------------------
+# server surface
+
+
+class TestServerSurface:
+    @pytest.fixture(scope="class")
+    def srv(self, model):
+        cfg = ServeConfig(num_slots=2, max_new=8, constrain=True,
+                          constrain_blocks=2, constrain_states=16,
+                          logprobs=3)
+        s = InferenceServer(*model, cfg,
+                            install_signal_handler=False).start()
+        yield s
+        s.close(30)
+
+    def test_constrained_stream_and_logprobs(self, srv, tg):
+        p = _prompt().tolist()
+        h1 = srv.submit(p, temperature=0.9, seed=7, eos_id=EOS,
+                        grammar=PAT, logprobs=2)
+        h2 = srv.submit(p, temperature=0.9, seed=7, eos_id=EOS)
+        assert h1.wait(120) and h2.wait(120)
+        assert tg.walk(_trim(h1.tokens)) is not None, h1.tokens
+        assert h1.finish_reason in ("eos", "length")
+        # logprobs: one row per token; the prefill-sampled first token
+        # has none (its logits live in the prefill program), the rest
+        # are top-2 (id, logprob) slices of the engine-wide width
+        assert len(h1.logprobs) == len(h1.tokens)
+        assert h1.logprobs[0] is None
+        for row in h1.logprobs[1:]:
+            assert len(row[0]) == 2 and all(v <= 0.0 for v in row[1])
+        assert h2.logprobs == []  # did not ask
+
+    def test_stop_sequence_and_straddle(self, srv):
+        p = _prompt().tolist()
+        free = srv.submit(p, temperature=0.9, seed=7, max_new=8)
+        assert free.wait(120)
+        tgt = free.tokens[2]
+        first = free.tokens.index(tgt)
+        h = srv.submit(p, temperature=0.9, seed=7, stop=[tgt], max_new=8)
+        assert h.wait(120)
+        assert h.finish_reason == "stop_sequence"
+        assert h.tokens == free.tokens[:first + 1]
+        # a 2-token stop crossing a decode-block boundary still matches
+        # (the suffix check runs on the DELIVERED stream, not per block)
+        pair = tuple(free.tokens[2:4])
+        h = srv.submit(p, temperature=0.9, seed=7, stop=[pair], max_new=8)
+        assert h.wait(120)
+        assert h.finish_reason == "stop_sequence"
+        assert h.tokens == free.tokens[:4]
+
+    def test_json_schema_end_to_end(self, srv):
+        # the 16-token vocab spells only punctuation — an enum of
+        # quotable punctuation strings is the satisfiable schema here
+        h = srv.submit(_prompt().tolist(), temperature=0.9, seed=3,
+                       eos_id=EOS, json_schema={"enum": ["!!", "##"]},
+                       max_new=8)
+        assert h.wait(120)
+        text = "".join(VOCAB[t] for t in _trim(h.tokens))
+        assert text in ('"!!"', '"##"'), (h.tokens, text)
+
+    def test_synchronous_rejections(self, srv):
+        p = _prompt().tolist()
+        for kw, want in [
+            (dict(grammar="[unclosed"), "invalid_grammar"),
+            (dict(grammar=PAT), "invalid_grammar"),  # no eos_id
+            (dict(grammar=PAT, json_schema={}, eos_id=EOS),
+             "invalid_grammar"),
+            (dict(logprobs=9), "logprobs_unavailable"),
+            (dict(logprobs=-1), "invalid_logprobs"),
+            (dict(stop=[[]]), "invalid_stop"),
+        ]:
+            with pytest.raises(AdmissionError) as ei:
+                srv.submit(p, **kw)
+            assert ei.value.reason.startswith(want), (kw, ei.value.reason)
+
+    def test_statusz_carries_constrained_section(self, srv):
+        st = srv._statusz_doc()
+        assert st["constrained"]["enabled"]
+        assert st["constrained"]["logprobs"] == 3
+
+    def test_finish_reasons_registered(self):
+        assert "grammar_violation" in FINISH_REASONS
+        assert "stop_sequence" in FINISH_REASONS
+
+
+class TestConstrainOffSurface:
+    def test_rejects_without_pool(self, model):
+        srv = InferenceServer(*model, ServeConfig(num_slots=2, max_new=4),
+                              install_signal_handler=False).start()
+        try:
+            p = _prompt().tolist()
+            for kw, want in [
+                (dict(grammar=PAT, eos_id=EOS), "constrain_disabled"),
+                (dict(logprobs=1), "logprobs_unavailable"),
+            ]:
+                with pytest.raises(AdmissionError) as ei:
+                    srv.submit(p, **kw)
+                assert ei.value.reason.startswith(want)
+        finally:
+            srv.close(30)
+
+
+class TestSessionGrammarDegrade:
+    def test_grammar_turns_never_resume(self, model, tg):
+        """A parked turn that decoded under a grammar must NOT seed the
+        next turn's resume: the parked automaton state belongs to ITS
+        turn, the new turn's grammar starts at state 0.  Either side
+        having a grammar degrades to a fresh prefill — slower, never
+        wrong."""
+        import time as _time
+
+        cfg = ServeConfig(num_slots=2, max_new=6, host_tier=True,
+                          prefill_pad=8, constrain=True,
+                          constrain_blocks=2, constrain_states=16)
+        srv = InferenceServer(*model, cfg,
+                              install_signal_handler=False).start()
+        try:
+            p1 = _prompt(0)
+            h1 = srv.submit(p1, max_new=6, session="g1", tenant="t",
+                            grammar=PAT, eos_id=EOS, temperature=0.9,
+                            seed=7)
+            assert h1.wait(120)
+            deadline = _time.time() + 30
+            while srv._tier.parks < 1 and _time.time() < deadline:
+                _time.sleep(0.02)
+            p2 = np.concatenate([p1, np.asarray(h1.tokens, np.int32),
+                                 _prompt(1, 4)])
+            h2 = srv.submit(p2, max_new=6, session="g1", tenant="t")
+            assert h2.wait(120)
+            assert h2.finish_reason != "session_resumed"
+        finally:
+            srv.close(30)

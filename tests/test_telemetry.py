@@ -631,6 +631,10 @@ class TestAggregatorBackCompat:
         assert "fleet" not in report["serving"]
         # a flywheel-less stream gains no distill section (PR-17)
         assert "distill" not in report["serving"]
+        # a grammar-less stream gains no constrained section (PR-18
+        # additive discipline — no constrain config, no deferrals, no
+        # constrained-tagged finishes)
+        assert "constrained" not in report["serving"]
         assert report["serving"]["requests_finished"] == 1
         # no trace artifacts leak into the report of a trace-less stream
         assert "trace" not in json.dumps(report).lower()
@@ -726,6 +730,49 @@ class TestAggregatorBackCompat:
             assert before[key] == after[key], f"{key} moved"
         for key in ("ttft", "tpot", "finish_reasons", "decode_tokens",
                     "tokens_out", "occupancy_mean"):
+            assert before["serving"][key] == after["serving"][key]
+
+    def test_constrain_records_are_purely_additive(self, tmp_path):
+        """Structured-output events (PR 18) bolt a `constrained`
+        section on; every pre-existing serving field keeps its exact
+        value."""
+        self._write_old(tmp_path)
+        before = aggregate_run(tmp_path)
+        with open(tmp_path / "rank0_gen0.jsonl", "a") as f:
+            for rec in (
+                {"kind": "event", "name": "serve_constrain_config",
+                 "t": 100.0, "dur": 0.0, "rank": 0, "gen": 0,
+                 "enabled": True, "blocks": 4, "max_states": 64,
+                 "pool_bytes": 65536, "logprobs": 3},
+                {"kind": "event", "name": "constrain_deferred",
+                 "t": 100.1, "dur": 0.0, "rank": 0, "gen": 0, "n": 2},
+                {"kind": "event", "name": "request_finished",
+                 "t": 100.45, "dur": 0.0, "rank": 0, "gen": 0, "id": 1,
+                 "reason": "eos", "prompt_len": 4, "tokens_out": 5,
+                 "ttft_s": 0.2, "tpot_s": 0.01, "queue_wait_s": 0.001,
+                 "constrained": "regex", "logprobs": 2},
+                {"kind": "event", "name": "request_finished",
+                 "t": 100.46, "dur": 0.0, "rank": 0, "gen": 0, "id": 2,
+                 "reason": "stop_sequence", "prompt_len": 4,
+                 "tokens_out": 3, "ttft_s": 0.2, "tpot_s": 0.01,
+                 "queue_wait_s": 0.001, "stop_seqs": 1},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        after = aggregate_run(tmp_path)
+        cn = after["serving"]["constrained"]
+        assert cn["blocks"] == 4 and cn["max_states"] == 64
+        assert cn["logprobs_width"] == 3
+        assert cn["requests"] == {"regex": 1}
+        assert cn["free_requests"] == 2  # the old-stream finish + stop
+        assert cn["deferred"] == 2
+        assert cn["stop_finished"] == 1
+        assert cn["violations_finished"] == 0
+        assert cn["logprobs_requests"] == 1
+        assert "constrained" in render_markdown(after)
+        for key in ("goodput", "step", "wall_clock_s", "per_rank"):
+            assert before[key] == after[key], f"{key} moved"
+        for key in ("ttft", "tpot", "decode_tokens",
+                    "occupancy_mean"):
             assert before["serving"][key] == after["serving"][key]
 
     def test_distill_records_are_purely_additive(self, tmp_path):

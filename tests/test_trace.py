@@ -113,20 +113,42 @@ class TestHandoffSchema:
                 "lane": {"k": np.arange(6, dtype=np.float32).reshape(2, 3)},
                 "state": {"last": np.int32(7)}}
 
-    def test_v4_round_trips_trace_id_and_adapter(self):
+    def test_v5_round_trips_trace_id_adapter_and_grammar(self):
         from tpudist.serve.disagg import (HANDOFF_SCHEMA_VERSION,
                                           deserialize_package,
                                           serialize_package)
 
-        ser = serialize_package({**self._pkg(), "adapter": "acme"})
-        assert ser["schema_version"] == HANDOFF_SCHEMA_VERSION == 4
+        genv = {"source": {"kind": "regex", "src": "[ab]{1,3}"},
+                "eos_id": 1}
+        ser = serialize_package({**self._pkg(), "adapter": "acme",
+                                 "grammar": genv})
+        assert ser["schema_version"] == HANDOFF_SCHEMA_VERSION == 5
         assert ser["trace_id"] == "cafe0123deadbeef"
         assert ser["adapter"] == "acme"
+        assert ser["grammar"] == genv
         out = deserialize_package(ser)
         assert out["trace_id"] == "cafe0123deadbeef"
         assert out["adapter"] == "acme"
+        # the grammar travels by SOURCE: the importer recompiles and
+        # re-binds into its own pool (block ids are pool-local)
+        assert out["grammar"] == genv
         np.testing.assert_array_equal(out["lane"]["k"],
                                       self._pkg()["lane"]["k"])
+
+    def test_v4_package_still_deserializes(self):
+        """BACK-COMPAT (PR-8 discipline): a schema_version-4 package —
+        the pre-structured-output wire format, no grammar field — must
+        still import; grammar reads back None (unconstrained)."""
+        from tpudist.serve.disagg import (deserialize_package,
+                                          serialize_package)
+
+        ser = serialize_package({**self._pkg(), "adapter": "acme"})
+        ser["schema_version"] = 4
+        del ser["grammar"]  # exactly what a v4 sender puts on the wire
+        out = deserialize_package(ser)
+        assert out["adapter"] == "acme"
+        assert out["grammar"] is None
+        assert out["pos"] == 3 and out["budget"] == 5
 
     def test_v2_package_still_deserializes(self):
         """BACK-COMPAT (PR-8 discipline): a schema_version-2 package —
@@ -139,9 +161,11 @@ class TestHandoffSchema:
         ser["schema_version"] = 2
         del ser["trace_id"]  # exactly what a v2 sender puts on the wire
         del ser["adapter"]
+        del ser["grammar"]
         out = deserialize_package(ser)
         assert out["trace_id"] is None
         assert out["adapter"] is None
+        assert out["grammar"] is None
         assert out["pos"] == 3 and out["budget"] == 5
         np.testing.assert_array_equal(out["lane"]["k"],
                                       self._pkg()["lane"]["k"])

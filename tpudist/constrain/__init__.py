@@ -1,0 +1,53 @@
+"""Structured output: grammar-constrained decoding for the slot engine.
+
+The subsystem turns a host-side grammar (a regex, or a JSON schema
+lowered to one) into a token-level finite-state automaton — the
+Outlines-style compilation: regex → character DFA → vocabulary-indexed
+transition/mask tables — and the serving stack applies it as DATA
+inside the compiled slot programs:
+
+- :mod:`tpudist.constrain.regex_dfa` — the regex subset parser and the
+  Thompson NFA → subset-construction DFA pipeline (pure Python, no
+  dependencies);
+- :mod:`tpudist.constrain.schema` — JSON schema → regex lowering (a
+  schema constrains by becoming a regex over the canonical
+  whitespace-free JSON serialization);
+- :mod:`tpudist.constrain.grammar` — the token-table compiler
+  (:func:`compile_grammar`, cached by grammar hash) and the host-side
+  shadow automaton (:class:`TokenGrammar`);
+- :mod:`tpudist.constrain.registry` — the resident-block registry the
+  engine binds per-request grammars through (the adapter-pool
+  discipline applied to grammars: a fixed pool of table blocks, LRU
+  eviction of cold refcount-zero entries, per-slot refcount pins).
+
+Per-slot automaton state lives in ``SlotState`` (``gidx``/``gstate``;
+the pool's ``num_blocks`` sentinel = unconstrained), the dense tables
+ride into ``decode_block``/``spec_verify`` as a read-only program
+argument gathered per slot in-graph, and mixed constrained/
+unconstrained traffic shares one batch with zero recompilation per
+grammar.
+"""
+
+from tpudist.constrain.grammar import (ConstrainConfig, GrammarError,
+                                       TokenGrammar, compile_cache_stats,
+                                       compile_grammar, default_vocab,
+                                       grammar_source_key)
+from tpudist.constrain.regex_dfa import RegexError, compile_regex_dfa
+from tpudist.constrain.registry import GrammarPoolFull, GrammarRegistry
+from tpudist.constrain.schema import SchemaError, schema_to_regex
+
+__all__ = [
+    "ConstrainConfig",
+    "GrammarError",
+    "GrammarPoolFull",
+    "GrammarRegistry",
+    "RegexError",
+    "SchemaError",
+    "TokenGrammar",
+    "compile_cache_stats",
+    "compile_grammar",
+    "compile_regex_dfa",
+    "default_vocab",
+    "grammar_source_key",
+    "schema_to_regex",
+]

@@ -324,7 +324,20 @@ class SlotState(NamedTuple):
       non-adapter engine the leaf rides along as zeros.  KV handoff
       carries it with the row, but ids are POOL-LOCAL — the importing
       engine re-binds by adapter NAME (the package's ``adapter`` field)
-      before install.
+      before install;
+    - ``gidx [S] int32`` / ``gstate [S] int32`` — the slot's grammar
+      block in the structured-output pool (:mod:`tpudist.constrain`)
+      and its automaton state.  The adapter-id discipline applied to
+      grammars: the pool's ``num_blocks`` sentinel = unconstrained
+      (the sentinel block's mask is all-True identity, so free lanes
+      sample bit-exactly beside constrained neighbors), the programs
+      gather each slot's mask/transition rows from ``(gidx, gstate)``
+      IN-GRAPH, and ``gstate`` advances as part of the emitted-token
+      commit — so park/resume and disagg handoff carry the constraint
+      state byte-faithfully with the row.  Like adapter ids, ``gidx``
+      is POOL-LOCAL: an importing engine re-binds by grammar SOURCE
+      (the package's ``grammar`` field) and overwrites it.  Zeros on
+      non-constrained engines.
     """
 
     last_tok: jax.Array
@@ -336,6 +349,8 @@ class SlotState(NamedTuple):
     accepted: jax.Array
     drafted: jax.Array
     adapter_id: jax.Array
+    gidx: jax.Array
+    gstate: jax.Array
 
 
 class SlotDecode(NamedTuple):
@@ -513,7 +528,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                      spec: Optional[Tuple] = None,
                      draft_constraint: Optional[Callable] = None,
                      attn_kernel: str = "gather",
-                     adapters=None
+                     adapters=None,
+                     constrain=None,
+                     logprobs: int = 0
                      ) -> SlotDecode:
     """Build the slot-decode primitive set over ``module``/``params`` —
     see :class:`SlotDecode` for the contract of each callable.  With
@@ -565,7 +582,35 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     projection geometry matches the target's; a geometry-mismatched
     loaded draft runs base-only — acceptance may drop, output
     correctness cannot (the adapter'd target verify is the oracle).
-    Without ``adapters`` every signature is byte-identical to before."""
+    Without ``adapters`` every signature is byte-identical to before.
+
+    ``constrain``: a :class:`tpudist.constrain.ConstrainConfig` —
+    enable the structured-output seam.  ``insert_batch`` /
+    ``prefill_extend`` / ``decode_block`` / ``spec_verify`` grow a
+    trailing ``gpool`` argument: the dense grammar tables
+    ``(allowed [G+1, S_max, V] bool, next [G+1, S_max, V] int32)``,
+    read-only — host grammar binds swap rows in the arrays, never the
+    program.  Each slot's mask row is gathered from ``SlotState.gidx``
+    / ``gstate`` in-graph and applied on the vocabulary axis before
+    sampling (the decode-window mask discipline applied to vocab
+    instead of positions); block ``G`` is the all-True identity
+    sentinel, so unconstrained lanes in the same batch sample
+    bit-exactly.  In ``spec_verify`` the target's verify rows are
+    masked along the draft's automaton trajectory and a
+    grammar-forbidden draft token is simply a rejection (speculation
+    composes for free; the draft itself decodes unmasked).
+    ``insert_batch`` additionally takes the admission batch's ``gids``
+    before the pool.  Without ``constrain`` every signature is
+    byte-identical to before.
+
+    ``logprobs``: top-n count for the logprobs surface (0 = off).
+    When set, ``decode_block`` returns two extra arrays ``(lp_ids
+    [K, S, n], lp_vals [K, S, n])`` and ``spec_verify`` returns
+    ``(lp_ids [S, k+1, n], lp_vals [S, k+1, n])`` — the top-n of the
+    POST-MASK log-softmax at each emitted position (constrained lanes
+    report the distribution actually sampled), riding the existing
+    packed D2H fetch.  Prefill-sampled first tokens carry no logprobs
+    (the host surfaces ``None`` for them)."""
     if attn_kernel not in ("gather", "paged"):
         raise ValueError(
             f"attn_kernel must be 'gather' or 'paged', got {attn_kernel!r}")
@@ -603,6 +648,72 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         return _lora.gather_collection(
             apool, ids, n_lora_layers if n_layers is None else n_layers)
 
+    # -- structured-output seam (tpudist.constrain) -------------------------
+    use_gram = constrain is not None
+    #: the sentinel grammar id = unconstrained (also what evict resets to)
+    _gid_empty = int(constrain.num_blocks) if use_gram else 0
+    n_lp = int(logprobs)
+    if n_lp < 0:
+        raise ValueError(f"logprobs must be >= 0, got {n_lp}")
+
+    def _gmask(gp, gidx, gstate, logits):
+        """Vocabulary-axis grammar mask: disallowed tokens at each
+        lane's ``(gidx, gstate)`` drop to finfo.min (identity when the
+        seam is off or the lane indexes the sentinel block — the
+        all-True row makes ``where`` a no-op, so free lanes keep
+        bit-exact logits)."""
+        if gp is None:
+            return logits
+        allow = gp[0][gidx, gstate]
+        return jnp.where(allow, logits, jnp.finfo(logits.dtype).min)
+
+    def _gadvance(gp, gidx, gstate, toks, moved):
+        """Automaton advance over one emitted token per lane — part of
+        the token commit, so parked/handed-off rows carry it.  Lanes
+        with ``moved`` False (inactive, or a prefill that sampled
+        nothing) hold still; the tables self-loop on disallowed tokens,
+        so even a defensive gather never escapes the automaton."""
+        if gp is None:
+            return gstate
+        nxt = gp[1][gidx, gstate, toks]
+        return jnp.where(moved, nxt, gstate)
+
+    def _top_lp(logits):
+        """Top-n (id, logprob) of the POST-MASK distribution — the
+        logprobs surface reports what was actually sampled from."""
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        vals, ids = lax.top_k(lp, n_lp)
+        return ids.astype(jnp.int32), vals.astype(jnp.float32)
+
+    def _slot_tail(tail, sel_ids):
+        """Split a program's variadic pool tail into ``(ads, gp)``:
+        the adapter pool rides first (when that seam is on), the
+        grammar pool last.  Both seams off → empty tail, and the
+        traced signature is byte-identical to a pre-seam program."""
+        i = 0
+        ads = None
+        if use_lora:
+            ads = _gather_ads(tail[0], sel_ids)
+            i = 1
+        gp = tail[i] if use_gram else None
+        return ads, gp
+
+    def _insert_tail(tail):
+        """The insert programs' tail: ``[aids, apool][, gids, gpool]``
+        — per-lane ids ride as data beside each pool.  Seams that are
+        off synthesize their sentinel ids."""
+        i = 0
+        if use_lora:
+            aids, ads = tail[0], _gather_ads(tail[1], tail[0])
+            i = 2
+        else:
+            aids, ads = jnp.full(num_slots, _aid_empty, jnp.int32), None
+        if use_gram:
+            gids, gp = tail[i], tail[i + 1]
+        else:
+            gids, gp = jnp.full(num_slots, _gid_empty, jnp.int32), None
+        return aids, ads, gids, gp
+
     init_cache, _step_base = make_decode_step(module, params)
     vocab = module.vocab
     if use_lora:
@@ -637,7 +748,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             keys=jnp.zeros((s, 2), jnp.uint32),
             accepted=jnp.zeros(s, jnp.int32),
             drafted=jnp.zeros(s, jnp.int32),
-            adapter_id=jnp.full(s, _aid_empty, jnp.int32))
+            adapter_id=jnp.full(s, _aid_empty, jnp.int32),
+            gidx=jnp.full(s, _gid_empty, jnp.int32),
+            gstate=jnp.zeros(s, jnp.int32))
 
     def init_slots():
         one = init_cache(1)
@@ -673,13 +786,16 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
     _force_chunk = _make_force(step)
 
-    def _decode_scan(state, cache, k, ads):
+    def _decode_scan(state, cache, k, ads, gp):
         """The K-step fused decode body shared by the dense and paged
         ``decode_block`` programs: in-graph token feedback, inactive
         lanes' cache writes undone by the ``active`` select.  ``ads``
-        (the gathered per-slot adapter collections) is loop-invariant —
-        slot bindings never change mid-dispatch — so XLA hoists the
-        gather out of the scan."""
+        (the gathered per-slot adapter collections) and ``gp`` (the
+        grammar pool) are loop-invariant — slot bindings never change
+        mid-dispatch — so XLA hoists the gathers out of the scan.  The
+        grammar mask applies BEFORE sampling and ``gstate`` advances
+        with the token commit; with ``logprobs`` on, each step also
+        emits the post-mask top-n rows."""
 
         def body(carry, _):
             state, cache = carry
@@ -690,14 +806,19 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 return jnp.where(m, n, o)
 
             cache = jax.tree.map(sel, nc, cache)
-            toks = _slot_sample(logits[:, 0], state.keys, state.temps,
+            lg = _gmask(gp, state.gidx, state.gstate, logits[:, 0])
+            toks = _slot_sample(lg, state.keys, state.temps,
                                 state.counts)
             toks = jnp.where(state.active, toks,
                              state.last_tok).astype(jnp.int32)
             inc = state.active.astype(jnp.int32)
             state = state._replace(last_tok=toks, counts=state.counts + inc,
-                                   pos=state.pos + inc)
-            return (state, cache), toks
+                                   pos=state.pos + inc,
+                                   gstate=_gadvance(gp, state.gidx,
+                                                    state.gstate, toks,
+                                                    state.active))
+            ys = toks if n_lp == 0 else (toks,) + _top_lp(lg)
+            return (state, cache), ys
 
         return lax.scan(body, (state, cache), None, length=k)
 
@@ -840,7 +961,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 body, (state.last_tok, dview), jnp.arange(k + 1))
             return dview, drafts[:k], dlogits[:k]
 
-        def _accept(state, logits, drafts, dlogits, spec_on, rem):
+        def _accept(state, logits, drafts, dlogits, spec_on, rem, gp):
             """Leading-prefix acceptance over the verify window, the
             correction/bonus token, and the per-lane budget clamp.
 
@@ -860,10 +981,44 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             non-speculative engine's.  Returns ``(x, a, a_raw, inc,
             out)`` — ``a_raw`` is the UNCLAMPED accept count (the
             draft-quality measure acceptance-rate telemetry wants;
-            ``a``/``inc`` are the budget-clamped emission)."""
+            ``a``/``inc`` are the budget-clamped emission).
+
+            ``gp`` (the grammar pool): the verify rows are masked along
+            each lane's automaton TRAJECTORY over its drafts (row ``i``
+            masked at the state after consuming ``d_1..d_i``), so a
+            grammar-forbidden draft token is just a rejection — its
+            masked target probability is zero — and the correction/
+            bonus draws come from the constrained distribution.  The
+            rejection is additionally FORCED (``acc &= tok_ok``):
+            ``u == 0.0`` is a real value of ``jax.random.uniform`` and
+            ``0 * p_d <= 0`` would otherwise accept.  Row 0 is masked
+            at the lane's CURRENT state, so spec-off lanes (whose
+            trajectory over garbage drafts is meaningless past row 0)
+            still sample their one token correctly.  Returns two extra
+            values: ``gnew`` (post-commit automaton states) and ``lp``
+            (post-mask top-n rows, or None with logprobs off)."""
             k = drafts.shape[0]
             d = jnp.swapaxes(drafts, 0, 1)                  # [S, k]
             ld = jnp.swapaxes(dlogits, 0, 1)                # [S, k, V]
+            if gp is not None:
+                gallow, gnext = gp
+
+                def gstep(st, dt):
+                    arow = gallow[state.gidx, st]           # [S, V]
+                    ok = jnp.take_along_axis(
+                        arow, dt[:, None], 1)[:, 0]
+                    return (jnp.where(ok, gnext[state.gidx, st, dt], st),
+                            (st, ok))
+
+                st_end, (traj_pre, tok_ok) = lax.scan(
+                    gstep, state.gstate, jnp.swapaxes(d, 0, 1))
+                traj = jnp.concatenate(
+                    [jnp.swapaxes(traj_pre, 0, 1), st_end[:, None]], 1)
+                logits = jnp.where(gallow[state.gidx[:, None], traj],
+                                   logits, jnp.finfo(logits.dtype).min)
+                tok_ok = jnp.swapaxes(tok_ok, 0, 1)         # [S, k]
+            else:
+                tok_ok = jnp.ones((num_slots, k), bool)
             lt = logits[:, :k]                              # [S, k, V]
             temp = jnp.maximum(state.temps, 1e-6)[:, None, None]
             greedy = state.temps <= 0.0
@@ -882,6 +1037,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 lambda c: u_one(key, c))(cs))(state.keys, cidx)
             s_acc = u * pd_d <= pt_d
             acc = jnp.where(greedy[:, None], g_acc, s_acc)
+            acc &= tok_ok
             acc &= (spec_on & state.active)[:, None]
             a_raw = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(1)
             # budget clamp: emitted = a + 1 <= rem.  A clamped lane's
@@ -929,16 +1085,33 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             out = jnp.where(i_ < a[:, None], dpad,
                             jnp.where(i_ == a[:, None], x[:, None], 0))
             out = jnp.where(state.active[:, None], out, 0)
-            return x, a, a_raw, inc, out
+            if gp is not None:
+                # automaton advance over the EMITTED tokens (out[:, :inc])
+                # — accepted drafts all passed tok_ok and corrections come
+                # from the masked rows, so every consumed transition is a
+                # real one (and the tables self-loop defensively anyway)
+                def cstep(st, xs):
+                    col, j = xs
+                    nst = gnext[state.gidx, st, col]
+                    return jnp.where(j < inc, nst, st), None
 
-        def _spec_state(state, x, a_raw, inc, spec_on, k):
+                gnew, _ = lax.scan(
+                    cstep, state.gstate,
+                    (jnp.swapaxes(out, 0, 1), jnp.arange(k + 1)))
+            else:
+                gnew = state.gstate
+            lp = None if n_lp == 0 else _top_lp(logits)
+            return x, a, a_raw, inc, out, gnew, lp
+
+        def _spec_state(state, x, a_raw, inc, spec_on, k, gnew):
             return state._replace(
                 last_tok=jnp.where(state.active, x, state.last_tok),
                 counts=state.counts + inc,
                 pos=state.pos + inc,
                 accepted=state.accepted + a_raw,
                 drafted=state.drafted + jnp.where(
-                    state.active & spec_on, k, 0))
+                    state.active & spec_on, k, 0),
+                gstate=gnew)
 
         def _build_spec(pg_target):
             if pg_target is None:
@@ -1054,37 +1227,30 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                         return _dconstrain(dcache), drafts, dlogits
 
                 def _spec_verify_impl(state, cache, dcache, drafts, dlogits,
-                                      spec_on, rem, ads):
+                                      spec_on, rem, ads, gp):
                     pos0 = _cache_cursor(cache)
                     toks = jnp.concatenate(
                         [state.last_tok[None], drafts], 0).T
                     ncache, logits = vwindow(cache, toks, ads)
-                    x, a, a_raw, inc, out = _accept(state, logits, drafts,
-                                                    dlogits, spec_on, rem)
+                    x, a, a_raw, inc, out, gnew, lp = _accept(
+                        state, logits, drafts, dlogits, spec_on, rem, gp)
                     cache = _sel_active(state.active, ncache, cache)
                     cache = _set_cursors(cache, pos0 + inc)
                     dcache = _set_cursors(dcache, pos0 + inc)
                     state = _spec_state(state, x, a_raw, inc, spec_on,
-                                        drafts.shape[0])
+                                        drafts.shape[0], gnew)
                     packed = jnp.concatenate(
                         [inc[:, None], a_raw[:, None], out], 1)
-                    return (_constrain_state(state), _constrain(cache),
+                    base = (_constrain_state(state), _constrain(cache),
                             _dconstrain(dcache), packed)
+                    return base if lp is None else base + lp
 
-                if use_lora:
-                    @partial(jax.jit, donate_argnums=(0, 1, 2))
-                    def spec_verify(state, cache, dcache, drafts, dlogits,
-                                    spec_on, rem, apool):
-                        return _spec_verify_impl(
-                            state, cache, dcache, drafts, dlogits, spec_on,
-                            rem, _gather_ads(apool, state.adapter_id))
-                else:
-                    @partial(jax.jit, donate_argnums=(0, 1, 2))
-                    def spec_verify(state, cache, dcache, drafts, dlogits,
-                                    spec_on, rem):
-                        return _spec_verify_impl(state, cache, dcache,
-                                                 drafts, dlogits, spec_on,
-                                                 rem, None)
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def spec_verify(state, cache, dcache, drafts, dlogits,
+                                spec_on, rem, *tail):
+                    ads, gp = _slot_tail(tail, state.adapter_id)
+                    return _spec_verify_impl(state, cache, dcache, drafts,
+                                             dlogits, spec_on, rem, ads, gp)
 
                 return dict(init_draft=init_draft,
                             draft_prefill=draft_prefill,
@@ -1213,7 +1379,7 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     return _dconstrain(dkv), drafts, dlogits
 
             def _spec_verify_impl(state, pkv, dkv, drafts, dlogits,
-                                  spec_on, rem, ads):
+                                  spec_on, rem, ads, gp):
                 k = drafts.shape[0]
                 pos0 = _cache_cursor(pkv.meta)
                 toks = jnp.concatenate([state.last_tok[None], drafts], 0).T
@@ -1228,8 +1394,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 else:
                     nview, logits = vwindow(pg_target.slot_cache(pkv), toks,
                                             ads)
-                x, a, a_raw, inc, out = _accept(state, logits, drafts,
-                                                dlogits, spec_on, rem)
+                x, a, a_raw, inc, out, gnew, lp = _accept(
+                    state, logits, drafts, dlogits, spec_on, rem, gp)
                 if attn_kernel == "paged":
                     pkv = pg_target.commit_window(pkv, nview, pos0, k + 1,
                                                   state.active)
@@ -1241,25 +1407,19 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     lambda full: new_cur.astype(full.dtype), pkv.meta))
                 dkv = dkv._replace(meta=jax.tree.map(
                     lambda full: new_cur.astype(full.dtype), dkv.meta))
-                state = _spec_state(state, x, a_raw, inc, spec_on, k)
+                state = _spec_state(state, x, a_raw, inc, spec_on, k, gnew)
                 packed = jnp.concatenate(
                     [inc[:, None], a_raw[:, None], out], 1)
-                return (_constrain_state(state), _constrain(pkv),
+                base = (_constrain_state(state), _constrain(pkv),
                         _dconstrain(dkv), packed)
+                return base if lp is None else base + lp
 
-            if use_lora:
-                @partial(jax.jit, donate_argnums=(0, 1, 2))
-                def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on,
-                                rem, apool):
-                    return _spec_verify_impl(
-                        state, pkv, dkv, drafts, dlogits, spec_on, rem,
-                        _gather_ads(apool, state.adapter_id))
-            else:
-                @partial(jax.jit, donate_argnums=(0, 1, 2))
-                def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on,
-                                rem):
-                    return _spec_verify_impl(state, pkv, dkv, drafts,
-                                             dlogits, spec_on, rem, None)
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on,
+                            rem, *tail):
+                ads, gp = _slot_tail(tail, state.adapter_id)
+                return _spec_verify_impl(state, pkv, dkv, drafts, dlogits,
+                                         spec_on, rem, ads, gp)
 
             return dict(init_draft=pg_d.init, draft_prefill=draft_prefill,
                         draft_extend=draft_extend, draft_evict=draft_evict,
@@ -1314,7 +1474,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 return mut["cache"], logits.astype(jnp.float32)
 
         def _insert_paged_impl(state, pkv, tables, poss, prompts, clens,
-                               dsts, seeds, temps, last, aids, ads):
+                               dsts, seeds, temps, last, aids, ads,
+                               gids, gp):
             # Each lane teacher-forces its first NON-SHARED chunk on top
             # of a dense view gathered through its (host-built) table
             # row: a reused prefix's K/V is already in the pool, so the
@@ -1328,11 +1489,11 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             lanes, last_logits = jax.vmap(lane)(tables, poss, prompts,
                                                 clens, ads)
             keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
-            firsts = _slot_sample(last_logits, keys, temps,
-                                  jnp.zeros(num_slots, jnp.int32))
+            zero = jnp.zeros(num_slots, jnp.int32)
+            firsts = _slot_sample(_gmask(gp, gids, zero, last_logits),
+                                  keys, temps, zero)
             pkv = _constrain(pg.commit_lanes(pkv, lanes, tables, dsts, poss,
                                              prefill_pad))
-            zero = jnp.zeros(num_slots, jnp.int32)
             state = SlotState(
                 last_tok=state.last_tok.at[dsts].set(
                     jnp.where(last, firsts, 0)),
@@ -1343,27 +1504,22 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 keys=state.keys.at[dsts].set(keys),
                 accepted=state.accepted.at[dsts].set(zero),
                 drafted=state.drafted.at[dsts].set(zero),
-                adapter_id=state.adapter_id.at[dsts].set(aids))
+                adapter_id=state.adapter_id.at[dsts].set(aids),
+                gidx=state.gidx.at[dsts].set(gids),
+                gstate=state.gstate.at[dsts].set(
+                    _gadvance(gp, gids, zero, firsts, last)))
             return _constrain_state(state), pkv, firsts
 
-        if use_lora:
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
-                                   dsts, seeds, temps, last, aids, apool):
-                return _insert_paged_impl(
-                    state, pkv, tables, poss, prompts, clens, dsts, seeds,
-                    temps, last, aids, _gather_ads(apool, aids))
-        else:
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
-                                   dsts, seeds, temps, last):
-                aids = jnp.full(num_slots, _aid_empty, jnp.int32)
-                return _insert_paged_impl(
-                    state, pkv, tables, poss, prompts, clens, dsts, seeds,
-                    temps, last, aids, None)
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
+                               dsts, seeds, temps, last, *tail):
+            aids, ads, gids, gp = _insert_tail(tail)
+            return _insert_paged_impl(
+                state, pkv, tables, poss, prompts, clens, dsts, seeds,
+                temps, last, aids, ads, gids, gp)
 
         def _prefill_extend_paged_impl(state, pkv, slot, chunk, clen,
-                                       is_last, ad):
+                                       is_last, ad, gp):
             row = pkv.table[slot]
             meta1 = jax.tree.map(lambda full: full[slot], pkv.meta)
             pos0 = _cache_cursor(meta1)
@@ -1373,33 +1529,32 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 pkv, jax.tree.map(lambda a: a[None], cache),
                 row[None], jnp.reshape(slot, (1,)), jnp.reshape(pos0, (1,)),
                 prefill_pad))
+            gi = state.gidx[slot][None]
+            gs = state.gstate[slot][None]
             first = _slot_sample(
-                last_logits[None], state.keys[slot][None],
+                _gmask(gp, gi, gs, last_logits[None]),
+                state.keys[slot][None],
                 state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
             state = state._replace(
                 pos=state.pos.at[slot].add(clen),
                 active=state.active.at[slot].set(is_last),
                 last_tok=state.last_tok.at[slot].set(
                     jnp.where(is_last, first, 0)),
-                counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
+                counts=state.counts.at[slot].set(is_last.astype(jnp.int32)),
+                gstate=state.gstate.at[slot].set(_gadvance(
+                    gp, gi, gs, first[None],
+                    jnp.reshape(is_last, (1,)))[0]))
             return _constrain_state(state), pkv, first
 
-        if use_lora:
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def prefill_extend_paged(state, pkv, slot, chunk, clen,
-                                     is_last, apool):
-                return _prefill_extend_paged_impl(
-                    state, pkv, slot, chunk, clen, is_last,
-                    _gather_ads(apool, state.adapter_id[slot]))
-        else:
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def prefill_extend_paged(state, pkv, slot, chunk, clen,
-                                     is_last):
-                return _prefill_extend_paged_impl(
-                    state, pkv, slot, chunk, clen, is_last, None)
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def prefill_extend_paged(state, pkv, slot, chunk, clen,
+                                 is_last, *tail):
+            ad, gp = _slot_tail(tail, state.adapter_id[slot])
+            return _prefill_extend_paged_impl(
+                state, pkv, slot, chunk, clen, is_last, ad, gp)
 
         if use_kernel:
-            def _decode_kernel_impl(state, pkv, k, ads):
+            def _decode_kernel_impl(state, pkv, k, ads, gp):
                 # The kernel arm: NO dense gather.  The pool is read in
                 # place by the kernel (live blocks only — loop-invariant,
                 # so it stays out of the scan carry); the scan carries
@@ -1420,49 +1575,49 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                         variables,
                         state.last_tok[:, None], mutable=["cache"])
                     view = _sel_active(state.active, mut["cache"], view)
-                    toks = _slot_sample(
-                        logits[:, -1].astype(jnp.float32), state.keys,
-                        state.temps, state.counts)
+                    lg = _gmask(gp, state.gidx, state.gstate,
+                                logits[:, -1].astype(jnp.float32))
+                    toks = _slot_sample(lg, state.keys,
+                                        state.temps, state.counts)
                     toks = jnp.where(state.active, toks,
                                      state.last_tok).astype(jnp.int32)
                     inc = state.active.astype(jnp.int32)
                     state = state._replace(
                         last_tok=toks, counts=state.counts + inc,
-                        pos=state.pos + inc)
-                    return (state, view), toks
+                        pos=state.pos + inc,
+                        gstate=_gadvance(gp, state.gidx, state.gstate,
+                                         toks, state.active))
+                    ys = toks if n_lp == 0 else (toks,) + _top_lp(lg)
+                    return (state, view), ys
 
-                (state, view), toks = lax.scan(body, (state, view), None,
-                                               length=k)
+                (state, view), ys = lax.scan(body, (state, view), None,
+                                             length=k)
                 pkv = _constrain(pg.commit_window(pkv, view, pos0, k, mask))
-                return _constrain_state(state), pkv, toks
+                if n_lp:
+                    toks, li, lv = ys
+                    return _constrain_state(state), pkv, toks, li, lv
+                return _constrain_state(state), pkv, ys
 
-            if use_lora:
-                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-                def decode_block_paged(state, pkv, k, apool):
-                    return _decode_kernel_impl(
-                        state, pkv, k, _gather_ads(apool, state.adapter_id))
-            else:
-                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-                def decode_block_paged(state, pkv, k):
-                    return _decode_kernel_impl(state, pkv, k, None)
+            @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+            def decode_block_paged(state, pkv, k, *tail):
+                ads, gp = _slot_tail(tail, state.adapter_id)
+                return _decode_kernel_impl(state, pkv, k, ads, gp)
         else:
-            def _decode_paged_impl(state, pkv, k, ads):
+            def _decode_paged_impl(state, pkv, k, ads, gp):
                 pos0 = _cache_cursor(pkv.meta)
                 mask = state.active
-                (state, cache), toks = _decode_scan(
-                    state, pg.slot_cache(pkv), k, ads)
+                (state, cache), ys = _decode_scan(
+                    state, pg.slot_cache(pkv), k, ads, gp)
                 pkv = _constrain(pg.commit_slots(pkv, cache, pos0, k, mask))
-                return _constrain_state(state), pkv, toks
+                if n_lp:
+                    toks, li, lv = ys
+                    return _constrain_state(state), pkv, toks, li, lv
+                return _constrain_state(state), pkv, ys
 
-            if use_lora:
-                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-                def decode_block_paged(state, pkv, k, apool):
-                    return _decode_paged_impl(
-                        state, pkv, k, _gather_ads(apool, state.adapter_id))
-            else:
-                @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-                def decode_block_paged(state, pkv, k):
-                    return _decode_paged_impl(state, pkv, k, None)
+            @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+            def decode_block_paged(state, pkv, k, *tail):
+                ads, gp = _slot_tail(tail, state.adapter_id)
+                return _decode_paged_impl(state, pkv, k, ads, gp)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def evict_paged(state, pkv, slot, free_ids):
@@ -1478,7 +1633,10 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 accepted=state.accepted.at[slot].set(zero),
                 drafted=state.drafted.at[slot].set(zero),
                 adapter_id=state.adapter_id.at[slot].set(
-                    jnp.asarray(_aid_empty, jnp.int32)))
+                    jnp.asarray(_aid_empty, jnp.int32)),
+                gidx=state.gidx.at[slot].set(
+                    jnp.asarray(_gid_empty, jnp.int32)),
+                gstate=state.gstate.at[slot].set(zero))
             return _constrain_state(state), pkv
 
         def _peek_paged_impl(state, pkv, ads):
@@ -1526,20 +1684,20 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
     # max_len] K/V arena into fresh buffers — doubling peak cache memory
     # and paying a full-arena memcpy per decode block.
     def _insert_impl(state, cache, prompts, clens, dsts, seeds, temps,
-                     last, aids, ads):
+                     last, aids, ads, gids, gp):
         lanes, last_logits = jax.vmap(
             lambda p, n, a: _force_chunk(init_cache(1), p, n, a)
         )(prompts, clens, ads)
         keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
-        firsts = _slot_sample(last_logits, keys, temps,
-                              jnp.zeros(num_slots, jnp.int32))
+        zero = jnp.zeros(num_slots, jnp.int32)
+        firsts = _slot_sample(_gmask(gp, gids, zero, last_logits),
+                              keys, temps, zero)
         # Scatter lane j into slot dsts[j].  Unused lanes carry the
         # sentinel dst num_slots: out-of-bounds scatter indices are
         # DROPPED (jax's default scatter mode), so one fixed-shape
         # program serves every admission-batch size.
         cache = _constrain(jax.tree.map(
             lambda full, b: full.at[dsts].set(b), cache, lanes))
-        zero = jnp.zeros(num_slots, jnp.int32)
         state = SlotState(
             last_tok=state.last_tok.at[dsts].set(jnp.where(last, firsts, 0)),
             active=state.active.at[dsts].set(last),
@@ -1549,24 +1707,21 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             keys=state.keys.at[dsts].set(keys),
             accepted=state.accepted.at[dsts].set(zero),
             drafted=state.drafted.at[dsts].set(zero),
-            adapter_id=state.adapter_id.at[dsts].set(aids))
+            adapter_id=state.adapter_id.at[dsts].set(aids),
+            gidx=state.gidx.at[dsts].set(gids),
+            gstate=state.gstate.at[dsts].set(
+                _gadvance(gp, gids, zero, firsts, last)))
         return _constrain_state(state), cache, firsts
 
-    if use_lora:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def insert_batch(state, cache, prompts, clens, dsts, seeds, temps,
-                         last, aids, apool):
-            return _insert_impl(state, cache, prompts, clens, dsts, seeds,
-                                temps, last, aids, _gather_ads(apool, aids))
-    else:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def insert_batch(state, cache, prompts, clens, dsts, seeds, temps,
-                         last):
-            aids = jnp.full(num_slots, _aid_empty, jnp.int32)
-            return _insert_impl(state, cache, prompts, clens, dsts, seeds,
-                                temps, last, aids, None)
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def insert_batch(state, cache, prompts, clens, dsts, seeds, temps,
+                     last, *tail):
+        aids, ads, gids, gp = _insert_tail(tail)
+        return _insert_impl(state, cache, prompts, clens, dsts, seeds,
+                            temps, last, aids, ads, gids, gp)
 
-    def _prefill_extend_impl(state, cache, slot, chunk, clen, is_last, ad):
+    def _prefill_extend_impl(state, cache, slot, chunk, clen, is_last, ad,
+                             gp):
         lane = jax.tree.map(
             lambda full: lax.dynamic_index_in_dim(
                 full, slot, 0, keepdims=False), cache)
@@ -1574,39 +1729,36 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         cache = _constrain(jax.tree.map(
             lambda full, l: lax.dynamic_update_index_in_dim(full, l, slot, 0),
             cache, lane))
+        gi = state.gidx[slot][None]
+        gs = state.gstate[slot][None]
         first = _slot_sample(
-            last_logits[None], state.keys[slot][None],
+            _gmask(gp, gi, gs, last_logits[None]),
+            state.keys[slot][None],
             state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
         state = state._replace(
             pos=state.pos.at[slot].add(clen),
             active=state.active.at[slot].set(is_last),
             last_tok=state.last_tok.at[slot].set(
                 jnp.where(is_last, first, 0)),
-            counts=state.counts.at[slot].set(is_last.astype(jnp.int32)))
+            counts=state.counts.at[slot].set(is_last.astype(jnp.int32)),
+            gstate=state.gstate.at[slot].set(_gadvance(
+                gp, gi, gs, first[None], jnp.reshape(is_last, (1,)))[0]))
         return _constrain_state(state), cache, first
 
-    if use_lora:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def prefill_extend(state, cache, slot, chunk, clen, is_last, apool):
-            return _prefill_extend_impl(
-                state, cache, slot, chunk, clen, is_last,
-                _gather_ads(apool, state.adapter_id[slot]))
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def prefill_extend(state, cache, slot, chunk, clen, is_last, *tail):
+        ad, gp = _slot_tail(tail, state.adapter_id[slot])
+        return _prefill_extend_impl(state, cache, slot, chunk, clen,
+                                    is_last, ad, gp)
 
-        @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-        def decode_block(state, cache, k, apool):
-            (state, cache), toks = _decode_scan(
-                state, cache, k, _gather_ads(apool, state.adapter_id))
-            return _constrain_state(state), _constrain(cache), toks
-    else:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def prefill_extend(state, cache, slot, chunk, clen, is_last):
-            return _prefill_extend_impl(state, cache, slot, chunk, clen,
-                                        is_last, None)
-
-        @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
-        def decode_block(state, cache, k):
-            (state, cache), toks = _decode_scan(state, cache, k, None)
-            return _constrain_state(state), _constrain(cache), toks
+    @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
+    def decode_block(state, cache, k, *tail):
+        ads, gp = _slot_tail(tail, state.adapter_id)
+        (state, cache), ys = _decode_scan(state, cache, k, ads, gp)
+        if n_lp:
+            toks, li, lv = ys
+            return _constrain_state(state), _constrain(cache), toks, li, lv
+        return _constrain_state(state), _constrain(cache), ys
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def evict(state, cache, slot):
@@ -1625,7 +1777,10 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             accepted=state.accepted.at[slot].set(zero),
             drafted=state.drafted.at[slot].set(zero),
             adapter_id=state.adapter_id.at[slot].set(
-                jnp.asarray(_aid_empty, jnp.int32)))
+                jnp.asarray(_aid_empty, jnp.int32)),
+            gidx=state.gidx.at[slot].set(
+                jnp.asarray(_gid_empty, jnp.int32)),
+            gstate=state.gstate.at[slot].set(zero))
         return _constrain_state(state), cache
 
     def _peek_impl(state, cache, ads):

@@ -90,7 +90,8 @@ from typing import Dict, List, Optional, Tuple
 
 from tpudist.serve.engine import SlotEngine
 from tpudist.serve.scheduler import AdmissionError, RequestHandle, Scheduler
-from tpudist.serve.server import ReplicaKilled, _Observability
+from tpudist.serve.server import (ReplicaKilled, _Observability,
+                                  _compile_grammar_for)
 
 _IDLE_WAIT_S = 0.01
 
@@ -107,7 +108,15 @@ _IDLE_WAIT_S = 0.01
 #: ninth SlotState leaf (``adapter_id``) in the blob: pool block ids
 #: are local, so the importing pool re-binds by NAME — v2/v3 packages
 #: still deserialize (adapter reads back ``None``, the base-only path).
-HANDOFF_SCHEMA_VERSION = 4
+#: v5 added the structured-output ``grammar`` envelope field
+#: (tpudist.constrain — the grammar travels by SOURCE so the importing
+#: pool recompiles and re-binds in its own table pool) and the tenth and
+#: eleventh SlotState leaves (``gidx``/``gstate``) in the blob; the
+#: automaton STATE carries byte-faithfully while the pool-local block id
+#: is overwritten at install.  v2..v4 packages still deserialize
+#: (grammar reads back ``None`` — the importing engine installs the lane
+#: unconstrained with a sentinel gidx and zero gstate).
+HANDOFF_SCHEMA_VERSION = 5
 
 #: Oldest wire format :func:`deserialize_package` accepts.
 HANDOFF_SCHEMA_MIN = 2
@@ -172,6 +181,7 @@ def serialize_package(pkg: dict) -> dict:
            "counts": pkg["counts"], "budget": pkg["budget"],
            "trace_id": pkg.get("trace_id"),
            "adapter": pkg.get("adapter"),
+           "grammar": pkg.get("grammar"),
            "blob": blob, "tree": tree,
            "digest": _blob_digest(blob),
            "bytes": sum(len(b) for b, _, _ in blob)}
@@ -223,6 +233,7 @@ def deserialize_package(ser: dict) -> dict:
             "counts": ser["counts"], "budget": ser["budget"],
             "trace_id": ser.get("trace_id"),  # None on a v2 package
             "adapter": ser.get("adapter"),  # None on a v2/v3 package
+            "grammar": ser.get("grammar"),  # None on a v2..v4 package
             "lane": lane, "state": state}
 
 
@@ -240,6 +251,19 @@ class DisaggServer(_Observability):
 
         self.config = config or ServeConfig.from_env()
         cfg = self.config
+        # structured output spans BOTH pools: the prefill engine masks
+        # the first sampled token (insert/prefill_extend carry the
+        # grammar tail), the decode pool recompiles and re-binds the
+        # grammar by source at import (v5 envelope field)
+        ccfg = None
+        if cfg.constrain:
+            from tpudist.constrain import ConstrainConfig, default_vocab
+
+            ccfg = ConstrainConfig(
+                vocab=default_vocab(int(module.vocab)),
+                num_blocks=cfg.constrain_blocks,
+                max_states=cfg.constrain_states)
+        self.constrain_cfg = ccfg
         shared = dict(
             prefill_pad=cfg.prefill_pad, paged=cfg.paged,
             kv_block=cfg.kv_block, kv_blocks=cfg.kv_blocks,
@@ -249,7 +273,8 @@ class DisaggServer(_Observability):
             # depends on it) and the decode pool re-binds by name on
             # import; load_adapter broadcasts to all of them
             adapters=cfg.adapters, adapter_blocks=cfg.adapter_blocks,
-            adapter_rank=cfg.adapter_rank)
+            adapter_rank=cfg.adapter_rank,
+            constrain=ccfg, logprobs=cfg.logprobs)
         p_slots = cfg.prefill_slots or cfg.num_slots
         # prefill workers keep the prefix cache (reuse saves prefill
         # compute — that is this pool's whole job); decode workers get
@@ -301,7 +326,11 @@ class DisaggServer(_Observability):
             default_max_new=cfg.max_new, default_deadline_s=cfg.deadline_s,
             prefix_hasher=hasher,
             check_adapter=lambda name: (
-                None if pe.has_adapter(name) else "adapter_missing"))
+                None if pe.has_adapter(name) else "adapter_missing"),
+            compile_grammar_fn=(None if ccfg is None else (
+                lambda regex, schema, eos: _compile_grammar_for(
+                    ccfg, regex, schema, eos))),
+            max_logprobs=de.n_lp)
         self._install_signal = install_signal_handler
         self._installed_preemption = False
         self._thread: Optional[threading.Thread] = None
@@ -371,6 +400,13 @@ class DisaggServer(_Observability):
             handoff=self.handoff_mode,
             mesh=self.decode_pool[0].spmd_stats().get("mesh"))
         self._stamp_adapter_config()
+        de0 = self.decode_pool[0]
+        if de0.has_constrain() or de0.n_lp:
+            cs = de0.constrain_stats()
+            telemetry.event(
+                "serve_constrain_config", enabled=cs["enabled"],
+                blocks=cs.get("blocks"), max_states=cs.get("max_states"),
+                pool_bytes=cs.get("pool_bytes"), logprobs=de0.n_lp)
         if self._capture is None:
             # TPUDIST_DISTILL_CAPTURE arms the live-traffic tap at the
             # same entry the faults grammar arms at — no code changes
@@ -391,7 +427,9 @@ class DisaggServer(_Observability):
                on_token=None, spec: Optional[bool] = None,
                tenant: Optional[str] = None, priority: int = 0,
                session: Optional[str] = None,
-               adapter: Optional[str] = None) -> RequestHandle:
+               adapter: Optional[str] = None,
+               grammar: Optional[str] = None, json_schema=None,
+               stop=None, logprobs: int = 0) -> RequestHandle:
         from tpudist import telemetry
 
         # +1 BEFORE the handle is visible to the engine thread (see
@@ -404,7 +442,9 @@ class DisaggServer(_Observability):
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
                 on_token=on_token, spec=spec, tenant=tenant,
-                priority=priority, session=session, adapter=adapter)
+                priority=priority, session=session, adapter=adapter,
+                grammar=grammar, json_schema=json_schema, stop=stop,
+                logprobs=logprobs)
         except BaseException as e:
             self._track_tenant(tkey, -1)  # never admitted (ANY failure)
             if isinstance(e, AdmissionError):
@@ -576,6 +616,13 @@ class DisaggServer(_Observability):
             "tenants_in_flight": dict(self._tenant_inflight),
             **({"adapters": self.decode_pool[0].adapter_stats()}
                if self.decode_pool[0].adapters is not None else {}),
+            # structured-output grammar pool + logprobs width (absent
+            # when both are off)
+            **({"constrained": {
+                **self.decode_pool[0].constrain_stats(),
+                "logprobs": self.decode_pool[0].n_lp}}
+               if self.decode_pool[0].has_constrain()
+               or self.decode_pool[0].n_lp else {}),
             # pool-aggregated speculation + distillation flywheel
             # (absent when off) — the swap gate's numbers, per operator
             **({"spec": self._spec_status(self._agg_spec_stats())}
@@ -1112,10 +1159,11 @@ class DisaggServer(_Observability):
                 items.append((slot, h.request.prompt, h.request.temperature,
                               h.request.seed, h.request.max_new,
                               h.request.prefix_hashes, None,
-                              h.request.adapter))
+                              h.request.adapter, h.request.grammar))
                 self._slot_handles[("prefill", w, slot)] = h
             if not items:
                 continue
+            from tpudist.constrain.registry import GrammarPoolFull
             from tpudist.serve.adapters import AdapterMissingError
 
             firsts = {}
@@ -1126,6 +1174,23 @@ class DisaggServer(_Observability):
                                         pool="prefill", worker=w):
                         firsts = eng.start_batch(items)
                     break
+                except GrammarPoolFull:
+                    # every grammar block on this prefill worker is
+                    # pinned (start_batch rolled the dispatch back):
+                    # defer the CONSTRAINED items through the requeue
+                    # line, admit the free ones.  NOT a worker death.
+                    keep = []
+                    for it in items:
+                        if it[8] is not None:
+                            h2 = self._slot_handles.pop(
+                                ("prefill", w, it[0]))
+                            h2.slot = None
+                            self._requeue.append(h2)
+                        else:
+                            keep.append(it)
+                    telemetry.event("constrain_deferred",
+                                    n=len(items) - len(keep))
+                    items = keep
                 except AdapterMissingError as e:
                     # a user thread unloaded the adapter between the
                     # recheck and the dispatch (whole-batch validation —
@@ -1178,6 +1243,11 @@ class DisaggServer(_Observability):
             # the parked KV was written THROUGH its turn's adapter; a
             # turn binding a different adapter (or none) re-prefills
             # fresh — resuming would continue the wrong fine-tune's cache
+            return False
+        if raw.get("grammar") is not None or req.grammar is not None:
+            # a parked lane's automaton state belongs to ITS turn; the
+            # next turn starts at state 0 (or unconstrained) — fresh
+            # prefill instead (degraded, never wrong bytes)
             return False
         t0 = time.monotonic()
         from tpudist.serve.adapters import AdapterMissingError
@@ -1262,13 +1332,38 @@ class DisaggServer(_Observability):
                 # else the lane would never have been requeued)
                 tok = None
         if tok is not None:
+            tg = h.request.grammar
+            if tg is not None and not tg.token_allowed(h.gstate, tok):
+                # the device mask makes this unreachable unless the pool
+                # tables and the host shadow diverge — truncate BEFORE
+                # the violating token delivers
+                del self._slot_handles[key]
+                eng.evict(slot)
+                h._finish("grammar_violation")
+                self._note_finished(h)
+                return
+            if tg is not None:
+                h.gstate = tg.advance(h.gstate, tok)
             h._deliver(tok)
+            if h.request.logprobs > 0:
+                # token 0 is prefill-sampled: no logprobs row rides it
+                h.logprobs.append(None)
             self.tokens_out += 1
-            if (eos is not None and tok == eos) \
-                    or len(h.tokens) >= h.request.max_new:
+            reason = None
+            if eos is not None and tok == eos:
+                reason = "eos"
+            elif h.request.stop and any(
+                    len(h.tokens) >= len(s)
+                    and tuple(h.tokens[-len(s):]) == s
+                    for s in h.request.stop):
+                reason = "stop_sequence"
+            elif len(h.tokens) >= h.request.max_new:
+                reason = "session_resumed" if h.resumed else "length"
+            if reason is not None:
                 del self._slot_handles[key]
                 if (self._tier is not None
                         and h.request.session is not None
+                        and reason != "stop_sequence"
                         and eng.exportable(slot, len(h.tokens))):
                     # a max_new==1 turn finishes in-prefill: its lane
                     # still parks for the session's next turn
@@ -1276,16 +1371,12 @@ class DisaggServer(_Observability):
                         self._tick("prefill", w)
                         self._park_session_lane(eng, slot, h)
                     except Exception as e:
-                        h._finish("eos" if eos is not None and tok == eos
-                                  else "session_resumed" if h.resumed
-                                  else "length")
+                        h._finish(reason)
                         self._note_finished(h)
                         self._lose_worker("prefill", w, e)
                         return
                 eng.evict(slot)
-                h._finish("eos" if eos is not None and tok == eos
-                          else "session_resumed" if h.resumed
-                          else "length")
+                h._finish(reason)
                 self._note_finished(h)
                 return
         if not self._alive("decode"):
@@ -1389,9 +1480,21 @@ class DisaggServer(_Observability):
                 t0 = time.monotonic()
                 from tpudist.serve.adapters import AdapterMissingError
 
+                from tpudist.constrain.registry import GrammarPoolFull
+
                 try:
                     self._tick("decode", w)
                     eng.import_slot(slot, raw, spec=h.request.spec)
+                except GrammarPoolFull:
+                    # every grammar block on this decode worker is
+                    # pinned: the package is intact — back to the queue
+                    # head, stalled head-of-line (like a full pool) and
+                    # retried next iteration as lanes finish.  NOT a
+                    # worker death, and NOT placed (placed=True would
+                    # spin this same head forever within one call).
+                    self._handoff.appendleft((h, pkg))
+                    placed = False
+                    break
                 except AdapterMissingError:
                     # the decode pool cannot re-bind the package's
                     # adapter name (unloaded while the lane crossed the
@@ -1500,11 +1603,12 @@ class DisaggServer(_Observability):
                 else:
                     tele.record_span("decode_block", t0,
                                      time.monotonic() - t0, tags)
+            block_lp = (info or {}).get("logprobs") or {}
             for slot, toks in blocks.items():
-                self._deliver_block(w, slot, toks)
+                self._deliver_block(w, slot, toks, block_lp.get(slot))
         return worked
 
-    def _deliver_block(self, w: int, slot: int, toks) -> None:
+    def _deliver_block(self, w: int, slot: int, toks, lp=None) -> None:
         h = self._slot_handles.get(("decode", w, slot))
         if h is None:
             # the worker died delivering an EARLIER slot of this same
@@ -1513,6 +1617,7 @@ class DisaggServer(_Observability):
             # deliver them here too, the replay-skip count is already set
             return
         eos = h.request.eos_id
+        tg = h.request.grammar
         if self._ctrl is not None:
             # the fairness gate's measurement: DELIVERED tokens/s per
             # tenant — replay/fallback duplicates are dropped below and
@@ -1520,21 +1625,42 @@ class DisaggServer(_Observability):
             delivered = max(0, len(toks) - self._skip.get(h.id, 0))
             if delivered:
                 self._ctrl.note_tokens(h.request.tenant, delivered)
-        for tok in toks:
+        for i, tok in enumerate(toks):
             skip = self._skip.get(h.id, 0)
             if skip > 0:
                 # replay of a recovered lane: this token was already
                 # delivered by the lost worker — the re-emission is a
-                # duplicate (its finish checks ran the first time)
+                # duplicate (its finish checks — and its shadow-automaton
+                # advance — ran the first time)
                 if skip == 1:
                     del self._skip[h.id]
                 else:
                     self._skip[h.id] = skip - 1
                 continue
+            if tg is not None:
+                if not tg.token_allowed(h.gstate, tok):
+                    # defense in depth: unreachable unless the pool
+                    # tables and the host shadow diverge — truncate
+                    # BEFORE the violating token delivers
+                    self._finish_key(("decode", w, slot),
+                                     "grammar_violation")
+                    return
+                h.gstate = tg.advance(h.gstate, tok)
             h._deliver(tok)
+            if h.request.logprobs > 0:
+                n = h.request.logprobs
+                row = lp[i] if lp is not None and i < len(lp) else None
+                h.logprobs.append(None if row is None
+                                  else (row[0][:n], row[1][:n]))
             self.tokens_out += 1
             if eos is not None and tok == eos:
                 self._finish_key(("decode", w, slot), "eos")
+                return
+            if h.request.stop and any(
+                    len(h.tokens) >= len(s)
+                    and tuple(h.tokens[-len(s):]) == s
+                    for s in h.request.stop):
+                self._finish_key(("decode", w, slot), "stop_sequence")
                 return
             if len(h.tokens) >= h.request.max_new:
                 # a resumed turn's budget-completion is countable from
@@ -1605,7 +1731,13 @@ class DisaggServer(_Observability):
             pool="disagg", handoff_wait_s=h.handoff_wait_s,
             trace_id=h.trace_id,
             **({"tenant": h.request.tenant} if h.request.tenant else {}),
-            **({"adapter": h.request.adapter} if h.request.adapter else {}))
+            **({"adapter": h.request.adapter} if h.request.adapter else {}),
+            **({"constrained": h.request.grammar.source["kind"]}
+               if h.request.grammar is not None else {}),
+            **({"stop_seqs": len(h.request.stop)} if h.request.stop
+               else {}),
+            **({"logprobs": h.request.logprobs} if h.request.logprobs
+               else {}))
         # per-request lifeline (req_queue → req_prefill → req_handoff →
         # one req_decode per residency segment): the cross-pool trace
         trace.emit_request_lifeline(h)

@@ -64,10 +64,13 @@ from tpudist.serve.paged_alloc import BlockAllocator
 #: plus an optional 6th element — the prompt's prefix hash chain
 #: (:func:`tpudist.serve.paged_alloc.hash_chain`, stamped at submit by the
 #: scheduler) enabling shared-prefix block reuse on the paged engine —
-#: and an optional 7th — the request's speculative-decoding opt
+#: an optional 7th — the request's speculative-decoding opt
 #: (True/False; only meaningful on a spec engine, where a False lane
 #: rides the same spec programs with acceptance forced to zero and its
-#: tokens drawn on the plain per-request stream).
+#: tokens drawn on the plain per-request stream) — an optional 8th —
+#: the lane's adapter NAME (None = base-only) — and an optional 9th —
+#: the request's compiled :class:`tpudist.constrain.TokenGrammar`
+#: (None = unconstrained; the engine binds it into the grammar pool).
 InsertItem = Tuple[int, np.ndarray, float, int, int]
 
 
@@ -103,7 +106,8 @@ class SlotEngine:
                  spec_draft=None, spec_k: int = 4,
                  attn_kernel: Optional[str] = None,
                  adapters: bool = False, adapter_blocks: int = 8,
-                 adapter_rank: int = 8):
+                 adapter_rank: int = 8,
+                 constrain=None, logprobs: int = 0):
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         # -- decode attention path: "gather" (dense view per dispatch)
@@ -157,6 +161,52 @@ class SlotEngine:
             #: decoding (and releasing) the one it bound
             self.slot_adapter: List[Optional[Tuple[str, int]]] = \
                 [None] * num_slots
+        # -- structured output (tpudist.constrain): a dense grammar
+        # table pool [G+1, S_max, V] next to the adapter pool, per-slot
+        # grammar block ids + automaton states in SlotState, host
+        # registry deciding which compiled grammar occupies which block
+        # (the adapter-pool discipline of PR 15 applied to grammars).
+        # Block G is the sentinel identity row unconstrained lanes
+        # index — every token allowed, next state 0 — so ONE program
+        # serves mixed constrained/unconstrained batches.
+        self.constrain_cfg = constrain
+        self.grammars = None
+        self.gpool = None
+        #: host shadow: slot → bound ``(TokenGrammar, block_id)``
+        #: (None = unconstrained).  The grammar object carries the
+        #: serializable SOURCE export_slot stamps into handoff/park
+        #: packages (block ids are pool-local, like adapter ids) and
+        #: the host shadow automaton the server walks over delivered
+        #: tokens.
+        self.slot_grammar: List[Optional[Tuple[object, int]]] = \
+            [None] * num_slots
+        if constrain is not None:
+            import jax.numpy as _jnp
+
+            from tpudist.constrain import GrammarRegistry
+
+            V = int(module.vocab)
+            if len(constrain.vocab) != V:
+                raise ValueError(
+                    f"constrain vocab has {len(constrain.vocab)} entries, "
+                    f"model vocab is {V}")
+            self.grammars = GrammarRegistry(constrain.num_blocks)
+            G, S = constrain.num_blocks, constrain.max_states
+            # every block starts as the identity (all-True, next 0): a
+            # never-written block decodes unconstrained instead of
+            # sampling an all--inf row, and block G stays the sentinel
+            # forever (binds only ever write blocks < G)
+            self._gallow = _jnp.ones((G + 1, S, V), bool)
+            self._gnext = _jnp.zeros((G + 1, S, V), _jnp.int32)
+            self.gpool = (self._gallow, self._gnext)
+        #: top-n logprobs width the decode/verify programs return per
+        #: emitted token (0 = off).  An engine-wide compile-time width:
+        #: per-request ``logprobs=n`` asks are a host-side slice of
+        #: this n, so request churn never recompiles.
+        self.n_lp = max(0, int(logprobs))
+        if self.n_lp > int(module.vocab):
+            raise ValueError(
+                f"logprobs {self.n_lp} > vocab {int(module.vocab)}")
         # -- SPMD serving mesh (tpudist.serve.spmd): params + KV storage
         # get NamedShardings, SlotState/tables stay replicated, and the
         # SAME four programs run partitioned — shardings change, code
@@ -284,7 +334,9 @@ class SlotEngine:
                                         spec=spec_pair,
                                         draft_constraint=cache_constraint,
                                         attn_kernel=attn_kernel,
-                                        adapters=acfg)
+                                        adapters=acfg,
+                                        constrain=constrain,
+                                        logprobs=self.n_lp)
             self.alloc = BlockAllocator(
                 self.paged_cfg.num_blocks, kv_block, self.max_len,
                 prefix_cache_blocks=prefix_cache_blocks)
@@ -296,7 +348,9 @@ class SlotEngine:
                                         state_constraint=state_constraint,
                                         spec=spec_pair,
                                         draft_constraint=cache_constraint,
-                                        adapters=acfg)
+                                        adapters=acfg,
+                                        constrain=constrain,
+                                        logprobs=self.n_lp)
         self.num_slots = num_slots
         self.prefill_pad = prefill_pad
         self.block = max(1, int(decode_block if decode_block else 8))
@@ -798,6 +852,97 @@ class SlotEngine:
                  else None)
         return self._aid_sentinel() if bound is None else bound[1]
 
+    # -- structured output (grammar pool) -----------------------------------
+
+    def has_constrain(self) -> bool:
+        """Would a NEW constrained request bind right now (pool-full
+        deferral aside)?  False on an engine built without
+        ``constrain=``, where admission rejects synchronously."""
+        return self.grammars is not None
+
+    def _gid_sentinel(self) -> int:
+        return (self.constrain_cfg.num_blocks
+                if self.constrain_cfg is not None else 0)
+
+    def _g_tail(self) -> Tuple:
+        """Trailing grammar-pool argument for the constrained program
+        wrappers (empty when structured output is off — the traced
+        signatures then match the pre-constrain programs exactly)."""
+        return () if self.gpool is None else (self.gpool,)
+
+    def _write_grammar_block(self, block: int, tg) -> None:
+        """Write ``tg``'s dense tables into pool ``block`` (rows past
+        ``n_states`` stay the identity — unreachable, but a defensive
+        gather must never land on an all-masked row)."""
+        import jax.numpy as jnp
+
+        cfg = self.constrain_cfg
+        S, V = cfg.max_states, len(cfg.vocab)
+        if tg.n_states > S or tg.allowed.shape[1] != V:
+            from tpudist.constrain import GrammarError
+
+            raise GrammarError(
+                f"grammar tables [{tg.n_states}, {tg.allowed.shape[1]}] "
+                f"exceed the pool row [{S}, {V}] "
+                "(TPUDIST_CONSTRAIN_STATES)")
+        allow = np.ones((S, V), bool)
+        nxt = np.zeros((S, V), np.int32)
+        allow[:tg.n_states] = tg.allowed
+        nxt[:tg.n_states] = tg.next_state
+        self._gallow = self._gallow.at[block].set(jnp.asarray(allow))
+        self._gnext = self._gnext.at[block].set(jnp.asarray(nxt))
+        self.gpool = (self._gallow, self._gnext)
+
+    def _acquire_grammar(self, slot: int, tg) -> int:
+        """Bind compiled grammar ``tg`` to ``slot`` (refcount pin) and
+        return its pool block id — the sentinel for an unconstrained
+        lane.  A fresh bind writes the device tables before any lane
+        can decode under the block.  Raises
+        :class:`~tpudist.constrain.GrammarPoolFull` when every block is
+        pinned (admission defers rather than errors)."""
+        if tg is None:
+            return self._gid_sentinel()
+        if self.grammars is None:
+            raise RuntimeError("engine built without constrain=")
+        block, fresh = self.grammars.bind(tg)
+        if fresh:
+            try:
+                self._write_grammar_block(block, tg)
+            except BaseException:
+                self.grammars.release(block)
+                raise
+        self.slot_grammar[slot] = (tg, block)
+        return block
+
+    def _release_grammar(self, slot: int) -> None:
+        if self.grammars is None:
+            return
+        bound = self.slot_grammar[slot]
+        if bound is None:
+            return
+        self.slot_grammar[slot] = None
+        self.grammars.release(bound[1])
+
+    def constrain_stats(self) -> Dict[str, object]:
+        """Grammar-pool accounting for reports/statusz: registry
+        counters, compile-cache hit/miss, pool geometry/bytes (all
+        trivial when off)."""
+        if self.grammars is None:
+            return {"enabled": False}
+        from tpudist.constrain.grammar import compile_cache_stats
+
+        cfg = self.constrain_cfg
+        return {
+            "enabled": True,
+            "max_states": cfg.max_states,
+            "pool_bytes": int(self._gallow.size
+                              + self._gnext.size * 4),
+            "slots_bound": sum(1 for g in self.slot_grammar
+                               if g is not None),
+            "compile_cache": compile_cache_stats(),
+            **self.grammars.stats(),
+        }
+
     # -- KV handoff (prefill/decode disaggregation) -------------------------
 
     def export_slot(self, slot: int) -> Dict[str, object]:
@@ -826,7 +971,16 @@ class SlotEngine:
                 "adapter": (self.slot_adapter[slot][0]
                             if self.adapters is not None
                             and self.slot_adapter[slot] is not None
-                            else None)}
+                            else None),
+                # grammar binding travels by SOURCE: pool block ids are
+                # local, so the importing engine re-compiles (cache
+                # hit) and re-binds; the row's gidx/gstate leaves ride
+                # the state blob and gidx is overwritten at install
+                "grammar": (
+                    {"source": self.slot_grammar[slot][0].source,
+                     "eos_id": int(self.slot_grammar[slot][0].eos_id)}
+                    if self.grammars is not None
+                    and self.slot_grammar[slot] is not None else None)}
 
     def can_import(self, package: Dict[str, object]) -> bool:
         """Would this engine's KV budget take the package right now
@@ -861,7 +1015,8 @@ class SlotEngine:
         budget = int(package["budget"])
         self._install_lane(slot, package["lane"], package["state"], pos,
                            admit_span=(pos, budget),
-                           adapter=package.get("adapter"))
+                           adapter=package.get("adapter"),
+                           grammar=package.get("grammar"))
         self.occupied[slot] = True
         self.decoding[slot] = True
         self.pos[slot] = pos
@@ -872,7 +1027,8 @@ class SlotEngine:
 
     def _install_lane(self, slot: int, lane, row_state, pos: int, *,
                       admit_span: Tuple[int, int],
-                      adapter: Optional[str] = None) -> None:
+                      adapter: Optional[str] = None,
+                      grammar: Optional[Dict[str, object]] = None) -> None:
         """The ONE import dispatch both :meth:`import_slot` (handoff /
         preemption resume) and :meth:`resume_slot` (session resume)
         ride: paged engines reserve ``admit_span`` (admission args for
@@ -894,6 +1050,48 @@ class SlotEngine:
             aid = self._acquire_adapter(slot, adapter)
             row_state = row_state._replace(
                 adapter_id=_np.asarray(aid, _np.int32))
+        if grammar is not None and self.grammars is None:
+            from tpudist.constrain import GrammarError
+
+            self._release_adapter(slot)
+            raise GrammarError(
+                "imported lane carries a grammar but this engine was "
+                "built without constrain= — pools must agree on "
+                "structured-output support")
+        if grammar is not None or self.grammars is not None:
+            # re-bind by SOURCE: the row's gidx leaf is the source
+            # pool's block id — recompile (a cache hit for any grammar
+            # this process has seen) and overwrite with ours.  The
+            # gstate leaf carries byte-faithfully; an unconstrained
+            # import resets it alongside the sentinel gidx (a foreign
+            # gstate could exceed THIS pool's state rows).
+            gid = self._gid_sentinel()
+            if grammar is not None:
+                from tpudist.constrain import compile_grammar
+
+                src = grammar["source"]
+                try:
+                    tg = compile_grammar(
+                        regex=(src["src"] if src["kind"] == "regex"
+                               else None),
+                        json_schema=(src["src"]
+                                     if src["kind"] == "json_schema"
+                                     else None),
+                        vocab=self.constrain_cfg.vocab,
+                        eos_id=int(grammar["eos_id"]),
+                        max_states=self.constrain_cfg.max_states)
+                    gid = self._acquire_grammar(slot, tg)
+                except BaseException:
+                    # a failed bind must not leak the adapter pin
+                    # acquired above
+                    self._release_adapter(slot)
+                    raise
+                row_state = row_state._replace(
+                    gidx=_np.asarray(gid, _np.int32))
+            else:
+                row_state = row_state._replace(
+                    gidx=_np.asarray(gid, _np.int32),
+                    gstate=_np.zeros((), _np.int32))
         if self.alloc is not None:
             row, _ = self.alloc.admit(slot, admit_span[0], admit_span[1],
                                       ())
@@ -972,7 +1170,8 @@ class SlotEngine:
         # resumed lane), then the same install dispatch imports ride
         self._install_lane(slot, package["lane"], row_state, pos,
                            admit_span=(len(prompt), max_new),
-                           adapter=package.get("adapter"))
+                           adapter=package.get("adapter"),
+                           grammar=package.get("grammar"))
         self.occupied[slot] = True
         self.decoding[slot] = False
         self.pos[slot] = pos
@@ -1107,6 +1306,7 @@ class SlotEngine:
         taken = set()
         spec_flags = {}
         adapter_names: Dict[int, Optional[str]] = {}
+        grammar_objs: Dict[int, Optional[object]] = {}
         for item in items:
             slot, prompt, temperature, seed, max_new = item[:5]
             hashes = tuple(item[5]) if len(item) > 5 else ()
@@ -1121,6 +1321,12 @@ class SlotEngine:
 
                 raise AdapterMissingError(str(adapter))
             adapter_names[int(slot)] = adapter
+            grammar = item[8] if len(item) > 8 else None
+            if grammar is not None and self.grammars is None:
+                raise ValueError(
+                    "constrained request on an engine built without "
+                    "constrain= (TPUDIST_SERVE_CONSTRAIN)")
+            grammar_objs[int(slot)] = grammar
             if self.occupied[slot] or slot in taken:
                 raise ValueError(f"slot {slot} is occupied")
             taken.add(slot)
@@ -1153,6 +1359,27 @@ class SlotEngine:
                     self._release_adapter(slot)
                 raise
             ad_args = (jnp.asarray(aids), self.apool)
+        g_args = ()
+        if self.grammars is not None:
+            # grammar binds follow the adapter discipline exactly:
+            # transactional (a mid-batch GrammarPoolFull — every block
+            # pinned by running lanes — rolls every earlier pin back,
+            # adapter pins included; the server defers the batch), and
+            # the resolved block ids ride in as data
+            gids = np.full(self.num_slots, self._gid_sentinel(), np.int32)
+            gbound: List[int] = []
+            try:
+                for j, (slot, *_rest) in enumerate(norm):
+                    gids[j] = self._acquire_grammar(slot,
+                                                    grammar_objs[slot])
+                    gbound.append(slot)
+            except BaseException:
+                for slot in gbound:
+                    self._release_grammar(slot)
+                for slot, *_rest in norm:
+                    self._release_adapter(slot)
+                raise
+            g_args = (jnp.asarray(gids), self.gpool)
         reused_len = np.zeros(self.num_slots, np.int32)
         if self.alloc is not None:
             M = self.max_len // self.paged_cfg.block_size
@@ -1178,11 +1405,12 @@ class SlotEngine:
             except RuntimeError:
                 # a half-admitted batch must not leak reservations; the
                 # caller gates on can_admit_kv, so this is the defense
-                # (adapter pins acquired above roll back with it)
+                # (adapter/grammar pins acquired above roll back too)
                 for slot in admitted:
                     self.alloc.release(slot)
                 for slot, *_rest in norm:
                     self._release_adapter(slot)
+                    self._release_grammar(slot)
                 raise
         for j, (slot, prompt, temperature, seed, max_new, _) in \
                 enumerate(norm):
@@ -1201,7 +1429,7 @@ class SlotEngine:
                 self.state, self.cache, jnp.asarray(tables),
                 jnp.asarray(reused_len), jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
-                jnp.asarray(temps), jnp.asarray(last), *ad_args)
+                jnp.asarray(temps), jnp.asarray(last), *ad_args, *g_args)
             if self.spec:
                 # same chunks, same (host-built) table rows: the draft's
                 # pool blocks mirror the target's ids, so a reused
@@ -1215,7 +1443,7 @@ class SlotEngine:
             self.state, self.cache, firsts = self.fns.insert_batch(
                 self.state, self.cache, jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
-                jnp.asarray(temps), jnp.asarray(last), *ad_args)
+                jnp.asarray(temps), jnp.asarray(last), *ad_args, *g_args)
             if self.spec:
                 self.dcache = self.fns.draft_prefill(
                     self.dcache, jnp.asarray(prompts), jnp.asarray(clens),
@@ -1263,7 +1491,7 @@ class SlotEngine:
             self.state, self.cache, first = self.fns.prefill_extend(
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
-                jnp.asarray(is_last), *ad_tail)
+                jnp.asarray(is_last), *ad_tail, *self._g_tail())
             if self.spec:
                 d_tail = () if self.adapters is None else (
                     jnp.asarray(self._slot_aid(slot), jnp.int32),
@@ -1321,12 +1549,21 @@ class SlotEngine:
         headroom = int((self.max_len - self.pos[dec]).min())
         k = _pow2_floor(min(cap, int(remaining.min()), headroom))
         pos0 = self.pos[dec].copy()  # dispatch-start cursors (accounting)
-        ad_tail = () if self.adapters is None else (self.apool,)
+        tail = (() if self.adapters is None else (self.apool,)) \
+            + self._g_tail()
         t0 = time.perf_counter()
-        self.state, self.cache, blocks = self.fns.decode_block(
-            self.state, self.cache, k, *ad_tail)
+        lpi = lpv = None
+        if self.n_lp:
+            self.state, self.cache, blocks, lpi, lpv = \
+                self.fns.decode_block(self.state, self.cache, k, *tail)
+        else:
+            self.state, self.cache, blocks = self.fns.decode_block(
+                self.state, self.cache, k, *tail)
         t1 = time.perf_counter()
         arr = np.asarray(blocks)  # ONE host sync for K×num_slots tokens
+        if self.n_lp:
+            # the top-n arrays ride the same packed fetch window
+            lpi, lpv = np.asarray(lpi), np.asarray(lpv)
         t2 = time.perf_counter()
         self.n_decode_blocks += 1
         self.n_decode_tokens += k * len(dec)
@@ -1344,6 +1581,12 @@ class SlotEngine:
         info = {"k": k, "tokens": k * len(dec),
                 "dispatch_s": t1 - t0, "sync_s": t2 - t1,
                 "kv_read_bytes": int(kv_read)}
+        if self.n_lp:
+            # slot → one (ids, logprobs) top-n pair per emitted token,
+            # aligned with the token lists in ``out``
+            info["logprobs"] = {
+                int(s): [(lpi[i, s].tolist(), lpv[i, s].tolist())
+                         for i in range(k)] for s in dec}
         return info, out
 
     def step(self) -> Dict[int, int]:
@@ -1410,15 +1653,30 @@ class SlotEngine:
         pos0 = self.pos[dec].copy()  # dispatch-start cursors (accounting)
         ad_tail = () if self.adapters is None else (self.apool,)
         t0 = time.perf_counter()
+        # the draft proposes UNMASKED (a grammar-forbidden draft token
+        # is just a rejection in the verify) — its tail stays
+        # adapter-only
         self.dcache, drafts, dlogits = self.fns.draft_propose(
             self.state, self.dcache, k, *ad_tail, self.draft_params)
         jax.block_until_ready(drafts)
         t1 = time.perf_counter()
-        self.state, self.cache, self.dcache, packed = self.fns.spec_verify(
-            self.state, self.cache, self.dcache, drafts, dlogits,
-            jnp.asarray(self.spec_on), jnp.asarray(rem), *ad_tail)
+        lpi = lpv = None
+        if self.n_lp:
+            (self.state, self.cache, self.dcache, packed, lpi,
+             lpv) = self.fns.spec_verify(
+                self.state, self.cache, self.dcache, drafts, dlogits,
+                jnp.asarray(self.spec_on), jnp.asarray(rem), *ad_tail,
+                *self._g_tail())
+        else:
+            self.state, self.cache, self.dcache, packed = \
+                self.fns.spec_verify(
+                    self.state, self.cache, self.dcache, drafts, dlogits,
+                    jnp.asarray(self.spec_on), jnp.asarray(rem), *ad_tail,
+                    *self._g_tail())
         t2 = time.perf_counter()
         pk = np.asarray(packed)  # ONE host sync: counts + token block
+        if self.n_lp:
+            lpi, lpv = np.asarray(lpi), np.asarray(lpv)
         t3 = time.perf_counter()
         n_emit = pk[dec, 0]
         a_raw = pk[dec, 1]
@@ -1476,6 +1734,13 @@ class SlotEngine:
                 **({"accept_by_adapter": {
                     n: [int(a), int(d)] for n, (a, d) in
                     by_adapter.items()}} if by_adapter else {})}
+        if self.n_lp:
+            # slot → per-emitted-token top-n pairs, rows [:n_emit] of
+            # the verify's [S, k+1, n] arrays (aligned with ``out``)
+            info["logprobs"] = {
+                int(s): [(lpi[s, i].tolist(), lpv[s, i].tolist())
+                         for i in range(int(pk[s, 0]))]
+                for s in dec if pk[s, 0] > 0}
         return info, out
 
     def decode_auto_plain(self, max_k: Optional[int] = None
@@ -1548,6 +1813,7 @@ class SlotEngine:
                 self.dcache = self.fns.draft_evict(
                     self.dcache, jnp.asarray(slot, jnp.int32))
         self._release_adapter(slot)
+        self._release_grammar(slot)
         self.occupied[slot] = False
         self.decoding[slot] = False
         self.pos[slot] = 0

@@ -266,6 +266,14 @@ class RouterHandle:
         mints its own — the per-replica lifelines join on it)."""
         return None if self.inner is None else self.inner.trace_id
 
+    @property
+    def logprobs(self) -> list:
+        """Per-token top-n logprob rows from the CURRENT inner attempt
+        (``submit(logprobs=n)``).  A re-homed attempt re-decodes the
+        stream byte-identically from the prompt, so the final attempt's
+        rows cover the whole delivered stream."""
+        return [] if self.inner is None else list(self.inner.logprobs)
+
     # -- router side --------------------------------------------------------
 
     def _expired(self, now: float) -> bool:
@@ -460,11 +468,17 @@ class FleetRouter:
                on_token: Optional[Callable[[int, int], None]] = None,
                spec: Optional[bool] = None, tenant: Optional[str] = None,
                priority: int = 0, session: Optional[str] = None,
-               adapter: Optional[str] = None) -> RouterHandle:
+               adapter: Optional[str] = None,
+               grammar: Optional[str] = None, json_schema=None,
+               stop=None, logprobs: int = 0) -> RouterHandle:
         """Route and admit one request; raises :class:`AdmissionError`
         only when the WHOLE fleet rejects (the sheddiest reason passes
         through — ``shed_load`` wins so fleet saturation is
-        distinguishable from one replica's bad moment)."""
+        distinguishable from one replica's bad moment).  Structured
+        output (``grammar``/``json_schema``/``stop``/``logprobs``)
+        passes through verbatim — each replica compiles/validates in
+        its own scheduler, and a failover resubmission carries the ask
+        unchanged."""
         if self._closing:
             raise AdmissionError("draining")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -478,7 +492,9 @@ class FleetRouter:
         kwargs = dict(max_new=max_new, temperature=temperature,
                       deadline_s=deadline_s, seed=seed, eos_id=eos_id,
                       spec=spec, tenant=tenant, priority=priority,
-                      session=session, adapter=adapter)
+                      session=session, adapter=adapter,
+                      grammar=grammar, json_schema=json_schema,
+                      stop=stop, logprobs=logprobs)
         outer = RouterHandle(prompt, kwargs, on_token, skey, pkey)
         with self._lock:
             outer.id = self._next_id

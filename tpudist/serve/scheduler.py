@@ -99,6 +99,16 @@ FINISH_REASONS = {
                        "with the same reason instead; the engine NEVER "
                        "silently serves base-model output for an "
                        "adapter request)",
+    "stop_sequence": "matched one of its per-request stop sequences on "
+                     "the delivered stream (host-side suffix match on "
+                     "the packed block fetch, block-boundary straddles "
+                     "included; the stop tokens stay in the output)",
+    "grammar_violation": "a constrained lane's emitted token broke its "
+                         "grammar's host shadow automaton — the stream "
+                         "is truncated before the violating token "
+                         "(defense in depth: the device-side mask makes "
+                         "this unreachable unless the pool tables and "
+                         "the host shadow diverge)",
 }
 
 
@@ -107,7 +117,13 @@ class AdmissionError(RuntimeError):
     (``queue_full``, ``draining``, ``budget_exceeded: ...``,
     ``empty_prompt``, ``kv_exhausted: ...`` — a paged-KV footprint no
     empty pool could ever hold —, ``adapter_missing`` — the named
-    per-tenant adapter is not loaded in the pool)."""
+    per-tenant adapter is not loaded in the pool —,
+    ``invalid_grammar: ...`` — an uncompilable/unsatisfiable grammar,
+    a grammar+json_schema double ask, or a grammar without ``eos_id``
+    —, ``constrain_disabled`` — a grammar on a server without the
+    structured-output pool —, ``invalid_stop: ...`` — a malformed stop
+    sequence —, ``invalid_logprobs``/``logprobs_unavailable: ...`` — a
+    bad or over-wide top-n ask)."""
 
     def __init__(self, reason: str):
         super().__init__(f"request rejected: {reason}")
@@ -159,6 +175,24 @@ class Request:
     #: not loaded; a lane that must re-bind on another pool (handoff /
     #: host-tier resume) carries the name in its package.
     adapter: Optional[str] = None
+    #: compiled grammar (tpudist.constrain.TokenGrammar): the request's
+    #: output is constrained token-by-token by the grammar's dense mask
+    #: tables (bound into the engine's device pool at placement) and
+    #: tracked by its host shadow automaton on delivery.  Compiled ONCE
+    #: at submit (uncompilable grammars reject ``invalid_grammar``
+    #: synchronously); None = unconstrained (the bit-exact free path).
+    grammar: Optional[object] = None
+    #: stop sequences: tuple of token-id tuples, matched host-side as a
+    #: suffix of the delivered stream after every block fetch (straddles
+    #: across block boundaries match too).  First match finishes the
+    #: request ``stop_sequence``; the stop tokens stay in the output
+    #: (the eos convention).
+    stop: tuple = ()
+    #: top-n logprobs per emitted token (0 = off): each delivered token
+    #: grows a ``(ids, logprobs)`` pair on ``handle.logprobs`` — the
+    #: post-mask distribution on constrained lanes.  Capped by the
+    #: server's engine-wide width (``logprobs_unavailable`` past it).
+    logprobs: int = 0
 
 
 class RequestHandle:
@@ -201,6 +235,16 @@ class RequestHandle:
         #: ``session_resumed`` so the resume path is countable from the
         #: report's finish reasons alone)
         self.resumed: bool = False
+        #: structured output: the host shadow automaton's state over the
+        #: DELIVERED tokens (request.grammar only; parked sessions carry
+        #: it across turns).  The server advances it in _deliver_block
+        #: and truncates ``grammar_violation`` on divergence.
+        self.gstate: int = 0
+        #: per-token top-n logprobs (request.logprobs > 0 only): one
+        #: ``(ids, logprobs)`` pair per delivered token, or None for
+        #: tokens sampled by the prefill programs (the first token of a
+        #: stream), sliced to the request's asked width.
+        self.logprobs: List = []
 
     # -- caller side --------------------------------------------------------
 
@@ -277,7 +321,9 @@ class Scheduler:
                  default_max_new: int = 64,
                  default_deadline_s: Optional[float] = None,
                  prefix_hasher: Optional[Callable] = None,
-                 check_adapter: Optional[Callable] = None):
+                 check_adapter: Optional[Callable] = None,
+                 compile_grammar_fn: Optional[Callable] = None,
+                 max_logprobs: int = 0):
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         self.queue_limit = queue_limit
@@ -293,6 +339,19 @@ class Scheduler:
         #: request naming an unloaded adapter rejects ``adapter_missing``
         #: NOW instead of occupying queue+slot just to fail binding
         self.check_adapter = check_adapter
+        #: grammar compiler (the serving layer passes a closure over the
+        #: engine's vocab/state-cap): ``(regex, json_schema, eos_id) ->
+        #: TokenGrammar``, raising on anything uncompilable — run
+        #: OUTSIDE the lock (compilation is O(states × vocab)), with
+        #: failures rejecting ``invalid_grammar`` synchronously.  None =
+        #: structured output off (grammar asks reject
+        #: ``constrain_disabled``).
+        self.compile_grammar_fn = compile_grammar_fn
+        #: engine-wide top-n logprobs width (0 = off); per-request asks
+        #: past it reject ``logprobs_unavailable`` — the width is a
+        #: compile-time constant of the decode programs, so it cannot
+        #: stretch per request
+        self.max_logprobs = int(max_logprobs)
         self._q: "collections.deque[RequestHandle]" = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -307,6 +366,51 @@ class Scheduler:
 
     # -- ingestion side -----------------------------------------------------
 
+    def _reject(self, reason: str) -> None:
+        with self._lock:
+            self.rejected += 1
+        raise AdmissionError(reason)
+
+    def _compile_grammar(self, grammar, json_schema, eos_id):
+        """Compile a submit's grammar ask (outside the lock — O(states
+        × vocab) work must not serialize submitters) or reject."""
+        if grammar is None and json_schema is None:
+            return None
+        if self.compile_grammar_fn is None:
+            self._reject("constrain_disabled")
+        if grammar is not None and json_schema is not None:
+            self._reject("invalid_grammar: pass exactly one of "
+                         "grammar/json_schema")
+        if eos_id is None:
+            self._reject("invalid_grammar: a grammar requires eos_id — "
+                         "the automaton can only terminate on EOS in an "
+                         "accept state")
+        try:
+            return self.compile_grammar_fn(grammar, json_schema,
+                                           int(eos_id))
+        except ValueError as e:
+            self._reject(f"invalid_grammar: {e}")
+
+    def _norm_stop(self, stop) -> tuple:
+        """Normalize a submit's ``stop`` ask to a tuple of token-id
+        tuples (a bare int is a single-token sequence) or reject."""
+        if not stop:
+            return ()
+        seqs = []
+        try:
+            for s in stop:
+                if isinstance(s, (int, np.integer)):
+                    seqs.append((int(s),))
+                else:
+                    t = tuple(int(x) for x in s)
+                    if not t:
+                        self._reject("invalid_stop: empty stop sequence")
+                    seqs.append(t)
+        except (TypeError, ValueError):
+            self._reject("invalid_stop: stop must be a list of token "
+                         "ids or token-id sequences")
+        return tuple(seqs)
+
     def submit(self, prompt, *, max_new: Optional[int] = None,
                temperature: float = 0.0, deadline_s: Optional[float] = None,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
@@ -314,14 +418,34 @@ class Scheduler:
                spec: Optional[bool] = None, tenant: Optional[str] = None,
                priority: int = 0, session: Optional[str] = None,
                adapter: Optional[str] = None,
+               grammar: Optional[str] = None,
+               json_schema=None,
+               stop=None,
+               logprobs: int = 0,
                ) -> RequestHandle:
         """Admit a request or raise :class:`AdmissionError` (backpressure
         is synchronous — the caller learns NOW, not after a timeout).
         ``priority`` orders the queue (FIFO within a class; higher wins);
         ``session`` keys the host-tier multi-turn resume; ``adapter``
         names the per-tenant LoRA adapter the lane decodes through
-        (must be loaded — else ``adapter_missing``)."""
+        (must be loaded — else ``adapter_missing``); ``grammar`` (a
+        regex) / ``json_schema`` (a schema mapping) constrain the output
+        — compiled HERE, so an uncompilable grammar rejects
+        ``invalid_grammar`` now, and a grammar requires ``eos_id`` (the
+        automaton only terminates on EOS in an accept state); ``stop``
+        is a list of stop sequences (token ids, or lists of token ids);
+        ``logprobs`` asks for top-n (id, logprob) pairs per token."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tg = self._compile_grammar(grammar, json_schema, eos_id)
+        stop_seqs = self._norm_stop(stop)
+        n_lp = int(logprobs or 0)
+        if n_lp < 0:
+            self._reject("invalid_logprobs")
+        if n_lp > self.max_logprobs:
+            self._reject(
+                "logprobs_unavailable: asked top-%d, the engine computes "
+                "top-%d (TPUDIST_SERVE_LOGPROBS)"
+                % (n_lp, self.max_logprobs))
         # Deadline convention matches TPUDIST_SERVE_DEADLINE_S: ``None``
         # inherits the server default, ``<= 0`` means explicitly NO
         # deadline — the per-request opt-out when a default is set.
@@ -358,6 +482,9 @@ class Scheduler:
             priority=int(priority),
             session=None if session is None else str(session),
             adapter=None if adapter is None else str(adapter),
+            grammar=tg,
+            stop=stop_seqs,
+            logprobs=n_lp,
         )
         with self._lock:
             reason = self._refuse_reason

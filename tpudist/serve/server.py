@@ -127,6 +127,18 @@ class ServeConfig:
     adapters: bool = False
     adapter_blocks: int = 8  # resident-adapter capacity (one block each)
     adapter_rank: int = 8  # LoRA rank r shared by the pool
+    # -- structured output (tpudist/constrain/) ----------------------------
+    # grammar-constrained decoding: per-request ``grammar=`` (regex) /
+    # ``json_schema=`` asks compile host-side into token-level FSAs
+    # resident in a fixed device table pool — the mask rides the slot
+    # programs as DATA, zero recompilation under grammar churn
+    constrain: bool = False
+    constrain_blocks: int = 4  # resident-grammar capacity (one block each)
+    constrain_states: int = 64  # automaton state cap per compiled grammar
+    # engine-wide top-n logprobs width per emitted token (0 = off); a
+    # request asks any ``submit(logprobs=n)`` with n <= this — the
+    # width is a compile-time constant, per-request asks are slices
+    logprobs: int = 0
     # -- speculative decoding (draft-propose / batched target-verify) ------
     spec: bool = False  # draft proposes K, target verifies in one pass
     spec_k: int = 4  # drafted tokens per speculative block
@@ -207,11 +219,26 @@ class ServeConfig:
             adapters=env_flag("TPUDIST_SERVE_ADAPTERS", False),
             adapter_blocks=env_int("TPUDIST_SERVE_ADAPTER_BLOCKS", 8) or 8,
             adapter_rank=env_int("TPUDIST_SERVE_ADAPTER_RANK", 8) or 8,
+            constrain=env_flag("TPUDIST_SERVE_CONSTRAIN", False),
+            constrain_blocks=env_int("TPUDIST_CONSTRAIN_BLOCKS", 4) or 4,
+            constrain_states=env_int("TPUDIST_CONSTRAIN_STATES", 64) or 64,
+            logprobs=env_int("TPUDIST_SERVE_LOGPROBS", 0) or 0,
             spec=env_flag("TPUDIST_SERVE_SPEC", False),
             spec_k=env_int("TPUDIST_SERVE_SPEC_K", 4) or 4,
             spec_draft_layers=env_int(
                 "TPUDIST_SERVE_SPEC_DRAFT_LAYERS", 0) or 0,
         )
+
+
+def _compile_grammar_for(ccfg, regex, json_schema, eos_id):
+    """The scheduler-injected grammar compiler: closes over the engine's
+    constrain geometry so admission can compile (LRU-cached) and reject
+    synchronously without importing the engine."""
+    from tpudist.constrain import compile_grammar
+
+    return compile_grammar(regex=regex, json_schema=json_schema,
+                           vocab=ccfg.vocab, eos_id=eos_id,
+                           max_states=ccfg.max_states)
 
 
 class ReplicaKilled(RuntimeError):
@@ -777,6 +804,19 @@ class InferenceServer(_Observability):
     def __init__(self, module, params, config: Optional[ServeConfig] = None,
                  *, install_signal_handler: bool = True):
         self.config = config or ServeConfig.from_env()
+        # structured output: the token vocabulary the grammar compiler
+        # lowers against is an engine-level constant (token id → decoded
+        # text); EOS stays per-request — compile_grammar wires its
+        # accept-state column at compile time, not here
+        ccfg = None
+        if self.config.constrain:
+            from tpudist.constrain import ConstrainConfig, default_vocab
+
+            ccfg = ConstrainConfig(
+                vocab=default_vocab(int(module.vocab)),
+                num_blocks=self.config.constrain_blocks,
+                max_states=self.config.constrain_states)
+        self.constrain_cfg = ccfg
         self.engine = SlotEngine(
             module, params, num_slots=self.config.num_slots,
             prefill_pad=self.config.prefill_pad,
@@ -790,7 +830,8 @@ class InferenceServer(_Observability):
             spec_k=self.config.spec_k,
             adapters=self.config.adapters,
             adapter_blocks=self.config.adapter_blocks,
-            adapter_rank=self.config.adapter_rank)
+            adapter_rank=self.config.adapter_rank,
+            constrain=ccfg, logprobs=self.config.logprobs)
         hasher = None
         if self.config.paged and self.config.prefix_cache_blocks > 0:
             from tpudist.serve.paged_alloc import hash_chain
@@ -805,7 +846,14 @@ class InferenceServer(_Observability):
             prefix_hasher=hasher,
             check_adapter=lambda name: (
                 None if self.engine.has_adapter(name)
-                else "adapter_missing"))
+                else "adapter_missing"),
+            # grammar compilation runs OUTSIDE the scheduler lock (it is
+            # O(states × vocab) host work); GrammarError subclasses
+            # ValueError, so an uncompilable ask rejects synchronously
+            compile_grammar_fn=(None if ccfg is None else (
+                lambda regex, schema, eos: _compile_grammar_for(
+                    ccfg, regex, schema, eos))),
+            max_logprobs=self.engine.n_lp)
         self._install_signal = install_signal_handler
         self._installed_preemption = False
         self._thread: Optional[threading.Thread] = None
@@ -854,6 +902,15 @@ class InferenceServer(_Observability):
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)
         self._stamp_adapter_config()
+        if self.engine.has_constrain() or self.engine.n_lp:
+            # the structured-output config stamp the aggregator pairs
+            # with the per-request constrained tags
+            cs = self.engine.constrain_stats()
+            telemetry.event(
+                "serve_constrain_config", enabled=cs["enabled"],
+                blocks=cs.get("blocks"), max_states=cs.get("max_states"),
+                pool_bytes=cs.get("pool_bytes"),
+                logprobs=self.engine.n_lp)
         if self._capture is None:
             # TPUDIST_DISTILL_CAPTURE arms the live-traffic tap at the
             # same entry the faults grammar arms at — no code changes
@@ -879,6 +936,8 @@ class InferenceServer(_Observability):
                spec: Optional[bool] = None, tenant: Optional[str] = None,
                priority: int = 0, session: Optional[str] = None,
                adapter: Optional[str] = None,
+               grammar: Optional[str] = None, json_schema=None,
+               stop=None, logprobs: int = 0,
                ) -> RequestHandle:
         """Thread-safe ingestion; raises :class:`AdmissionError` on
         backpressure/budget rejection (reason stamped into telemetry).
@@ -891,7 +950,19 @@ class InferenceServer(_Observability):
         a prompt extending a parked session's context token-for-token
         re-imports its KV instead of re-prefilling it.  ``adapter``
         names the per-tenant LoRA the lane decodes through (must be
-        loaded via :meth:`load_adapter`; else ``adapter_missing``)."""
+        loaded via :meth:`load_adapter`; else ``adapter_missing``).
+
+        Structured output: ``grammar`` (a regex over the decoded text)
+        or ``json_schema`` constrains the emitted stream to the
+        grammar's language — uncompilable asks reject synchronously
+        (``invalid_grammar``), and a grammar requires ``eos_id``.
+        ``stop`` is a list of token-id sequences (a bare int is a
+        1-sequence) matched host-side on the delivered stream; a match
+        finishes ``stop_sequence`` with the stop tokens kept in the
+        output.  ``logprobs=n`` attaches the top-n ``(token_id,
+        logprob)`` pairs per emitted token to ``handle.logprobs``
+        (post-mask values on constrained lanes; ``n`` must not exceed
+        the engine's compiled TPUDIST_SERVE_LOGPROBS width)."""
         from tpudist import telemetry
 
         # count the in-flight BEFORE the handle becomes visible to the
@@ -905,7 +976,9 @@ class InferenceServer(_Observability):
                 prompt, max_new=max_new, temperature=temperature,
                 deadline_s=deadline_s, seed=seed, eos_id=eos_id,
                 on_token=on_token, spec=spec, tenant=tenant,
-                priority=priority, session=session, adapter=adapter)
+                priority=priority, session=session, adapter=adapter,
+                grammar=grammar, json_schema=json_schema, stop=stop,
+                logprobs=logprobs)
         except BaseException as e:
             # never admitted — ANY failure (bad prompt included, not
             # just AdmissionError) must give the +1 back or the tenant
@@ -1001,6 +1074,12 @@ class InferenceServer(_Observability):
             # per-tenant adapter pool (absent when off)
             **({"adapters": self.engine.adapter_stats()}
                if self.engine.adapters is not None else {}),
+            # structured-output grammar pool + logprobs width (absent
+            # when both are off)
+            **({"constrained": {**self.engine.constrain_stats(),
+                                "logprobs": self.engine.n_lp}}
+               if self.engine.has_constrain() or self.engine.n_lp
+               else {}),
             # speculative decode + distillation flywheel (absent when
             # off) — the swap gate reads the SAME numbers shown here
             **({"spec": self._spec_status(self.engine.spec_stats())}
@@ -1036,6 +1115,7 @@ class InferenceServer(_Observability):
             "decode": self.engine.decode_stats(),
             "spec": self.engine.spec_stats(),
             "kv": self.engine.kv_stats(),
+            "constrain": self.engine.constrain_stats(),
             "spmd": self.engine.spmd_stats(),
             "adapters": self.engine.adapter_stats(),
             "preemptions": self.preemptions,
@@ -1224,6 +1304,9 @@ class InferenceServer(_Observability):
                         from tpudist.serve.adapters import \
                             AdapterMissingError
 
+                        from tpudist.constrain.registry import \
+                            GrammarPoolFull
+
                         for h, slot in fresh:
                             items.append((slot, h.request.prompt,
                                           h.request.temperature,
@@ -1231,7 +1314,8 @@ class InferenceServer(_Observability):
                                           h.request.max_new,
                                           h.request.prefix_hashes,
                                           h.request.spec,
-                                          h.request.adapter))
+                                          h.request.adapter,
+                                          h.request.grammar))
                             self._slot_handles[slot] = h
                         firsts = {}
                         while items:
@@ -1240,6 +1324,25 @@ class InferenceServer(_Observability):
                                                     n=len(items)):
                                     firsts = eng.start_batch(items)
                                 break
+                            except GrammarPoolFull:
+                                # every grammar block is pinned by a
+                                # decoding lane (start_batch rolled the
+                                # whole dispatch back): defer the
+                                # CONSTRAINED items through the requeue
+                                # line — they retry head-of-line as
+                                # lanes finish — and admit the free ones
+                                keep = []
+                                for it in items:
+                                    if it[8] is not None:
+                                        h2 = self._slot_handles.pop(it[0])
+                                        h2.slot = None
+                                        self._requeue.append(h2)
+                                    else:
+                                        keep.append(it)
+                                telemetry.event(
+                                    "constrain_deferred",
+                                    n=len(items) - len(keep))
+                                items = keep
                             except AdapterMissingError as e:
                                 # a user thread unloaded the adapter
                                 # between the admission recheck and the
@@ -1312,8 +1415,9 @@ class InferenceServer(_Observability):
                                          time.monotonic() - t0, tags)
                 self._occupancy_sum += occ
                 self._steps += 1
+                block_lp = (info or {}).get("logprobs") or {}
                 for slot, toks in blocks.items():
-                    self._deliver_block(slot, toks)
+                    self._deliver_block(slot, toks, block_lp.get(slot))
             elif eng.prefilling_slots():
                 pass  # prefill work continues next iteration
             elif (self._draining and sched.pending() == 0
@@ -1325,15 +1429,28 @@ class InferenceServer(_Observability):
             else:
                 sched.wait_for_work(_IDLE_WAIT_S)
 
-    def _deliver_block(self, slot: int, toks) -> None:
+    def _deliver_block(self, slot: int, toks, lp=None) -> None:
         """Stream a token block to the slot's request, truncating
         post-hoc at its stop token or length budget (the device block is
         speculative past either — bounded by the block size).  A lane
         re-decoding after a re-prefill fallback (spilled/corrupt parked
         package) drops exactly its already-delivered duplicates first
-        (``_skip``) — the stream stays byte-identical."""
+        (``_skip``) — the stream stays byte-identical.
+
+        ``lp`` is the block's top-n logprobs rows aligned with ``toks``
+        (absent on prefill-sampled first tokens — those surface None).
+        A constrained lane walks its grammar's host shadow automaton
+        per delivered token; a token the shadow disallows truncates the
+        stream BEFORE delivery and finishes ``grammar_violation`` —
+        defense in depth, since the device-side mask makes a violating
+        sample unreachable unless the pool tables and the shadow
+        diverge.  A per-request stop sequence is suffix-matched on the
+        delivered stream after each token (block-boundary straddles
+        included, since the match runs on ``h.tokens``, not the block)
+        and finishes ``stop_sequence`` with the stop tokens kept."""
         h = self._slot_handles[slot]
         eos = h.request.eos_id
+        tg = h.request.grammar
         if self._ctrl is not None:
             # the fairness gate's measurement: DELIVERED tokens/s per
             # tenant — duplicates a fallback lane re-decodes are dropped
@@ -1341,18 +1458,36 @@ class InferenceServer(_Observability):
             delivered = max(0, len(toks) - self._skip.get(h.id, 0))
             if delivered:
                 self._ctrl.note_tokens(h.request.tenant, delivered)
-        for tok in toks:
+        for i, tok in enumerate(toks):
             skip = self._skip.get(h.id, 0)
             if skip > 0:
+                # a re-decoded duplicate was shadow-walked when it first
+                # delivered — drop it (and its lp row) without advancing
                 if skip == 1:
                     del self._skip[h.id]
                 else:
                     self._skip[h.id] = skip - 1
                 continue
+            if tg is not None:
+                if not tg.token_allowed(h.gstate, tok):
+                    self._finish_slot(slot, "grammar_violation")
+                    return
+                h.gstate = tg.advance(h.gstate, tok)
             h._deliver(tok)
+            if h.request.logprobs > 0:
+                n = h.request.logprobs
+                row = lp[i] if lp is not None and i < len(lp) else None
+                h.logprobs.append(None if row is None
+                                  else (row[0][:n], row[1][:n]))
             self.tokens_out += 1
             if eos is not None and tok == eos:
                 self._finish_slot(slot, "eos")
+                return
+            if h.request.stop and any(
+                    len(h.tokens) >= len(s)
+                    and tuple(h.tokens[-len(s):]) == s
+                    for s in h.request.stop):
+                self._finish_slot(slot, "stop_sequence")
                 return
             if len(h.tokens) >= h.request.max_new:
                 # a resumed turn's budget-completion is countable from
@@ -1399,6 +1534,13 @@ class InferenceServer(_Observability):
             # the parked KV was written THROUGH its turn's adapter; a
             # turn binding a different adapter (or none) must re-prefill
             # — resuming would continue from the wrong fine-tune's cache
+            return False
+        if raw.get("grammar") is not None or req.grammar is not None:
+            # a parked lane's automaton state belongs to ITS turn
+            # (mid-walk), while a constrained next turn must start at
+            # state 0 — and an unconstrained next turn must not inherit
+            # the parked mask.  Either way: fresh prefill (degraded,
+            # never wrong bytes).
             return False
         t0 = time.monotonic()
         from tpudist.serve.adapters import AdapterMissingError
@@ -1544,7 +1686,13 @@ class InferenceServer(_Observability):
             ttft_s=h.ttft_s, tpot_s=h.tpot_s, queue_wait_s=h.queue_wait_s,
             trace_id=h.trace_id,
             **({"tenant": h.request.tenant} if h.request.tenant else {}),
-            **({"adapter": h.request.adapter} if h.request.adapter else {}))
+            **({"adapter": h.request.adapter} if h.request.adapter else {}),
+            **({"constrained": h.request.grammar.source["kind"]}
+               if h.request.grammar is not None else {}),
+            **({"stop_seqs": len(h.request.stop)} if h.request.stop
+               else {}),
+            **({"logprobs": h.request.logprobs} if h.request.logprobs
+               else {}))
         # per-request lifeline spans (req_queue/req_prefill/req_decode)
         # for the cross-pool trace join + Chrome export
         trace.emit_request_lifeline(h)

@@ -332,6 +332,12 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
     ad_loads, ad_evicts = 0, 0
     ad_evict_kinds: Dict[str, int] = {}
     ad_resident_peak = 0
+    # structured output (tpudist.constrain): the serve_constrain_config
+    # stamp, per-request constrained/stop/logprobs tags on
+    # request_finished, and pool-full admission deferrals — absent
+    # entirely from old streams, so the section below is purely additive
+    cn_config: Optional[dict] = None
+    cn_deferred = 0
     # fleet router (tpudist.serve.router): routing split, spills,
     # re-home retries, replica deaths, session migrations — absent
     # entirely from single-replica streams, so the section below is
@@ -368,6 +374,14 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             if isinstance(r.get("resident"), (int, float)):
                 ad_resident_peak = max(ad_resident_peak,
                                        int(r["resident"]))
+            continue
+        if (r.get("kind") == "event"
+                and r.get("name") == "serve_constrain_config"):
+            cn_config = r  # last one wins (restart/regeneration)
+            continue
+        if (r.get("kind") == "event"
+                and r.get("name") == "constrain_deferred"):
+            cn_deferred += int(r.get("n", 1) or 0)
             continue
         if (r.get("kind") == "event"
                 and r.get("name") == "serve_disagg_config"):
@@ -601,6 +615,34 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
             "base_only_requests": len(fins) - sum(by_adapter.values()),
             "missing_finished": reasons.get("adapter_missing", 0),
         }
+    constrained: Optional[dict] = None
+    if cn_config is not None or cn_deferred \
+            or any(r.get("constrained") for r in fins):
+        by_kind: Dict[str, int] = {}
+        lp_requests = 0
+        for r in fins:
+            k = r.get("constrained")
+            if isinstance(k, str) and k:
+                by_kind[k] = by_kind.get(k, 0) + 1
+            if r.get("logprobs"):
+                lp_requests += 1
+        constrained = {
+            **({"blocks": cn_config.get("blocks"),
+                "max_states": cn_config.get("max_states"),
+                "pool_bytes": cn_config.get("pool_bytes"),
+                "logprobs_width": cn_config.get("logprobs")}
+               if cn_config is not None else {}),
+            # per-grammar-kind served-request split (regex vs schema)
+            "requests": by_kind,
+            "free_requests": len(fins) - sum(by_kind.values()),
+            "deferred": cn_deferred,
+            # both should stay 0 in healthy runs: violations mean the
+            # device mask and the host shadow diverged; stop_sequence
+            # is here because the stop satellite shares the section
+            "violations_finished": reasons.get("grammar_violation", 0),
+            "stop_finished": reasons.get("stop_sequence", 0),
+            "logprobs_requests": lp_requests,
+        }
     spec: Optional[dict] = None
     if spec_blocks:
         pp = sorted(spec_per_pass)
@@ -722,6 +764,9 @@ def _serving_summary(records: List[dict]) -> Optional[dict]:
         "occupancy_max": round(occ_max, 4) if occ_dur > 0 else None,
         **({"kv": kv} if kv is not None else {}),
         **({"adapters": adapters} if adapters is not None else {}),
+        # constrained section only when structured output ran — old
+        # streams aggregate byte-identically without it
+        **({"constrained": constrained} if constrained is not None else {}),
         **({"spec": spec} if spec is not None else {}),
         # distill section only when the flywheel ran — old streams (and
         # capture-off runs) aggregate byte-identically without it
@@ -951,6 +996,28 @@ def render_markdown(report: dict) -> str:
             if ad.get("missing_finished"):
                 bits.append(f"{ad['missing_finished']} adapter_missing")
             lines.append("- adapters: " + "; ".join(bits))
+        if sv.get("constrained"):
+            cn = sv["constrained"]
+            bits = []
+            if cn.get("blocks") is not None:
+                bits.append(f"pool {cn['blocks']} blocks × "
+                            f"{cn['max_states']} states")
+            if cn.get("requests"):
+                served = ", ".join(f"{k}: {c}" for k, c in
+                                   sorted(cn["requests"].items()))
+                bits.append(f"constrained requests ({served}; free "
+                            f"{cn['free_requests']})")
+            if cn.get("deferred"):
+                bits.append(f"{cn['deferred']} pool-full deferrals")
+            if cn.get("violations_finished"):
+                bits.append(f"{cn['violations_finished']} "
+                            "grammar_violation")
+            if cn.get("stop_finished"):
+                bits.append(f"{cn['stop_finished']} stop_sequence")
+            if cn.get("logprobs_requests"):
+                bits.append(f"{cn['logprobs_requests']} logprobs "
+                            f"requests (width {cn.get('logprobs_width')})")
+            lines.append("- constrained: " + "; ".join(bits))
         if sv.get("spec"):
             sp = sv["spec"]
             app = sp.get("accepted_per_pass") or {}

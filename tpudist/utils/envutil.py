@@ -187,6 +187,20 @@ ENV_VARS = {
         "(default 8; LRU-evicts cold adapters on load)",
     "TPUDIST_SERVE_ADAPTER_RANK":
         "LoRA rank r shared by every adapter in the pool (default 8)",
+    # structured output (tpudist/constrain/)
+    "TPUDIST_SERVE_CONSTRAIN":
+        "structured output: per-request grammar/json_schema asks compile "
+        "to token FSAs masking decode in-graph (default off)",
+    "TPUDIST_CONSTRAIN_BLOCKS":
+        "grammar-pool capacity in table blocks — one resident compiled "
+        "grammar each (default 4; LRU-evicts unpinned grammars)",
+    "TPUDIST_CONSTRAIN_STATES":
+        "automaton state cap per compiled grammar — fixes the dense "
+        "mask/transition table height (default 64; bigger grammars "
+        "reject invalid_grammar)",
+    "TPUDIST_SERVE_LOGPROBS":
+        "engine-wide top-n logprobs width per emitted token (default 0 "
+        "= off; per-request submit(logprobs=n) asks are slices of it)",
     "TPUDIST_SERVE_SPEC":
         "speculative decoding: draft proposes K, target verifies in one pass",
     "TPUDIST_SERVE_SPEC_K": "drafted tokens per speculative block",
