@@ -246,7 +246,8 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
                            max_len: int = 128, slots: int = 4,
                            k: int = 8, blocks: int = 16,
                            top: int = 25, spec: bool = True,
-                           paged: bool = True) -> dict:
+                           paged: bool = True,
+                           family: bool = True) -> dict:
     """Trace the bf16 fused decode loop and attribute its device time
     per op (module doc, ``--capture-decode``).  Returns the artifact
     dict; writes it to ``out_path`` when given.
@@ -263,7 +264,16 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
     paged-attention kernel path — as separate phase rows, so the
     artifact splits paged-kernel time (the ``custom (pallas/kernels)``
     group on TPU; interpret-lowered ops on CPU) from the residual
-    fusion/layout ops the kernel exists to shrink."""
+    fusion/layout ops the kernel exists to shrink.
+
+    ``family``: trace the rest of the kernel family (PR 19) as phase
+    rows — ``prefill.gather`` vs ``prefill.kernel`` (the batched
+    admission prefill, gather path vs the paged-prefill flash kernel
+    writing KV blocks in-kernel), ``sample.kernel`` (the fused
+    sampling tail riding the decode loop), ``rope_qkv.kernel`` (fused
+    RoPE+QKV on the paged decode arm) and ``lora.kernel`` (the
+    in-kernel adapter gather-matmul) — so the frozen artifact shows
+    each fused path's residual next to its in-graph twin."""
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     import jax
     import jax.numpy as jnp
@@ -304,9 +314,9 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
 
     spec_tables = None
     if spec:
-        sfns = make_slot_decode(
-            module, params, slots, pad,
-            spec=tied_draft(module, params, max(1, n_layers // 2)))
+        dpair = tied_draft(module, params, max(1, n_layers // 2))
+        dparams = dpair[1]
+        sfns = make_slot_decode(module, params, slots, pad, spec=dpair)
         sstate, scache = sfns.init_state(), sfns.init_slots()
         dcache = sfns.init_draft()
         sstate, scache, _ = sfns.insert_batch(
@@ -317,12 +327,13 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
             jnp.ones(slots, bool))
         dcache = sfns.draft_prefill(
             dcache, jnp.asarray(prompts), jnp.full(slots, pad, jnp.int32),
-            jnp.arange(slots, dtype=jnp.int32))
+            jnp.arange(slots, dtype=jnp.int32), dparams)
         sk = min(k, 4)
         spec_on = jnp.ones(slots, bool)
         rem = jnp.full(slots, max_len, jnp.int32)
         # warmup every phase program outside the traces
-        dcache, drafts, dlogits = sfns.draft_propose(sstate, dcache, sk)
+        dcache, drafts, dlogits = sfns.draft_propose(sstate, dcache, sk,
+                                                     dparams)
         sstate, scache, dcache, packed = sfns.spec_verify(
             sstate, scache, dcache, drafts, dlogits, spec_on, rem)
         jax.block_until_ready(packed)
@@ -332,7 +343,8 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
         dc = {"d": dcache}
 
         def draft_phase():
-            dc["d"], dr, _ = sfns.draft_propose(sstate, dc["d"], sk)
+            dc["d"], dr, _ = sfns.draft_propose(sstate, dc["d"], sk,
+                                                dparams)
             return dr
 
         n_phase = min(blocks, max(2, (max_len - 2 * pad) // (sk + 1) - 2))
@@ -429,6 +441,82 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
             paged_tables[key]["kernel_us"] = kg.get("us", 0.0)
             paged_tables[key]["kernel_pct"] = kg.get("pct", 0.0)
 
+    family_tables = None
+    if family:
+        from tpudist.models.paged import PagedKVConfig
+
+        kv_block = 16 if max_len % 16 == 0 else max_len
+        pcfg = PagedKVConfig(num_blocks=slots * (max_len // kv_block),
+                             block_size=kv_block)
+        M = max_len // kv_block
+        tables = np.stack([np.arange(j * M, (j + 1) * M)
+                           for j in range(slots)]).astype(np.int32)
+        ins_args = (jnp.asarray(tables), jnp.zeros(slots, jnp.int32),
+                    jnp.asarray(prompts), jnp.full(slots, pad, jnp.int32),
+                    jnp.arange(slots, dtype=jnp.int32),
+                    jnp.zeros(slots, jnp.int32),
+                    jnp.zeros(slots, jnp.float32), jnp.ones(slots, bool))
+        family_tables = {"kv_block": kv_block}
+
+        def _prefill_row(**kw):
+            """Trace the batched admission prefill alone: the same
+            insert re-dispatched (state/cache threaded; admitting the
+            same slots again is a plain overwrite, so the program sees
+            steady-state shapes every call)."""
+            ffns = make_slot_decode(module, params, slots, pad,
+                                    paged=pcfg, **kw)
+            fc = {"s": ffns.init_state(), "c": ffns.init_slots()}
+            fc["s"], fc["c"], w = ffns.insert_batch(  # warmup
+                fc["s"], fc["c"], *ins_args)
+            jax.block_until_ready(w)
+
+            def thunk():
+                fc["s"], fc["c"], t = ffns.insert_batch(
+                    fc["s"], fc["c"], *ins_args)
+                return t
+
+            return _slice_table(_trace_phase(thunk, blocks, top))
+
+        family_tables["prefill.gather"] = _prefill_row()
+        family_tables["prefill.kernel"] = _prefill_row(prefill_kernel=True)
+
+        def _decode_row(tail=(), **kw):
+            """One decode-loop phase row with the given knobs (``tail``
+            is the adapter tail: insert takes ``(aids, apool)``, decode
+            just ``(apool,)``)."""
+            ffns = make_slot_decode(module, params, slots, pad,
+                                    paged=pcfg, **kw)
+            fs, fcache = ffns.init_state(), ffns.init_slots()
+            fs, fcache, _ = ffns.insert_batch(fs, fcache, *ins_args,
+                                              *tail)
+            fs, fcache, w = ffns.decode_block(fs, fcache, k, *tail[1:])
+            jax.block_until_ready(w)
+            fc = {"s": fs, "c": fcache}
+
+            def thunk():
+                fc["s"], fc["c"], t = ffns.decode_block(
+                    fc["s"], fc["c"], k, *tail[1:])
+                return t
+
+            n_fb = min(blocks, max(2, (max_len - 2 * pad) // k - 1))
+            return _slice_table(_trace_phase(thunk, n_fb, top))
+
+        family_tables["sample.kernel"] = _decode_row(sample_kernel=True)
+        family_tables["rope_qkv.kernel"] = _decode_row(
+            attn_kernel="paged", fused_rope=True)
+        from tpudist.models.lora import (AdapterPoolConfig,
+                                         init_adapter_pool,
+                                         load_factors,
+                                         make_adapter_factors)
+
+        acfg = AdapterPoolConfig(num_blocks=2, rank=4)
+        apool = load_factors(
+            init_adapter_pool(module, acfg), 0,
+            make_adapter_factors(jax.random.PRNGKey(7), module, 4))
+        family_tables["lora.kernel"] = _decode_row(
+            tail=(jnp.zeros(slots, jnp.int32), apool),
+            attn_kernel="paged", adapters=acfg, lora_kernel=True)
+
     groups = s.get("groups", {})
     mxu = groups.get("matmul (MXU)", {"us": 0.0, "pct": 0.0})
     residual = {g: row for g, row in groups.items() if g != "matmul (MXU)"}
@@ -450,6 +538,7 @@ def capture_decode_profile(out_path=None, *, dtype: str = "bf16",
             residual.items(), key=lambda kv: -kv[1]["us"])),
         **({"spec": spec_tables} if spec_tables is not None else {}),
         **({"paged": paged_tables} if paged_tables is not None else {}),
+        **({"family": family_tables} if family_tables is not None else {}),
         **({"error": s["error"]} if "error" in s else {}),
     }
     if out_path is not None:

@@ -161,6 +161,70 @@ def paged_decode_row() -> dict:
                      "check of the DMA elision")}
 
 
+def paged_prefill_row() -> dict:
+    """Prefill roofline on the paged cache: the gather prefill vs the
+    paged-prefill kernel (tpudist/ops/paged_prefill.py), per the kernel
+    family PR.  Per PROMPT token when a chunk of ``P`` tokens lands on
+    a lane whose cursor sits at live-KV fraction ``f`` of ``max_len``
+    (the chunked-prefill steady state — each chunk after the first
+    attends a committed prefix):
+
+    - **gather**: the dense-view path streams the lane's full
+      ``(1 + pad) × max_len`` geometry per dispatch and scatters the
+      static pad span — KV bytes/prompt-token are FLAT in ``f``;
+    - **kernel**: the in-kernel walk reads ``ceil(f·max_len / block)``
+      blocks of prefix and WRITES only the ``ceil``-span of blocks the
+      chunk covers — read bytes/prompt-token TRACK live KV and write
+      bytes are chunk-proportional.
+
+    ``SlotEngine._prefill_kv_bytes`` applies the same per-path model to
+    real traffic (serve_bench's ``kernel_family_twin`` rung quotes it);
+    the independent check of the in-kernel write DMA is an on-chip
+    profile, not either model."""
+    cfg = dict(d_model=512, n_layers=4, max_len=2048, kv_block=16,
+               prefill_pad=64, dtype_bytes=4)
+    kv_per_pos = 2 * cfg["n_layers"] * cfg["d_model"] * cfg["dtype_bytes"]
+    bs, P = cfg["kv_block"], cfg["prefill_pad"]
+    rows = []
+    for f in (0.125, 0.25, 0.5, 0.875):
+        live = int(f * cfg["max_len"])
+        prefix_blocks = -(-live // bs) * bs
+        chunk_blocks = (-(-(live + P) // bs) - live // bs) * bs
+        gather_r = (1 + P) * cfg["max_len"] * kv_per_pos / P
+        gather_w = P * kv_per_pos / P  # static pad span ≈ the chunk
+        kernel_r = prefix_blocks * kv_per_pos / P
+        kernel_w = chunk_blocks * kv_per_pos / P
+        rows.append({
+            "live_kv_fraction": f,
+            "read_bytes_per_prompt_token_gather": int(gather_r),
+            "read_bytes_per_prompt_token_kernel": int(kernel_r),
+            "write_bytes_per_prompt_token_kernel": int(kernel_w),
+            "write_bytes_per_prompt_token_gather": int(gather_w),
+            "gather_over_kernel_read": round(gather_r / kernel_r, 3),
+            "t_hbm_us_per_prompt_token_gather": round(
+                (gather_r + gather_w) / HBM_BYTES_PER_S * 1e6, 2),
+            "t_hbm_us_per_prompt_token_kernel": round(
+                (kernel_r + kernel_w) / HBM_BYTES_PER_S * 1e6, 2),
+        })
+    return {"rung": "paged_prefill", "config": cfg, "bound": "bandwidth",
+            "rows": rows,
+            # the acceptance property: kernel prefill reads are monotone
+            # in live KV (they track the walked prefix), gather's flat
+            "kernel_tracks_live_kv": all(
+                rows[i]["read_bytes_per_prompt_token_kernel"]
+                < rows[i + 1]["read_bytes_per_prompt_token_kernel"]
+                for i in range(len(rows) - 1)),
+            "gather_flat_in_occupancy": len(
+                {r["read_bytes_per_prompt_token_gather"]
+                 for r in rows}) == 1,
+            "kernel_below_gather_everywhere": all(
+                r["read_bytes_per_prompt_token_kernel"]
+                < r["read_bytes_per_prompt_token_gather"] for r in rows),
+            "note": ("KV bytes per prompt token per prefill path "
+                     "(analytic); serve_bench's kernel_family_twin "
+                     "applies the engine's accounting to real traffic")}
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -200,6 +264,8 @@ def main(argv=None) -> int:
     rows.append(decode_row())
     print(json.dumps(rows[-1]), flush=True)
     rows.append(paged_decode_row())
+    print(json.dumps(rows[-1]), flush=True)
+    rows.append(paged_prefill_row())
     print(json.dumps(rows[-1]), flush=True)
     from benchmarks._round import current_round  # REPO is on sys.path
 

@@ -264,6 +264,22 @@ _SLOW_PATTERNS = (
     "TestKernelEngine::test_spec_verify_through_kernel",
     "TestKernelEngine::test_handoff_adopted_lane_continues_byte_identical",
     "TestKernelEngine::test_compile_counts_flat_across_mesh_shapes",
+    # kernel-family engine heavies (same discipline: each cell drives
+    # fresh engines through full churn; the default lane keeps every
+    # op-level kernel-vs-reference sweep, the f32 prefill
+    # byte-identity + oracle + byte-accounting drive, the
+    # paged-sampled fused-sampling representative, the all-four-
+    # kernels full-stack greedy drive, the churn compile pins, and
+    # the knob validation — these siblings extend to int8 prefill,
+    # the remaining sampling cells, the spec arm, and the cross-mesh
+    # pin matrix; the Native class is additionally TPU-only)
+    "TestKernelFamilyEngine::test_prefill_kernel_greedy_byte_identity[int8]",
+    "TestKernelFamilyEngine::test_fused_sampling_streams_identical[paged-greedy]",
+    "TestKernelFamilyEngine::test_fused_sampling_streams_identical[dense-sampled]",
+    "TestKernelFamilyEngine::test_fused_sampling_streams_identical[dense-greedy]",
+    "TestKernelFamilyEngine::test_spec_through_kernel_prefill",
+    "TestKernelFamilyEngine::test_compile_counts_flat_across_mesh_shapes",
+    "TestKernelFamilyNative",
     # LM facade resume chain (three compiled fits)
     "test_lm_checkpoint_resume_matches_unbroken",
 )

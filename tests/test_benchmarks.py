@@ -334,6 +334,21 @@ class TestServeBench:
         assert tw["read_bytes_per_token_kernel"] > 0
         assert tw["kernel_beats_gather_bytes"] is True
         assert tw["bytes_ratio_gather_over_kernel"] > 1.0
+        # kernel-family twin rungs (always-on): each fused path vs its
+        # in-graph twin on the same saturated burst; the prefill pair's
+        # acceptance claim is byte-based — the in-kernel writes beat
+        # the gather path's dense sweep + pad-span scatter
+        fam = rec["kernel_family_twin"]
+        for pair in ("prefill", "sample", "rope_qkv"):
+            assert fam[pair]["base"]["completed"] \
+                == fam[pair]["fused"]["completed"], pair
+            assert fam[pair]["tokens_per_s_fused"] > 0, pair
+        assert fam["prefill"]["fused"]["kv"]["prefill_kernel"] is True
+        assert fam["prefill"]["base"]["kv"]["prefill_kernel"] is False
+        assert fam["prefill"]["prefill_write_bytes_kernel"] > 0
+        assert fam["prefill"]["kernel_beats_gather_prefill_bytes"] is True
+        assert fam["sample"]["fused"]["kv"]["sample_kernel"] is True
+        assert fam["rope_qkv"]["fused"]["kv"]["fused_rope"] is True
 
     def test_smoke_mesh_rung(self, tmp_path):
         """The --mesh rung (single-process emulated-device mode): the
@@ -447,6 +462,13 @@ class TestServeBench:
             assert pg[arm]["total_us"] > 0, arm
             assert pg[arm]["groups"], arm
             assert "kernel_us" in pg[arm] and "kernel_pct" in pg[arm]
+        # kernel-family phase rows: each fused path traced separately
+        # against the gather prefill baseline
+        fam = rec["family"]
+        for phase in ("prefill.gather", "prefill.kernel",
+                      "sample.kernel", "rope_qkv.kernel", "lora.kernel"):
+            assert fam[phase]["total_us"] > 0, phase
+            assert fam[phase]["groups"], phase
 
     def test_dh128_twin_smoke(self, tmp_path):
         """The d_head twin harness (VERDICT Weak #1): both twins run in
@@ -1297,3 +1319,38 @@ class TestRoofline:
         assert decode_roofline(
             batch=8, prompt_len=16, max_new=240, d_model=512, n_layers=4,
             d_ff=2048, vocab=256, hbm_bytes_per_s=0) is None
+
+    def test_paged_prefill_roofline_tracks_live_kv(self):
+        """The kernel-family PR's prefill rung: analytic KV bytes per
+        prompt token — the kernel path's reads are monotone in live-KV
+        fraction (it walks the committed prefix) and sit below the
+        gather path everywhere, while gather's dense-view reads are
+        flat in occupancy."""
+        import importlib.util
+        from pathlib import Path as _P
+
+        spec = importlib.util.spec_from_file_location(
+            "roofline", _P(__file__).resolve().parent.parent
+            / "benchmarks" / "roofline.py")
+        rl = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rl)
+
+        row = rl.paged_prefill_row()
+        assert row["rung"] == "paged_prefill"
+        assert row["bound"] == "bandwidth"
+        assert row["kernel_tracks_live_kv"] is True
+        assert row["gather_flat_in_occupancy"] is True
+        assert row["kernel_below_gather_everywhere"] is True
+        # spot-check the accounting at f = 0.5: prefix blocks × kv
+        # bytes/pos over the pad-sized chunk
+        cfg = row["config"]
+        kv_pos = 2 * cfg["n_layers"] * cfg["d_model"] * cfg["dtype_bytes"]
+        at_half = [r for r in row["rows"]
+                   if r["live_kv_fraction"] == 0.5][0]
+        live = cfg["max_len"] // 2
+        assert at_half["read_bytes_per_prompt_token_kernel"] == int(
+            -(-live // cfg["kv_block"]) * cfg["kv_block"] * kv_pos
+            / cfg["prefill_pad"])
+        assert at_half["read_bytes_per_prompt_token_gather"] == int(
+            (1 + cfg["prefill_pad"]) * cfg["max_len"] * kv_pos
+            / cfg["prefill_pad"])

@@ -528,6 +528,10 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                      spec: Optional[Tuple] = None,
                      draft_constraint: Optional[Callable] = None,
                      attn_kernel: str = "gather",
+                     prefill_kernel: bool = False,
+                     sample_kernel: bool = False,
+                     fused_rope: bool = False,
+                     lora_kernel: bool = False,
                      adapters=None,
                      constrain=None,
                      logprobs: int = 0
@@ -618,6 +622,28 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         raise ValueError(
             "attn_kernel='paged' is the paged-pool kernel — it requires "
             "a paged cache (pass paged=PagedKVConfig(...))")
+    # -- the kernel-family knobs (tpudist.ops; PR 19) -----------------------
+    use_prefill_kernel = bool(prefill_kernel)
+    use_sample_kernel = bool(sample_kernel)
+    use_fused_rope = bool(fused_rope)
+    use_lora_kernel = bool(lora_kernel)
+    if use_prefill_kernel and paged is None:
+        raise ValueError(
+            "prefill_kernel=True is the paged-prefill kernel — it "
+            "requires a paged cache (pass paged=PagedKVConfig(...))")
+    if use_fused_rope and attn_kernel != "paged" and not use_prefill_kernel:
+        raise ValueError(
+            "fused_rope=True fuses RoPE+QKV on the kernel arms only — "
+            "enable attn_kernel='paged' and/or prefill_kernel=True")
+    if use_lora_kernel and adapters is None:
+        raise ValueError(
+            "lora_kernel=True is the in-kernel adapter gather-matmul — "
+            "it requires the adapter seam (pass adapters=...)")
+    if use_lora_kernel and attn_kernel != "paged" and not use_prefill_kernel:
+        raise ValueError(
+            "lora_kernel=True rides the slot-batched kernel programs "
+            "only — enable attn_kernel='paged' and/or prefill_kernel="
+            "True (the vmapped gather programs keep gather_collection)")
     if num_slots < 1:
         raise ValueError(f"num_slots must be >= 1, got {num_slots}")
     if not 1 <= prefill_pad <= module.max_len:
@@ -685,26 +711,41 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         vals, ids = lax.top_k(lp, n_lp)
         return ids.astype(jnp.int32), vals.astype(jnp.float32)
 
-    def _slot_tail(tail, sel_ids):
+    def _ads_for(apool, ids, kernel_path: bool,
+                 n_layers: Optional[int] = None):
+        """The ``"adapters"`` collection for a program: pool form
+        (full pools + ids, consumed by the Pallas gather-matmul) on the
+        slot-batched kernel programs when ``lora_kernel`` is on,
+        pre-gathered factors everywhere else."""
+        if not use_lora:
+            return None
+        nl = n_lora_layers if n_layers is None else n_layers
+        if kernel_path and use_lora_kernel:
+            return _lora.pool_collection(apool, ids, nl)
+        return _lora.gather_collection(apool, ids, nl)
+
+    def _slot_tail(tail, sel_ids, kernel_path: bool = False):
         """Split a program's variadic pool tail into ``(ads, gp)``:
         the adapter pool rides first (when that seam is on), the
         grammar pool last.  Both seams off → empty tail, and the
-        traced signature is byte-identical to a pre-seam program."""
+        traced signature is byte-identical to a pre-seam program.
+        ``kernel_path`` marks slot-batched kernel programs (they take
+        the pool-form adapter collection under ``lora_kernel``)."""
         i = 0
         ads = None
         if use_lora:
-            ads = _gather_ads(tail[0], sel_ids)
+            ads = _ads_for(tail[0], sel_ids, kernel_path)
             i = 1
         gp = tail[i] if use_gram else None
         return ads, gp
 
-    def _insert_tail(tail):
+    def _insert_tail(tail, kernel_path: bool = False):
         """The insert programs' tail: ``[aids, apool][, gids, gpool]``
         — per-lane ids ride as data beside each pool.  Seams that are
         off synthesize their sentinel ids."""
         i = 0
         if use_lora:
-            aids, ads = tail[0], _gather_ads(tail[1], tail[0])
+            aids, ads = tail[0], _ads_for(tail[1], tail[0], kernel_path)
             i = 2
         else:
             aids, ads = jnp.full(num_slots, _aid_empty, jnp.int32), None
@@ -713,6 +754,36 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         else:
             gids, gp = jnp.full(num_slots, _gid_empty, jnp.int32), None
         return aids, ads, gids, gp
+
+    # -- fused sampling tail (tpudist.ops.fused_sample) ---------------------
+    _interp = jax.devices()[0].platform != "tpu"
+    if use_sample_kernel:
+        from tpudist.ops.fused_sample import (fused_residual_prep,
+                                              fused_sample_prep)
+
+        def _sample_tail(gp, gidx, gstate, logits, keys, temps, counts):
+            """Fused-kernel twin of ``_gmask`` + ``_slot_sample``:
+            constrain mask, greedy argmax, and temperature scaling run
+            as ONE Pallas pass; the categorical draw stays in-graph on
+            the kernel's scaled logits — same fold_in substream, same
+            division, so sampled AND greedy streams are byte-identical
+            to the unfused tail.  Returns ``(toks, masked_logits)``
+            with ``masked_logits`` feeding ``_top_lp``/``_gadvance``
+            unchanged."""
+            ga = gp[0] if gp is not None else None
+            masked, scaled, greedy = fused_sample_prep(
+                logits, temps, ga, gidx, gstate, interpret=_interp)
+
+            def one(key, lg, c):
+                return jax.random.categorical(
+                    jax.random.fold_in(key, c), lg)
+
+            sampled = jax.vmap(one)(keys, scaled, counts).astype(jnp.int32)
+            return jnp.where(temps > 0.0, sampled, greedy), masked
+    else:
+        def _sample_tail(gp, gidx, gstate, logits, keys, temps, counts):
+            lg = _gmask(gp, gidx, gstate, logits)
+            return _slot_sample(lg, keys, temps, counts), lg
 
     init_cache, _step_base = make_decode_step(module, params)
     vocab = module.vocab
@@ -806,9 +877,9 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                 return jnp.where(m, n, o)
 
             cache = jax.tree.map(sel, nc, cache)
-            lg = _gmask(gp, state.gidx, state.gstate, logits[:, 0])
-            toks = _slot_sample(lg, state.keys, state.temps,
-                                state.counts)
+            toks, lg = _sample_tail(gp, state.gidx, state.gstate,
+                                    logits[:, 0], state.keys, state.temps,
+                                    state.counts)
             toks = jnp.where(state.active, toks,
                              state.last_tok).astype(jnp.int32)
             inc = state.active.astype(jnp.int32)
@@ -1023,8 +1094,16 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             temp = jnp.maximum(state.temps, 1e-6)[:, None, None]
             greedy = state.temps <= 0.0
             g_acc = d == jnp.argmax(lt, -1)
-            pt = jax.nn.softmax(lt / temp, -1)
-            pd = jax.nn.softmax(ld / temp, -1)
+            if use_sample_kernel:
+                # one fused pass: both softmaxes + the residual logits
+                # (bit-matching the in-graph formulas below, so the
+                # accept/reject decisions and residual draws are
+                # byte-identical)
+                pt, pd, res_logits = fused_residual_prep(
+                    lt, ld, state.temps, interpret=_interp)
+            else:
+                pt = jax.nn.softmax(lt / temp, -1)
+                pd = jax.nn.softmax(ld / temp, -1)
             pt_d = jnp.take_along_axis(pt, d[..., None], -1)[..., 0]
             pd_d = jnp.take_along_axis(pd, d[..., None], -1)[..., 0]
             cidx = state.counts[:, None] + jnp.arange(k)[None]
@@ -1054,9 +1133,11 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             plain_rows = jax.vmap(lambda key, ls, t, cs: jax.vmap(
                 lambda lgr, c: plain_one(key, lgr, t, c))(ls, cs))(
                 state.keys, logits, state.temps, call).astype(jnp.int32)
-            res = jnp.maximum(pt - pd, 0.0)
-            has_res = res.sum(-1, keepdims=True) > 0.0
-            res_logits = jnp.where(has_res, jnp.log(res + 1e-30), lt / temp)
+            if not use_sample_kernel:
+                res = jnp.maximum(pt - pd, 0.0)
+                has_res = res.sum(-1, keepdims=True) > 0.0
+                res_logits = jnp.where(has_res, jnp.log(res + 1e-30),
+                                       lt / temp)
 
             def res_one(key, lgr, c):
                 kc = jax.random.fold_in(jax.random.fold_in(key, c), 3)
@@ -1417,7 +1498,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             @partial(jax.jit, donate_argnums=(0, 1, 2))
             def spec_verify(state, pkv, dkv, drafts, dlogits, spec_on,
                             rem, *tail):
-                ads, gp = _slot_tail(tail, state.adapter_id)
+                ads, gp = _slot_tail(tail, state.adapter_id,
+                                     kernel_path=attn_kernel == "paged")
                 return _spec_verify_impl(state, pkv, dkv, drafts, dlogits,
                                          spec_on, rem, ads, gp)
 
@@ -1444,7 +1526,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             # ride as vectors.
             dec_kernel_mod = module.clone(
                 decode=True, moe_fn=None, decode_kernel="paged",
-                lora_rank=adapters.rank if use_lora else 0)
+                lora_rank=adapters.rank if use_lora else 0,
+                fused_rope=use_fused_rope, lora_kernel=use_lora_kernel)
 
             def _pool_col(pkv, pos0):
                 # one shared entry per layer; the leaves are the SAME
@@ -1473,6 +1556,65 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                     variables, toks, mutable=["cache"])
                 return mut["cache"], logits.astype(jnp.float32)
 
+        if use_prefill_kernel:
+            # The prefill twin of the decode kernel clone: Block
+            # dispatches to the Pallas paged-PREFILL kernel — each
+            # lane's chunk attends over its pool prefix (walked
+            # in-kernel through the block table) plus itself, and the
+            # touched KV blocks are quantized and emitted IN-KERNEL
+            # (the "pwrites" collection), so no dense [slots, max_len]
+            # lane view is ever materialized and no sequential
+            # teacher-force scan runs.  ONE batched program serves the
+            # whole admission batch AND single-slot chunk extends (the
+            # extend synthesizes a one-hot batch) — program count
+            # stays flat.
+            pre_mod = module.clone(
+                decode=True, moe_fn=None, decode_kernel="paged_prefill",
+                lora_rank=adapters.rank if use_lora else 0,
+                fused_rope=use_fused_rope, lora_kernel=use_lora_kernel)
+
+            def _kernel_prefill(pkv, rows, poss, clens, prompts,
+                                lane_mask, ads):
+                """One batched kernel prefill over ``prompts [S, P]``:
+                returns ``(pkv, last_logits [S, V])`` with the touched
+                blocks committed (storage-form scatter; sentinel
+                write-table entries drop, so masked/zero-clen lanes
+                write nothing).  Table/meta installation is the
+                caller's — insert scatters rows at ``dsts``, extend
+                advances one cursor."""
+                wtables = pg.write_tables(rows, poss, clens, prefill_pad,
+                                          lane_mask)
+                col = dict(pk=pkv.pool_k, pv=pkv.pool_v, sk=pkv.scale_k,
+                           sv=pkv.scale_v, table=rows,
+                           pos0=poss.astype(jnp.int32),
+                           clen=clens.astype(jnp.int32), wtable=wtables)
+                variables = {"params": params["params"],
+                             "pool": {name: col for name in pg.layers}}
+                if not module.rope:
+                    # the learned position table reads per-lane vector
+                    # cursors (the decode kernel path's contract)
+                    variables["cache"] = {"pos": poss.astype(jnp.int32)}
+                if ads is not None:
+                    variables["adapters"] = ads
+                logits, mut = pre_mod.apply(
+                    variables, prompts, mutable=["cache", "pwrites"])
+                pw = mut["pwrites"]
+                qk = jnp.stack([pw[n]["k"] for n in pg.layers])
+                qv = jnp.stack([pw[n]["v"] for n in pg.layers])
+                sk = jnp.stack([pw[n]["sk"] for n in pg.layers])
+                sv = jnp.stack([pw[n]["sv"] for n in pg.layers])
+                n_ids = wtables.size
+                pkv = pg.commit_quantized(
+                    pkv, wtables.reshape(n_ids),
+                    qk.reshape((qk.shape[0], n_ids) + qk.shape[3:]),
+                    qv.reshape((qv.shape[0], n_ids) + qv.shape[3:]),
+                    sk.reshape(sk.shape[0], n_ids, sk.shape[-1]),
+                    sv.reshape(sv.shape[0], n_ids, sv.shape[-1]))
+                last = jnp.take_along_axis(
+                    logits, jnp.clip(clens - 1, 0, logits.shape[1] - 1)
+                    [:, None, None], axis=1)
+                return pkv, last[:, 0].astype(jnp.float32)
+
         def _insert_paged_impl(state, pkv, tables, poss, prompts, clens,
                                dsts, seeds, temps, last, aids, ads,
                                gids, gp):
@@ -1481,19 +1623,31 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             # row: a reused prefix's K/V is already in the pool, so the
             # lane's cursor starts at poss[j] — prefilled once, mapped
             # into every slot that shares it.
-            def lane(row, pos0, p, n, ad):
-                meta1 = jax.tree.map(
-                    lambda t: jnp.asarray(pos0, t.dtype), meta_template)
-                return _force_chunk(pg.lane_cache(pkv, row, meta1), p, n, ad)
+            if use_prefill_kernel:
+                pkv, last_logits = _kernel_prefill(
+                    pkv, tables, poss, clens, prompts,
+                    dsts < num_slots, ads)
+                new_cur = poss + clens
+                pkv = _constrain(pkv._replace(
+                    table=pkv.table.at[dsts].set(tables),
+                    meta=jax.tree.map(
+                        lambda full: full.at[dsts].set(
+                            new_cur.astype(full.dtype)), pkv.meta)))
+            else:
+                def lane(row, pos0, p, n, ad):
+                    meta1 = jax.tree.map(
+                        lambda t: jnp.asarray(pos0, t.dtype), meta_template)
+                    return _force_chunk(pg.lane_cache(pkv, row, meta1),
+                                        p, n, ad)
 
-            lanes, last_logits = jax.vmap(lane)(tables, poss, prompts,
-                                                clens, ads)
+                lanes, last_logits = jax.vmap(lane)(tables, poss, prompts,
+                                                    clens, ads)
+                pkv = _constrain(pg.commit_lanes(pkv, lanes, tables, dsts,
+                                                 poss, prefill_pad))
             keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
             zero = jnp.zeros(num_slots, jnp.int32)
-            firsts = _slot_sample(_gmask(gp, gids, zero, last_logits),
-                                  keys, temps, zero)
-            pkv = _constrain(pg.commit_lanes(pkv, lanes, tables, dsts, poss,
-                                             prefill_pad))
+            firsts = _sample_tail(gp, gids, zero, last_logits,
+                                  keys, temps, zero)[0]
             state = SlotState(
                 last_tok=state.last_tok.at[dsts].set(
                     jnp.where(last, firsts, 0)),
@@ -1513,28 +1667,46 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_batch_paged(state, pkv, tables, poss, prompts, clens,
                                dsts, seeds, temps, last, *tail):
-            aids, ads, gids, gp = _insert_tail(tail)
+            aids, ads, gids, gp = _insert_tail(
+                tail, kernel_path=use_prefill_kernel)
             return _insert_paged_impl(
                 state, pkv, tables, poss, prompts, clens, dsts, seeds,
                 temps, last, aids, ads, gids, gp)
 
         def _prefill_extend_paged_impl(state, pkv, slot, chunk, clen,
                                        is_last, ad, gp):
-            row = pkv.table[slot]
-            meta1 = jax.tree.map(lambda full: full[slot], pkv.meta)
-            pos0 = _cache_cursor(meta1)
-            cache, last_logits = _force_chunk(
-                pg.lane_cache(pkv, row, meta1), chunk, clen, ad)
-            pkv = _constrain(pg.commit_lanes(
-                pkv, jax.tree.map(lambda a: a[None], cache),
-                row[None], jnp.reshape(slot, (1,)), jnp.reshape(pos0, (1,)),
-                prefill_pad))
+            if use_prefill_kernel:
+                # one-hot batch through the SAME batched kernel-prefill
+                # program as insert (zero-clen lanes' write tables are
+                # all-sentinel, so they commit nothing) — chunked
+                # prefill adds no second program shape.
+                onehot = jnp.arange(num_slots) == slot
+                poss = _cache_cursor(pkv.meta)
+                prompts1 = jnp.zeros((num_slots, prefill_pad),
+                                     jnp.int32).at[slot].set(chunk)
+                clens = jnp.where(onehot, clen, 0).astype(jnp.int32)
+                pkv, last_all = _kernel_prefill(
+                    pkv, pkv.table, poss, clens, prompts1, onehot, ad)
+                last_logits = last_all[slot]
+                pkv = _constrain(pkv._replace(meta=jax.tree.map(
+                    lambda full: full.at[slot].add(
+                        jnp.asarray(clen, full.dtype)), pkv.meta)))
+            else:
+                row = pkv.table[slot]
+                meta1 = jax.tree.map(lambda full: full[slot], pkv.meta)
+                pos0 = _cache_cursor(meta1)
+                cache, last_logits = _force_chunk(
+                    pg.lane_cache(pkv, row, meta1), chunk, clen, ad)
+                pkv = _constrain(pg.commit_lanes(
+                    pkv, jax.tree.map(lambda a: a[None], cache),
+                    row[None], jnp.reshape(slot, (1,)),
+                    jnp.reshape(pos0, (1,)), prefill_pad))
             gi = state.gidx[slot][None]
             gs = state.gstate[slot][None]
-            first = _slot_sample(
-                _gmask(gp, gi, gs, last_logits[None]),
+            first = _sample_tail(
+                gp, gi, gs, last_logits[None],
                 state.keys[slot][None],
-                state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
+                state.temps[slot][None], jnp.zeros(1, jnp.int32))[0][0]
             state = state._replace(
                 pos=state.pos.at[slot].add(clen),
                 active=state.active.at[slot].set(is_last),
@@ -1549,7 +1721,12 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         @partial(jax.jit, donate_argnums=(0, 1))
         def prefill_extend_paged(state, pkv, slot, chunk, clen,
                                  is_last, *tail):
-            ad, gp = _slot_tail(tail, state.adapter_id[slot])
+            if use_prefill_kernel:
+                # the one-hot batched program runs EVERY lane's adapter
+                ad, gp = _slot_tail(tail, state.adapter_id,
+                                    kernel_path=True)
+            else:
+                ad, gp = _slot_tail(tail, state.adapter_id[slot])
             return _prefill_extend_paged_impl(
                 state, pkv, slot, chunk, clen, is_last, ad, gp)
 
@@ -1575,10 +1752,10 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
                         variables,
                         state.last_tok[:, None], mutable=["cache"])
                     view = _sel_active(state.active, mut["cache"], view)
-                    lg = _gmask(gp, state.gidx, state.gstate,
-                                logits[:, -1].astype(jnp.float32))
-                    toks = _slot_sample(lg, state.keys,
-                                        state.temps, state.counts)
+                    toks, lg = _sample_tail(
+                        gp, state.gidx, state.gstate,
+                        logits[:, -1].astype(jnp.float32),
+                        state.keys, state.temps, state.counts)
                     toks = jnp.where(state.active, toks,
                                      state.last_tok).astype(jnp.int32)
                     inc = state.active.astype(jnp.int32)
@@ -1600,7 +1777,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
 
             @partial(jax.jit, static_argnums=2, donate_argnums=(0, 1))
             def decode_block_paged(state, pkv, k, *tail):
-                ads, gp = _slot_tail(tail, state.adapter_id)
+                ads, gp = _slot_tail(tail, state.adapter_id,
+                                     kernel_path=True)
                 return _decode_kernel_impl(state, pkv, k, ads, gp)
         else:
             def _decode_paged_impl(state, pkv, k, ads, gp):
@@ -1690,8 +1868,8 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
         )(prompts, clens, ads)
         keys = jax.vmap(jax.random.PRNGKey)(seeds).astype(jnp.uint32)
         zero = jnp.zeros(num_slots, jnp.int32)
-        firsts = _slot_sample(_gmask(gp, gids, zero, last_logits),
-                              keys, temps, zero)
+        firsts = _sample_tail(gp, gids, zero, last_logits,
+                              keys, temps, zero)[0]
         # Scatter lane j into slot dsts[j].  Unused lanes carry the
         # sentinel dst num_slots: out-of-bounds scatter indices are
         # DROPPED (jax's default scatter mode), so one fixed-shape
@@ -1731,10 +1909,10 @@ def make_slot_decode(module, params, num_slots: int, prefill_pad: int,
             cache, lane))
         gi = state.gidx[slot][None]
         gs = state.gstate[slot][None]
-        first = _slot_sample(
-            _gmask(gp, gi, gs, last_logits[None]),
+        first = _sample_tail(
+            gp, gi, gs, last_logits[None],
             state.keys[slot][None],
-            state.temps[slot][None], jnp.zeros(1, jnp.int32))[0]
+            state.temps[slot][None], jnp.zeros(1, jnp.int32))[0][0]
         state = state._replace(
             pos=state.pos.at[slot].add(clen),
             active=state.active.at[slot].set(is_last),

@@ -190,6 +190,24 @@ def gather_collection(pool: AdapterPool, ids, n_layers: int,
     return out
 
 
+def pool_collection(pool: AdapterPool, ids, n_layers: int,
+                    layer_prefix: str = "block_") -> Dict[str, Any]:
+    """The ``"adapters"`` collection in POOL form, for
+    ``Block.lora_kernel`` programs: every layer's dict carries the FULL
+    factor pools (the same arrays — no gather, no copy; flax just sees
+    one tracer per leaf) plus the per-slot ``ids`` vector, and the
+    Pallas gather-matmul (:func:`tpudist.ops.fused_linear.lora_delta`)
+    DMAs each slot's factor block inside the kernel.  ``on`` keeps the
+    bit-exact base select for sentinel ids, same as
+    :func:`gather_collection`."""
+    ids = jnp.asarray(ids, jnp.int32)
+    B = pool.a_qkv.shape[1]
+    col: Dict[str, Any] = {key: getattr(pool, key) for key in FACTOR_KEYS}
+    col["ids"] = ids
+    col["on"] = ids < B
+    return {f"{layer_prefix}{i}": col for i in range(n_layers)}
+
+
 def adapter_collection(factors: Dict[str, Any], n_layers: int,
                        on: bool = True,
                        layer_prefix: str = "block_") -> Dict[str, Any]:

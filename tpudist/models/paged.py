@@ -398,6 +398,46 @@ class _Paged:
             vals_of(overlay(old_v, wv)))
         return pkv._replace(meta=strip_kv(view))
 
+    def write_tables(self, rows: jax.Array, pos0: jax.Array,
+                     clen: jax.Array, span: int,
+                     lane_mask: jax.Array) -> jax.Array:
+        """The prefill KERNEL path's write table: physical ids of the
+        blocks each lane's chunk ``[pos0, pos0 + clen)`` touches,
+        aligned so entry ``w`` is logical block ``pos0 // bs + w``.
+        ``rows [S, M]`` per-lane block ids, ``span`` the static chunk
+        capacity (bounds the width at ``_touch_count(span)``); entries
+        past a lane's actual ``ceil`` span — and whole masked lanes —
+        hold the sentinel, so the kernel's garbage blocks drop at the
+        commit scatter.  Unlike ``_commit`` this charges only the
+        blocks the chunk actually covers (the honest write-bytes
+        story), not the full static span."""
+        bs, B = self.cfg.block_size, self.cfg.num_blocks
+        M, T = self.blocks_per_slot, self._touch_count(span)
+        t0 = pos0 // bs
+        n_t = jnp.where(clen > 0, (pos0 + clen - 1) // bs - t0 + 1, 0)
+        logical = t0[:, None] + jnp.arange(T)[None]           # [S, T]
+        ids = jnp.take_along_axis(rows, jnp.minimum(logical, M - 1), axis=1)
+        live = (jnp.arange(T)[None] < n_t[:, None]) & (logical < M) \
+            & lane_mask[:, None]
+        return jnp.where(live, ids, B).astype(jnp.int32)
+
+    def commit_quantized(self, pkv: PagedKV, ids: jax.Array,
+                         qk: jax.Array, qv: jax.Array,
+                         sk: jax.Array, sv: jax.Array) -> PagedKV:
+        """Adopt blocks ALREADY in storage form (the prefill kernel
+        quantizes in-registers with ``_scatter_values``'s exact
+        formula): raw scatter at ``ids [N]`` (sentinel drops), scales
+        taken as given in int8 mode, ignored otherwise.  ``qk``/``qv``
+        ``[L, N, n_kv, bs, dh]``, ``sk``/``sv [L, N, n_kv]``."""
+        pkv = pkv._replace(
+            pool_k=pkv.pool_k.at[:, ids].set(qk.astype(self.storage_dtype)),
+            pool_v=pkv.pool_v.at[:, ids].set(qv.astype(self.storage_dtype)))
+        if self.cfg.quantized:
+            pkv = pkv._replace(
+                scale_k=pkv.scale_k.at[:, ids].set(sk),
+                scale_v=pkv.scale_v.at[:, ids].set(sv))
+        return pkv
+
     # -- KV handoff (prefill/decode disaggregation) -------------------------
 
     def extract_lane(self, pkv: PagedKV, slot: jax.Array

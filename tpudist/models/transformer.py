@@ -270,9 +270,28 @@ class Block(nn.Module):
     #               collection ({pk, pv, sk, sv, table, pos0} per
     #               layer) — built by the slot-decode programs
     #               (tpudist.models.generate), never flax-initialized.
+    #   "paged_prefill" — the Pallas paged-PREFILL kernel
+    #               (tpudist.ops.paged_prefill): multi-token chunks per
+    #               slot attend over the pool prefix AND emit their
+    #               quantized KV block writes in-kernel, sown into a
+    #               "pwrites" collection the slot-decode program commits
+    #               (no dense lane view, no sequential teacher-force).
     decode_kernel: Optional[str] = None
     # static layer index into the [L, ...] pool (decode_kernel only)
     layer_idx: int = 0
+    # Fused RoPE+QKV projection (tpudist.ops.fused_linear.fused_rope_qkv)
+    # on the paged decode/prefill arms: the qkv matmul, head split, and
+    # rotary rotation run as one kernel on the per-slot cursor vector;
+    # the attention arm receives q/k already rotated.  Param tree is
+    # unchanged (_Kernel declares the same qkv/kernel param).
+    fused_rope: bool = False
+    # In-kernel LoRA gather-matmul (tpudist.ops.fused_linear.lora_delta):
+    # the "adapters" collection carries the FULL factor pools plus the
+    # per-slot ``ids`` vector (tpudist.models.lora.pool_collection)
+    # instead of pre-gathered factors — each slot's grid step DMAs only
+    # its own factor block.  Batched slot programs only (the vmapped
+    # gather path keeps gather_collection).
+    lora_kernel: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -294,14 +313,30 @@ class Block(nn.Module):
                     "lora_rank adapters wrap the plain qkv/wi/wo Dense "
                     "path; they cannot compose with an MoE FFN or an "
                     "injected mlp_fn (the fused MLP hides the wi/wo seam)")
-            ad = {k: self.get_variable("adapters", k)
-                  for k in ("a_qkv", "b_qkv", "a_wi", "b_wi",
-                            "a_wo", "b_wo", "on")}
+            keys = ("a_qkv", "b_qkv", "a_wi", "b_wi", "a_wo", "b_wo", "on")
+            if self.lora_kernel:
+                keys = keys + ("ids",)   # pool form: full pools + ids
+            ad = {k: self.get_variable("adapters", k) for k in keys}
             if ad["a_qkv"] is None:
                 raise ValueError(
                     "lora_rank > 0 requires an 'adapters' collection "
                     "(tpudist.models.lora.gather_collection / "
                     "adapter_collection) supplied with apply()")
+
+        def _delta(h_in, a_key, b_key):
+            """The raw adapter delta ``(h·A)·B`` — in-graph against the
+            pre-gathered factors, or through the Pallas gather-matmul
+            when lora_kernel (the pool rides whole; each slot's grid
+            step DMAs only its own factor block)."""
+            if self.lora_kernel:
+                from tpudist.ops.fused_linear import lora_delta
+                interpret = jax.devices()[0].platform != "tpu"
+                return lora_delta(
+                    h_in.astype(self.dtype), ad[a_key], ad[b_key],
+                    ad["ids"], layer=self.layer_idx, interpret=interpret)
+            a = ad[a_key].astype(self.dtype)
+            bm = ad[b_key].astype(self.dtype)
+            return (h_in.astype(self.dtype) @ a) @ bm
 
         def _ad(y, h_in, a_key, b_key):
             """``where(on, y + (h·A)·B, y)`` — the adapter delta as a
@@ -310,9 +345,7 @@ class Block(nn.Module):
             discipline applied to parameters)."""
             if ad is None:
                 return y
-            a = ad[a_key].astype(self.dtype)
-            bm = ad[b_key].astype(self.dtype)
-            delta = (h_in.astype(self.dtype) @ a) @ bm
+            delta = _delta(h_in, a_key, b_key)
             on = jnp.asarray(ad["on"])
             m = on.reshape(on.shape + (1,) * (y.ndim - on.ndim))
             return jnp.where(m, y + delta, y)
@@ -320,28 +353,63 @@ class Block(nn.Module):
         # LayerNorm statistics in f32 for stability; projections compute in
         # ``dtype`` (flax casts inputs + the f32 master params at apply).
         h = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)
-        qkv = nn.Dense(self.d_model + 2 * kv_dim, use_bias=False,
-                       name="qkv", dtype=self.dtype)(h)
-        qkv = _ad(qkv, h, "a_qkv", "b_qkv")
-        q = qkv[..., : self.d_model]
-        k = qkv[..., self.d_model : self.d_model + kv_dim]
-        v = qkv[..., self.d_model + kv_dim :]
+        use_fused_qkv = self.fused_rope and not self.is_initializing()
+        if use_fused_qkv and not (
+                self.decode
+                and self.decode_kernel in ("paged", "paged_prefill")):
+            raise ValueError(
+                "fused_rope fuses the QKV projection with the per-slot "
+                "rope offsets of the paged decode/prefill arms — set "
+                "decode_kernel='paged'/'paged_prefill' (training and the "
+                "dense decode path keep the unfused projection)")
+        rotated = False
+        if use_fused_qkv:
+            from tpudist.ops.fused_linear import fused_rope_qkv
+            # same qkv/kernel param as the Dense twin (_Kernel seam)
+            w = _Kernel((self.d_model, self.d_model + 2 * kv_dim),
+                        name="qkv")()
+            if self.decode_kernel == "paged":
+                offs = self.get_variable("cache", "idx")  # absolute cursors
+            else:
+                offs = self.get_variable("pool", "pos0")  # chunk starts
+            extra = on = None
+            if ad is not None:
+                extra = _delta(h, "a_qkv", "b_qkv")
+                on = jnp.asarray(ad["on"]).astype(jnp.int32)
+            q, k, v = fused_rope_qkv(
+                h.astype(self.dtype), w.astype(self.dtype),
+                offs.astype(jnp.int32), extra, on,
+                n_heads=self.n_heads, n_kv=n_kv, dh=dh, rope=self.rope,
+                interpret=jax.devices()[0].platform != "tpu")
+            rotated = True
+        else:
+            qkv = nn.Dense(self.d_model + 2 * kv_dim, use_bias=False,
+                           name="qkv", dtype=self.dtype)(h)
+            qkv = _ad(qkv, h, "a_qkv", "b_qkv")
+            q = qkv[..., : self.d_model]
+            k = qkv[..., self.d_model : self.d_model + kv_dim]
+            v = qkv[..., self.d_model + kv_dim :]
 
-        def heads(t, n):  # [b, s, n·dh] -> [b, n, s, dh]
-            b, s, _ = t.shape
-            return t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
+            def heads(t, n):  # [b, s, n·dh] -> [b, n, s, dh]
+                b, s, _ = t.shape
+                return t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
 
-        q = heads(q, self.n_heads)
-        k = heads(k, n_kv)
-        v = heads(v, n_kv)
+            q = heads(q, self.n_heads)
+            k = heads(k, n_kv)
+            v = heads(v, n_kv)
         if self.decode:
             if self.decode_kernel == "paged":
-                attn = self._decode_attention_paged(q, k, v)
+                attn = self._decode_attention_paged(q, k, v,
+                                                    rotated=rotated)
+            elif self.decode_kernel == "paged_prefill":
+                attn = self._prefill_attention_paged(q, k, v,
+                                                     rotated=rotated)
             elif self.decode_kernel is not None:
                 raise ValueError(
                     f"unknown decode_kernel {self.decode_kernel!r} "
                     "(None = dense cached softmax, 'paged' = the Pallas "
-                    "paged-attention kernel)")
+                    "paged-attention decode kernel, 'paged_prefill' = "
+                    "the Pallas paged-prefill kernel)")
             else:
                 attn = self._decode_attention(q, k, v)
         else:
@@ -448,7 +516,7 @@ class Block(nn.Module):
                          preferred_element_type=jnp.float32)
         return out.reshape(b, nh, s, dh).astype(q.dtype)
 
-    def _decode_attention_paged(self, q, k, v):
+    def _decode_attention_paged(self, q, k, v, rotated=False):
         """Cached decode attention through the Pallas paged-attention
         kernel (:func:`tpudist.ops.paged_attention`): the KV pool stays
         paged — the kernel walks this slot batch's block tables in its
@@ -488,7 +556,7 @@ class Block(nn.Module):
         ci = self.variable("cache", "idx", _missing)
         pos = ci.value                      # [b] absolute cursors
         fill = (pos - pos0).astype(jnp.int32)   # window tokens already in
-        if self.rope:
+        if self.rope and not rotated:
             q = rope_rotate(q, offset=pos)
             k = rope_rotate(k, offset=pos)
         # append this call's K/V at each lane's window offset (the
@@ -509,6 +577,59 @@ class Block(nn.Module):
             pos0.astype(jnp.int32), fill, ck.value, cv.value,
             layer=self.layer_idx, window=self.sliding_window,
             interpret=interpret)
+
+    def _prefill_attention_paged(self, q, k, v, rotated=False):
+        """Chunked-prefill attention through the Pallas paged-PREFILL
+        kernel (:func:`tpudist.ops.paged_prefill`): every slot's
+        multi-token chunk attends over its pool prefix (walked in-kernel
+        via the block table) plus itself (causal), and the blocks the
+        chunk touches are quantized and emitted IN-KERNEL — sown into
+        the mutable "pwrites" collection (per layer: ``k``/``v``
+        ``[S, Mw, n_kv, bs, dh]`` storage dtype + ``sk``/``sv``
+        ``[S, Mw, n_kv]`` scales) for the slot-decode program to
+        scatter with ``_Paged.commit_quantized``.  No dense lane view,
+        no sequential teacher-force scan: the whole admission batch
+        prefills in one dispatch per layer.
+
+        The pool collection carries two extra leaves next to the decode
+        arm's: ``clen [S]`` (each lane's live chunk length) and
+        ``wtable [S, Mw]`` (the touched blocks' physical ids, sentinel
+        past the lane's span — ``_Paged.write_tables``)."""
+
+        def _missing():
+            raise ValueError(
+                "decode_kernel='paged_prefill' pools are built by the "
+                "slot-decode prefill programs (tpudist.models.generate."
+                "make_slot_decode(prefill_kernel=True)) — they are "
+                "supplied with apply(), never flax-initialized")
+
+        pool_k = self.get_variable("pool", "pk")
+        pool_v = self.get_variable("pool", "pv")
+        scale_k = self.get_variable("pool", "sk")
+        scale_v = self.get_variable("pool", "sv")
+        table = self.get_variable("pool", "table")
+        pos0 = self.get_variable("pool", "pos0")
+        clen = self.get_variable("pool", "clen")
+        wtable = self.get_variable("pool", "wtable")
+        if pool_k is None or wtable is None:
+            _missing()
+        if self.rope and not rotated:
+            q = rope_rotate(q, offset=pos0)
+            k = rope_rotate(k, offset=pos0)
+        from tpudist.ops.paged_prefill import paged_prefill_attention
+
+        interpret = jax.devices()[0].platform != "tpu"
+        o, qk, qv, sk, sv = paged_prefill_attention(
+            q, k.astype(self.dtype), v.astype(self.dtype),
+            pool_k, pool_v, scale_k, scale_v, table, wtable,
+            pos0.astype(jnp.int32), clen.astype(jnp.int32),
+            layer=self.layer_idx, window=self.sliding_window,
+            interpret=interpret)
+        self.put_variable("pwrites", "k", qk)
+        self.put_variable("pwrites", "v", qv)
+        self.put_variable("pwrites", "sk", sk)
+        self.put_variable("pwrites", "sv", sv)
+        return o
 
 
 class TransformerLM(nn.Module):
@@ -554,6 +675,12 @@ class TransformerLM(nn.Module):
     # 0 compiles it out; > 0 makes apply() take an "adapters"
     # collection of gathered rank-r LoRA factors (tpudist.models.lora).
     lora_rank: int = 0
+    # Fused RoPE+QKV projection on the paged decode/prefill arms
+    # (see Block.fused_rope).
+    fused_rope: bool = False
+    # In-kernel LoRA gather-matmul (see Block.lora_kernel): the
+    # "adapters" collection carries full pools + per-slot ids.
+    lora_kernel: bool = False
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activation memory drops from O(layers × per-block internals) to the
     # block boundaries, at ~1 extra forward of FLOPs — the lever that fits
@@ -656,7 +783,8 @@ class TransformerLM(nn.Module):
                 n_kv_heads=self.n_kv_heads, decode=self.decode,
                 max_len=self.max_len, sliding_window=self.sliding_window,
                 decode_kernel=self.decode_kernel, layer_idx=i,
-                lora_rank=self.lora_rank,
+                lora_rank=self.lora_rank, fused_rope=self.fused_rope,
+                lora_kernel=self.lora_kernel,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, dtype=jnp.float32)(x)

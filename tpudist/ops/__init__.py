@@ -10,6 +10,16 @@ as fallbacks and in correctness tests (interpret mode on CPU).
   KV cache's block table INSIDE the kernel (vLLM-PagedAttention style):
   live blocks only, int8 dequant in-registers, the decode-window mask
   fused so s=1 decode and the speculative verify share one kernel.
+- :mod:`paged_prefill` — the prefill sibling: walks the reused prefix
+  out of the pool, runs the chunk's causal self-attention, and WRITES
+  the touched KV blocks in-kernel (merge + requantize), closing the
+  last dense ``[slots, max_len]`` materialization.
+- :mod:`fused_sample` — the decode tail (constrain mask, greedy argmax,
+  temperature, top-k/top-p, spec-decode residual prep) in one kernel;
+  random draws stay in-graph so sampled streams are byte-identical.
+- :mod:`fused_linear` — fused RoPE+QKV projection on per-slot vector
+  offsets, and the LoRA gather-matmul addressed through
+  scalar-prefetched adapter ids.
 """
 
 from tpudist.ops.flash_attention import (  # noqa: F401
@@ -25,4 +35,20 @@ from tpudist.ops.fused_mlp import (  # noqa: F401
     fused_mlp,
     mlp_reference,
     pad_params,
+)
+from tpudist.ops.paged_prefill import (  # noqa: F401
+    paged_prefill_attention,
+    paged_prefill_reference,
+)
+from tpudist.ops.fused_sample import (  # noqa: F401
+    fused_residual_prep,
+    fused_residual_reference,
+    fused_sample_prep,
+    fused_sample_reference,
+)
+from tpudist.ops.fused_linear import (  # noqa: F401
+    fused_rope_qkv,
+    fused_rope_qkv_reference,
+    lora_delta,
+    lora_delta_reference,
 )

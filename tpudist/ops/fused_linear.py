@@ -1,0 +1,280 @@
+"""Pallas TPU fused linear kernels for the decode hot path: RoPE+QKV
+projection and the LoRA gather-matmul.
+
+:func:`fused_rope_qkv` fuses the decode step's QKV projection, the
+head split/transpose, and the rotary embedding into one kernel over a
+``(slots,)`` grid.  The unfused path runs these as separate HLOs —
+Dense matmul, three slices, three reshape/transposes, then
+``rope_rotate``'s trig tower — each round-tripping the ``[S, T, d]``
+activations through HBM.  Here the weight tile stays resident in VMEM
+across the slot loop, the per-slot VECTOR offsets (PR 11's paged
+cursors) ride in as a scalar-prefetch operand, and the rotation applies
+in-registers right after the matmul, bit-matching
+``transformer.rope_rotate`` (same f32 angle/trig math, same half-split
+layout).  The optional ``extra`` operand is the LoRA delta, applied
+pre-rotation under its ``on`` mask — exactly where ``Block._ad``
+applies it on the unfused path.
+
+:func:`lora_delta` is the in-kernel LoRA gather-matmul: instead of
+``gather_collection`` materializing each slot's ``[d_in, r]`` /
+``[r, d_out]`` factors with an in-graph gather before a batched double
+matmul, the FULL adapter pool rides in and each slot's grid step DMAs
+only its own factor block, addressed through the scalar-prefetched
+adapter ids — the same indirection discipline as the paged KV walk
+(sentinel ids clamp; the caller keeps the ``on`` mask select, so
+adapter-less slots stay bit-identical to the base model).
+
+``interpret=True`` (any non-TPU backend) is the tier-1 CPU path.
+Weight/factor tiles are loaded whole per grid step — fine for the model
+sizes this repo runs; tile the contraction dimension before pointing
+this at multi-GB weights.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+
+def _rope_qkv_kernel(off_ref, on_ref, h_ref, w_ref, e_ref,
+                     q_ref, k_ref, v_ref, *, n_heads: int, n_kv: int,
+                     dh: int, base: float, rope: bool, has_extra: bool):
+    """One slot: matmul -> (+ masked LoRA delta) -> split -> rotate."""
+    s = pl.program_id(0)
+    hm = h_ref[0]                                      # [T, d]
+    qkv = jnp.dot(hm, w_ref[...])                      # [T, d + 2*kv_dim]
+    if has_extra:
+        qkv = jnp.where(on_ref[s] != 0, qkv + e_ref[0], qkv)
+    T = hm.shape[0]
+
+    def heads(t, n):                                   # [T, n*dh] -> [n, T, dh]
+        return t.reshape(T, n, dh).transpose(1, 0, 2)
+
+    qh = heads(qkv[:, : n_heads * dh], n_heads)
+    kh = heads(qkv[:, n_heads * dh: (n_heads + n_kv) * dh], n_kv)
+    vh = heads(qkv[:, (n_heads + n_kv) * dh:], n_kv)
+    if rope:
+        # mirror transformer.rope_rotate bit-for-bit: f32 angles from
+        # the slot's absolute offset, GPT-NeoX half-split rotation
+        half = dh // 2
+        freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+        positions = off_ref[s].astype(jnp.float32) + jnp.arange(
+            T, dtype=jnp.float32)
+        angles = positions[:, None] * freqs[None]      # [T, half]
+        sin, cos = jnp.sin(angles), jnp.cos(angles)
+
+        def rot(x):                                    # [n, T, dh]
+            x1 = x[..., :half].astype(jnp.float32)
+            x2 = x[..., half:].astype(jnp.float32)
+            return jnp.concatenate(
+                [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                axis=-1).astype(x.dtype)
+
+        qh, kh = rot(qh), rot(kh)
+    q_ref[0] = qh.astype(q_ref.dtype)
+    k_ref[0] = kh.astype(k_ref.dtype)
+    v_ref[0] = vh.astype(v_ref.dtype)
+
+
+def fused_rope_qkv(
+    h: jax.Array,
+    w: jax.Array,
+    offsets: jax.Array,
+    extra: jax.Array | None = None,
+    on: jax.Array | None = None,
+    *,
+    n_heads: int,
+    n_kv: int,
+    dh: int,
+    base: float = 10000.0,
+    rope: bool = True,
+    interpret: bool = False,
+):
+    """Fused QKV projection + head split + rotary embedding.
+
+    - ``h [S, T, d]`` — post-norm activations in the compute dtype;
+    - ``w [d, n_heads*dh + 2*n_kv*dh]`` — the ``qkv`` Dense kernel
+      (same param, fetched via ``_Kernel``), compute dtype;
+    - ``offsets [S]`` int32 — each slot's absolute position of the
+      window's first token (the rope offset vector);
+    - ``extra [S, T, d + 2*kv_dim]`` — optional additive delta (the
+      LoRA qkv delta), applied pre-rotation where ``on [S]`` is
+      nonzero — the ``Block._ad`` contract in-kernel;
+    - ``rope=False`` skips rotation (non-rope models still win the
+      dispatch fusion).
+
+    Returns ``(q [S, n_heads, T, dh], k [S, n_kv, T, dh], v)`` with q/k
+    already rotated — feed straight to the attention arms with their
+    own rope skipped.
+    """
+    S, T, d = h.shape
+    dtot = w.shape[1]
+    if w.shape[0] != d or dtot != (n_heads + 2 * n_kv) * dh:
+        raise ValueError(f"qkv kernel shape {w.shape} does not match "
+                         f"d={d}, n_heads={n_heads}, n_kv={n_kv}, dh={dh}")
+    has_extra = extra is not None
+    if on is None:
+        on = jnp.ones((S,), jnp.int32)
+    scalars = (offsets.astype(jnp.int32), on.astype(jnp.int32))
+
+    def hidx(s, *_):
+        return (s, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, T, d), hidx),
+        pl.BlockSpec((d, dtot), lambda s, *_: (0, 0)),
+    ]
+    operands = scalars + (h, w)
+    if has_extra:
+        in_specs.append(pl.BlockSpec((1, T, dtot), hidx))
+        operands = operands + (extra,)
+
+    def kernel(*refs):
+        off_ref, on_ref = refs[0], refs[1]
+        h_ref, w_ref = refs[2], refs[3]
+        e_ref = refs[4] if has_extra else None
+        outs = refs[5:] if has_extra else refs[4:]
+        _rope_qkv_kernel(off_ref, on_ref, h_ref, w_ref, e_ref, *outs,
+                         n_heads=n_heads, n_kv=n_kv, dh=dh, base=base,
+                         rope=rope, has_extra=has_extra)
+
+    def oidx(s, *_):
+        return (s, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, n_heads, T, dh), oidx),
+            pl.BlockSpec((1, n_kv, T, dh), oidx),
+            pl.BlockSpec((1, n_kv, T, dh), oidx),
+        ],
+    )
+    q, k, v = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((S, n_heads, T, dh), h.dtype),
+            jax.ShapeDtypeStruct((S, n_kv, T, dh), h.dtype),
+            jax.ShapeDtypeStruct((S, n_kv, T, dh), h.dtype),
+        ),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(2 * S * T * d * dtot),
+            transcendentals=int(S * T * dh),
+            bytes_accessed=int((h.size + S * w.size + 3 * S * T * dtot)
+                               * h.dtype.itemsize),
+        ),
+        interpret=interpret,
+    )(*operands)
+    return q, k, v
+
+
+def fused_rope_qkv_reference(h, w, offsets, extra=None, on=None, *,
+                             n_heads, n_kv, dh, base=10000.0, rope=True):
+    """Plain-jnp twin: Dense matmul + `_ad` select + head split +
+    `rope_rotate`, composed exactly as `Block.__call__` does."""
+    from tpudist.models.transformer import rope_rotate
+    S, T, d = h.shape
+    qkv = h @ w
+    if extra is not None:
+        m = (on if on is not None else jnp.ones((S,), bool))
+        qkv = jnp.where(m[:, None, None] != 0, qkv + extra, qkv)
+
+    def heads(t, n):
+        return t.reshape(S, T, n, dh).transpose(0, 2, 1, 3)
+
+    q = heads(qkv[..., : n_heads * dh], n_heads)
+    k = heads(qkv[..., n_heads * dh: (n_heads + n_kv) * dh], n_kv)
+    v = heads(qkv[..., (n_heads + n_kv) * dh:], n_kv)
+    if rope:
+        q = rope_rotate(q, base=base, offset=offsets)
+        k = rope_rotate(k, base=base, offset=offsets)
+    return q, k, v
+
+
+def _lora_kernel(ids_ref, x_ref, a_ref, b_ref, o_ref):
+    """One slot: double matmul against its own factor block."""
+    x = x_ref[0]                                       # [T, din]
+    a = a_ref[0, 0].astype(x.dtype)                    # [din, r]
+    bm = b_ref[0, 0].astype(x.dtype)                   # [r, dout]
+    o_ref[0] = jnp.dot(jnp.dot(x, a), bm).astype(o_ref.dtype)
+
+
+def lora_delta(
+    x: jax.Array,
+    pool_a: jax.Array,
+    pool_b: jax.Array,
+    ids: jax.Array,
+    *,
+    layer: int,
+    interpret: bool = False,
+):
+    """In-kernel LoRA gather-matmul: ``delta[s] = (x[s] @ A[ids[s]]) @
+    B[ids[s]]`` without materializing the gathered factors.
+
+    - ``x [S, T, d_in]`` — activations in the compute dtype;
+    - ``pool_a [L, B, d_in, r]`` / ``pool_b [L, B, r, d_out]`` — the
+      FULL adapter pool (f32 factors, cast to the compute dtype
+      in-registers, matching ``Block._ad``);
+    - ``ids [S]`` int32 — per-slot adapter block ids (sentinel ``B`` =
+      no adapter; clamped here, masked by the caller's ``on`` select).
+
+    Returns ``[S, T, d_out]`` in ``x.dtype``.
+    """
+    S, T, d_in = x.shape
+    L, B, _, r = pool_a.shape
+    d_out = pool_b.shape[-1]
+    if not 0 <= layer < L:
+        raise ValueError(f"layer {layer} out of range [0, {L})")
+
+    def a_index(s, ids_ref):
+        return (layer, jnp.minimum(ids_ref[s], B - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, T, d_in), lambda s, *_: (s, 0, 0)),
+            pl.BlockSpec((1, 1, d_in, r), a_index),
+            pl.BlockSpec((1, 1, r, d_out), a_index),
+        ],
+        out_specs=pl.BlockSpec((1, T, d_out), lambda s, *_: (s, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _lora_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, T, d_out), x.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(2 * S * T * r * (d_in + d_out)),
+            transcendentals=0,
+            bytes_accessed=int(
+                (x.size + S * (d_in * r + r * d_out) + S * T * d_out)
+                * x.dtype.itemsize),
+        ),
+        interpret=interpret,
+    )(ids.astype(jnp.int32), x, pool_a, pool_b)
+    return out
+
+
+def lora_delta_reference(x, pool_a, pool_b, ids, *, layer):
+    """Plain-jnp twin: `gather_collection`'s gather + `Block._ad`'s
+    double matmul."""
+    B = pool_a.shape[1]
+    rows = jnp.minimum(ids, B - 1)
+    a = pool_a[layer][rows].astype(x.dtype)            # [S, d_in, r]
+    bm = pool_b[layer][rows].astype(x.dtype)           # [S, r, d_out]
+    return (x @ a) @ bm

@@ -282,7 +282,15 @@ class DisaggServer(_Observability):
         self.prefill_pool: List[SlotEngine] = [
             SlotEngine(module, params, num_slots=p_slots, decode_block=1,
                        prefix_cache_blocks=cfg.prefix_cache_blocks,
-                       attn_kernel="gather", **shared)
+                       attn_kernel="gather",
+                       # the prefill kernel is THIS pool's hot path; the
+                       # fused-RoPE/LoRA kernels only ride here when it
+                       # is on (the pool's decode arm stays gather)
+                       prefill_kernel=cfg.prefill_kernel,
+                       sample_kernel=cfg.sample_kernel,
+                       fused_rope=cfg.fused_rope and cfg.prefill_kernel,
+                       lora_kernel=cfg.lora_kernel and cfg.prefill_kernel,
+                       **shared)
             for _ in range(max(1, cfg.prefill_workers))]
         # the DECODE pool owns the speculative draft (prefill workers
         # never decode, so a draft there is dead weight); handoff
@@ -297,6 +305,10 @@ class DisaggServer(_Observability):
                        prefix_cache_blocks=0,
                        spec_draft=cfg.resolve_spec_draft(module),
                        spec_k=cfg.spec_k, attn_kernel=cfg.attn_kernel,
+                       prefill_kernel=cfg.prefill_kernel,
+                       sample_kernel=cfg.sample_kernel,
+                       fused_rope=cfg.fused_rope,
+                       lora_kernel=cfg.lora_kernel,
                        **shared)
             for _ in range(max(1, cfg.decode_workers))]
         self.handoff_mode = cfg.handoff

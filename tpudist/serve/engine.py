@@ -105,6 +105,10 @@ class SlotEngine:
                  mesh=None,
                  spec_draft=None, spec_k: int = 4,
                  attn_kernel: Optional[str] = None,
+                 prefill_kernel: bool = False,
+                 sample_kernel: bool = False,
+                 fused_rope: bool = False,
+                 lora_kernel: bool = False,
                  adapters: bool = False, adapter_blocks: int = 8,
                  adapter_rank: int = 8,
                  constrain=None, logprobs: int = 0):
@@ -126,6 +130,34 @@ class SlotEngine:
                 "attn_kernel='paged' walks the paged block pool in-kernel "
                 "— it requires paged=True (TPUDIST_SERVE_PAGED)")
         self.attn_kernel = attn_kernel
+        # -- the kernel family's other members (tpudist.ops): prefill
+        # through the paged-prefill kernel (in-kernel KV block writes),
+        # the fused sampling tail, fused RoPE+QKV, and the in-kernel
+        # LoRA gather-matmul.  Env-free here like attn_kernel; the
+        # TPUDIST_SERVE_{PREFILL_KERNEL,SAMPLE_KERNEL,FUSED_ROPE,
+        # LORA_KERNEL} knobs parse once in ServeConfig.from_env.
+        if prefill_kernel and not paged:
+            raise ValueError(
+                "prefill_kernel=True is the paged-prefill kernel — it "
+                "requires paged=True (TPUDIST_SERVE_PREFILL_KERNEL)")
+        if fused_rope and attn_kernel != "paged" and not prefill_kernel:
+            raise ValueError(
+                "fused_rope=True fuses RoPE+QKV on the kernel arms only "
+                "— enable attn_kernel='paged' and/or prefill_kernel=True "
+                "(TPUDIST_SERVE_FUSED_ROPE)")
+        if lora_kernel and not adapters:
+            raise ValueError(
+                "lora_kernel=True is the in-kernel adapter gather-matmul "
+                "— it requires adapters=True (TPUDIST_SERVE_LORA_KERNEL)")
+        if lora_kernel and attn_kernel != "paged" and not prefill_kernel:
+            raise ValueError(
+                "lora_kernel=True rides the slot-batched kernel programs "
+                "only — enable attn_kernel='paged' and/or "
+                "prefill_kernel=True (TPUDIST_SERVE_LORA_KERNEL)")
+        self.prefill_kernel = bool(prefill_kernel)
+        self.sample_kernel = bool(sample_kernel)
+        self.fused_rope = bool(fused_rope)
+        self.lora_kernel = bool(lora_kernel)
         self.module = module
         self.max_len = int(module.max_len)
         # -- per-tenant adapters (tpudist.models.lora + serve.adapters):
@@ -334,6 +366,10 @@ class SlotEngine:
                                         spec=spec_pair,
                                         draft_constraint=cache_constraint,
                                         attn_kernel=attn_kernel,
+                                        prefill_kernel=prefill_kernel,
+                                        sample_kernel=sample_kernel,
+                                        fused_rope=fused_rope,
+                                        lora_kernel=lora_kernel,
                                         adapters=acfg,
                                         constrain=constrain,
                                         logprobs=self.n_lp)
@@ -348,6 +384,7 @@ class SlotEngine:
                                         state_constraint=state_constraint,
                                         spec=spec_pair,
                                         draft_constraint=cache_constraint,
+                                        sample_kernel=sample_kernel,
                                         adapters=acfg,
                                         constrain=constrain,
                                         logprobs=self.n_lp)
@@ -421,6 +458,12 @@ class SlotEngine:
         #: ACTIVE path's honest model (see _decode_kv_read_bytes) — the
         #: per-rung bytes/token column in serve_bench reads the delta
         self.kv_read_bytes_total = 0
+        #: honest prefill traffic per path (_prefill_kv_bytes; the kv
+        #: report's prefill rows): the kernel path charges prefix blocks
+        #: walked + blocks its chunks cover, the gather path the dense
+        #: lane views it materializes and the static commit span
+        self.prefill_read_bytes_total = 0
+        self.prefill_write_bytes_total = 0
         # speculative-decode counters (spec_stats)
         self.n_spec_blocks = 0
         self.n_spec_lane_passes = 0  # Σ active lanes over spec blocks
@@ -593,6 +636,47 @@ class SlotEngine:
                        + len(pos0) * window_per_lane * window_bpp)
         return int(passes * self.num_slots * self.max_len * bpp)
 
+    def _prefill_kv_bytes(self, pos0: np.ndarray, clens: np.ndarray,
+                          gather_lanes: int) -> Tuple[int, int]:
+        """``(read, write)`` KV bytes one prefill dispatch streams, per
+        the ACTIVE path — the prefill twin of :meth:`_decode_kv_read_bytes`
+        (the serving report's ``kv`` prefill rows):
+
+        - **kernel** (``prefill_kernel``): each lane walks its reused
+          pool PREFIX in whole blocks (``ceil(pos0/bs)·bs`` positions —
+          every lane of the batched program walks, including the
+          bystander lanes of a one-hot chunk extend) and WRITES only
+          the blocks its chunk ``[pos0, pos0+clen)`` actually covers —
+          reads ∝ reused prefix, writes ∝ chunk;
+        - **gather / dense**: the vmapped lane program materializes a
+          ``max_len`` dense view per lane and the teacher-force scan
+          re-streams it once per padded step (``(1 + pad) · max_len``
+          positions per lane, all ``gather_lanes`` lanes — fixed
+          shapes, inactive lanes compute too), and the commit scatters
+          the full static ``_touch_count(pad)`` span (dense engine:
+          the whole lane) regardless of the chunk length.
+        """
+        bpp = self._bytes_per_pos()
+        pos0 = np.asarray(pos0, np.int64)
+        clens = np.asarray(clens, np.int64)
+        live = clens > 0
+        if self.prefill_kernel:
+            bs = self.paged_cfg.block_size
+            pref = ((pos0 + bs - 1) // bs) * bs
+            touched = np.where(
+                live, (pos0 + clens - 1) // bs - pos0 // bs + 1, 0)
+            return (int(pref.sum() * bpp),
+                    int(touched.sum() * bs * bpp))
+        pad = self.prefill_pad
+        read = gather_lanes * (1 + pad) * self.max_len * bpp
+        if self.alloc is not None:
+            bs = self.paged_cfg.block_size
+            T = min(self.max_len // bs, (max(1, pad) - 1) // bs + 2)
+            write = int(live.sum()) * T * bs * bpp
+        else:
+            write = int(live.sum()) * self.max_len * bpp
+        return int(read), int(write)
+
     def kv_stats(self) -> Dict[str, object]:
         """KV residency accounting — the serving report's capacity
         story.  ``bytes_resident`` is what actually pins HBM: the whole
@@ -605,6 +689,12 @@ class SlotEngine:
             total = self.num_slots * self.max_len * bpp
             return {
                 "paged": False, "attn_kernel": self.attn_kernel,
+                "prefill_kernel": self.prefill_kernel,
+                "sample_kernel": self.sample_kernel,
+                "fused_rope": self.fused_rope,
+                "lora_kernel": self.lora_kernel,
+                "prefill_read_bytes": self.prefill_read_bytes_total,
+                "prefill_write_bytes": self.prefill_write_bytes_total,
                 "quantized": False,
                 "block_size": None, "blocks_total": None,
                 "blocks_in_use": None, "blocks_free": None,
@@ -617,6 +707,12 @@ class SlotEngine:
         pg, al = self.fns.paged, self.alloc
         return {
             "paged": True, "attn_kernel": self.attn_kernel,
+            "prefill_kernel": self.prefill_kernel,
+            "sample_kernel": self.sample_kernel,
+            "fused_rope": self.fused_rope,
+            "lora_kernel": self.lora_kernel,
+            "prefill_read_bytes": self.prefill_read_bytes_total,
+            "prefill_write_bytes": self.prefill_write_bytes_total,
             "quantized": self.paged_cfg.quantized,
             "block_size": self.paged_cfg.block_size,
             "blocks_total": al.num_blocks,
@@ -1430,6 +1526,10 @@ class SlotEngine:
                 jnp.asarray(reused_len), jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
                 jnp.asarray(temps), jnp.asarray(last), *ad_args, *g_args)
+            r, w = self._prefill_kv_bytes(reused_len, clens,
+                                          self.num_slots)
+            self.prefill_read_bytes_total += r
+            self.prefill_write_bytes_total += w
             if self.spec:
                 # same chunks, same (host-built) table rows: the draft's
                 # pool blocks mirror the target's ids, so a reused
@@ -1444,6 +1544,10 @@ class SlotEngine:
                 self.state, self.cache, jnp.asarray(prompts),
                 jnp.asarray(clens), jnp.asarray(dsts), jnp.asarray(seeds),
                 jnp.asarray(temps), jnp.asarray(last), *ad_args, *g_args)
+            r, w = self._prefill_kv_bytes(reused_len, clens,
+                                          self.num_slots)
+            self.prefill_read_bytes_total += r
+            self.prefill_write_bytes_total += w
             if self.spec:
                 self.dcache = self.fns.draft_prefill(
                     self.dcache, jnp.asarray(prompts), jnp.asarray(clens),
@@ -1492,6 +1596,17 @@ class SlotEngine:
                 self.state, self.cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(chunk), jnp.asarray(clen, jnp.int32),
                 jnp.asarray(is_last), *ad_tail, *self._g_tail())
+            if self.prefill_kernel:
+                # the one-hot batched program walks EVERY lane's prefix
+                r, w = self._prefill_kv_bytes(
+                    self.pos,
+                    np.where(np.arange(self.num_slots) == slot, clen, 0),
+                    1)
+            else:
+                r, w = self._prefill_kv_bytes(
+                    np.asarray([self.pos[slot]]), np.asarray([clen]), 1)
+            self.prefill_read_bytes_total += r
+            self.prefill_write_bytes_total += w
             if self.spec:
                 d_tail = () if self.adapters is None else (
                     jnp.asarray(self._slot_aid(slot), jnp.int32),

@@ -81,6 +81,21 @@ class ServeConfig:
     # per dispatch) or "paged" (the Pallas paged-attention kernel —
     # block table walked in-kernel, decode bytes/token ∝ live KV)
     attn_kernel: str = "gather"
+    # -- the kernel family's other members (tpudist/ops/) ------------------
+    # prefill through the paged-prefill flash kernel (block table walked
+    # AND written in-kernel — prefill bytes ∝ chunk + reused prefix,
+    # not pool geometry); requires paged
+    prefill_kernel: bool = False
+    # fused in-kernel sampling tail (temperature + top-k/top-p mask +
+    # grammar-mask gather + greedy argmax in one pass; works on every
+    # engine shape)
+    sample_kernel: bool = False
+    # fused RoPE+QKV projection kernel on the kernel arms (requires
+    # attn_kernel="paged" and/or prefill_kernel)
+    fused_rope: bool = False
+    # in-kernel LoRA gather-matmul on the kernel arms (requires
+    # adapters and a kernel arm)
+    lora_kernel: bool = False
     # -- SPMD serving mesh (tpudist/serve/spmd.py) -------------------------
     # "DxM" (data × model) or "M"; "1" = single device.  Declarative on
     # purpose (AMP-style): a planner searches this field, not the code.
@@ -189,6 +204,10 @@ class ServeConfig:
                 "TPUDIST_SERVE_PREFIX_CACHE", 0) or 0,
             attn_kernel=os.environ.get(
                 "TPUDIST_SERVE_ATTN_KERNEL", "").strip() or "gather",
+            prefill_kernel=env_flag("TPUDIST_SERVE_PREFILL_KERNEL", False),
+            sample_kernel=env_flag("TPUDIST_SERVE_SAMPLE_KERNEL", False),
+            fused_rope=env_flag("TPUDIST_SERVE_FUSED_ROPE", False),
+            lora_kernel=env_flag("TPUDIST_SERVE_LORA_KERNEL", False),
             mesh=os.environ.get("TPUDIST_SERVE_MESH", "").strip() or None,
             tp_overlap=os.environ.get(
                 "TPUDIST_SERVE_TP_OVERLAP", "").strip() or None,
@@ -825,6 +844,10 @@ class InferenceServer(_Observability):
             kv_blocks=self.config.kv_blocks, kv_int8=self.config.kv_int8,
             prefix_cache_blocks=self.config.prefix_cache_blocks,
             attn_kernel=self.config.attn_kernel,
+            prefill_kernel=self.config.prefill_kernel,
+            sample_kernel=self.config.sample_kernel,
+            fused_rope=self.config.fused_rope,
+            lora_kernel=self.config.lora_kernel,
             mesh=self.config.mesh_config(),
             spec_draft=self.config.resolve_spec_draft(module),
             spec_k=self.config.spec_k,
@@ -898,6 +921,9 @@ class InferenceServer(_Observability):
         telemetry.event(
             "serve_kv_config", paged=kv["paged"], quantized=kv["quantized"],
             attn_kernel=kv["attn_kernel"],
+            prefill_kernel=kv["prefill_kernel"],
+            sample_kernel=kv["sample_kernel"],
+            fused_rope=kv["fused_rope"], lora_kernel=kv["lora_kernel"],
             block_size=kv["block_size"], blocks_total=kv["blocks_total"],
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)

@@ -105,6 +105,18 @@ ENV_VARS = {
     "TPUDIST_SERVE_ATTN_KERNEL":
         "decode attention on the paged cache: gather (dense view per "
         "dispatch) | paged (Pallas kernel, in-kernel block-table walk)",
+    "TPUDIST_SERVE_PREFILL_KERNEL":
+        "paged-prefill flash kernel: block table walked AND written "
+        "in-kernel (requires TPUDIST_SERVE_PAGED)",
+    "TPUDIST_SERVE_SAMPLE_KERNEL":
+        "fused in-kernel sampling tail: temperature + top-k/top-p + "
+        "grammar mask + greedy argmax in one pass",
+    "TPUDIST_SERVE_FUSED_ROPE":
+        "fused RoPE+QKV projection kernel on the kernel arms "
+        "(requires ATTN_KERNEL=paged and/or PREFILL_KERNEL)",
+    "TPUDIST_SERVE_LORA_KERNEL":
+        "in-kernel LoRA gather-matmul from the adapter pool "
+        "(requires ADAPTERS and a kernel arm)",
     "TPUDIST_SERVE_MESH":
         "serving mesh shape 'DxM' (data x model; '1' = single device)",
     "TPUDIST_SERVE_TP_OVERLAP":
