@@ -59,6 +59,35 @@ def detect_round() -> int:
     return current_round()
 
 
+def _stamp_artifact_header(path: Path, family: str, rnd: int) -> None:
+    """Stamp the ``{"artifact": {schema, family, round}}`` header into an
+    artifact this snapshot just wrote — declared metadata beats filename
+    parsing (``tpudist.plan.artifacts`` validates it against both).
+    Idempotent; existing header fields win."""
+    try:
+        text = path.read_text()
+    except OSError:
+        return
+    header = {"schema": 1, "family": family, "round": rnd}
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        declared = obj.get("artifact")
+        obj["artifact"] = {**header, **declared} \
+            if isinstance(declared, dict) else header
+        path.write_text(json.dumps(obj, indent=1) + "\n")
+        return
+    if isinstance(obj, list):
+        return  # plain-array artifacts: the loader wraps them as rows
+    # JSONL: prepend one header line unless the first line already is one
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if lines and '"artifact"' in lines[0]:
+        return
+    path.write_text(json.dumps({"artifact": header}) + "\n" + text)
+
+
 def run_lines(cmd: list[str], timeout: int,
               env: dict | None = None) -> list[dict]:
     proc = subprocess.run(cmd, capture_output=True, text=True,
@@ -111,8 +140,11 @@ def main(argv=None) -> None:
             except Exception as e:
                 rows.append({"regime": "multiprocess-cpu",
                              "error": repr(e)})
+            if mp_out.exists():
+                _stamp_artifact_header(mp_out, "SCALING_MULTIPROC", rnd)
         out = REPO / f"{label}_r{rnd:02d}.json"
         out.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        _stamp_artifact_header(out, label, rnd)
         print(f"{out.name}: {json.dumps(rows[-1])}")
 
     # Serving joins the round scoreboard: serve_bench writes its own
@@ -290,6 +322,37 @@ def main(argv=None) -> None:
     except Exception as e:
         prof_out.write_text(json.dumps({"error": repr(e)}) + "\n")
         print(f"{prof_out.name}: error {e!r}")
+
+    # Planner honesty rung (the measurement-driven planner PR): predict
+    # every candidate from the round's frozen artifacts, measure them
+    # live, freeze the error band the planner quotes on every report.
+    # plan_bench writes its own declared header.  Failure-isolated like
+    # the serve snapshot.
+    plan_out = REPO / f"PLAN_r{rnd:02d}.json"
+    try:
+        rows = run_lines(
+            [sys.executable, str(REPO / "benchmarks" / "plan_bench.py"),
+             "--round", str(rnd), "--out", str(plan_out)],
+            timeout=1800,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        print(f"{plan_out.name}: {json.dumps(rows[-1])}")
+    except Exception as e:
+        plan_out.write_text(json.dumps({"error": repr(e)}) + "\n")
+        print(f"{plan_out.name}: error {e!r}")
+
+    # Every artifact this snapshot wrote carries the declared header the
+    # plan loader validates (declared metadata beats filename parsing);
+    # error-path stubs get stamped too, so a failed bench still declares
+    # what it was.
+    for family, path in (
+        ("BENCH_SERVE", serve_out), ("BENCH_ELASTIC", elastic_out),
+        ("BENCH_OBS", obs_out), ("BENCH_SESSION", session_out),
+        ("BENCH_ADAPTER", adapter_out), ("BENCH_ROUTER", router_out),
+        ("BENCH_DISTILL", distill_out), ("BENCH_GRAMMAR", grammar_out),
+        ("DECODE_PROFILE", prof_out),
+    ):
+        if path.exists():
+            _stamp_artifact_header(path, family, rnd)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,98 @@
+"""Machine-check "failure set unchanged" against the frozen manifest.
+
+Usage::
+
+    python -m tests.check_failures /tmp/_t1.log [--manifest PATH]
+
+Parses ``FAILED``/``ERROR`` lines out of a pytest log and diffs the set
+against ``tests/known_env_failures.txt`` — the frozen pre-existing
+environment failures (missing optional deps, platform limits of the
+1-core CI box).  Exit codes:
+
+* 0 — every failure in the log is a known env failure.  Entries in the
+  manifest that did NOT fail are listed as ``resolved`` (shrink the
+  manifest in the PR that fixed them), but do not fail the check.
+* 1 — the log contains failures outside the manifest (a regression
+  this change introduced), each listed as ``NEW``.
+* 2 — usage/parse problems (missing log, empty log, no summary lines
+  and no "passed"/"failed" tail — a log that never ran).
+
+The per-PR claim "tier-1 no worse than the seed" stops being a by-hand
+grep: run tier-1, tee the log, run this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+MANIFEST = Path(__file__).resolve().parent / "known_env_failures.txt"
+
+# "FAILED tests/test_x.py::TestY::test_z[param] - AssertionError: ..."
+# (the trailing reason is unstable across runs; the id is the key)
+_LINE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+)")
+_RAN = re.compile(r"\d+ (?:passed|failed|error|deselected|skipped)")
+
+
+def parse_failures(text: str) -> set[str]:
+    out = set()
+    for line in text.splitlines():
+        m = _LINE.match(line.strip())
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def load_manifest(path: Path) -> set[str]:
+    if not path.exists():
+        return set()
+    out = set()
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.add(line.split()[0])
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tests.check_failures",
+        description="diff a pytest log against the frozen env-failure "
+                    "manifest")
+    ap.add_argument("log", help="pytest output (tee'd tier-1 log)")
+    ap.add_argument("--manifest", type=Path, default=MANIFEST)
+    args = ap.parse_args(argv)
+
+    log_path = Path(args.log)
+    if not log_path.exists():
+        print(f"check_failures: no such log: {log_path}", file=sys.stderr)
+        return 2
+    text = log_path.read_text(errors="replace")
+    failures = parse_failures(text)
+    if not failures and not _RAN.search(text):
+        print("check_failures: log has no pytest summary — did the run "
+              "start?", file=sys.stderr)
+        return 2
+
+    known = load_manifest(args.manifest)
+    new = sorted(failures - known)
+    resolved = sorted(known - failures)
+
+    print(f"log failures: {len(failures)}  known: {len(known)}  "
+          f"new: {len(new)}  resolved: {len(resolved)}")
+    for t in resolved:
+        print(f"  resolved (shrink manifest): {t}")
+    for t in new:
+        print(f"  NEW: {t}")
+    if new:
+        print(f"check_failures: {len(new)} failure(s) outside "
+              f"{args.manifest.name} — regression", file=sys.stderr)
+        return 1
+    print("check_failures: failure set within the known env set")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1354,3 +1354,56 @@ class TestRoofline:
         assert at_half["read_bytes_per_prompt_token_gather"] == int(
             (1 + cfg["prefill_pad"]) * cfg["max_len"] * kv_pos
             / cfg["prefill_pad"])
+
+
+class TestPlanBench:
+    """The frozen planner-validation artifact (plan_bench): every rung
+    must carry predicted-vs-measured rows and the error band the
+    planner quotes at plan time."""
+
+    def test_frozen_plan_artifact_fields(self):
+        import json as _json
+        from pathlib import Path as _P
+
+        frozen = sorted(_P(__file__).resolve().parent.parent.glob(
+            "PLAN_r*.json"))
+        if not frozen:
+            pytest.skip("no frozen PLAN artifact yet")
+        doc = _json.loads(frozen[-1].read_text())
+        hdr = doc["artifact"]
+        assert hdr["schema"] == 1 and hdr["family"] == "PLAN"
+        assert hdr["round"] == int(frozen[-1].stem.split("_r")[-1])
+        for wl in ("training", "serving"):
+            sec = doc[wl]
+            assert sec["rungs"], wl
+            for rung in sec["rungs"]:
+                assert rung["predicted_best"] and rung["measured_best"]
+                assert isinstance(rung["match"], bool)
+                for row in rung["configs"]:
+                    assert row["predicted_s"] > 0
+                    assert row["measured_s"] > 0
+                    assert row["error_frac"] >= 0
+            band = sec["error_band"]
+            assert 0 <= band["max_frac"]
+            assert band["n_configs"] >= band["n_rungs"] >= 1
+        smry = doc["summary"]
+        assert isinstance(smry["all_match"], bool)
+        assert smry["rungs_ok"] >= 1 and 0 < smry["match_rtol"] < 1
+
+    def test_round_detection_scans_all_families(self):
+        """BENCH_r* counter lags the per-family artifacts — the round
+        stamp must come from the max across every *_rNN.json family."""
+        import importlib.util
+        from pathlib import Path as _P
+
+        repo = _P(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location(
+            "plan_bench", repo / "benchmarks" / "plan_bench.py")
+        pb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pb)
+        rnd = pb.detect_round()
+        existing = max(
+            int(m.group(1))
+            for p in repo.glob("*_r*.json")
+            if (m := pb._ROUND_RE.match(p.name)))
+        assert rnd == existing + 1

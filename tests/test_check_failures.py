@@ -1,0 +1,74 @@
+"""The known-env-failure checker: the per-PR "failure set unchanged"
+claim must be machine-checkable, not a by-hand grep."""
+
+from pathlib import Path
+
+from tests import check_failures as cf
+
+
+def _log(tmp_path, body):
+    p = tmp_path / "t1.log"
+    p.write_text(body)
+    return p
+
+
+def _manifest(tmp_path, *ids):
+    p = tmp_path / "known.txt"
+    p.write_text("# frozen env failures\n" + "".join(f"{i}\n" for i in ids))
+    return p
+
+
+class TestParse:
+    def test_failed_and_error_lines_reason_stripped(self):
+        got = cf.parse_failures(
+            "FAILED tests/test_a.py::TestX::test_y[p-1] - AssertionError\n"
+            "ERROR tests/test_b.py::test_z\n"
+            "PASSED tests/test_c.py::test_ok\n"
+            "tests/test_d.py::test_also_ok PASSED\n")
+        assert got == {"tests/test_a.py::TestX::test_y[p-1]",
+                       "tests/test_b.py::test_z"}
+
+    def test_manifest_comments_and_blanks_skipped(self, tmp_path):
+        m = _manifest(tmp_path, "tests/test_a.py::t1")
+        m.write_text(m.read_text() + "\n# trailing comment\n\n")
+        assert cf.load_manifest(m) == {"tests/test_a.py::t1"}
+
+    def test_missing_manifest_is_empty(self, tmp_path):
+        assert cf.load_manifest(tmp_path / "nope.txt") == set()
+
+
+class TestExitCodes:
+    def test_subset_of_known_passes(self, tmp_path, capsys):
+        log = _log(tmp_path, "FAILED tests/test_a.py::t1 - x\n1 failed\n")
+        m = _manifest(tmp_path, "tests/test_a.py::t1",
+                      "tests/test_b.py::t2")
+        assert cf.main([str(log), "--manifest", str(m)]) == 0
+        out = capsys.readouterr().out
+        assert "resolved" in out and "tests/test_b.py::t2" in out
+
+    def test_new_failure_is_regression(self, tmp_path, capsys):
+        log = _log(tmp_path,
+                   "FAILED tests/test_new.py::boom - x\n1 failed\n")
+        m = _manifest(tmp_path, "tests/test_a.py::t1")
+        assert cf.main([str(log), "--manifest", str(m)]) == 1
+        assert "NEW: tests/test_new.py::boom" in capsys.readouterr().out
+
+    def test_clean_log_passes(self, tmp_path):
+        log = _log(tmp_path, "500 passed in 1200s\n")
+        m = _manifest(tmp_path)
+        assert cf.main([str(log), "--manifest", str(m)]) == 0
+
+    def test_logless_run_is_usage_error(self, tmp_path):
+        log = _log(tmp_path, "collecting...\n")  # never ran
+        assert cf.main([str(log), "--manifest",
+                        str(_manifest(tmp_path))]) == 2
+        assert cf.main([str(tmp_path / "absent.log")]) == 2
+
+    def test_repo_manifest_parses(self):
+        """The frozen manifest itself must stay well-formed: real test
+        ids only (``tests/...py::``), no duplicates."""
+        ids = sorted(cf.load_manifest(cf.MANIFEST))
+        lines = [l.split()[0] for l in cf.MANIFEST.read_text().splitlines()
+                 if l.strip() and not l.strip().startswith("#")]
+        assert len(lines) == len(ids)  # no duplicates
+        assert all(i.startswith("tests/") and "::" in i for i in ids)

@@ -13,6 +13,11 @@ from pathlib import Path
 import pytest
 
 REPO = Path(__file__).resolve().parent.parent
+# Both submitters derive project_name from the checkout basename
+# ($(basename "$(pwd)")) — a test hardcoding the literal "repo" flips
+# whenever the suite runs from a differently-named checkout (the PR-19
+# "5 launch flakes" were exactly this, measured from a head_base copy).
+PROJ = REPO.name
 SCRIPTS = sorted((REPO / "launch").rglob("*.sh")) + sorted(
     (REPO / "launch" / "clusters").glob("*.profile"))
 
@@ -64,7 +69,7 @@ class TestJobSubmitter:
         assert "launch/standard_job.sh" in call
         assert "--ntasks-per-node=1" in call
         # Experiment workspace provisioned (job_submitter.sh:157-163 parity).
-        exp = tmp_path / "scratch" / "repo" / "exp"
+        exp = tmp_path / "scratch" / PROJ / "exp"
         assert (exp / "checkpoints").is_dir() and (exp / "hpc_outputs").is_dir()
 
     def test_distributed_tpurun_shape(self, slurm_stubs, tmp_path):
@@ -119,7 +124,7 @@ class TestJobSubmitter:
         assert "staged=[" in call
         staged = call.split("staged=[")[1].split("]")[0]
         assert staged.endswith("da.tar," + str(tmp_path / "scratch")
-                               + "/repo/exp/data/db.tar")
+                               + f"/{PROJ}/exp/data/db.tar")
         assert "staged_tarballs" not in call.split("--export=")[1].split()[0]
 
     def test_container_mode_swaps_job_scripts(self, slurm_stubs, tmp_path):
@@ -359,11 +364,11 @@ class TestGcloudSubmitter:
         assert "tpu-vm create" not in calls
         # Code tarball staged to all workers and unpacked.
         assert "tpu-vm scp" in calls and "--worker=all" in calls
-        assert "tar -xf /tmp/repo-code.tar" in calls
+        assert f"tar -xf /tmp/{PROJ}-code.tar" in calls
         # Per-worker fan-out: one ssh per parsed worker (two endpoints).
         assert "--worker=0" in calls and "--worker=1" in calls
         # Per-worker outputs captured.
-        outs = sorted((tmp_path / "scratch" / "repo" / "exp" /
+        outs = sorted((tmp_path / "scratch" / PROJ / "exp" /
                        "cloud_outputs").glob("attempt0-worker*.out"))
         assert [o.name for o in outs] == ["attempt0-worker0.out",
                                           "attempt0-worker1.out"]
@@ -373,17 +378,17 @@ class TestGcloudSubmitter:
         assert "tpudist_env_exp" in calls  # env file scp'd + sourced
         worker_cmd = [l for l in calls.splitlines() if "--worker=0" in l][-1]
         assert "source /tmp/tpudist_env_exp" in worker_cmd
-        env_file = (tmp_path / "scratch" / "repo" / "exp" / "data" /
+        env_file = (tmp_path / "scratch" / PROJ / "exp" / "data" /
                     "remote_env.sh")
         content = env_file.read_text()
         assert "WANDB_API_KEY='SECRETKEY123'" in content
         assert "exp_name='exp'" in content
-        assert "project_name='repo'" in content
+        assert f"project_name='{PROJ}'" in content
         # scratch_dir must expand on the WORKER, not the submitter.
         assert 'scratch_dir="$HOME/scratch"' in content
         assert oct(env_file.stat().st_mode)[-3:] == "600"
         # Experiment workspace provisioned (job_submitter.sh:157-163).
-        exp = tmp_path / "scratch" / "repo" / "exp"
+        exp = tmp_path / "scratch" / PROJ / "exp"
         assert (exp / "checkpoints").is_dir()
 
     def test_missing_tpu_without_type_fails(self, gcloud_stub, tmp_path):
@@ -419,7 +424,7 @@ class TestGcloudSubmitter:
         (state / "fail_first").touch()
         r = _gsubmit(env, tmp_path, "-r", "2", "-b", "0")
         assert r.returncode == 0, r.stderr + r.stdout
-        outdir = tmp_path / "scratch" / "repo" / "exp" / "cloud_outputs"
+        outdir = tmp_path / "scratch" / PROJ / "exp" / "cloud_outputs"
         assert (outdir / "attempt0-worker0.out").exists()
         assert (outdir / "attempt1-worker0.out").exists()
         assert "injected worker failure" in (
@@ -467,13 +472,13 @@ class TestGcloudSubmitter:
         (d / "x.txt").write_text("hi")
         r = _gsubmit(env, tmp_path, "-d", str(d))
         assert r.returncode == 0, r.stderr + r.stdout
-        tb = tmp_path / "scratch" / "repo" / "exp" / "data" / "corpus.tar"
+        tb = tmp_path / "scratch" / PROJ / "exp" / "data" / "corpus.tar"
         assert tb.exists()
         calls = log.read_text()
         # Data lands in TPUDIST_TMPDIR on the workers (the standard_job.sh
         # landing contract), and the env file points the job at it.
         assert "tar -xf /tmp/corpus.tar -C $HOME/tpudist_data/exp" in calls
-        env_file = (tmp_path / "scratch" / "repo" / "exp" / "data" /
+        env_file = (tmp_path / "scratch" / PROJ / "exp" / "data" /
                     "remote_env.sh")
         assert 'TPUDIST_TMPDIR="$HOME/tpudist_data/exp"' in \
             env_file.read_text()

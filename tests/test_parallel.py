@@ -11,6 +11,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudist.parallel import (
     MoEStats,
     attention_reference,
+    compat_shard_map,
     init_mlp_params,
     make_moe,
     make_pipeline,
@@ -335,9 +336,8 @@ class TestPipeline:
         mesh = Mesh(np.array(jax.devices()[:4]), ("stage",))
         with pytest.raises(ValueError, match="collective"):
             jax.eval_shape(
-                jax.shard_map(run, mesh=mesh,
-                          in_specs=P(), out_specs=P(),
-                          check_vma=False),
+                compat_shard_map(run, mesh=mesh,
+                                 in_specs=P(), out_specs=P()),
                 args)
 
     def test_head_collective_free_loss_passes(self):
@@ -750,11 +750,12 @@ class TestZigzagRing:
         mesh = Mesh(np.asarray(devices[:4]), (AXIS_SEQ,))
         q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 12, 8))
         with pytest.raises(ValueError, match="even"):
-            jax.shard_map(
+            compat_shard_map(
                 lambda a, b, c: ring_attention_shard_zigzag(a, b, c),
                 mesh=mesh,
                 in_specs=(P(None, None, AXIS_SEQ, None),) * 3,
                 out_specs=P(None, None, AXIS_SEQ, None),
+                check_vma=True,
             )(q, q, q)
 
     def test_lm_trains_end_to_end_via_standard_step(self, devices):

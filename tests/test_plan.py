@@ -1,0 +1,403 @@
+"""Measurement-driven planner (tpudist/plan): artifact loading,
+enumeration legality, cost-model sanity, ranking/pick/stamp, and the
+two auto-mode entry points end-to-end on the virtual mesh.
+
+Artifact fixtures write into tmp dirs — the REAL frozen artifacts at
+the repo root are load-tested too (they are part of the contract), but
+never mutated.
+"""
+
+import json
+import warnings
+
+import numpy as np
+import optax
+import pytest
+
+from tpudist.plan import (
+    Calibration,
+    PlanArtifactError,
+    ServeCandidate,
+    ServeWorkload,
+    TrainCandidate,
+    TrainWorkload,
+    load_artifacts,
+    plan_serving,
+    plan_training,
+    predict_serving,
+    predict_training,
+    serving_candidates,
+    training_candidates,
+)
+
+
+def _write(root, name, obj):
+    p = root / name
+    p.write_text(json.dumps(obj))
+    return p
+
+
+def _wl_train(**kw):
+    base = dict(param_bytes=4e6, flops_per_step=1e9, n_devices=8,
+                global_batch=8, lm=True, precision="fp32")
+    base.update(kw)
+    return TrainWorkload(**base)
+
+
+def _wl_serve(**kw):
+    base = dict(weight_bytes=1e6, kv_bytes_per_pos=1024, n_layers=4,
+                max_len=64, n_devices=1, slots=4, prompt_len=32)
+    base.update(kw)
+    return ServeWorkload(**base)
+
+
+class TestArtifactLoading:
+    def test_newest_round_wins(self, tmp_path):
+        _write(tmp_path, "BENCH_SERVE_r01.json", {"v": "old"})
+        _write(tmp_path, "BENCH_SERVE_r03.json", {"v": "new"})
+        arts = load_artifacts(tmp_path)
+        a = arts.get("BENCH_SERVE")
+        assert a.round == 3 and a.data["v"] == "new"
+        # the superseded round stays reachable through history
+        assert [h.round for h in arts.history["BENCH_SERVE"]] == [3, 1]
+
+    def test_stale_round_rejected_loudly(self, tmp_path):
+        _write(tmp_path, "COMM_AUDIT_r01.json", {"regimes": {}})
+        _write(tmp_path, "BENCH_SERVE_r30.json", {})
+        with pytest.warns(UserWarning, match="stale"):
+            arts = load_artifacts(tmp_path, stale_rounds=20)
+        assert arts.get("COMM_AUDIT") is None
+        assert any("stale" in r.reason for r in arts.rejected)
+
+    def test_foreign_geometry_rejected(self, tmp_path):
+        _write(tmp_path, "ROOFLINE_r02.json", {
+            "artifact": {"schema": 1, "family": "ROOFLINE", "round": 2,
+                         "geometry": {"platform": "tpu"}}})
+        with pytest.warns(UserWarning, match="foreign geometry"):
+            arts = load_artifacts(
+                tmp_path, expect_geometry={"platform": "cpu"})
+        assert arts.get("ROOFLINE") is None
+
+    def test_header_contradicting_filename_rejected(self, tmp_path):
+        _write(tmp_path, "ROOFLINE_r02.json", {
+            "artifact": {"family": "BENCH_SERVE", "round": 2}})
+        with pytest.warns(UserWarning, match="contradicts"):
+            arts = load_artifacts(tmp_path)
+        assert arts.get("ROOFLINE") is None
+
+    def test_newer_schema_rejected_falls_back(self, tmp_path):
+        _write(tmp_path, "BENCH_SERVE_r02.json", {
+            "artifact": {"schema": 99, "family": "BENCH_SERVE",
+                         "round": 2}})
+        _write(tmp_path, "BENCH_SERVE_r01.json", {"v": "ok"})
+        with pytest.warns(UserWarning, match="schema"):
+            arts = load_artifacts(tmp_path)
+        # a rejected newest round falls back to the next valid one
+        assert arts.get("BENCH_SERVE").round == 1
+
+    def test_jsonl_with_header_line(self, tmp_path):
+        p = tmp_path / "DECODE_PROFILE_r04.json"
+        p.write_text(
+            json.dumps({"artifact": {"schema": 1, "round": 4,
+                                     "family": "DECODE_PROFILE"}})
+            + "\n" + json.dumps({"op": "matmul"}) + "\n")
+        arts = load_artifacts(tmp_path)
+        a = arts.get("DECODE_PROFILE")
+        assert a.header["schema"] == 1
+        assert a.rows == [{"op": "matmul"}]
+
+    def test_missing_family_degrades_not_raises(self, tmp_path):
+        arts = load_artifacts(tmp_path)  # empty dir
+        assert arts.get("COMM_AUDIT") is None
+        est = predict_training(TrainCandidate("fsdp"), _wl_train(), arts)
+        assert est.seconds > 0
+        assert "wire:fsdp" in est.extrapolated  # flagged, not silent
+
+    def test_strict_mode_raises_on_missing(self, tmp_path):
+        with pytest.raises(PlanArtifactError, match="missing"):
+            load_artifacts(tmp_path, strict=True)
+
+    def test_repo_frozen_artifacts_load_clean(self):
+        """The real artifact tree must load without a single rejection —
+        a planner quietly ignoring frozen evidence is the failure mode
+        this loader exists to prevent."""
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            arts = load_artifacts()
+        assert arts.rejected == []
+        assert arts.get("COMM_AUDIT") is not None
+        assert arts.get("BENCH_SERVE") is not None
+
+    def test_section_walks_history(self, tmp_path):
+        _write(tmp_path, "BENCH_SERVE_r01.json", {"spec_sweep": {"a": 1}})
+        _write(tmp_path, "BENCH_SERVE_r02.json", {"other": True})
+        arts = load_artifacts(tmp_path)
+        val, rnd = arts.section("BENCH_SERVE", "spec_sweep")
+        assert val == {"a": 1} and rnd == 1  # newest round lacks it
+
+
+class TestEnumeration:
+    def test_lm_workload_refuses_dp_model(self):
+        names = {c.strategy for c in training_candidates(_wl_train())}
+        assert "dp_model" not in names
+        assert {"dp", "fsdp", "zero1", "pp"} <= names
+
+    def test_bf16_refuses_pp(self):
+        cands = training_candidates(_wl_train(precision="bf16"))
+        assert all(c.strategy != "pp" for c in cands)
+
+    def test_pp_stage_width_divides_devices(self):
+        cands = training_candidates(_wl_train(n_devices=6),
+                                    stages=(2, 4))
+        pp = [c for c in cands if c.strategy == "pp"]
+        assert pp and all(c.stages == 2 for c in pp)  # 4 does not divide
+
+    def test_actionable_excludes_overlap_variants(self):
+        cands = training_candidates(_wl_train(), actionable=True)
+        assert all(c.overlap == "none" for c in cands)
+        full = training_candidates(_wl_train())
+        assert any(c.overlap != "none" for c in full)
+
+    def test_single_device_collapses_to_dp(self):
+        names = {c.strategy
+                 for c in training_candidates(_wl_train(n_devices=1))}
+        assert "fsdp" not in names and "zero1" not in names
+
+    def test_kv_block_must_divide_max_len(self):
+        cands = serving_candidates(_wl_serve(max_len=48),
+                                   kv_blocks=(7, 16))
+        paged = [c for c in cands if c.paged]
+        assert paged and all(c.kv_block == 16 for c in paged)
+
+    def test_kernel_arms_gated_on_paged_cache(self):
+        cands = serving_candidates(_wl_serve(), include_kernels=True)
+        for c in cands:
+            if c.attn_kernel == "paged" or c.prefill_kernel:
+                assert c.paged
+            if c.fused_rope:
+                assert c.attn_kernel == "paged" or c.prefill_kernel
+
+    def test_spec_needs_caller_draft_and_dense_arm(self):
+        assert all(c.spec_layers is None
+                   for c in serving_candidates(_wl_serve()))
+        cands = serving_candidates(_wl_serve(), spec_layers=(1, 4, 9))
+        spec = [c for c in cands if c.spec_layers is not None]
+        # a draft as deep as the 4-layer target is not a draft
+        assert spec and {c.spec_layers for c in spec} == {1}
+        assert all(not c.paged and c.attn_kernel == "gather"
+                   for c in spec)
+
+
+class TestCostModel:
+    def test_more_overlap_never_predicts_slower(self):
+        wl = _wl_train()
+        none, ring, bidir = (
+            predict_training(TrainCandidate("fsdp", overlap=o), wl)
+            for o in ("none", "ring", "bidir"))
+        assert bidir.seconds <= ring.seconds <= none.seconds
+
+    def test_overlap_monotone_with_real_audit(self):
+        arts = load_artifacts()
+        wl = _wl_train()
+        none, ring, bidir = (
+            predict_training(TrainCandidate("fsdp", overlap=o), wl, arts)
+            for o in ("none", "ring", "bidir"))
+        assert bidir.seconds <= ring.seconds <= none.seconds
+
+    def test_calibration_anchors_compute(self):
+        est = predict_training(
+            TrainCandidate("dp"), _wl_train(),
+            calibration=Calibration(base_s=0.5,
+                                    collective_bytes_per_s=1e9))
+        assert est.parts["compute_s"] == 0.5
+        assert "compute" in est.measured and "link_bw" in est.measured
+
+    def test_state_shard_ratio_scales_sharded_compute(self):
+        wl = _wl_train()
+        calib = Calibration(base_s=1.0, collective_bytes_per_s=1e12,
+                            state_shard_ratio=0.8)
+        z = predict_training(TrainCandidate("zero1"), wl,
+                             calibration=calib)
+        d = predict_training(TrainCandidate("dp"), wl, calibration=calib)
+        assert z.seconds < d.seconds  # the ratio can flip the ranking
+        assert "state_sharding" in z.measured
+        assert z.parts["m_state"] == 0.8
+        # dp is never scaled by it
+        assert d.parts["m_state"] == 1.0
+
+    def test_pp_bubble_shrinks_with_microbatches(self):
+        wl = _wl_train()
+        few = predict_training(
+            TrainCandidate("pp", stages=2, microbatches=2), wl)
+        many = predict_training(
+            TrainCandidate("pp", stages=2, microbatches=4), wl)
+        assert many.seconds < few.seconds
+
+    def test_small_decode_block_never_predicts_faster(self):
+        wl = _wl_serve()
+        arts = load_artifacts()
+        k1, _ = predict_serving(ServeCandidate(decode_block=1), wl, arts)
+        k8, _ = predict_serving(ServeCandidate(decode_block=8), wl, arts)
+        assert k1.seconds >= k8.seconds
+
+    def test_unmeasured_knob_is_neutral_with_note(self):
+        wl = _wl_serve()
+        base, _ = predict_serving(ServeCandidate(), wl, None)
+        i8, _ = predict_serving(ServeCandidate(kv_int8=True), wl, None)
+        assert i8.parts["m_paged"] == 1.0
+        assert i8.seconds == pytest.approx(base.seconds)
+        assert any("int8" in n for n in i8.notes)
+        assert "kv_int8" in i8.extrapolated
+
+
+class TestPlanner:
+    def test_ranked_ascending_and_table(self):
+        report = plan_training(_wl_train(), load_artifacts())
+        secs = [p.estimate.seconds for p in report.ranked]
+        assert secs == sorted(secs)
+        assert report.ranked[0].rank == 1
+        txt = report.table()
+        assert "training plan" in txt and "rank" in txt
+        assert "error band" in txt
+
+    def test_pick_promotes_simplest_within_tie(self):
+        wl = _wl_train()
+        # a collective bandwidth so high every comm delta is sub-tie
+        calib = Calibration(base_s=1e-3, collective_bytes_per_s=1e15)
+        report = plan_training(
+            wl, None,
+            candidates=[TrainCandidate("zero1"), TrainCandidate("dp")],
+            calibration=calib)
+        chosen = report.pick()
+        assert chosen.candidate.strategy == "dp"
+        assert report.best is chosen
+        if report.ranked[0] is not chosen:
+            assert any("tie" in n for n in chosen.estimate.notes)
+
+    def test_stamp_has_no_reserved_kind_key(self):
+        report = plan_serving(_wl_serve(), load_artifacts())
+        report.pick()
+        stamp = report.stamp()
+        assert "kind" not in stamp  # reserved telemetry record key
+        assert stamp["workload"] == "serving"
+        assert stamp["chosen"] == report.best.candidate.name
+        assert stamp["predicted_s"] > 0
+        assert "predicted_ttft_s" in stamp
+        assert stamp["n_candidates"] == len(report.ranked)
+
+    def test_error_band_quoted_from_frozen_plan_rung(self, tmp_path):
+        _write(tmp_path, "PLAN_r05.json", {
+            "artifact": {"schema": 1, "family": "PLAN", "round": 5},
+            "training": {"error_band": {"max_frac": 0.12}}})
+        report = plan_training(_wl_train(), load_artifacts(tmp_path))
+        assert report.error_band["max_frac"] == 0.12
+        assert report.stamp()["error_band_frac"] == 0.12
+
+
+class _TinyLM:
+    pass
+
+
+class TestAutoModes:
+    """The two runtime entry points, end-to-end on the virtual mesh,
+    with the chosen plan stamped into telemetry (the acceptance line)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_telemetry(self):
+        from tpudist import telemetry
+        telemetry.finish(write_report=False)
+        yield
+        telemetry.finish(write_report=False)
+
+    def _module(self):
+        from tpudist.models import create_transformer
+        from tpudist.trainer import LMTrainerModule
+
+        class TinyLM(LMTrainerModule):
+            def configure_lm(self, rng):
+                return create_transformer(
+                    rng, seq_len=16, vocab=32, d_model=16, n_layers=2,
+                    n_heads=2, d_ff=32, max_len=16)
+
+            def configure_optimizers(self):
+                return optax.adam(1e-2)
+
+        return TinyLM()
+
+    def test_trainer_auto_picks_and_stamps(self, tmp_path):
+        from tpudist import telemetry
+        from tpudist.trainer import Trainer
+
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        batches = [np.random.default_rng(i).integers(
+            0, 32, size=(8, 16)).astype(np.int32) for i in range(2)]
+        tr = Trainer(strategy="auto", max_steps=2, progress_bar=False,
+                     dry_run=True)
+        losses = tr.fit(self._module(), batches)
+        # offline analytic path: dp predicts fastest (every other
+        # strategy adds comm/bubble to the same compute term) and the
+        # tie rule keeps the simplest config
+        assert tr.strategy == "dp"
+        assert tr.plan is not None
+        assert tr.plan.best.candidate.strategy == "dp"
+        assert np.isfinite(losses["lm"])
+        events = [r for r in s.ring if r.get("name") == "plan_selected"]
+        assert len(events) == 1
+        assert events[0]["workload"] == "training"
+        assert events[0]["chosen"] == "dp"
+
+    def test_engine_auto_fills_unpinned_knobs(self, tmp_path):
+        import jax
+
+        from tpudist import telemetry
+        from tpudist.models import create_transformer
+        from tpudist.serve import InferenceServer, ServeConfig
+
+        s = telemetry.start(tmp_path, rank=0, generation=0)
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, vocab=16, d_model=32,
+            n_layers=2, n_heads=2, d_ff=64, max_len=32)
+        server = InferenceServer(
+            module, params,
+            ServeConfig(auto=True, num_slots=2, queue_limit=8,
+                        prefill_pad=8),
+            install_signal_handler=False).start()
+        try:
+            assert server.engine.plan is not None
+            # the frozen block sweep says the largest block wins
+            assert server.engine.block == 8
+            h = server.submit(np.arange(6, dtype=np.int32), max_new=4,
+                              seed=0)
+            h.wait()
+            assert len(h.tokens) == 4
+        finally:
+            server.close()
+        events = [r for r in s.ring if r.get("name") == "plan_selected"]
+        assert len(events) == 1
+        assert events[0]["workload"] == "serving"
+        assert events[0]["chosen"].startswith("K=8")
+
+    def test_engine_auto_respects_pinned_knob(self):
+        import jax
+
+        from tpudist.models import create_transformer
+        from tpudist.serve import SlotEngine
+
+        module, params = create_transformer(
+            jax.random.PRNGKey(0), seq_len=16, vocab=16, d_model=32,
+            n_layers=2, n_heads=2, d_ff=64, max_len=32)
+        eng = SlotEngine(module, params, num_slots=2, decode_block=2,
+                         auto=True)
+        # the caller pinned decode_block=2: the plan may not override it
+        assert eng.block == 2
+        assert eng.plan is not None
+
+
+class TestPlanCLI:
+    def test_module_main_prints_tables(self, capsys):
+        from tpudist.plan.__main__ import main
+
+        rc = main(["--workload", "both"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "training plan" in out and "serving plan" in out

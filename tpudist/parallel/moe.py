@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpudist.parallel.overlap import (compat_axis_size,
+                                     compat_shard_map)
 from tpudist.runtime.mesh import AXIS_MODEL
 
 # ExpertFn: (expert_params, tokens [slots, d]) -> [slots, d]
@@ -107,7 +109,7 @@ def moe_shard(
     (n_experts == axis size); ``k`` routes each token to its top-k experts
     (capacity scales with k so the fair share per expert is unchanged).
     """
-    n_experts = lax.axis_size(axis_name)
+    n_experts = compat_axis_size(axis_name)
     tokens = x.shape[0]
     capacity = int(capacity_factor * k * tokens / n_experts + 0.5)
 
@@ -167,11 +169,10 @@ def make_moe(
         return out, stats
 
     param_specs = {"router": P(), "experts": P(axis_name)}
-    sharded = jax.shard_map(
+    sharded = compat_shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, P(batch_axis, None)),
         out_specs=(P(batch_axis, None), MoEStats(P(), P(), P())),
-        check_vma=False,
     )
     return jax.jit(sharded)

@@ -1,0 +1,63 @@
+"""Measurement-driven configuration planner (AMP-style, but measured).
+
+Every other pillar of the repo produces *frozen measurements* — comm
+audits, rooflines, scaling-model fits, serve sweeps.  This package turns
+them into decisions: enumerate the legal configuration space, predict a
+step time (training) or TTFT/TPOT (serving) for each candidate by
+composing the measured artifacts, rank, and pick.
+
+The division of labor (one module each):
+
+- :mod:`tpudist.plan.artifacts` — typed loader for the frozen
+  ``<FAMILY>_rNN.json`` artifacts (newest round wins; stale or
+  foreign-geometry artifacts rejected loudly; missing families degrade
+  to the analytic model with an explicit "unmeasured" flag).
+- :mod:`tpudist.plan.cost` — the predicted-step-time and
+  predicted-TTFT/TPOT models.  Measured ratios beat analytic guesses
+  (arXiv:2505.12832): wherever an artifact carries a measured twin for
+  a knob, the model quotes THAT ratio; analytic formulas fill the gaps
+  and are tagged ``extrapolated``.
+- :mod:`tpudist.plan.enumerate` — the legal candidate space, mirroring
+  the refusal rules the Trainer and SlotEngine enforce (pp needs an LM
+  module, pp×bf16 refused, kernel arms need the paged cache, ...).
+- :mod:`tpudist.plan.planner` — score, rank, report; the
+  ``Trainer(strategy="auto")`` / ``SlotEngine(auto=True)`` resolution
+  entry points; the plan stamps into telemetry as a ``plan_selected``
+  event so prediction-vs-actual is auditable from any run.
+
+Offline: ``python -m tpudist.plan`` prints the ranked table.
+
+Knobs (all parsed once, ENV_VARS-registered): ``TPUDIST_PLAN_DIR``,
+``TPUDIST_PLAN_TOPN``, ``TPUDIST_PLAN_STALE_ROUNDS``,
+``TPUDIST_PLAN_STRICT``.
+"""
+
+from tpudist.plan.artifacts import (  # noqa: F401
+    Artifact,
+    ArtifactSet,
+    PlanArtifactError,
+    default_root,
+    load_artifacts,
+)
+from tpudist.plan.cost import (  # noqa: F401
+    Calibration,
+    Estimate,
+    ServeCandidate,
+    ServeWorkload,
+    TrainCandidate,
+    TrainWorkload,
+    predict_serving,
+    predict_training,
+)
+from tpudist.plan.enumerate import (  # noqa: F401
+    serving_candidates,
+    training_candidates,
+)
+from tpudist.plan.planner import (  # noqa: F401
+    PlannedConfig,
+    PlanReport,
+    plan_serving,
+    plan_training,
+    resolve_engine_auto,
+    resolve_trainer_auto,
+)

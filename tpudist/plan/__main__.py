@@ -1,0 +1,121 @@
+"""``python -m tpudist.plan`` — the offline ranked table.
+
+Scores the legal config space for a described workload against the
+frozen measurement artifacts and prints the ranked prediction table,
+with provenance (artifact rounds, measured-vs-extrapolated components,
+the frozen prediction-error band) inline.  No devices are touched —
+this is pure JSON-in, table-out.
+
+Examples::
+
+    python -m tpudist.plan --workload training --devices 8 \
+        --param-bytes 4e8
+    python -m tpudist.plan --workload serving --d-model 256 \
+        --n-layers 4 --max-len 512 --spec-layers 1
+    python -m tpudist.plan --workload both --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpudist.plan import artifacts as _artifacts
+from tpudist.plan import cost as _cost
+from tpudist.plan import planner as _planner
+
+
+def _train_report(args, arts):
+    pb = float(args.param_bytes)
+    wl = _cost.TrainWorkload(
+        param_bytes=pb,
+        flops_per_step=6.0 * (pb / 4.0) * args.batch * args.seq_len,
+        n_devices=args.devices, global_batch=args.batch,
+        lm=not args.toy, precision=args.precision)
+    return _planner.plan_training(wl, arts, top_n=args.top_n)
+
+
+def _serve_report(args, arts):
+    d, L = args.d_model, args.n_layers
+    heads = max(2, d // 64)
+    wl = _cost.ServeWorkload(
+        weight_bytes=4.0 * (args.vocab * d + L * 12 * d * d),
+        kv_bytes_per_pos=2.0 * L * d * 4,
+        n_layers=L, max_len=args.max_len, n_devices=args.devices,
+        slots=args.slots, prompt_len=args.prompt_len)
+    del heads
+    return _planner.plan_serving(
+        wl, arts, top_n=args.top_n,
+        decode_blocks=tuple(int(k) for k in args.blocks.split(",")),
+        spec_layers=(args.spec_layers,) if args.spec_layers else (),
+        include_kernels=args.kernels, include_int8=args.int8)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpudist.plan",
+        description=__doc__.split("\n")[0])
+    p.add_argument("--workload", choices=("training", "serving", "both"),
+                   default="both")
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--top-n", type=int, default=None,
+                   help="rows to print (default TPUDIST_PLAN_TOPN or all)")
+    p.add_argument("--json", action="store_true",
+                   help="machine form: one JSON object instead of tables")
+    # training workload shape
+    p.add_argument("--param-bytes", default=4e8,
+                   help="model parameter bytes (training)")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--precision", choices=("fp32", "bf16"), default="fp32")
+    p.add_argument("--toy", action="store_true",
+                   help="multi-model toy module (opens dp_model, "
+                        "closes pp)")
+    # serving workload shape
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=32)
+    p.add_argument("--blocks", default="1,4,8")
+    p.add_argument("--spec-layers", type=int, default=0,
+                   help="tied-draft depth to include spec candidates")
+    p.add_argument("--kernels", action="store_true",
+                   help="include the Pallas kernel arms")
+    p.add_argument("--int8", action="store_true",
+                   help="include int8 KV candidates")
+    args = p.parse_args(argv)
+
+    arts = _artifacts.load_artifacts()
+    reports = {}
+    if args.workload in ("training", "both"):
+        reports["training"] = _train_report(args, arts)
+    if args.workload in ("serving", "both"):
+        reports["serving"] = _serve_report(args, arts)
+
+    if args.json:
+        out = {}
+        for kind, rep in reports.items():
+            out[kind] = {
+                "best": rep.best.candidate.name,
+                "stamp": rep.stamp(),
+                "ranked": [
+                    {"rank": r.rank, "config": r.candidate.name,
+                     "predicted_s": r.estimate.seconds,
+                     "measured": r.estimate.measured,
+                     "extrapolated": r.estimate.extrapolated}
+                    for r in rep.ranked],
+            }
+        print(json.dumps(out, indent=1))
+    else:
+        for i, rep in enumerate(reports.values()):
+            if i:
+                print()
+            print(rep.table())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

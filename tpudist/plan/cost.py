@@ -1,0 +1,629 @@
+"""Predicted step-time (training) and TTFT/TPOT (serving) cost models.
+
+The modeling stance, in one sentence: **measured ratios beat analytic
+guesses** (the DDP/FSDP characterization result, arXiv:2505.12832), so
+every knob whose effect the repo has FROZEN a measured twin for is
+scored with that ratio, and only the gaps are filled with the analytic
+formulas — each gap tagged ``extrapolated`` in the estimate so a plan
+report can say exactly which parts of a prediction rest on evidence.
+
+Composition (what plugs into what):
+
+- training: ``t = t_compute · (1 + pp_bubble) + exposed_comm`` where
+  the wire bytes per strategy come from the COMM_AUDIT byte ledgers
+  (measured for fsdp/tp regimes, the classic ``2(n−1)/n`` ring formulas
+  otherwise) and the exposed fraction is the audit's measured
+  ``exposed_fraction`` per overlap mode.  ``t_compute`` and the
+  collective bandwidth come from a :class:`Calibration` when the caller
+  measured them (the plan_bench path), else from the device tables in
+  :mod:`tpudist.utils.flops` (the offline path — explicitly
+  extrapolated).
+- serving: ``tpot = base · Π multiplier(knob)`` where the multipliers
+  are measured twins out of BENCH_SERVE (decode-block sweep, spec
+  acceptance sweep, kernel-family twins) and ROOFLINE (paged bytes
+  curves).  A knob with byte-level evidence but NO measured wall twin
+  (e.g. int8 KV) contributes a **neutral 1.0 multiplier plus a note**:
+  the planner never claims a win it has not measured.
+
+An unmeasured input never fails the estimate — it degrades to the
+analytic value and lands in ``Estimate.extrapolated``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+from tpudist.plan.artifacts import Artifact, ArtifactSet
+
+# -- device tables (the offline, uncalibrated path) ---------------------
+
+#: Fall-back device kind when none is visible (the artifact history was
+#: frozen against v5e-class assumptions).
+DEFAULT_DEVICE_KIND = "TPU v5 lite"
+
+
+def _device_tables() -> Tuple[dict, dict, dict]:
+    from tpudist.utils.flops import (
+        HBM_BYTES_PER_S,
+        ICI_LINK_BYTES_PER_S,
+        PEAK_BF16_FLOPS,
+    )
+
+    return PEAK_BF16_FLOPS, ICI_LINK_BYTES_PER_S, HBM_BYTES_PER_S
+
+
+# -- workloads and candidates ------------------------------------------
+
+
+@dataclasses.dataclass
+class TrainWorkload:
+    """What the training step IS, independent of how it is laid out."""
+
+    param_bytes: float
+    flops_per_step: float
+    n_devices: int
+    global_batch: int = 8
+    lm: bool = True
+    precision: str = "fp32"
+    device_kind: str = DEFAULT_DEVICE_KIND
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainCandidate:
+    """One point of the training config space (enumerate.py emits these)."""
+
+    strategy: str            # dp | dp_model | fsdp | zero1 | pp
+    overlap: str = "none"    # none | ring | bidir (fsdp/tp regimes only)
+    microbatches: Optional[int] = None   # pp only
+    stages: int = 1                      # pp only
+    model_parallel: int = 1              # dp_model only
+
+    @property
+    def name(self) -> str:
+        bits = [self.strategy]
+        if self.overlap != "none":
+            bits.append(f"overlap={self.overlap}")
+        if self.strategy == "pp":
+            bits.append(f"stages={self.stages}")
+            if self.microbatches:
+                bits.append(f"micro={self.microbatches}")
+        if self.strategy == "dp_model":
+            bits.append(f"mp={self.model_parallel}")
+        return ",".join(bits)
+
+
+@dataclasses.dataclass
+class ServeWorkload:
+    """What serving a model IS: the byte geometry decode must stream."""
+
+    weight_bytes: float
+    kv_bytes_per_pos: float
+    n_layers: int
+    max_len: int
+    n_devices: int = 1
+    slots: int = 4
+    prompt_len: int = 32
+    device_kind: str = DEFAULT_DEVICE_KIND
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeCandidate:
+    """One point of the serving config space."""
+
+    decode_block: int = 8
+    paged: bool = False
+    kv_block: int = 16
+    kv_int8: bool = False
+    attn_kernel: str = "gather"      # gather | paged
+    prefill_kernel: bool = False
+    sample_kernel: bool = False
+    fused_rope: bool = False
+    spec_layers: Optional[int] = None  # tied-draft depth; None = no spec
+    spec_k: int = 4
+    slots: int = 4
+    mesh: Optional[str] = None
+    disagg: bool = False
+    host_tier_bytes: int = 0
+
+    @property
+    def name(self) -> str:
+        bits = [f"K={self.decode_block}",
+                "paged" if self.paged else "dense"]
+        if self.kv_int8:
+            bits.append("int8")
+        if self.attn_kernel != "gather":
+            bits.append(f"attn={self.attn_kernel}")
+        if self.prefill_kernel:
+            bits.append("prefill_kernel")
+        if self.sample_kernel:
+            bits.append("sample_kernel")
+        if self.fused_rope:
+            bits.append("fused_rope")
+        if self.spec_layers is not None:
+            bits.append(f"spec={self.spec_layers}x{self.spec_k}")
+        if self.slots != 4:
+            bits.append(f"slots={self.slots}")
+        if self.mesh:
+            bits.append(f"mesh={self.mesh}")
+        if self.disagg:
+            bits.append("disagg")
+        return ",".join(bits)
+
+
+@dataclasses.dataclass
+class Calibration:
+    """Measured unit costs for THIS machine (plan_bench measures them;
+    offline callers omit the whole object and get device-table numbers
+    tagged extrapolated).
+
+    ``base_s`` anchors the compute term: the measured seconds of the
+    BASE candidate (dp for training, the dense-``K=8`` engine for
+    serving) on the target workload.  ``collective_bytes_per_s`` is a
+    micro-measured all-reduce bandwidth on the target mesh;
+    ``dispatch_overhead_s`` a measured per-dispatch host cost.
+
+    ``state_shard_ratio`` is the measured zero1/dp step-time ratio on a
+    small PROXY workload on this host.  On real accelerators replicated
+    optimizer math is free (it runs in parallel on distinct chips) and
+    the ratio measures > 1 (gather overhead); on shared-core virtual
+    meshes every replica competes for the same silicon and the ratio
+    measures < 1.  Scoring fsdp/zero1's compute term by this ratio is
+    what lets the planner rank state sharding correctly on BOTH kinds
+    of host — an analytic model can't know which one it is on."""
+
+    base_s: Optional[float] = None
+    collective_bytes_per_s: Optional[float] = None
+    dispatch_overhead_s: Optional[float] = None
+    state_shard_ratio: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Estimate:
+    """A prediction plus its evidence trail."""
+
+    seconds: float
+    #: named components/multipliers (seconds for additive parts,
+    #: dimensionless for multipliers) — the "show your work" dict
+    parts: Dict[str, float]
+    #: component names backed by a frozen measurement
+    measured: List[str]
+    #: component names filled by the analytic fallback
+    extrapolated: List[str]
+    notes: List[str]
+
+    def tag(self, name: str, measured: bool) -> None:
+        (self.measured if measured else self.extrapolated).append(name)
+
+
+# -- training ----------------------------------------------------------
+
+#: Analytic wire bytes per parameter byte for an ``n``-way ring; the
+#: audit's measured ledgers override these where they exist.
+def _ring_factor(n: int) -> float:
+    return 2.0 * (n - 1) / n if n > 1 else 0.0
+
+
+def _audit_regime(arts: Optional[ArtifactSet], name: str) -> Optional[dict]:
+    if arts is None:
+        return None
+    a = arts.get("COMM_AUDIT")
+    if a is None:
+        return None
+    reg = a.data.get("regimes", {})
+    r = reg.get(name)
+    return r if isinstance(r, dict) else None
+
+
+def _wire_bytes(cand: TrainCandidate, wl: TrainWorkload,
+                arts: Optional[ArtifactSet], est: Estimate) -> float:
+    """Per-step collective bytes for the candidate's strategy."""
+    n = max(2, wl.n_devices)
+    P = wl.param_bytes
+    if cand.strategy == "dp":
+        # grad all-reduce: ring all-reduce moves 2(n-1)/n of the tree
+        est.tag("wire:dp", measured=False)
+        return _ring_factor(n) * P
+    if cand.strategy == "zero1":
+        # grad all-reduce + updated-shard all-gather
+        est.tag("wire:zero1", measured=False)
+        return (_ring_factor(n) + (n - 1) / n) * P
+    if cand.strategy == "fsdp":
+        reg = _audit_regime(arts, "fsdp")
+        if reg is not None:
+            info = reg.get("info", {})
+            split = reg.get("overlap_split", {})
+            pb = float(info.get("param_bytes", 0) or 0)
+            total = float(split.get("exposed_bytes", 0)
+                          + split.get("overlapped_bytes", 0))
+            if pb > 0 and total > 0:
+                est.tag("wire:fsdp", measured=True)
+                return total / pb * P
+        est.tag("wire:fsdp", measured=False)
+        # analytic: all-gather params (fwd) + all-gather (bwd) +
+        # reduce-scatter grads — 3 ring passes over the sharded tree
+        return 3.0 * (n - 1) / n * P
+    if cand.strategy == "dp_model":
+        # activations cross the model axis, not the param tree — small
+        # next to grad sync; the audit's tp_mlp regime measures the
+        # per-layer all-reduce bytes for the toy split-MLP.
+        reg = _audit_regime(arts, "tp_mlp")
+        if reg is not None:
+            split = reg.get("overlap_split", {})
+            total = float(split.get("exposed_bytes", 0)
+                          + split.get("overlapped_bytes", 0))
+            if total > 0:
+                est.tag("wire:dp_model", measured=True)
+                # audit bytes are per toy step; scale by batch share
+                return total + _ring_factor(n) * P
+        est.tag("wire:dp_model", measured=False)
+        return 0.1 * P + _ring_factor(n) * P
+    if cand.strategy == "pp":
+        # stage boundaries move activations only
+        est.tag("wire:pp", measured=False)
+        return 0.05 * P
+    raise ValueError(f"unknown strategy {cand.strategy!r}")
+
+
+#: Analytic exposed fractions when the audit has no regime for the
+#: overlap mode.  Ordered so more overlap NEVER predicts slower (the
+#: monotonicity contract tests pin).
+_ANALYTIC_EXPOSED = {"none": 1.0, "ring": 0.45, "bidir": 0.30}
+
+
+def _exposed_fraction(cand: TrainCandidate,
+                      arts: Optional[ArtifactSet], est: Estimate) -> float:
+    base = _audit_regime(arts, cand.strategy)  # e.g. "fsdp"
+    reg = None
+    if cand.overlap != "none":
+        reg = _audit_regime(arts, f"{cand.strategy}_overlap_{cand.overlap}")
+    elif base is not None:
+        reg = base
+    if reg is not None and isinstance(reg.get("exposed_fraction"),
+                                      (int, float)):
+        frac = float(reg["exposed_fraction"])
+        # clamp against the no-overlap regime so a noisy audit can
+        # never invert the more-overlap-never-slower ordering
+        if cand.overlap != "none" and base is not None and isinstance(
+                base.get("exposed_fraction"), (int, float)):
+            frac = min(frac, float(base["exposed_fraction"]))
+        est.tag(f"exposed:{cand.overlap}", measured=True)
+        return frac
+    est.tag(f"exposed:{cand.overlap}", measured=False)
+    return _ANALYTIC_EXPOSED.get(cand.overlap, 1.0)
+
+
+def predict_training(
+    cand: TrainCandidate,
+    wl: TrainWorkload,
+    arts: Optional[ArtifactSet] = None,
+    calibration: Optional[Calibration] = None,
+) -> Estimate:
+    """Predicted seconds per optimizer step for one candidate."""
+    est = Estimate(seconds=0.0, parts={}, measured=[], extrapolated=[],
+                   notes=[])
+    peak_tbl, link_tbl, _ = _device_tables()
+
+    # compute term: data-parallel width divides the batch; model/stage
+    # axes divide the per-example flops, so per-device flops only
+    # depend on total device count for the dense strategies.
+    n = max(1, wl.n_devices)
+    if calibration is not None and calibration.base_s is not None:
+        t_comp = calibration.base_s
+        est.tag("compute", measured=True)
+        est.notes.append("compute anchored to measured base candidate")
+    else:
+        peak = peak_tbl.get(wl.device_kind, next(iter(peak_tbl.values())))
+        if wl.precision == "fp32":
+            peak = peak / 2.0  # fp32 runs at half the bf16 MXU rate
+        t_comp = wl.flops_per_step / (n * peak)
+        est.tag("compute", measured=False)
+
+    wire = _wire_bytes(cand, wl, arts, est)
+    if calibration is not None and calibration.collective_bytes_per_s:
+        bw = calibration.collective_bytes_per_s
+        est.tag("link_bw", measured=True)
+    else:
+        bw = link_tbl.get(wl.device_kind, next(iter(link_tbl.values())))
+        est.tag("link_bw", measured=False)
+
+    # Exposure: dp/zero1's grad all-reduce streams DURING backward —
+    # the scaling model's own law (benchmarks/scaling_model.py):
+    # exposed = max(0, t_comm − t_bwd), t_bwd ≈ 2/3·t_step.  fsdp and
+    # the tp regimes use the comm audit's MEASURED exposed fractions.
+    t_bwd = (2.0 / 3.0) * t_comp
+    if cand.strategy in ("dp", "zero1"):
+        ar_wire = _ring_factor(n) * wl.param_bytes
+        rest = max(0.0, wire - ar_wire)
+        t_comm = max(0.0, ar_wire / bw - t_bwd) + rest / bw
+        frac = t_comm * bw / wire if wire > 0 else 0.0
+        est.tag("exposed:bwd-overlap", measured=False)
+    else:
+        frac = _exposed_fraction(cand, arts, est)
+        t_comm = wire * frac / bw
+
+    bubble = 0.0
+    if cand.strategy == "pp":
+        m = cand.microbatches or cand.stages
+        bubble = (cand.stages - 1) / (m + cand.stages - 1)
+
+    # state sharding reshapes the COMPUTE term, not just the wire: a
+    # sharded optimizer update does 1/n of the replicated math.  Free
+    # on real accelerators (parallel chips), real wall time on shared-
+    # core hosts — only a measured ratio can tell the two apart.
+    m_state = 1.0
+    if cand.strategy in ("fsdp", "zero1"):
+        if calibration is not None and calibration.state_shard_ratio:
+            m_state = float(calibration.state_shard_ratio)
+            est.tag("state_sharding", measured=True)
+            est.notes.append(
+                f"compute scaled by the calibrated zero1/dp step ratio "
+                f"{m_state:.3f} (proxy-workload measurement on this "
+                f"host)")
+        else:
+            est.tag("state_sharding", measured=False)
+
+    # dp's anchored base already contains dp's own (small) exposed
+    # comm; model every candidate the same way so DELTAS are honest.
+    est.parts = {
+        "compute_s": t_comp,
+        "bubble_frac": bubble,
+        "m_state": m_state,
+        "wire_bytes": wire,
+        "exposed_fraction": frac,
+        "exposed_comm_s": t_comm,
+    }
+    est.seconds = t_comp * m_state * (1.0 + bubble) + t_comm
+    if cand.strategy in ("fsdp", "zero1"):
+        est.notes.append(
+            f"{cand.strategy} is a MEMORY lever — pick it when the "
+            "model does not fit replicated, even ranked behind dp")
+    return est
+
+
+# -- serving -----------------------------------------------------------
+
+
+def _serve_section(arts: Optional[ArtifactSet], key: str,
+                   est: Optional[Estimate] = None):
+    """Newest BENCH_SERVE round that measured section ``key`` — bench
+    rounds are not supersets (r18 froze the kernel twins, r09 the spec
+    sweep), so each section resolves independently."""
+    if arts is None:
+        return None
+    val, rnd = arts.section("BENCH_SERVE", key)
+    newest = arts.get("BENCH_SERVE")
+    if (est is not None and val is not None and newest is not None
+            and rnd != newest.round):
+        est.notes.append(
+            f"{key} quoted from BENCH_SERVE r{rnd:02d} (newest round "
+            f"r{newest.round:02d} did not re-measure it)")
+    return val
+
+
+def _block_sweep_rows(sweep) -> List[dict]:
+    return [r for r in sweep if isinstance(r, dict)] \
+        if isinstance(sweep, list) else []
+
+
+def _block_multiplier(k: int, arts: Optional[ArtifactSet],
+                      calib: Optional[Calibration],
+                      est: Estimate) -> float:
+    """TPOT multiplier of decode block ``k`` relative to the largest
+    measured block (the base config)."""
+    rows = _block_sweep_rows(_serve_section(arts, "block_sweep", est))
+    by_k = {int(r["decode_block"]): r for r in rows
+            if isinstance(r.get("tpot_s_p50"), (int, float))}
+    if by_k:
+        ref_k = max(by_k)
+        ref = float(by_k[ref_k]["tpot_s_p50"])
+        if k in by_k and ref > 0:
+            est.tag(f"block:K={k}", measured=True)
+            return float(by_k[k]["tpot_s_p50"]) / ref
+        if ref > 0:
+            # interpolate on dispatches/token (∝ 1/K) between the
+            # measured endpoints; outside the sweep range, clamp —
+            # never extrapolate a trend past its evidence
+            ks = sorted(by_k)
+            lo, hi = ks[0], ks[-1]
+            kk = min(max(k, lo), hi)
+            m_lo = float(by_k[lo]["tpot_s_p50"]) / ref
+            m_hi = float(by_k[hi]["tpot_s_p50"]) / ref
+            if hi != lo:
+                w = (1.0 / kk - 1.0 / hi) / (1.0 / lo - 1.0 / hi)
+            else:
+                w = 0.0
+            est.tag(f"block:K={k}", measured=False)
+            return m_hi + w * (m_lo - m_hi)
+    # no sweep at all: analytic dispatch-amortization model
+    est.tag(f"block:K={k}", measured=False)
+    h = (calib.dispatch_overhead_s
+         if calib is not None and calib.dispatch_overhead_s else 5e-4)
+    base_t = 2e-3
+    return (base_t + h / k) / (base_t + h / 8)
+
+
+def _spec_multiplier(cand: ServeCandidate, wl: ServeWorkload,
+                     arts: Optional[ArtifactSet], est: Estimate) -> float:
+    if cand.spec_layers is None:
+        return 1.0
+    sweep = _serve_section(arts, "spec_sweep", est) or {}
+    floor = sweep.get("floor") or {}
+    floor_tpot = floor.get("tpot_s_p50")
+    for row in sweep.get("rows") or []:
+        if not isinstance(row, dict):
+            continue
+        if (int(row.get("draft_layers", -1)) == cand.spec_layers
+                and int(row.get("k", -1)) == cand.spec_k
+                and not row.get("distilled", False)
+                and isinstance(row.get("tpot_s_p50"), (int, float))
+                and isinstance(floor_tpot, (int, float))
+                and floor_tpot > 0):
+            est.tag(f"spec:{cand.spec_layers}x{cand.spec_k}",
+                    measured=True)
+            return float(row["tpot_s_p50"]) / float(floor_tpot)
+    # analytic: a tied draft accepts ~1 + 0.25·K tokens per pass at
+    # best; each pass costs one verify plus K draft passes
+    est.tag(f"spec:{cand.spec_layers}x{cand.spec_k}", measured=False)
+    draft_frac = cand.spec_layers / max(1, wl.n_layers)
+    accepted = 1.0 + 0.25 * cand.spec_k
+    return max(1e-9, (1.0 + cand.spec_k * draft_frac) / accepted)
+
+
+def _kernel_multipliers(cand: ServeCandidate, arts: Optional[ArtifactSet],
+                        est: Estimate) -> Tuple[float, float]:
+    """(tpot multiplier, ttft multiplier) from the kernel-family twins."""
+    m_tpot, m_ttft = 1.0, 1.0
+    twin = _serve_section(arts, "kernel_family_twin", est) or {}
+    attn = _serve_section(arts, "attn_kernel_twin", est) or {}
+
+    def _ratio(section: dict, key: str) -> Optional[float]:
+        base = section.get("base") or section.get("gather") or {}
+        fused = section.get("fused") or section.get("kernel") or {}
+        b, f = base.get(key), fused.get(key)
+        if isinstance(b, (int, float)) and isinstance(f, (int, float)) \
+                and b > 0:
+            return float(f) / float(b)
+        return None
+
+    if cand.attn_kernel == "paged":
+        r = _ratio(attn, "tpot_busy_s")
+        if r is None and isinstance(attn, dict):
+            # r18 twin stores tokens/s, invert it
+            g, k = attn.get("tokens_per_s_gather"), attn.get(
+                "tokens_per_s_kernel")
+            if isinstance(g, (int, float)) and isinstance(
+                    k, (int, float)) and k > 0:
+                r = float(g) / float(k)
+        if r is not None:
+            est.tag("attn_kernel", measured=True)
+            m_tpot *= r
+        else:
+            est.tag("attn_kernel", measured=False)
+            est.notes.append(
+                "attn_kernel='paged' wall twin unmeasured — neutral 1.0 "
+                "(bytes/token curve says it wins at long live KV)")
+    for name, flag, affects_ttft in (
+            ("prefill", cand.prefill_kernel, True),
+            ("sample", cand.sample_kernel, False),
+            ("rope_qkv", cand.fused_rope, False)):
+        if not flag:
+            continue
+        sec = twin.get(name) or {}
+        r = _ratio(sec, "tpot_busy_s")
+        rt = _ratio(sec, "ttft_s_p50")
+        if r is not None:
+            est.tag(f"kernel:{name}", measured=True)
+            m_tpot *= r
+            if affects_ttft:
+                m_ttft *= rt if rt is not None else r
+        else:
+            est.tag(f"kernel:{name}", measured=False)
+            est.notes.append(
+                f"kernel arm {name!r}: no measured twin — neutral 1.0")
+    return m_tpot, m_ttft
+
+
+def predict_serving(
+    cand: ServeCandidate,
+    wl: ServeWorkload,
+    arts: Optional[ArtifactSet] = None,
+    calibration: Optional[Calibration] = None,
+) -> Tuple[Estimate, Estimate]:
+    """Predicted ``(tpot, ttft)`` for one serving candidate.
+
+    The TPOT estimate is the ranking key; TTFT rides along with the
+    prefill-side multipliers applied.
+    """
+    est = Estimate(seconds=0.0, parts={}, measured=[], extrapolated=[],
+                   notes=[])
+    _, _, hbm_tbl = _device_tables()
+
+    # base TPOT: measured anchor > artifact floor > HBM roofline
+    if calibration is not None and calibration.base_s is not None:
+        base = calibration.base_s
+        est.tag("base_tpot", measured=True)
+    else:
+        floor = (_serve_section(arts, "spec_sweep", est) or {}).get(
+            "floor") or {}
+        if isinstance(floor.get("tpot_s_p50"), (int, float)):
+            base = float(floor["tpot_s_p50"])
+            est.tag("base_tpot", measured=True)
+            est.notes.append(
+                "base TPOT quoted from the frozen BENCH_SERVE floor — "
+                "its geometry, not necessarily yours")
+        else:
+            hbm = hbm_tbl.get(wl.device_kind,
+                              next(iter(hbm_tbl.values())))
+            per_tok = (wl.weight_bytes
+                       + wl.slots * wl.max_len * wl.kv_bytes_per_pos) \
+                / max(1, wl.n_devices)
+            base = per_tok / hbm
+            est.tag("base_tpot", measured=False)
+
+    m_block = _block_multiplier(cand.decode_block, arts, calibration, est)
+    m_spec = _spec_multiplier(cand, wl, arts, est)
+    m_kern, m_ttft_kern = _kernel_multipliers(cand, arts, est)
+
+    # paged-vs-dense and int8: byte-level evidence exists (ROOFLINE
+    # paged rows, the kv_dtype sweep) but no wall twin — neutral, noted.
+    m_paged = 1.0
+    if cand.paged:
+        est.tag("paged", measured=False)
+        est.notes.append(
+            "paged cache: wall twin unmeasured — neutral 1.0 (capacity "
+            "and live-KV bytes are its wins, not raw TPOT)")
+    if cand.kv_int8:
+        sweep = _serve_section(arts, "kv_dtype_sweep", est) or {}
+        rows = sweep if isinstance(sweep, list) else sweep.get("rows") or []
+        ratio = None
+        bpp = {}
+        for r in rows:
+            if isinstance(r, dict) and isinstance(
+                    r.get("bytes_per_pos"), (int, float)):
+                bpp[r.get("kv_dtype", r.get("dtype"))] = float(
+                    r["bytes_per_pos"])
+        if "native" in bpp and "int8" in bpp and bpp["int8"] > 0:
+            ratio = bpp["native"] / bpp["int8"]
+        est.tag("kv_int8", measured=False)
+        est.notes.append(
+            "int8 KV: wall twin unmeasured — neutral 1.0"
+            + (f" (measured bytes/pos win: {ratio:.2f}x)" if ratio
+               else ""))
+    m_slots = 1.0
+    if cand.slots != wl.slots:
+        # more lanes amortize the weight stream over more tokens —
+        # analytic, HBM-roofline shaped
+        kv_tok = wl.max_len * wl.kv_bytes_per_pos
+        w = wl.weight_bytes / max(1, wl.n_devices)
+        m_slots = ((w / cand.slots + kv_tok)
+                   / (w / wl.slots + kv_tok))
+        m_slots = max(0.5, min(2.0, m_slots))
+        est.tag("slots", measured=False)
+
+    est.parts = {
+        "base_tpot_s": base,
+        "m_block": m_block,
+        "m_spec": m_spec,
+        "m_kernels": m_kern,
+        "m_paged": m_paged,
+        "m_slots": m_slots,
+    }
+    est.seconds = base * m_block * m_spec * m_kern * m_paged * m_slots
+
+    ttft = Estimate(seconds=0.0, parts={}, measured=list(est.measured),
+                    extrapolated=list(est.extrapolated), notes=[])
+    # TTFT: one prefill pass over the prompt at the compute/byte floor,
+    # scaled by the prefill-side kernel twin when that arm is on
+    base_ttft = base * max(1, wl.prompt_len) / max(1, cand.decode_block)
+    floor = (_serve_section(arts, "spec_sweep") or {}).get("floor") or {}
+    if isinstance(floor.get("ttft_s_p50"), (int, float)) and (
+            calibration is None or calibration.base_s is None):
+        base_ttft = float(floor["ttft_s_p50"])
+        ttft.tag("base_ttft", measured=True)
+    else:
+        ttft.tag("base_ttft", measured=False)
+    ttft.parts = {"base_ttft_s": base_ttft, "m_kernels": m_ttft_kern}
+    ttft.seconds = base_ttft * m_ttft_kern
+    return est, ttft

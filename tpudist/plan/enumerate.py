@@ -1,0 +1,162 @@
+"""The legal configuration space the planner scores.
+
+One rule here for every refusal the runtime enforces — the planner must
+never rank a config the Trainer or SlotEngine would raise on:
+
+training (mirrors ``tpudist/trainer/trainer.py``):
+
+- ``dp_model`` is the toy split-MLP layout — refused for LM modules;
+- ``pp`` needs an LMTrainerModule (blocks shard over stages) and
+  refuses ``precision='bf16'``;
+- ``pp`` stage width must divide the device count; microbatches must
+  be a multiple the schedule can fill;
+- overlap modes attach only to regimes that HAVE an overlapped twin in
+  the comm audit (fsdp ring/bidir) — and are emitted only when
+  ``actionable=False``, because the Trainer facade does not expose an
+  overlap knob yet (the CLI table shows them; auto mode must only pick
+  what it can enact).
+
+serving (mirrors ``tpudist/serve/engine.py``):
+
+- ``attn_kernel='paged'`` and ``prefill_kernel`` require the paged
+  cache; ``fused_rope`` requires a kernel arm;
+- ``kv_block`` must divide ``max_len``;
+- kernel arms and spec drafts are emitted only when requested —
+  ``SlotEngine(auto=True)`` cannot invent a draft module the caller
+  did not provide.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from tpudist.plan.cost import (
+    ServeCandidate,
+    ServeWorkload,
+    TrainCandidate,
+    TrainWorkload,
+)
+
+TRAIN_STRATEGIES = ("dp", "dp_model", "fsdp", "zero1", "pp")
+
+
+def _divisors(n: int, *, floor: int = 2) -> List[int]:
+    return [d for d in range(floor, n + 1) if n % d == 0]
+
+
+def training_candidates(
+    wl: TrainWorkload,
+    *,
+    strategies: Optional[Sequence[str]] = None,
+    actionable: bool = False,
+    stages: Sequence[int] = (2,),
+) -> List[TrainCandidate]:
+    """Legal training candidates for ``wl``.
+
+    ``actionable=True`` restricts to configs ``Trainer`` can enact
+    today (the auto-mode contract); the full space (overlap modes,
+    stage/microbatch sweeps) is for the offline table.
+    """
+    strategies = tuple(strategies or TRAIN_STRATEGIES)
+    n = wl.n_devices
+    out: List[TrainCandidate] = []
+    for s in strategies:
+        if s == "dp":
+            out.append(TrainCandidate(strategy="dp"))
+        elif s in ("fsdp", "zero1"):
+            if n < 2:
+                continue  # sharding one device is the dp config
+            out.append(TrainCandidate(strategy=s))
+            if s == "fsdp" and not actionable:
+                # the audit measured ring/bidir overlapped fsdp twins;
+                # the facade cannot switch them on yet — table-only
+                out.append(TrainCandidate(strategy="fsdp", overlap="ring"))
+                out.append(TrainCandidate(strategy="fsdp", overlap="bidir"))
+        elif s == "dp_model":
+            if wl.lm:
+                continue  # refused: dp_model is the toy split-MLP layout
+            for mp in _divisors(n):
+                if mp < n:  # keep a data axis
+                    out.append(TrainCandidate(strategy="dp_model",
+                                              model_parallel=mp))
+        elif s == "pp":
+            if not wl.lm or wl.precision == "bf16":
+                continue  # pp needs LM blocks; pp×bf16 is refused
+            for st in stages:
+                if st < 2 or n % st:
+                    continue
+                data = n // st
+                # microbatches must divide the per-step batch the data
+                # axis leaves to the schedule
+                per_data = wl.global_batch // max(1, data)
+                for micro in (st, 2 * st):
+                    if per_data and micro > per_data:
+                        continue
+                    out.append(TrainCandidate(
+                        strategy="pp", stages=st, microbatches=micro))
+    return out
+
+
+def serving_candidates(
+    wl: ServeWorkload,
+    *,
+    decode_blocks: Sequence[int] = (1, 4, 8),
+    paged: Sequence[bool] = (False, True),
+    kv_blocks: Sequence[int] = (16,),
+    spec_layers: Sequence[int] = (),
+    spec_ks: Sequence[int] = (4, 8),
+    include_kernels: bool = False,
+    include_int8: bool = False,
+    slots: Optional[Sequence[int]] = None,
+) -> List[ServeCandidate]:
+    """Legal serving candidates for ``wl``.
+
+    ``spec_layers`` is empty by default: speculative decode needs a
+    draft, and auto mode only enumerates spec points when the caller
+    actually provided one (``spec_draft=``/``spec_draft_layers``).
+    """
+    out: List[ServeCandidate] = []
+    slot_opts = tuple(slots or (wl.slots,))
+    for p in paged:
+        kb_opts = [kb for kb in kv_blocks if wl.max_len % kb == 0] \
+            if p else [16]
+        if p and not kb_opts:
+            continue  # no legal block size divides max_len
+        attn_opts = ["gather"]
+        if p and include_kernels:
+            attn_opts.append("paged")
+        for kb in kb_opts:
+            for attn in attn_opts:
+                prefill_opts = [False]
+                if p and include_kernels:
+                    prefill_opts.append(True)
+                for pk in prefill_opts:
+                    rope_opts = [False]
+                    if include_kernels and (attn == "paged" or pk):
+                        rope_opts.append(True)
+                    int8_opts = [False] + ([True] if include_int8 else [])
+                    for rope in rope_opts:
+                        for i8 in int8_opts:
+                            for k in decode_blocks:
+                                for ns in slot_opts:
+                                    out.append(ServeCandidate(
+                                        decode_block=k, paged=p,
+                                        kv_block=kb, kv_int8=i8,
+                                        attn_kernel=attn,
+                                        prefill_kernel=pk,
+                                        fused_rope=rope,
+                                        slots=ns))
+    base = list(out)
+    for sl in spec_layers:
+        if not 1 <= sl < wl.n_layers:
+            continue  # a draft as deep as the target is not a draft
+        for sk in spec_ks:
+            for c in base:
+                if c.paged or c.kv_int8 or c.attn_kernel != "gather" \
+                        or c.prefill_kernel or c.fused_rope:
+                    continue  # spec sweeps were measured on the dense arm
+                out.append(ServeCandidate(
+                    decode_block=c.decode_block, paged=False,
+                    kv_block=c.kv_block, slots=c.slots,
+                    spec_layers=sl, spec_k=sk))
+    return out

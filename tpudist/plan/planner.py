@@ -1,0 +1,384 @@
+"""Score, rank, report — and the ``auto`` entry points.
+
+A plan is a ranked table of :class:`PlannedConfig` rows plus the
+provenance a reader needs to trust (or distrust) it: which artifact
+rounds fed the prediction, which components were measured vs
+extrapolated, and the prediction-error band the LAST frozen plan_bench
+rung (``PLAN_rNN.json``) measured for this model family.
+
+Auto-mode contract (the part wired into the runtime):
+
+- ``Trainer(strategy="auto")`` → :func:`resolve_trainer_auto` picks
+  among the strategies the facade can enact for the module kind and
+  assigns ``trainer.strategy`` (+ pp fields when pp wins).  The chosen
+  plan stamps into telemetry as a ``plan_selected`` event the moment
+  the training loop's session is live, so every report can show
+  prediction next to the measured step time.
+- ``SlotEngine(auto=True)`` → :func:`resolve_engine_auto` fills the
+  engine's performance knobs (decode block, paged/kv geometry, kernel
+  arms, spec K) for whatever the caller did not explicitly pin;
+  ``InferenceServer.start()`` stamps the plan.
+- Ties break toward the SIMPLER config (fewer moving parts), and a
+  knob with no measured wall evidence predicts neutral — the planner
+  never claims a win it has not measured (cost.py's contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpudist.plan import artifacts as _artifacts
+from tpudist.plan import cost as _cost
+from tpudist.plan import enumerate as _enum
+from tpudist.utils.envutil import env_int
+
+
+@dataclasses.dataclass
+class PlannedConfig:
+    candidate: object            # TrainCandidate | ServeCandidate
+    estimate: _cost.Estimate
+    rank: int = 0
+    ttft: Optional[_cost.Estimate] = None
+
+
+def _complexity(c) -> int:
+    """Non-default field count — the moving-parts tiebreak metric."""
+    return sum(1 for f in dataclasses.fields(c)
+               if getattr(c, f.name) != f.default)
+
+
+@dataclasses.dataclass
+class PlanReport:
+    kind: str                    # "training" | "serving"
+    ranked: List[PlannedConfig]
+    artifact_rounds: Dict[str, int]
+    unmeasured: List[str]
+    rejected: List[str]
+    error_band: Optional[dict] = None
+    #: set by :meth:`pick` — the config auto mode enacts (rank 1 unless
+    #: the tie rule promoted a simpler near-equal)
+    chosen: Optional[PlannedConfig] = None
+
+    @property
+    def best(self) -> PlannedConfig:
+        return self.chosen if self.chosen is not None else self.ranked[0]
+
+    def pick(self, tie_s: float = 1e-4) -> PlannedConfig:
+        """The auto-mode choice: rank 1, UNLESS other candidates predict
+        within ``tie_s`` seconds of it — deltas below the per-dispatch
+        host-overhead floor are extrapolation noise, not findings — in
+        which case the simplest tied config wins.  (A planner should
+        only buy complexity with a measurable prediction.)"""
+        top = self.ranked[0]
+        tied = [p for p in self.ranked
+                if p.estimate.seconds - top.estimate.seconds <= tie_s]
+        tied.sort(key=lambda p: (_complexity(p.candidate),
+                                 p.estimate.seconds))
+        self.chosen = tied[0]
+        if self.chosen is not top:
+            self.chosen.estimate.notes.append(
+                f"picked over rank-1 {top.candidate.name!r}: predicted "
+                f"delta "
+                f"{self.chosen.estimate.seconds - top.estimate.seconds:.2e}"
+                f"s is under the {tie_s:.0e}s tie threshold — simplest "
+                f"tied config wins")
+        return self.chosen
+
+    def stamp(self) -> dict:
+        """Flat tags for the ``plan_selected`` telemetry event — the
+        prediction a report can later sit next to the measurement."""
+        best = self.best
+        out = {
+            # "kind" is a RESERVED telemetry record key — the workload
+            # kind travels as "workload" (the adapter-stamp precedent)
+            "workload": self.kind,
+            "chosen": best.candidate.name,
+            "predicted_s": round(best.estimate.seconds, 6),
+            "n_candidates": len(self.ranked),
+            "measured_components": len(best.estimate.measured),
+            "extrapolated_components": len(best.estimate.extrapolated),
+            "artifact_rounds": ",".join(
+                f"{f}:r{r:02d}"
+                for f, r in sorted(self.artifact_rounds.items())),
+        }
+        if self.kind == "serving" and best.ttft is not None:
+            out["predicted_ttft_s"] = round(best.ttft.seconds, 6)
+        if self.error_band and isinstance(
+                self.error_band.get("max_frac"), (int, float)):
+            out["error_band_frac"] = round(
+                float(self.error_band["max_frac"]), 4)
+        return out
+
+    def table(self) -> str:
+        """The ranked table ``python -m tpudist.plan`` prints."""
+        unit = "step" if self.kind == "training" else "TPOT"
+        lines = [f"# {self.kind} plan — predicted {unit} seconds",
+                 f"# artifacts: " + (", ".join(
+                     f"{f}:r{r:02d}" for f, r in sorted(
+                         self.artifact_rounds.items())) or "NONE"), ]
+        if self.unmeasured:
+            lines.append("# unmeasured (analytic fallback): "
+                         + ", ".join(sorted(set(self.unmeasured))))
+        if self.rejected:
+            lines.append("# rejected artifacts: " + "; ".join(self.rejected))
+        if self.error_band:
+            mx = self.error_band.get("max_frac")
+            src = self.error_band.get("source", "PLAN rung")
+            if isinstance(mx, (int, float)):
+                lines.append(f"# prediction error band: ±{mx:.1%} "
+                             f"(measured by {src})")
+        else:
+            lines.append("# prediction error band: unknown — no frozen "
+                         "PLAN rung (run benchmarks/plan_bench.py)")
+        w = max((len(p.candidate.name) for p in self.ranked), default=8)
+        lines.append(f"{'rank':>4}  {'config':<{w}}  {'pred_s':>12}  "
+                     f"evidence")
+        for p in self.ranked:
+            ev = f"{len(p.estimate.measured)} measured"
+            if p.estimate.extrapolated:
+                ev += f", {len(p.estimate.extrapolated)} extrapolated"
+            lines.append(f"{p.rank:>4}  {p.candidate.name:<{w}}  "
+                         f"{p.estimate.seconds:>12.6f}  {ev}")
+        for p in self.ranked:
+            for note in p.estimate.notes:
+                lines.append(f"# note[{p.candidate.name}]: {note}")
+        return "\n".join(lines)
+
+
+def _error_band(arts: Optional[_artifacts.ArtifactSet],
+                kind: str) -> Optional[dict]:
+    """Quote the prediction-vs-measured band the frozen plan_bench rung
+    carries (the planner's own honesty loop)."""
+    if arts is None:
+        return None
+    a = arts.get("PLAN")
+    if a is None:
+        return None
+    sec = a.data.get(kind) or {}
+    band = sec.get("error_band") or a.data.get(
+        "summary", {}).get("error_band", {}).get(kind)
+    if isinstance(band, dict) and isinstance(
+            band.get("max_frac"), (int, float)):
+        return {**band, "source": a.path.name}
+    return None
+
+
+def _finish(kind: str, rows: List[Tuple[object, _cost.Estimate,
+                                        Optional[_cost.Estimate]]],
+            arts: Optional[_artifacts.ArtifactSet],
+            top_n: Optional[int]) -> PlanReport:
+    rows = sorted(rows, key=lambda r: (r[1].seconds, _complexity(r[0])))
+    if top_n is None:
+        top_n = env_int("TPUDIST_PLAN_TOPN", 0) or len(rows)
+    ranked = [PlannedConfig(candidate=c, estimate=e, ttft=t, rank=i + 1)
+              for i, (c, e, t) in enumerate(rows[:max(1, top_n)])]
+    unmeasured = sorted({x for _, e, _ in rows for x in e.extrapolated})
+    return PlanReport(
+        kind=kind, ranked=ranked,
+        artifact_rounds=arts.rounds() if arts is not None else {},
+        unmeasured=unmeasured,
+        rejected=[f"{r.path.name}: {r.reason}"
+                  for r in (arts.rejected if arts is not None else [])],
+        error_band=_error_band(arts, kind))
+
+
+def plan_training(
+    wl: _cost.TrainWorkload,
+    arts: Optional[_artifacts.ArtifactSet] = None,
+    *,
+    candidates: Optional[Sequence[_cost.TrainCandidate]] = None,
+    calibration: Optional[_cost.Calibration] = None,
+    actionable: bool = False,
+    top_n: Optional[int] = None,
+) -> PlanReport:
+    if arts is None:
+        arts = _artifacts.load_artifacts()
+    if candidates is None:
+        candidates = _enum.training_candidates(wl, actionable=actionable)
+    rows = [(c, _cost.predict_training(c, wl, arts, calibration), None)
+            for c in candidates]
+    return _finish("training", rows, arts, top_n)
+
+
+def plan_serving(
+    wl: _cost.ServeWorkload,
+    arts: Optional[_artifacts.ArtifactSet] = None,
+    *,
+    candidates: Optional[Sequence[_cost.ServeCandidate]] = None,
+    calibration: Optional[_cost.Calibration] = None,
+    top_n: Optional[int] = None,
+    **enum_kw,
+) -> PlanReport:
+    if arts is None:
+        arts = _artifacts.load_artifacts()
+    if candidates is None:
+        candidates = _enum.serving_candidates(wl, **enum_kw)
+    rows = []
+    for c in candidates:
+        tpot, ttft = _cost.predict_serving(c, wl, arts, calibration)
+        rows.append((c, tpot, ttft))
+    return _finish("serving", rows, arts, top_n)
+
+
+# -- runtime wiring -----------------------------------------------------
+
+
+def _param_bytes(shapes) -> float:
+    import numpy as np
+
+    total = 0.0
+    for leaf in _tree_leaves(shapes):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        dt = getattr(leaf, "dtype", None)
+        size = np.dtype(dt).itemsize if dt is not None else 4
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * size
+    return total
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def trainer_workload(module, seed: int, n_devices: int,
+                     precision: str = "fp32",
+                     global_batch: int = 8) -> _cost.TrainWorkload:
+    """Build a :class:`TrainWorkload` from a TrainerModule WITHOUT
+    materializing parameters (``eval_shape``); falls back to a real
+    ``configure_*`` call for modules whose init resists tracing."""
+    import jax
+
+    from tpudist.trainer.trainer import LMTrainerModule
+
+    lm = isinstance(module, LMTrainerModule)
+    rng = jax.random.PRNGKey(seed)
+    if lm:
+        def shapes_of(r):
+            return module.configure_lm(r)[1]
+    else:
+        def shapes_of(r):
+            return {k: p for k, (_, p)
+                    in module.configure_models(r).items()}
+    try:
+        shapes = jax.eval_shape(shapes_of, rng)
+    except Exception:
+        shapes = shapes_of(rng)
+    pb = _param_bytes(shapes)
+    # fwd+bwd ≈ 6 flops per param per token; the batch token count is a
+    # coarse default — strategy RANKING only needs the comm-vs-compute
+    # scale, which the calibration path replaces with a measurement
+    flops = 6.0 * (pb / 4.0) * max(1, global_batch) * 32
+    kind = _cost.DEFAULT_DEVICE_KIND
+    try:
+        kind = jax.devices()[0].device_kind or kind
+    except Exception:
+        pass
+    return _cost.TrainWorkload(
+        param_bytes=pb, flops_per_step=flops, n_devices=n_devices,
+        global_batch=global_batch, lm=lm, precision=precision,
+        device_kind=kind)
+
+
+def resolve_trainer_auto(trainer, module, seed: int) -> PlanReport:
+    """``Trainer(strategy='auto')`` resolution: plan over the
+    actionable strategies, assign the winner onto ``trainer``, return
+    the report (the loop stamps ``report.stamp()`` into telemetry)."""
+    import jax
+
+    wl = trainer_workload(module, seed, jax.device_count(),
+                          precision=trainer.precision)
+    report = plan_training(wl, actionable=True)
+    best = report.pick().candidate
+    trainer.strategy = best.strategy
+    if best.strategy == "pp":
+        trainer.pipeline_stages = best.stages
+        if best.microbatches:
+            trainer.microbatches = best.microbatches
+    return report
+
+
+def engine_workload(module, params, n_devices: int = 1,
+                    slots: int = 4) -> _cost.ServeWorkload:
+    wb = 0.0
+    for leaf in _tree_leaves(params):
+        size, dt = getattr(leaf, "size", None), getattr(leaf, "dtype", None)
+        if size is not None and dt is not None:
+            wb += int(size) * dt.itemsize
+    d = int(getattr(module, "d_model", 64))
+    heads = int(getattr(module, "n_heads", max(1, d // 64)))
+    n_kv = int(getattr(module, "n_kv_heads", None) or heads)
+    dh = d // max(1, heads)
+    kv_pos = 2 * getattr(module, "n_layers", 2) * n_kv * dh * 4
+    return _cost.ServeWorkload(
+        weight_bytes=wb, kv_bytes_per_pos=kv_pos,
+        n_layers=int(getattr(module, "n_layers", 2)),
+        max_len=int(getattr(module, "max_len", 512)),
+        n_devices=n_devices, slots=slots)
+
+
+#: Engine knobs auto mode owns, mapped to the values it treats as
+#: "caller did not pin this" — each knob's SlotEngine signature default
+#: AND its ServeConfig default (the two entry points spell some
+#: defaults differently: decode_block None vs 8, attn_kernel None vs
+#: "gather").  An explicitly-passed non-default value always wins over
+#: the plan.
+_ENGINE_AUTO_DEFAULTS = {
+    "decode_block": (None, 8), "paged": (False,), "kv_block": (16,),
+    "kv_int8": (False,), "attn_kernel": (None, "gather"),
+    "prefill_kernel": (False,), "sample_kernel": (False,),
+    "fused_rope": (False,), "spec_k": (4,),
+}
+
+
+def resolve_engine_auto(module, params, *, n_devices: int = 1,
+                        num_slots: int = 4,
+                        spec_draft_layers: Optional[int] = None,
+                        user_kwargs: Optional[dict] = None,
+                        ) -> Tuple[dict, PlanReport]:
+    """``SlotEngine(auto=True)`` resolution.
+
+    Returns ``(chosen_kwargs, report)``: engine kwargs for every auto-
+    owned knob the caller left at its default.  Spec points enter the
+    candidate space only when the caller supplied a draft depth — auto
+    cannot invent a draft model.
+    """
+    user_kwargs = user_kwargs or {}
+    wl = engine_workload(module, params, n_devices=n_devices,
+                         slots=num_slots)
+    spec_layers = (spec_draft_layers,) if spec_draft_layers else ()
+    report = plan_serving(
+        wl,
+        decode_blocks=(1, 4, 8),
+        spec_layers=spec_layers,
+        include_kernels=False,  # wall twins say the interpreter arms
+                                # lose on this host; neutral-1.0 arms
+                                # must not win a ranking by tie
+        include_int8=False,
+    )
+    best = report.pick().candidate
+    chosen = {
+        "decode_block": best.decode_block,
+        "paged": best.paged,
+        "kv_block": best.kv_block,
+        "kv_int8": best.kv_int8,
+        "attn_kernel": best.attn_kernel,
+        "prefill_kernel": best.prefill_kernel,
+        "sample_kernel": best.sample_kernel,
+        "fused_rope": best.fused_rope,
+        "spec_k": best.spec_k,
+    }
+    # the caller's explicit knobs win over the plan
+    out = {}
+    for k, v in chosen.items():
+        if k in user_kwargs and \
+                user_kwargs[k] not in _ENGINE_AUTO_DEFAULTS[k]:
+            continue
+        out[k] = v
+    return out, report

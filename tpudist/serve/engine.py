@@ -74,6 +74,19 @@ from tpudist.serve.paged_alloc import BlockAllocator
 InsertItem = Tuple[int, np.ndarray, float, int, int]
 
 
+def _mesh_devices(mesh) -> int:
+    """Device count a serve-mesh spec implies (1 = no mesh) — the
+    n_devices input of the auto planner's workload."""
+    dims = getattr(mesh, "dims", None)
+    if dims is None:
+        return 1
+    try:
+        d, m = dims
+        return int(d) * int(m)
+    except (TypeError, ValueError):
+        return 1
+
+
 def _pow2_floor(k: int) -> int:
     """Largest power of two ``<= k`` — the block-size bucketing rule that
     bounds ``decode_block``'s jit cache at ``log2(max_block) + 1``."""
@@ -111,7 +124,39 @@ class SlotEngine:
                  lora_kernel: bool = False,
                  adapters: bool = False, adapter_blocks: int = 8,
                  adapter_rank: int = 8,
-                 constrain=None, logprobs: int = 0):
+                 constrain=None, logprobs: int = 0,
+                 auto: bool = False):
+        #: measurement-driven planning (tpudist.plan): ``auto=True``
+        #: scores the legal configs against the frozen bench artifacts
+        #: and fills every performance knob the caller left at its
+        #: default (an explicitly-pinned knob always wins).  The chosen
+        #: plan lands here; InferenceServer.start() stamps it into
+        #: telemetry as ``plan_selected``.
+        self.plan = None
+        if auto:
+            from tpudist.plan import resolve_engine_auto
+
+            chosen, self.plan = resolve_engine_auto(
+                module, params, n_devices=_mesh_devices(mesh),
+                num_slots=num_slots,
+                spec_draft_layers=(spec_draft if isinstance(spec_draft, int)
+                                   else None),
+                user_kwargs=dict(
+                    decode_block=decode_block, paged=paged,
+                    kv_block=kv_block, kv_int8=kv_int8,
+                    attn_kernel=attn_kernel,
+                    prefill_kernel=prefill_kernel,
+                    sample_kernel=sample_kernel, fused_rope=fused_rope,
+                    spec_k=spec_k))
+            decode_block = chosen.get("decode_block", decode_block)
+            paged = chosen.get("paged", paged)
+            kv_block = chosen.get("kv_block", kv_block)
+            kv_int8 = chosen.get("kv_int8", kv_int8)
+            attn_kernel = chosen.get("attn_kernel", attn_kernel)
+            prefill_kernel = chosen.get("prefill_kernel", prefill_kernel)
+            sample_kernel = chosen.get("sample_kernel", sample_kernel)
+            fused_rope = chosen.get("fused_rope", fused_rope)
+            spec_k = chosen.get("spec_k", spec_k)
         if prefill_pad is None:
             prefill_pad = min(int(module.max_len), 64)
         # -- decode attention path: "gather" (dense view per dispatch)

@@ -62,6 +62,11 @@ class ServeConfig:
     """Serving knobs; :meth:`from_env` reads the ``TPUDIST_SERVE_*``
     family (registered in ``tpudist.utils.envutil.ENV_VARS``)."""
 
+    # measurement-driven planning (tpudist.plan): score the legal
+    # engine configs against the frozen bench artifacts and fill every
+    # performance knob left at its default; explicitly-set knobs win.
+    # The chosen plan stamps into telemetry as ``plan_selected``.
+    auto: bool = False
     num_slots: int = 4
     queue_limit: int = 64
     max_new: int = 64  # default per-request token budget
@@ -190,6 +195,7 @@ class ServeConfig:
                                            env_positive_float)
 
         return cls(
+            auto=env_flag("TPUDIST_SERVE_AUTO", False),
             num_slots=env_int("TPUDIST_SERVE_SLOTS", 4) or 4,
             queue_limit=env_int("TPUDIST_SERVE_QUEUE", 64) or 64,
             max_new=env_int("TPUDIST_SERVE_MAX_NEW", 64) or 64,
@@ -854,7 +860,8 @@ class InferenceServer(_Observability):
             adapters=self.config.adapters,
             adapter_blocks=self.config.adapter_blocks,
             adapter_rank=self.config.adapter_rank,
-            constrain=ccfg, logprobs=self.config.logprobs)
+            constrain=ccfg, logprobs=self.config.logprobs,
+            auto=self.config.auto)
         hasher = None
         if self.config.paged and self.config.prefix_cache_blocks > 0:
             from tpudist.serve.paged_alloc import hash_chain
@@ -927,6 +934,10 @@ class InferenceServer(_Observability):
             block_size=kv["block_size"], blocks_total=kv["blocks_total"],
             pool_bytes=kv["pool_bytes"], bytes_per_pos=kv["bytes_per_pos"],
             num_slots=self.engine.num_slots, max_len=self.engine.max_len)
+        if getattr(self.engine, "plan", None) is not None:
+            # auto-mode audit trail: the chosen plan + its predicted
+            # TPOT/TTFT in the same stream as the measured spans
+            telemetry.event("plan_selected", **self.engine.plan.stamp())
         self._stamp_adapter_config()
         if self.engine.has_constrain() or self.engine.n_lp:
             # the structured-output config stamp the aggregator pairs

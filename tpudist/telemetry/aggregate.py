@@ -86,7 +86,7 @@ _REPORTED_EVENTS = ("fault_injected", "watchdog_stall", "retry",
                     "adapter_load", "adapter_evict",
                     "replica_health", "session_migrated", "router_error",
                     "distill_round", "draft_swap",
-                    "telemetry_dropped")
+                    "telemetry_dropped", "plan_selected")
 
 
 def find_telemetry_dir(run_dir: "str | Path") -> Path:
@@ -889,6 +889,20 @@ def aggregate_run(run_dir: "str | Path") -> dict:
     serving = _serving_summary(records)
     if serving is not None:
         report["serving"] = serving
+    # Measurement-driven planner (tpudist.plan): the plan_selected
+    # stamps auto mode emitted — prediction next to the measured step/
+    # TPOT numbers above.  Additive: absent entirely for streams
+    # without the event (old-stream reports stay byte-identical).
+    plans = [
+        {k: e[k] for k in ("workload", "chosen", "predicted_s",
+                           "predicted_ttft_s", "n_candidates",
+                           "measured_components",
+                           "extrapolated_components", "artifact_rounds",
+                           "error_band_frac") if k in e}
+        for e in events if e.get("name") == "plan_selected"
+    ]
+    if plans:
+        report["plan"] = plans
     return report
 
 
@@ -1143,6 +1157,22 @@ def render_markdown(report: dict) -> str:
                             f"{mig.get('ok', 0)} session(s) migrated, "
                             f"{fl.get('lost_finished', 0)} lost")
             lines.append("- fleet router: " + "; ".join(bits))
+    if report.get("plan"):
+        lines += ["", "## Plan (auto mode)", ""]
+        for p in report["plan"]:
+            bits = [f"chose **{p.get('chosen', '?')}** "
+                    f"of {p.get('n_candidates', '?')} candidates",
+                    f"predicted {p.get('predicted_s', 0) * 1e3:.3f} ms"]
+            if p.get("predicted_ttft_s") is not None:
+                bits.append(f"TTFT {p['predicted_ttft_s'] * 1e3:.1f} ms")
+            bits.append(f"{p.get('measured_components', 0)} measured / "
+                        f"{p.get('extrapolated_components', 0)} "
+                        "extrapolated components")
+            if p.get("error_band_frac") is not None:
+                bits.append(f"error band ±{p['error_band_frac'] * 100:.1f}%")
+            lines.append(f"- {p.get('workload', '?')}: " + "; ".join(bits))
+            if p.get("artifact_rounds"):
+                lines.append(f"  - artifacts: {p['artifact_rounds']}")
     if report.get("telemetry_dropped"):
         td = report["telemetry_dropped"]
         lines += ["", f"**⚠ telemetry dropped records** — ring evictions: "

@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudist.models.transformer import lm_loss
+from tpudist.parallel.overlap import compat_pcast, compat_shard_map
 from tpudist.runtime.mesh import AXIS_DATA, AXIS_SEQ
 from tpudist.train.step import ModelState
 
@@ -59,7 +60,7 @@ def _make_lm_train_step_compressed(
         # so the explicit narrow pmean below is the ONLY wire traffic
         # (the audit asserts exactly this).
         params = jax.tree.map(
-            lambda p: lax.pcast(p, (AXIS_DATA,), to="varying"), params)
+            lambda p: compat_pcast(p, (AXIS_DATA,), to="varying"), params)
         # Local mean over this shard's rows; equal shards (the sharded
         # batch contract) make pmean-of-means the exact global mean.
         loss, grads = jax.value_and_grad(
@@ -69,7 +70,7 @@ def _make_lm_train_step_compressed(
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), narrow)
         return lax.pmean(loss, AXIS_DATA), grads
 
-    sharded_grad = jax.shard_map(
+    sharded_grad = compat_shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(AXIS_DATA)),

@@ -59,6 +59,13 @@ class TrainLoopConfig:
     # (all processes agree on it via the host fabric) and return early.
     preempt_save: bool = True
 
+    # Plan stamp (tpudist.plan): when the run's configuration was chosen
+    # by the measurement-driven planner (Trainer strategy="auto"), the
+    # chosen config + predicted numbers as flat telemetry tags — emitted
+    # as ONE plan_selected event once the loop's session is live, so the
+    # report shows prediction next to the measured step time.
+    plan_stamp: Optional[dict] = None
+
     # Hang watchdog (tpudist.runtime.watchdog): abort the process with
     # exit 124 + all-thread stack dump when no iteration/window completes
     # within this deadline, so tpurun's restart loop re-admits the group
@@ -264,6 +271,10 @@ def run_training(
     # job) — this loop records into it but must not finish it.
     owns_telemetry = telemetry.active() is None
     telemetry.ensure_started()  # goodput accounting: TPUDIST_TELEMETRY=0 disarms
+    if config.plan_stamp:
+        # auditable auto-mode: prediction lands in the same stream the
+        # measured step times do (telemetry.aggregate's plan section)
+        telemetry.event("plan_selected", **config.plan_stamp)
     # live observability: scrape endpoint (TPUDIST_METRICS_PORT gates it)
     # — step-time/goodput gauges flow from the step spans via the metrics
     # feed; the training loop adds its iteration/loss gauges at each

@@ -134,7 +134,10 @@ class LMTrainerModule(TrainerModule):
 @dataclasses.dataclass
 class Trainer:
     max_steps: int = 1000  # demo_pytorch_lightning.py:48 (1000 steps)
-    strategy: str = "dp"   # 'dp' | 'dp_model' | 'fsdp' | 'zero1' | 'pp'
+    # 'dp' | 'dp_model' | 'fsdp' | 'zero1' | 'pp' | 'auto' ('auto' =
+    # measurement-driven pick, tpudist.plan — resolved at fit(); the
+    # ranked report lands on self.plan and stamps into telemetry)
+    strategy: str = "dp"
     model_parallel: int = 2
     # fsdp/zero1: leaves under this many elements stay replicated (the
     # gather overhead beats the memory win for small tensors).
@@ -178,6 +181,16 @@ class Trainer:
         )
         initialize(use_node_rank=self.use_node_rank)
         seed = resolve_shared_seed(self.seed)
+        if self.strategy == "auto":
+            # measurement-driven resolution (tpudist.plan): score the
+            # strategies this facade can enact against the frozen
+            # artifacts, assign the winner onto self.strategy (+ pp
+            # fields when pp wins).  self.plan keeps the full ranked
+            # report; the loop stamps plan.stamp() into telemetry so
+            # prediction-vs-actual is auditable from the run report.
+            from tpudist.plan import resolve_trainer_auto
+
+            self.plan = resolve_trainer_auto(self, module, seed)
         if isinstance(module, LMTrainerModule):
             return self._fit_lm(module, loader, ckpt_dir, seed)
 
@@ -239,6 +252,9 @@ class Trainer:
             log_every=self.log_every,
             metric_backend=self.metric_backend,
             progress_bar=self.progress_bar,
+            plan_stamp=(self.plan.stamp()
+                        if getattr(self, "plan", None) is not None
+                        else None),
         )
         try:
             states, losses = run_training(
@@ -391,6 +407,10 @@ class Trainer:
         # serving process whose distill flywheel trains through here)
         owns_telemetry = telemetry.active() is None
         telemetry.ensure_started()
+        if getattr(self, "plan", None) is not None:
+            # auto-mode audit trail: the chosen plan + predictions land
+            # in the same stream as the measured step spans
+            telemetry.event("plan_selected", **self.plan.stamp())
         # live observability: scrape endpoint + step-time gauges flow
         # from the step spans via the metrics feed (TPUDIST_METRICS_PORT
         # gates the endpoint; no-op when unset)

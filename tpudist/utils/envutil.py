@@ -199,6 +199,21 @@ ENV_VARS = {
         "(default 8; LRU-evicts cold adapters on load)",
     "TPUDIST_SERVE_ADAPTER_RANK":
         "LoRA rank r shared by every adapter in the pool (default 8)",
+    # measurement-driven planner (tpudist/plan/)
+    "TPUDIST_SERVE_AUTO":
+        "env spelling of ServeConfig.auto: plan unpinned serving knobs "
+        "against the frozen measurement artifacts (default off)",
+    "TPUDIST_PLAN_DIR":
+        "planner artifact directory (default: the repo root, where "
+        "round_snapshot freezes *_rNN.json)",
+    "TPUDIST_PLAN_TOPN":
+        "rows the plan report prints per workload (default 0 = all)",
+    "TPUDIST_PLAN_STALE_ROUNDS":
+        "rounds behind the newest artifact before a family is rejected "
+        "as stale evidence (default 20)",
+    "TPUDIST_PLAN_STRICT":
+        "1 = missing/rejected artifact families raise PlanArtifactError "
+        "instead of degrading to the analytic model (default off)",
     # structured output (tpudist/constrain/)
     "TPUDIST_SERVE_CONSTRAIN":
         "structured output: per-request grammar/json_schema asks compile "
